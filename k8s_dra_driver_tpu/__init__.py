@@ -1,0 +1,38 @@
+"""tpu-dra-driver: a TPU-native Kubernetes Dynamic Resource Allocation driver.
+
+A from-scratch rebuild of the capabilities of NVIDIA's k8s-dra-driver
+(reference: /root/reference) for Cloud TPU:
+
+- ``discovery``    — TPU chip/ICI-topology enumeration (sysfs + C++ shim +
+                     hermetic fake backend).  Replaces NVML/go-nvml
+                     (reference cmd/nvidia-dra-plugin/nvlib.go).
+- ``api``          — isolated Kubernetes resource API surface
+                     (ResourceSlice/ResourceClaim/DeviceClass) and the
+                     ``tpu.google.com/v1alpha1`` opaque config API
+                     (reference api/nvidia.com/resource/gpu/v1alpha1/).
+- ``devicemodel``  — allocatable/prepared device records and the
+                     scheduler-visible attribute/capacity vocabulary,
+                     including ICI-contiguous slice shapes with overlap
+                     capacities (reference cmd/nvidia-dra-plugin/deviceinfo.go).
+- ``plugin``       — the kubelet-plugin: DRA gRPC NodeServer, DeviceState
+                     with checkpointed idempotent prepare/unprepare, CDI
+                     spec generation, sharing strategies and the per-slice
+                     runtime coordinator (MPS-daemon analog).
+- ``controller``   — cluster-level controller publishing multi-host
+                     pod-slice gang resources (IMEX-manager analog,
+                     reference cmd/nvidia-dra-controller/imex.go).
+- ``allocator``    — an in-repo structured-parameters allocator (CEL-subset
+                     selectors, capacity fitting, matchAttribute
+                     constraints) so the full claim lifecycle is
+                     hermetically testable without a kube-scheduler.
+- ``cluster``      — client interface + in-memory fake API server with
+                     watch/informer semantics for hermetic tests.
+- ``models``/``ops``/``parallel`` — the JAX workload layer: demo workloads
+                     that prove allocated chips work (pmap/pjit allreduce,
+                     sharded transformer), ring-attention sequence
+                     parallelism, mesh utilities.
+"""
+
+__version__ = "0.1.0"
+
+DRIVER_NAME = "tpu.google.com"

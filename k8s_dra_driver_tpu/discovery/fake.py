@@ -1,0 +1,112 @@
+"""Hermetic fake discovery: a synthetic sysfs tree + env.
+
+Closes the reference's biggest testability gap (SURVEY §4: NVML is not
+abstracted, so nothing touching enumeration is unit-testable).  Two
+levels:
+
+- ``FakeHost.materialize()`` writes a realistic ``/sys/class/accel`` +
+  ``/dev`` tree into a temp dir and returns a real ``SysfsBackend``
+  pointed at it — so the *production parser* is what tests exercise.
+- ``StaticBackend`` returns a hand-built ``HostTopology`` directly, for
+  tests that don't care about parsing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+
+from .sysfs import GOOGLE_PCI_VENDOR, SysfsBackend
+from .topology import GENERATIONS, GenerationSpec, MeshShape
+from .types import DiscoveryBackend, HostTopology
+
+
+@dataclasses.dataclass
+class FakeHost:
+    """Description of a synthetic TPU host."""
+
+    generation: str = "v5e"
+    num_chips: int = 4
+    hostname: str = "tpu-host-0"
+    host_bounds: str = "2,2,1"
+    # Multi-host slice identity; leave slice_id empty for single-host.
+    slice_id: str = ""
+    topology: str = ""          # e.g. "4x4"
+    worker_id: int = 0
+    worker_hostnames: tuple[str, ...] = ()
+    with_libtpu: bool = True
+    with_serials: bool = True
+
+    @property
+    def gen(self) -> GenerationSpec:
+        return GENERATIONS[self.generation]
+
+    def env(self) -> dict[str, str]:
+        env = {
+            "HOSTNAME": self.hostname,
+            "TPU_CHIPS_PER_HOST_BOUNDS": self.host_bounds,
+            "TPU_ACCELERATOR_TYPE": f"{self.generation}-{self.num_chips}",
+        }
+        if self.slice_id:
+            env["TPU_SLICE_ID"] = self.slice_id
+            env["TPU_TOPOLOGY"] = self.topology
+            env["TPU_WORKER_ID"] = str(self.worker_id)
+            env["TPU_WORKER_HOSTNAMES"] = ",".join(self.worker_hostnames)
+        return env
+
+    def materialize(self, root: Path) -> SysfsBackend:
+        """Write the sysfs/devfs tree under ``root`` and return a backend."""
+        root = Path(root)
+        accel = root / "sys/class/accel"
+        accel.mkdir(parents=True, exist_ok=True)
+        (root / "dev/vfio").mkdir(parents=True, exist_ok=True)
+        for i in range(self.num_chips):
+            # Real sysfs uses a symlink into /sys/devices/pci...; a plain
+            # dir named like the PCI address keeps realpath() behaviour.
+            pci_addr = f"0000:{i:02x}:00.0"
+            pci_dir = root / "sys/devices" / pci_addr
+            pci_dir.mkdir(parents=True, exist_ok=True)
+            (pci_dir / "vendor").write_text(GOOGLE_PCI_VENDOR + "\n")
+            (pci_dir / "device").write_text(self.gen.pci_ids[0] + "\n")
+            (pci_dir / "numa_node").write_text("0\n")
+            if self.with_serials:
+                (pci_dir / "serial_number").write_text(
+                    f"{self.hostname}-serial-{i}\n")
+            link = accel / f"accel{i}" / "device"
+            link.parent.mkdir(parents=True, exist_ok=True)
+            if not link.exists():
+                link.symlink_to(pci_dir)
+            (root / "dev" / f"accel{i}").write_text("")  # stand-in node
+        if self.with_libtpu:
+            lib = root / "usr/lib/libtpu.so"
+            lib.parent.mkdir(parents=True, exist_ok=True)
+            lib.write_text("fake libtpu")
+        return SysfsBackend(host_root=str(root), env=self.env(),
+                            hostname=self.hostname)
+
+
+def fake_slice_hosts(num_hosts: int, topology: str = "4x4",
+                     generation: str = "v5e",
+                     slice_id: str = "slice-a") -> list[FakeHost]:
+    """A gang of FakeHosts forming one multi-host pod slice."""
+    topo = MeshShape.parse(topology)
+    bounds = MeshShape.parse("2x2")
+    chips_per_host = bounds.num_chips
+    assert topo.num_chips == num_hosts * chips_per_host, (
+        f"{topology} needs {topo.num_chips // chips_per_host} hosts, "
+        f"got {num_hosts}")
+    names = tuple(f"{slice_id}-w{i}" for i in range(num_hosts))
+    return [
+        FakeHost(generation=generation, num_chips=chips_per_host,
+                 hostname=names[i], host_bounds="2,2,1", slice_id=slice_id,
+                 topology=topology, worker_id=i, worker_hostnames=names)
+        for i in range(num_hosts)
+    ]
+
+
+class StaticBackend(DiscoveryBackend):
+    def __init__(self, topo: HostTopology):
+        self._topo = topo
+
+    def enumerate(self) -> HostTopology:
+        return self._topo

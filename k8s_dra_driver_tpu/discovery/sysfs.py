@@ -1,0 +1,179 @@
+"""Sysfs/devfs/env TPU discovery backend.
+
+The TPU-native replacement for NVML enumeration (reference
+cmd/nvidia-dra-plugin/nvlib.go:111-313): instead of dlopen'ing
+libnvidia-ml, TPU chips are visible as Linux accel devices —
+``/sys/class/accel/accel<i>`` + ``/dev/accel<i>`` — and the slice/ICI
+topology comes from the libtpu environment contract
+(``TPU_CHIPS_PER_HOST_BOUNDS``, ``TPU_WORKER_ID``, ...) that GKE/GCE set
+on TPU VMs.  No native library is required for enumeration; the optional
+C++ shim (native/tpudiscovery.cc) covers hosts where sysfs attributes are
+incomplete.
+
+The ``host_root`` parameter plays the role of the reference's
+containerized driver-root resolution (reference
+cmd/nvidia-dra-plugin/root.go:25-109): when the plugin runs inside a pod
+with the host filesystem mounted at e.g. ``/host``, all probing happens
+under that prefix while the *published* device paths stay host-absolute.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from pathlib import Path
+
+from .topology import GENERATIONS, GenerationSpec, ICICoord, MeshShape
+from .types import ChipInfo, DiscoveryBackend, HostTopology, SliceMembership
+
+GOOGLE_PCI_VENDOR = "0x1ae0"
+
+# Well-known host locations of libtpu, probed in order (findFile analog,
+# reference root.go:92-109).
+LIBTPU_SEARCH_PATHS = (
+    "usr/lib/libtpu.so",
+    "usr/local/lib/libtpu.so",
+    "lib/libtpu.so",
+    "home/kubernetes/bin/libtpu.so",
+)
+
+
+def _read(path: Path) -> str | None:
+    try:
+        return path.read_text().strip()
+    except OSError:
+        return None
+
+
+def parse_bounds(s: str) -> MeshShape:
+    """Parse "2,2,1"-style bounds env values."""
+    parts = [int(p) for p in s.split(",")]
+    if not 1 <= len(parts) <= 3 or any(p < 1 for p in parts):
+        raise ValueError(f"bad bounds {s!r}")
+    while len(parts) < 3:
+        parts.append(1)
+    return MeshShape(*parts)
+
+
+def host_origin(worker_id: int, host_bounds: MeshShape,
+                topology: MeshShape) -> ICICoord:
+    """Absolute mesh origin of a worker's host box within the slice.
+
+    Hosts tile the slice topology in x-fastest order, the same order
+    libtpu assigns worker ids.
+    """
+    hx = max(topology.x // host_bounds.x, 1)
+    hy = max(topology.y // host_bounds.y, 1)
+    ox = worker_id % hx
+    oy = (worker_id // hx) % hy
+    oz = worker_id // (hx * hy)
+    return ICICoord(ox * host_bounds.x, oy * host_bounds.y,
+                    oz * host_bounds.z)
+
+
+class SysfsBackend(DiscoveryBackend):
+    def __init__(self, host_root: str = "/",
+                 env: dict[str, str] | None = None,
+                 hostname: str | None = None):
+        self.root = Path(host_root)
+        self.env = dict(os.environ) if env is None else env
+        self.hostname = hostname or self.env.get("HOSTNAME") or os.uname().nodename
+
+    # -- pieces -----------------------------------------------------------
+
+    def _accel_dirs(self) -> list[Path]:
+        base = self.root / "sys/class/accel"
+        if not base.is_dir():
+            return []
+        return sorted((d for d in base.iterdir() if d.name.startswith("accel")),
+                      key=lambda d: int(d.name.removeprefix("accel") or 0))
+
+    def _generation_for(self, device_dir: Path) -> GenerationSpec | None:
+        vendor = _read(device_dir / "vendor")
+        if vendor is not None and vendor.lower() != GOOGLE_PCI_VENDOR:
+            return None
+        dev_id = (_read(device_dir / "device") or "").lower()
+        for gen in GENERATIONS.values():
+            if dev_id in gen.pci_ids:
+                return gen
+        # Fall back to the env-declared accelerator type so unknown PCI ids
+        # (new steppings) still enumerate.
+        decl = self.env.get("TPU_ACCELERATOR_TYPE", "")
+        for gen in GENERATIONS.values():
+            if decl.startswith(gen.name) or decl.startswith(gen.product_name):
+                return gen
+        return None
+
+    def _slice_membership(self) -> SliceMembership | None:
+        topo_s = self.env.get("TPU_TOPOLOGY") or self.env.get("TPU_HOST_BOUNDS")
+        slice_id = self.env.get("TPU_SLICE_ID") or self.env.get("MEGASCALE_SLICE_ID")
+        if not topo_s or not slice_id:
+            return None
+        topology = (MeshShape.parse(topo_s) if "x" in topo_s
+                    else parse_bounds(topo_s))
+        host_bounds = parse_bounds(
+            self.env.get("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1"))
+        worker_id = int(self.env.get("TPU_WORKER_ID", "0"))
+        hostnames = [h for h in
+                     self.env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+        num_workers = len(hostnames) or max(
+            topology.num_chips // host_bounds.num_chips, 1)
+        coordinator = hostnames[0] if hostnames else ""
+        return SliceMembership(
+            slice_id=slice_id, topology=topology, worker_id=worker_id,
+            num_workers=num_workers, host_bounds=host_bounds,
+            coordinator_address=coordinator)
+
+    def _libtpu_path(self) -> str:
+        explicit = self.env.get("LIBTPU_INIT_PATH") or self.env.get("TPU_LIBRARY_PATH")
+        if explicit:
+            return explicit
+        for rel in LIBTPU_SEARCH_PATHS:
+            if (self.root / rel).is_file():
+                return "/" + rel
+        return ""
+
+    # -- main entry point --------------------------------------------------
+
+    def enumerate(self) -> HostTopology:
+        slice_info = self._slice_membership()
+        host_bounds = (slice_info.host_bounds if slice_info
+                       else parse_bounds(
+                           self.env.get("TPU_CHIPS_PER_HOST_BOUNDS", "2,2,1")))
+        origin = (host_origin(slice_info.worker_id, host_bounds,
+                              slice_info.topology)
+                  if slice_info else ICICoord(0, 0, 0))
+
+        chips: list[ChipInfo] = []
+        for accel_dir in self._accel_dirs():
+            index = int(accel_dir.name.removeprefix("accel"))
+            device_dir = accel_dir / "device"
+            gen = self._generation_for(device_dir)
+            if gen is None:
+                continue
+            pci = os.path.basename(os.path.realpath(device_dir))
+            numa = int(_read(device_dir / "numa_node") or -1)
+            serial = _read(device_dir / "serial_number")
+            if serial:
+                uuid = f"TPU-{gen.name}-{serial}"
+            else:
+                digest = hashlib.sha256(
+                    f"{self.hostname}/{pci}/{index}".encode()).hexdigest()[:16]
+                uuid = f"TPU-{gen.name}-{digest}"
+            lx = index % host_bounds.x
+            ly = (index // host_bounds.x) % host_bounds.y
+            lz = index // (host_bounds.x * host_bounds.y)
+            coord = ICICoord(origin.x + lx, origin.y + ly, origin.z + lz)
+            dev = f"/dev/accel{index}"
+            dev_paths = [dev]
+            # vfio passthrough nodes, when present, ride along.
+            vfio = self.root / f"dev/vfio/{index}"
+            if vfio.exists():
+                dev_paths.append(f"/dev/vfio/{index}")
+            chips.append(ChipInfo(
+                index=index, uuid=uuid, generation=gen, coord=coord,
+                dev_paths=tuple(dev_paths), pci_address=pci, numa_node=numa))
+
+        return HostTopology(
+            hostname=self.hostname, chips=tuple(chips),
+            libtpu_path=self._libtpu_path(), slice=slice_info)

@@ -1,0 +1,25 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before any jax import so workload-layer tests can exercise real
+multi-device sharding without TPU hardware.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+from k8s_dra_driver_tpu.discovery import FakeHost  # noqa: E402
+
+
+@pytest.fixture
+def v5e_host(tmp_path):
+    """A 4-chip v5e host backed by a materialized fake sysfs tree."""
+    host = FakeHost()
+    backend = host.materialize(tmp_path)
+    return backend.enumerate()

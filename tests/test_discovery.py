@@ -1,0 +1,119 @@
+"""Discovery layer tests: sysfs parsing, topology math, multi-host slices."""
+
+import pytest
+
+from k8s_dra_driver_tpu.discovery import (
+    GENERATIONS, FakeHost, ICICoord, MeshShape, SysfsBackend, fake_slice_hosts,
+    host_origin, parse_bounds, standard_slice_shapes)
+
+
+class TestMeshShape:
+    def test_parse_roundtrip(self):
+        assert str(MeshShape.parse("2x2")) == "2x2"
+        assert str(MeshShape.parse("4x4x4")) == "4x4x4"
+        assert MeshShape.parse("2x4").num_chips == 8
+
+    @pytest.mark.parametrize("bad", ["", "x", "0x2", "1x2x3x4", "-1x2"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            MeshShape.parse(bad)
+
+    def test_placements_aligned(self):
+        origins = list(MeshShape(2, 2).placements(MeshShape(4, 4)))
+        assert origins == [ICICoord(0, 0), ICICoord(0, 2),
+                           ICICoord(2, 0), ICICoord(2, 2)]
+
+    def test_placements_too_big(self):
+        assert list(MeshShape(4, 4).placements(MeshShape(2, 2))) == []
+
+    def test_standard_shapes_v5e_host(self):
+        shapes = standard_slice_shapes(GENERATIONS["v5e"], MeshShape(2, 2))
+        assert [str(s) for s in shapes] == ["1x2", "2x1", "2x2"]
+
+    def test_standard_shapes_v5e_pod16(self):
+        shapes = standard_slice_shapes(GENERATIONS["v5e"], MeshShape(4, 4))
+        names = [str(s) for s in shapes]
+        assert "2x2" in names and "4x4" in names and "2x4" in names
+        # no 3D shapes for a 2D generation
+        assert all(s.z == 1 for s in shapes)
+
+
+class TestBoundsAndOrigins:
+    def test_parse_bounds(self):
+        assert parse_bounds("2,2,1") == MeshShape(2, 2, 1)
+        assert parse_bounds("4") == MeshShape(4, 1, 1)
+
+    def test_host_origin_tiling(self):
+        topo, hb = MeshShape(4, 4), MeshShape(2, 2)
+        origins = [host_origin(w, hb, topo) for w in range(4)]
+        assert origins == [ICICoord(0, 0), ICICoord(2, 0),
+                           ICICoord(0, 2), ICICoord(2, 2)]
+
+
+class TestSysfsBackend:
+    def test_enumerates_chips(self, v5e_host):
+        assert len(v5e_host.chips) == 4
+        gen = v5e_host.generation
+        assert gen.name == "v5e"
+        assert v5e_host.chips[0].dev_paths == ("/dev/accel0",)
+        assert v5e_host.chips[0].hbm_bytes == 16 * 1024 ** 3
+        coords = [c.coord for c in v5e_host.chips]
+        assert coords == [ICICoord(0, 0), ICICoord(1, 0),
+                          ICICoord(0, 1), ICICoord(1, 1)]
+
+    def test_uuids_stable_and_unique(self, tmp_path):
+        topo1 = FakeHost().materialize(tmp_path / "a").enumerate()
+        topo2 = FakeHost().materialize(tmp_path / "b").enumerate()
+        uuids1 = [c.uuid for c in topo1.chips]
+        assert len(set(uuids1)) == 4
+        assert uuids1 == [c.uuid for c in topo2.chips]  # stable across runs
+
+    def test_uuid_without_serial(self, tmp_path):
+        topo = FakeHost(with_serials=False).materialize(tmp_path).enumerate()
+        assert all(c.uuid.startswith("TPU-v5e-") for c in topo.chips)
+        assert len({c.uuid for c in topo.chips}) == 4
+
+    def test_libtpu_found(self, v5e_host):
+        assert v5e_host.libtpu_path == "/usr/lib/libtpu.so"
+
+    def test_empty_host(self, tmp_path):
+        backend = SysfsBackend(host_root=str(tmp_path), env={})
+        topo = backend.enumerate()
+        assert topo.chips == ()
+        assert topo.generation is None
+
+    def test_foreign_vendor_skipped(self, tmp_path):
+        host = FakeHost(num_chips=2)
+        backend = host.materialize(tmp_path)
+        # corrupt chip 1's vendor id
+        (tmp_path / "sys/devices/0000:01:00.0/vendor").write_text("0x10de\n")
+        (tmp_path / "sys/devices/0000:01:00.0/device").write_text("0xffff\n")
+        topo = backend.enumerate()
+        assert [c.index for c in topo.chips] == [0]
+
+    def test_unknown_device_id_falls_back_to_env(self, tmp_path):
+        host = FakeHost(num_chips=1)
+        backend = host.materialize(tmp_path)
+        (tmp_path / "sys/devices/0000:00:00.0/device").write_text("0xbeef\n")
+        topo = backend.enumerate()
+        assert len(topo.chips) == 1  # TPU_ACCELERATOR_TYPE=v5e-1 rescues it
+
+
+class TestMultiHostSlice:
+    def test_fake_slice_gang(self, tmp_path):
+        hosts = fake_slice_hosts(4, topology="4x4")
+        topos = [h.materialize(tmp_path / h.hostname).enumerate()
+                 for h in hosts]
+        # every host knows the same slice identity
+        assert len({t.slice.slice_id for t in topos}) == 1
+        assert all(t.slice.num_workers == 4 for t in topos)
+        assert topos[0].slice.coordinator_address == "slice-a-w0"
+        # absolute coords across all hosts tile 4x4 with no overlap
+        coords = {c.coord.as_tuple() for t in topos for c in t.chips}
+        assert coords == {(x, y, 0) for x in range(4) for y in range(4)}
+
+    def test_worker3_origin(self, tmp_path):
+        host = fake_slice_hosts(4, topology="4x4")[3]
+        topo = host.materialize(tmp_path).enumerate()
+        assert topo.chips[0].coord == ICICoord(2, 2)
+        assert topo.slice.worker_id == 3
