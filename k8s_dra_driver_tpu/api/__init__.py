@@ -1,0 +1,6 @@
+"""API surfaces: Kubernetes resource types + tpu.google.com config API."""
+
+from . import resource
+from .config import v1alpha1 as configapi
+
+__all__ = ["resource", "configapi"]

@@ -1,0 +1,3 @@
+from . import v1alpha1
+
+__all__ = ["v1alpha1"]

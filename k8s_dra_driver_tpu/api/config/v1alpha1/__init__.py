@@ -1,0 +1,20 @@
+"""tpu.google.com/v1alpha1 opaque device-configuration API."""
+
+from .sharing import (ConfigError, CoordinatedSettings,
+                      InvalidDeviceSelectorError, InvalidLimitError, Sharing,
+                      TimeSlicingSettings, STRATEGY_COORDINATED,
+                      STRATEGY_EXCLUSIVE, STRATEGY_TIME_SLICING,
+                      INTERVAL_DEFAULT, INTERVAL_LONG, INTERVAL_MEDIUM,
+                      INTERVAL_SHORT)
+from .types import (API_GROUP, API_VERSION, RendezvousConfig, TpuChipConfig,
+                    TpuConfig, TpuPartitionConfig)
+from .decoder import decode
+
+__all__ = [
+    "API_GROUP", "API_VERSION", "ConfigError", "CoordinatedSettings",
+    "InvalidDeviceSelectorError", "InvalidLimitError", "RendezvousConfig",
+    "Sharing", "TimeSlicingSettings", "TpuChipConfig", "TpuConfig",
+    "TpuPartitionConfig", "decode",
+    "STRATEGY_COORDINATED", "STRATEGY_EXCLUSIVE", "STRATEGY_TIME_SLICING",
+    "INTERVAL_DEFAULT", "INTERVAL_LONG", "INTERVAL_MEDIUM", "INTERVAL_SHORT",
+]

@@ -1,0 +1,5 @@
+"""Shared utilities: quantities, logging, metrics, backoff."""
+
+from .quantity import QuantityError, format_quantity, parse_quantity
+
+__all__ = ["QuantityError", "format_quantity", "parse_quantity"]

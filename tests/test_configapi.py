@@ -1,0 +1,160 @@
+"""Config-API tests: decoder strictness, normalization, validation,
+per-device HBM limit resolution (the reference's most-tested surface,
+reference api/.../v1alpha1/sharing_test.go:28-160)."""
+
+import pytest
+
+from k8s_dra_driver_tpu.api.config.v1alpha1 import (
+    API_VERSION, ConfigError, CoordinatedSettings,
+    InvalidDeviceSelectorError, InvalidLimitError, RendezvousConfig,
+    STRATEGY_COORDINATED, STRATEGY_EXCLUSIVE, STRATEGY_TIME_SLICING,
+    TpuChipConfig, TpuPartitionConfig, decode)
+from k8s_dra_driver_tpu.utils import parse_quantity, format_quantity
+
+UUIDS = ["TPU-v5e-aaaa", "TPU-v5e-bbbb", "TPU-v5e-cccc"]
+GiB = 1024 ** 3
+
+
+class TestQuantity:
+    @pytest.mark.parametrize("s,want", [
+        ("16Gi", 16 * GiB), ("500M", 500 * 10**6), ("1024", 1024),
+        ("2Ti", 2 * 1024**4), ("1.5Gi", int(1.5 * GiB)), (42, 42),
+    ])
+    def test_parse(self, s, want):
+        assert parse_quantity(s) == want
+
+    @pytest.mark.parametrize("bad", ["", "abc", "12Q", "-5Gi"])
+    def test_parse_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_quantity(bad)
+
+    def test_format(self):
+        assert format_quantity(16 * GiB) == "16Gi"
+        assert format_quantity(1000) == "1000"
+
+
+class TestDecoder:
+    def test_chip_config_roundtrip(self):
+        cfg = decode({
+            "apiVersion": API_VERSION, "kind": "TpuChipConfig",
+            "sharing": {"strategy": "TimeSlicing",
+                        "timeSlicing": {"interval": "Short"}},
+        })
+        assert isinstance(cfg, TpuChipConfig)
+        cfg.normalize(); cfg.validate()
+        assert cfg.sharing.time_slicing.interval_ms == 1
+
+    def test_rejects_unknown_field(self):
+        with pytest.raises(ConfigError, match="unknown field"):
+            decode({"apiVersion": API_VERSION, "kind": "TpuChipConfig",
+                    "sharingg": {}})
+
+    def test_rejects_unknown_kind(self):
+        with pytest.raises(ConfigError, match="unsupported kind"):
+            decode({"apiVersion": API_VERSION, "kind": "GpuConfig"})
+
+    def test_rejects_wrong_api_version(self):
+        with pytest.raises(ConfigError, match="unsupported apiVersion"):
+            decode({"apiVersion": "nvidia.com/v1", "kind": "TpuChipConfig"})
+
+    def test_rendezvous_defaults(self):
+        cfg = decode({"apiVersion": API_VERSION, "kind": "RendezvousConfig"})
+        cfg.normalize(); cfg.validate()
+        assert cfg.port == 8471 and cfg.barrier_timeout_s == 600
+
+    def test_nested_unknown_field(self):
+        with pytest.raises(ConfigError, match="unknown field"):
+            decode({"apiVersion": API_VERSION, "kind": "TpuChipConfig",
+                    "sharing": {"strateggy": "Exclusive"}})
+
+
+class TestSharingValidation:
+    def test_default_is_exclusive(self):
+        cfg = TpuChipConfig.default()
+        assert cfg.sharing.strategy == STRATEGY_EXCLUSIVE
+        cfg.validate()
+
+    def test_unknown_strategy(self):
+        cfg = TpuChipConfig()
+        cfg.sharing.strategy = "MPS"
+        with pytest.raises(ConfigError, match="unknown sharing strategy"):
+            cfg.validate()
+
+    def test_settings_strategy_mismatch(self):
+        cfg = decode({"apiVersion": API_VERSION, "kind": "TpuChipConfig",
+                      "sharing": {"strategy": "Exclusive",
+                                  "timeSlicing": {"interval": "Short"}}})
+        with pytest.raises(ConfigError, match="strategy is Exclusive"):
+            cfg.validate()
+
+    def test_bad_interval(self):
+        cfg = decode({"apiVersion": API_VERSION, "kind": "TpuChipConfig",
+                      "sharing": {"strategy": "TimeSlicing",
+                                  "timeSlicing": {"interval": "Tiny"}}})
+        with pytest.raises(ConfigError, match="unknown time-slice interval"):
+            cfg.validate()
+
+    def test_partition_rejects_time_slicing(self):
+        cfg = decode({"apiVersion": API_VERSION, "kind": "TpuPartitionConfig",
+                      "sharing": {"strategy": "TimeSlicing"}})
+        with pytest.raises(ConfigError, match="not supported on core"):
+            cfg.validate()
+
+    def test_partition_allows_coordinated(self):
+        cfg = decode({"apiVersion": API_VERSION, "kind": "TpuPartitionConfig",
+                      "sharing": {"strategy": "Coordinated"}})
+        cfg.normalize(); cfg.validate()
+        assert cfg.sharing.coordinated.duty_cycle_percent == 100
+
+    def test_duty_cycle_bounds(self):
+        for bad in (-1, 101, 1000):
+            s = CoordinatedSettings(duty_cycle_percent=bad)
+            with pytest.raises(ConfigError):
+                s.validate()
+
+
+class TestHbmLimitResolution:
+    """Table-driven, mirroring sharing_test.go's coverage of
+    MpsPerDevicePinnedMemoryLimit.Normalize."""
+
+    def resolve(self, limits):
+        s = CoordinatedSettings(per_device_hbm_limits=limits)
+        s.validate()
+        return s.resolved_hbm_limits(UUIDS)
+
+    def test_empty(self):
+        assert self.resolve({}) == {}
+
+    def test_default_applies_to_all(self):
+        out = self.resolve({"default": "8Gi"})
+        assert out == {u: 8 * GiB for u in UUIDS}
+
+    def test_uuid_overrides_default(self):
+        out = self.resolve({"default": "8Gi", UUIDS[1]: "4Gi"})
+        assert out[UUIDS[0]] == 8 * GiB
+        assert out[UUIDS[1]] == 4 * GiB
+
+    def test_index_key(self):
+        out = self.resolve({"0": "2Gi"})
+        assert out == {UUIDS[0]: 2 * GiB}
+
+    def test_index_overrides_default(self):
+        out = self.resolve({"default": "8Gi", "2": "1Gi"})
+        assert out[UUIDS[2]] == 1 * GiB
+
+    def test_unit_conversion(self):
+        out = self.resolve({"default": "1000M"})
+        assert out[UUIDS[0]] == 10 ** 9
+
+    def test_unknown_uuid_rejected(self):
+        with pytest.raises(InvalidDeviceSelectorError):
+            self.resolve({"TPU-v5e-zzzz": "1Gi"})
+
+    def test_out_of_range_index_rejected(self):
+        with pytest.raises(InvalidDeviceSelectorError):
+            self.resolve({"7": "1Gi"})
+
+    def test_malformed_limit_rejected(self):
+        s = CoordinatedSettings(per_device_hbm_limits={"default": "1Qx"})
+        with pytest.raises(InvalidLimitError):
+            s.validate()

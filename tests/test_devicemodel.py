@@ -1,0 +1,123 @@
+"""Device-model tests: enumeration, attribute/capacity vocabulary,
+overlap-token collisions."""
+
+import pytest
+
+from k8s_dra_driver_tpu.devicemodel import (
+    KIND_CHIP, KIND_CORE, KIND_SLICE, PreparedClaim, PreparedDevice,
+    enumerate_host_devices, is_shared_token)
+from k8s_dra_driver_tpu.discovery import FakeHost, fake_slice_hosts
+
+GiB = 1024 ** 3
+
+
+@pytest.fixture
+def v5e_devices(v5e_host):
+    return enumerate_host_devices(v5e_host)
+
+
+@pytest.fixture
+def v5p_host(tmp_path):
+    return FakeHost(generation="v5p").materialize(tmp_path).enumerate()
+
+
+def shared_tokens(dev):
+    return {k for k in dev.to_device().capacity if is_shared_token(k)}
+
+
+class TestEnumeration:
+    def test_v5e_host_inventory(self, v5e_devices):
+        names = set(v5e_devices)
+        # 4 chips + 4 single-core partitions + slices (2x 1x2, 2x 2x1, 1x 2x2)
+        assert {f"chip-{i}" for i in range(4)} <= names
+        assert {f"chip-{i}-core-0" for i in range(4)} <= names
+        assert "slice-2x2-at-0-0-0" in names
+        assert "slice-1x2-at-0-0-0" in names and "slice-2x1-at-0-0-0" in names
+        assert len(names) == 4 + 4 + 2 + 2 + 1
+
+    def test_v5p_has_two_cores_per_chip(self, v5p_host):
+        devs = enumerate_host_devices(v5p_host)
+        assert "chip-0-core-0" in devs and "chip-0-core-1" in devs
+        half = devs["chip-0-core-0"].hbm_bytes
+        assert half == devs["chip-0"].hbm_bytes // 2
+
+    def test_kind_gating(self, v5e_host):
+        only_chips = enumerate_host_devices(v5e_host, kinds=(KIND_CHIP,))
+        assert all(d.kind == KIND_CHIP for d in only_chips.values())
+        assert len(only_chips) == 4
+
+
+class TestVocabulary:
+    def test_chip_attributes(self, v5e_devices):
+        dev = v5e_devices["chip-2"].to_device()
+        a = dev.attributes
+        assert a["type"] == "chip" and a["generation"] == "v5e"
+        assert a["productName"] == "tpu-v5-lite"
+        assert (a["ici.x"], a["ici.y"]) == (0, 1)
+        assert a["parentUUID"] == a["uuid"]
+        assert dev.capacity["hbm"] == 16 * GiB
+        assert dev.capacity["slot.chip.2"] == 1
+        assert dev.capacity["slot.core.2.0"] == 1
+
+    def test_slice_attributes(self, v5e_devices):
+        dev = v5e_devices["slice-2x2-at-0-0-0"].to_device()
+        assert dev.attributes["sliceShape"] == "2x2"
+        assert dev.attributes["numChips"] == 4
+        assert dev.capacity["hbm"] == 64 * GiB
+
+    def test_core_parent_uuid_constraint_surface(self, v5p_host):
+        devs = enumerate_host_devices(v5p_host)
+        c0 = devs["chip-1-core-0"].to_device()
+        c1 = devs["chip-1-core-1"].to_device()
+        assert c0.attributes["parentUUID"] == c1.attributes["parentUUID"]
+        assert c0.attributes["uuid"] != c1.attributes["uuid"]
+
+
+class TestOverlapTokens:
+    def test_chip_vs_its_core_collide(self, v5e_devices):
+        assert shared_tokens(v5e_devices["chip-0"]) & \
+               shared_tokens(v5e_devices["chip-0-core-0"])
+
+    def test_disjoint_chips_dont_collide(self, v5e_devices):
+        assert not shared_tokens(v5e_devices["chip-0"]) & \
+                   shared_tokens(v5e_devices["chip-1"])
+
+    def test_slice_collides_with_member_chip_only(self, v5e_devices):
+        s = shared_tokens(v5e_devices["slice-1x2-at-0-0-0"])  # chips 0,2
+        assert s & shared_tokens(v5e_devices["chip-0"])
+        assert s & shared_tokens(v5e_devices["chip-2"])
+        assert not s & shared_tokens(v5e_devices["chip-1"])
+
+    def test_overlapping_slices_collide(self, v5e_devices):
+        a = shared_tokens(v5e_devices["slice-2x2-at-0-0-0"])
+        for other in ("slice-1x2-at-0-0-0", "slice-2x1-at-0-0-0"):
+            assert a & shared_tokens(v5e_devices[other])
+
+    def test_sibling_cores_dont_collide(self, v5p_host):
+        devs = enumerate_host_devices(v5p_host)
+        assert not shared_tokens(devs["chip-0-core-0"]) & \
+                   shared_tokens(devs["chip-0-core-1"])
+
+
+class TestMultiHost:
+    def test_worker_coords_are_absolute(self, tmp_path):
+        host = fake_slice_hosts(4, topology="4x4")[3]
+        topo = host.materialize(tmp_path).enumerate()
+        devs = enumerate_host_devices(topo)
+        dev = devs["chip-0"].to_device()
+        assert (dev.attributes["ici.x"], dev.attributes["ici.y"]) == (2, 2)
+        assert dev.attributes["sliceId"] == "slice-a"
+        # in-host slice names are absolute too
+        assert "slice-2x2-at-2-2-0" in devs
+
+
+class TestPreparedRoundtrip:
+    def test_json_roundtrip(self):
+        pc = PreparedClaim(
+            claim_uid="uid-1", claim_namespace="ns", claim_name="c",
+            devices=[PreparedDevice(
+                request="r0", kind="chip", device_name="chip-0", pool="host-a",
+                uuids=["TPU-x"], chip_indices=[0],
+                cdi_device_ids=["tpu.google.com/chip=chip-0"])],
+            coordinator_ids=["coord-1"], timesliced_chips=[0])
+        assert PreparedClaim.from_json(pc.to_json()) == pc
