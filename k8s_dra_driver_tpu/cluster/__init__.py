@@ -1,0 +1,11 @@
+"""Cluster access: client interface, fake API server, object kinds."""
+
+from .client import (ClusterClient, ConflictError, EVENT_ADDED, EVENT_DELETED,
+                     EVENT_MODIFIED, FakeCluster, NotFoundError, match_labels)
+from .objects import Deployment, Node, Pod
+
+__all__ = [
+    "ClusterClient", "ConflictError", "Deployment", "EVENT_ADDED",
+    "EVENT_DELETED", "EVENT_MODIFIED", "FakeCluster", "Node",
+    "NotFoundError", "Pod", "match_labels",
+]

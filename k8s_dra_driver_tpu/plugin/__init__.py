@@ -1,0 +1,18 @@
+"""Kubelet-plugin side of the driver: DeviceState, CDI, checkpoint,
+sharing managers, gRPC NodeServer."""
+
+from .cdi import (CDI_CLAIM_KIND, CDI_DEVICE_KIND, CDIHandler, ContainerEdits,
+                  claim_topology_edits)
+from .checkpoint import CheckpointManager, ChecksumError
+from .device_state import (DRIVER_NAME, DeviceState, DeviceStateConfig,
+                           PrepareError)
+from .sharing import (CoordinatorDaemon, CoordinatorManager, SharingError,
+                      TimeSlicingManager)
+
+__all__ = [
+    "CDI_CLAIM_KIND", "CDI_DEVICE_KIND", "CDIHandler", "CheckpointManager",
+    "ChecksumError", "ContainerEdits", "CoordinatorDaemon",
+    "CoordinatorManager", "DRIVER_NAME", "DeviceState", "DeviceStateConfig",
+    "PrepareError", "SharingError", "TimeSlicingManager",
+    "claim_topology_edits",
+]

@@ -1,0 +1,187 @@
+"""CDI (Container Device Interface) spec generation for TPU devices.
+
+The analog of the reference's CDIHandler (reference
+cmd/nvidia-dra-plugin/cdi.go:50-298), with the NVIDIA mechanics replaced
+by the TPU container contract:
+
+- device nodes: ``/dev/accel<i>`` (+ ``/dev/vfio/<i>`` when present)
+  instead of ``/dev/nvidia*``;
+- library: a read-only bind mount of ``libtpu.so`` instead of the
+  nvidia-ctk hook machinery — no hook binary is needed at all
+  (SURVEY §2.2);
+- environment: the libtpu/JAX env contract (``TPU_VISIBLE_CHIPS``,
+  ``TPU_CHIPS_PER_HOST_BOUNDS``, ``TPU_WORKER_ID`` ...) instead of
+  ``NVIDIA_VISIBLE_DEVICES``.
+
+Two spec files per node, exactly like the reference: one *standard* spec
+enumerating every allocatable device (written once at startup,
+cdi.go:158-227 analog), and one transient *per-claim* spec carrying
+claim-scoped edits — topology env, sharing env, coordinator mounts
+(cdi.go:229-279 analog).  Workload visibility comes from injecting only
+the claimed device nodes; the guard analog of
+``NVIDIA_VISIBLE_DEVICES=void`` (cdi.go:175-180) is that the standard
+spec's common edits set ``TPU_SKIP_MDS_QUERY=true`` so libtpu never
+falls back to host-level GCE metadata discovery.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from ..devicemodel import AllocatableDevice, KIND_CORE, PreparedClaim
+
+CDI_VERSION = "0.6.0"
+CDI_VENDOR = "tpu.google.com"
+CDI_DEVICE_KIND = f"{CDI_VENDOR}/chip"
+CDI_CLAIM_KIND = f"{CDI_VENDOR}/claim"
+
+STANDARD_SPEC_FILENAME = "tpu.google.com-chip.json"
+
+# Container-side libtpu location; host side comes from discovery.
+CONTAINER_LIBTPU_PATH = "/usr/lib/libtpu.so"
+
+
+class ContainerEdits:
+    """Accumulator for CDI containerEdits."""
+
+    def __init__(self):
+        self.env: dict[str, str] = {}
+        self.device_nodes: list[str] = []
+        self.mounts: list[tuple[str, str, tuple[str, ...]]] = []
+
+    def merge(self, other: "ContainerEdits") -> "ContainerEdits":
+        self.env.update(other.env)
+        self.device_nodes.extend(other.device_nodes)
+        self.mounts.extend(other.mounts)
+        return self
+
+    def to_json(self) -> dict:
+        out: dict = {}
+        if self.env:
+            out["env"] = [f"{k}={v}" for k, v in sorted(self.env.items())]
+        if self.device_nodes:
+            out["deviceNodes"] = [{"path": p} for p in self.device_nodes]
+        if self.mounts:
+            out["mounts"] = [
+                {"hostPath": h, "containerPath": c, "options": list(opts)}
+                for h, c, opts in self.mounts]
+        return out
+
+
+class CDIHandler:
+    def __init__(self, cdi_root: str, driver_root: str = "/"):
+        self.cdi_root = Path(cdi_root)
+        self.driver_root = driver_root.rstrip("/") or "/"
+        self.cdi_root.mkdir(parents=True, exist_ok=True)
+
+    # -- qualified names (cdi.go:281-298 analog) -------------------------
+
+    @staticmethod
+    def standard_device_id(device_name: str) -> str:
+        return f"{CDI_DEVICE_KIND}={device_name}"
+
+    @staticmethod
+    def claim_device_id(claim_uid: str) -> str:
+        return f"{CDI_CLAIM_KIND}={claim_uid}"
+
+    # -- device-level edits ----------------------------------------------
+
+    def _device_edits(self, dev: AllocatableDevice) -> ContainerEdits:
+        edits = ContainerEdits()
+        for chip in dev.chips:
+            for path in chip.dev_paths:
+                edits.device_nodes.append(path)
+        if dev.kind == KIND_CORE:
+            # Sub-chip visibility: the runtime restricts the process to one
+            # TensorCore of the injected chip.
+            chip = dev.chips[0]
+            edits.env["TPU_VISIBLE_CORES"] = f"{chip.index}:{dev.core_index}"
+        return edits
+
+    def _host_path(self, path: str) -> str:
+        """Transform a host path for when the plugin runs containerized
+        with the host filesystem at driver_root (root-transform analog,
+        cdi.go:116-141 / root.go)."""
+        if self.driver_root == "/":
+            return path
+        return self.driver_root + path
+
+    # -- standard spec ----------------------------------------------------
+
+    def create_standard_spec(self, devices: dict[str, AllocatableDevice],
+                             libtpu_path: str = "") -> Path:
+        common = ContainerEdits()
+        common.env["TPU_SKIP_MDS_QUERY"] = "true"
+        if libtpu_path:
+            common.mounts.append((self._host_path(libtpu_path),
+                                  CONTAINER_LIBTPU_PATH, ("ro", "bind")))
+        spec = {
+            "cdiVersion": CDI_VERSION,
+            "kind": CDI_DEVICE_KIND,
+            "devices": [
+                {"name": name,
+                 "containerEdits": self._device_edits(dev).to_json()}
+                for name, dev in sorted(devices.items())
+            ],
+            "containerEdits": common.to_json(),
+        }
+        return self._write(STANDARD_SPEC_FILENAME, spec)
+
+    # -- per-claim spec ----------------------------------------------------
+
+    def create_claim_spec(self, claim_uid: str,
+                          edits: ContainerEdits) -> Path:
+        spec = {
+            "cdiVersion": CDI_VERSION,
+            "kind": CDI_CLAIM_KIND,
+            "devices": [
+                {"name": claim_uid, "containerEdits": edits.to_json()},
+            ],
+            "containerEdits": {},
+        }
+        return self._write(self._claim_filename(claim_uid), spec)
+
+    def delete_claim_spec(self, claim_uid: str) -> None:
+        path = self.cdi_root / self._claim_filename(claim_uid)
+        try:
+            path.unlink()
+        except FileNotFoundError:
+            pass
+
+    @staticmethod
+    def _claim_filename(claim_uid: str) -> str:
+        return f"tpu.google.com-claim_{claim_uid}.json"
+
+    def _write(self, filename: str, spec: dict) -> Path:
+        """Atomic write (tmp + rename) so the container runtime never
+        reads a torn spec."""
+        path = self.cdi_root / filename
+        tmp = path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(spec, indent=2, sort_keys=True) + "\n")
+        os.replace(tmp, path)
+        return path
+
+    def read_spec(self, filename: str) -> dict:
+        return json.loads((self.cdi_root / filename).read_text())
+
+
+def claim_topology_edits(prepared: PreparedClaim,
+                         host_bounds: str = "",
+                         slice_env: dict[str, str] | None = None
+                         ) -> ContainerEdits:
+    """Claim-level env describing exactly the chips this claim sees.
+
+    ``TPU_VISIBLE_CHIPS`` carries host chip indices so libtpu binds only
+    the injected devices; bounds/topology env mirror what GKE's TPU
+    device plugin sets so JAX works unmodified.
+    """
+    edits = ContainerEdits()
+    indices = sorted({i for d in prepared.devices for i in d.chip_indices})
+    edits.env["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in indices)
+    if host_bounds:
+        edits.env["TPU_CHIPS_PER_HOST_BOUNDS"] = host_bounds
+    for k, v in (slice_env or {}).items():
+        edits.env[k] = v
+    return edits

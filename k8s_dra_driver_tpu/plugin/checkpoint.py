@@ -1,0 +1,52 @@
+"""Crash-safe prepared-claims checkpoint.
+
+The analog of the reference's kubelet-checkpointmanager record
+(reference cmd/nvidia-dra-plugin/checkpoint.go:9-53 and its wiring in
+device_state.go:94-125): a JSON file with a checksum over the payload,
+written after every successful prepare/unprepare and read back at the
+start of each, making both idempotent across plugin restarts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+from pathlib import Path
+
+from ..devicemodel import PreparedClaim
+
+CHECKPOINT_FILENAME = "checkpoint.json"
+
+
+class ChecksumError(RuntimeError):
+    """Checkpoint payload does not match its checksum."""
+
+
+def _checksum(payload: dict) -> int:
+    canonical = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return zlib.crc32(canonical.encode())
+
+
+class CheckpointManager:
+    def __init__(self, plugin_root: str):
+        self.path = Path(plugin_root) / CHECKPOINT_FILENAME
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        if not self.path.exists():
+            self.save({})
+
+    def load(self) -> dict[str, PreparedClaim]:
+        data = json.loads(self.path.read_text())
+        payload = data.get("v1", {})
+        if _checksum(payload) != data.get("checksum"):
+            raise ChecksumError(f"corrupt checkpoint at {self.path}")
+        return {uid: PreparedClaim.from_json(pc)
+                for uid, pc in payload.get("preparedClaims", {}).items()}
+
+    def save(self, prepared: dict[str, PreparedClaim]) -> None:
+        payload = {"preparedClaims": {uid: pc.to_json()
+                                      for uid, pc in sorted(prepared.items())}}
+        data = {"checksum": _checksum(payload), "v1": payload}
+        tmp = self.path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(data, indent=1, sort_keys=True))
+        os.replace(tmp, self.path)

@@ -1,0 +1,217 @@
+"""Sharing-strategy application: time-slicing + coordinator daemons.
+
+The analog of the reference's TimeSlicingManager / MpsManager
+(reference cmd/nvidia-dra-plugin/sharing.go:58-403), with TPU-native
+mechanisms:
+
+- Time-slicing.  There is no ``nvidia-smi compute-policy`` analog on
+  TPU; the preemption quantum is a *node-local scheduling policy* the
+  runtime coordinator (and libtpu via env) honours.  The manager writes
+  one policy file per chip under the plugin dir and the per-claim CDI
+  spec carries ``TPU_RUNTIME_PREEMPTION_MS``; reset restores the default
+  the way unprepare resets time-slicing on full GPUs
+  (device_state.go:358-362).
+- Coordinated sharing.  A per-claim coordinator Deployment (the
+  MPS-control-daemon lifecycle, sharing.go:185-366): render template →
+  create via the cluster client → poll readiness with the same backoff
+  envelope → emit CDI edits (coordination-dir mount + env) → teardown.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import shutil
+import string
+import time
+from pathlib import Path
+
+import yaml
+
+from ..api.config.v1alpha1 import (CoordinatedSettings, TimeSlicingSettings)
+from ..api.resource import ObjectMeta
+from ..cluster import ClusterClient, Deployment, NotFoundError
+from ..devicemodel import AllocatableDevice, KIND_CHIP, KIND_SLICE
+from ..utils.backoff import Backoff
+from .cdi import ContainerEdits
+
+TEMPLATE_PATH = Path(__file__).parent / "templates/coordinator-daemon.yaml"
+
+DEFAULT_COORDINATOR_IMAGE = "gcr.io/tpu-dra-driver/coordinator:latest"
+
+
+class SharingError(RuntimeError):
+    pass
+
+
+class TimeSlicingManager:
+    """Applies preemption-quantum policy to whole chips/slices.
+
+    Rejects core partitions the way the reference rejects MIG devices
+    (sharing.go:103-110); resetting compute mode first has no TPU analog,
+    so set/reset is just the policy file + env.
+    """
+
+    def __init__(self, plugin_root: str):
+        self.policy_dir = Path(plugin_root) / "policy"
+        self.policy_dir.mkdir(parents=True, exist_ok=True)
+
+    def set_time_slice(self, devices: list[AllocatableDevice],
+                       settings: TimeSlicingSettings) -> list[int]:
+        chips: list[int] = []
+        for dev in devices:
+            if dev.kind not in (KIND_CHIP, KIND_SLICE):
+                raise SharingError(
+                    f"time-slicing is not supported on {dev.kind} devices")
+            chips.extend(c.index for c in dev.chips)
+        for idx in chips:
+            self._write_policy(idx, settings.interval_ms)
+        return chips
+
+    def reset(self, chip_indices: list[int]) -> None:
+        for idx in chip_indices:
+            self._write_policy(idx, 0)
+
+    def current_policy(self, chip_index: int) -> int:
+        path = self.policy_dir / f"chip{chip_index}.json"
+        if not path.exists():
+            return 0
+        return json.loads(path.read_text()).get("preemptionMs", 0)
+
+    def _write_policy(self, chip_index: int, preemption_ms: int) -> None:
+        path = self.policy_dir / f"chip{chip_index}.json"
+        if preemption_ms == 0:
+            path.unlink(missing_ok=True)
+        else:
+            path.write_text(json.dumps({"preemptionMs": preemption_ms}))
+
+
+class CoordinatorDaemon:
+    """Lifecycle of one per-claim coordinator Deployment
+    (MpsControlDaemon analog, sharing.go:124-403)."""
+
+    def __init__(self, manager: "CoordinatorManager", claim_uid: str,
+                 devices: list[AllocatableDevice],
+                 settings: CoordinatedSettings,
+                 preemption_ms: int = 0):
+        self.manager = manager
+        self.claim_uid = claim_uid
+        self.devices = devices
+        self.settings = settings
+        self.preemption_ms = preemption_ms
+        uuids = sorted(u for d in devices for u in d.uuids)
+        digest = hashlib.sha256(":".join(uuids).encode()).hexdigest()[:12]
+        # claimUID+uuid-hash identity (GetMpsControlDaemonID analog,
+        # sharing.go:151-155).
+        self.id = f"coord-{claim_uid[:13]}-{digest}"
+        self.name = f"tpu-coordinator-{self.id}"
+
+    @property
+    def coordination_dir(self) -> Path:
+        return self.manager.coordination_root / self.id
+
+    def start(self) -> None:
+        cdir = self.coordination_dir
+        (cdir / "log").mkdir(parents=True, exist_ok=True)
+        (cdir / "ctl").mkdir(parents=True, exist_ok=True)
+        uuids = [u for d in self.devices for u in d.uuids]
+        limits = self.settings.resolved_hbm_limits(uuids)
+        chips = sorted({c.index for d in self.devices for c in d.chips})
+        spec_text = string.Template(TEMPLATE_PATH.read_text()).substitute(
+            name=self.name,
+            namespace=self.manager.namespace,
+            claim_uid=self.claim_uid,
+            id=self.id,
+            node_name=self.manager.node_name,
+            image=self.manager.image,
+            duty_cycle_percent=str(self.settings.duty_cycle_percent),
+            preemption_ms=str(self.preemption_ms),
+            hbm_limits=",".join(f"{u}={b}" for u, b in sorted(limits.items())),
+            visible_chips=",".join(str(c) for c in chips),
+            coordination_dir=str(cdir),
+        )
+        manifest = yaml.safe_load(spec_text)
+        deployment = Deployment(
+            metadata=ObjectMeta(
+                name=self.name, namespace=self.manager.namespace,
+                labels=manifest["metadata"]["labels"]),
+            spec=manifest["spec"])
+        try:
+            self.manager.client.create(deployment)
+        except Exception:
+            # Already exists (restart-idempotency): adopt it.
+            self.manager.client.get(
+                "Deployment", self.manager.namespace, self.name)
+        # Policy snapshot for workloads/coordinator, mirroring how MPS
+        # passes limits through the daemon's control pipe.
+        (cdir / "policy.json").write_text(json.dumps({
+            "dutyCyclePercent": self.settings.duty_cycle_percent,
+            "hbmLimits": limits,
+            "preemptionMs": self.preemption_ms,
+            "chips": chips,
+        }, sort_keys=True))
+
+    def assert_ready(self, sleep=time.sleep) -> None:
+        """Poll deployment readiness (AssertReady analog,
+        sharing.go:289-344)."""
+        def ready() -> bool:
+            try:
+                dep = self.manager.client.get(
+                    "Deployment", self.manager.namespace, self.name)
+            except NotFoundError:
+                return False
+            return bool(dep.ready)
+        if not self.manager.backoff.poll(ready, sleep=sleep):
+            raise SharingError(
+                f"coordinator daemon {self.name} never became ready")
+
+    def cdi_edits(self) -> ContainerEdits:
+        """Env + mounts workloads need to rendezvous with the coordinator
+        (GetCDIContainerEdits analog, sharing.go:346-366)."""
+        edits = ContainerEdits()
+        edits.env["TPU_COORDINATOR_DIR"] = "/coordination"
+        edits.env["TPU_COORDINATOR_DUTY_CYCLE_PCT"] = str(
+            self.settings.duty_cycle_percent)
+        if self.preemption_ms:
+            edits.env["TPU_RUNTIME_PREEMPTION_MS"] = str(self.preemption_ms)
+        edits.mounts.append((str(self.coordination_dir), "/coordination",
+                             ("rw", "bind")))
+        return edits
+
+    def stop(self) -> None:
+        try:
+            self.manager.client.delete(
+                "Deployment", self.manager.namespace, self.name)
+        except NotFoundError:
+            pass
+        shutil.rmtree(self.coordination_dir, ignore_errors=True)
+
+
+class CoordinatorManager:
+    def __init__(self, client: ClusterClient, plugin_root: str,
+                 node_name: str, namespace: str = "tpu-dra-driver",
+                 image: str = DEFAULT_COORDINATOR_IMAGE,
+                 backoff: Backoff | None = None):
+        self.client = client
+        self.coordination_root = Path(plugin_root) / "coordinator"
+        self.coordination_root.mkdir(parents=True, exist_ok=True)
+        self.node_name = node_name
+        self.namespace = namespace
+        self.image = image
+        self.backoff = backoff or Backoff()
+
+    def new_daemon(self, claim_uid: str, devices: list[AllocatableDevice],
+                   settings: CoordinatedSettings,
+                   preemption_ms: int = 0) -> CoordinatorDaemon:
+        return CoordinatorDaemon(self, claim_uid, devices, settings,
+                                 preemption_ms)
+
+    def stop_by_id(self, coordinator_id: str) -> None:
+        """Teardown from a checkpoint record (claim_uid lost on restart)."""
+        name = f"tpu-coordinator-{coordinator_id}"
+        try:
+            self.client.delete("Deployment", self.namespace, name)
+        except NotFoundError:
+            pass
+        shutil.rmtree(self.coordination_root / coordinator_id,
+                      ignore_errors=True)

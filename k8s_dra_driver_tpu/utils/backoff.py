@@ -1,0 +1,40 @@
+"""Exponential backoff helper (wait.Backoff analog)."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from typing import Callable
+
+
+@dataclasses.dataclass
+class Backoff:
+    """Mirrors the knobs of the reference's readiness backoff
+    (reference cmd/nvidia-dra-plugin/sharing.go:290-296: duration 1s,
+    factor 2, jitter 1, steps 4, cap 10s)."""
+
+    duration_s: float = 1.0
+    factor: float = 2.0
+    jitter: float = 1.0
+    steps: int = 4
+    cap_s: float = 10.0
+
+    def delays(self) -> list[float]:
+        out, d = [], self.duration_s
+        for _ in range(self.steps):
+            j = d * self.jitter * random.random() if self.jitter else 0.0
+            out.append(min(d + j, self.cap_s))
+            d = min(d * self.factor, self.cap_s)
+        return out
+
+    def poll(self, fn: Callable[[], bool],
+             sleep: Callable[[float], None] = time.sleep) -> bool:
+        """Run ``fn`` until it returns True or steps are exhausted."""
+        if fn():
+            return True
+        for delay in self.delays():
+            sleep(delay)
+            if fn():
+                return True
+        return False

@@ -1,0 +1,47 @@
+"""Shared test helpers: claim builders and a fake deployment controller."""
+
+from k8s_dra_driver_tpu.api import resource
+from k8s_dra_driver_tpu.api.config.v1alpha1 import API_VERSION
+from k8s_dra_driver_tpu.cluster import EVENT_ADDED, FakeCluster
+from k8s_dra_driver_tpu.plugin import DRIVER_NAME
+
+
+def make_allocated_claim(name, assignments, configs=(), namespace="default",
+                         pool="host"):
+    """Build a ResourceClaim that looks post-allocation.
+
+    ``assignments``: list of (request_name, device_name).
+    ``configs``: list of (source, requests, parameters_dict).
+    """
+    alloc = resource.AllocationResult(
+        results=[resource.DeviceRequestAllocationResult(
+            request=req, driver=DRIVER_NAME, pool=pool, device=dev)
+            for req, dev in assignments],
+        config=[resource.AllocatedDeviceConfig(
+            source=src, requests=list(reqs),
+            opaque=resource.OpaqueConfig(driver=DRIVER_NAME, parameters=params))
+            for src, reqs, params in configs],
+    )
+    claim = resource.ResourceClaim(
+        metadata=resource.ObjectMeta(name=name, namespace=namespace),
+        spec=resource.ResourceClaimSpec(devices=resource.DeviceClaim(
+            requests=[resource.DeviceRequest(name=req)
+                      for req, _ in assignments])),
+        status=resource.ResourceClaimStatus(allocation=alloc),
+    )
+    return claim
+
+
+def chip_config(strategy="Exclusive", **kw):
+    p = {"apiVersion": API_VERSION, "kind": "TpuChipConfig",
+         "sharing": {"strategy": strategy, **kw}}
+    return p
+
+
+def start_fake_deployment_controller(cluster: FakeCluster):
+    """Marks every created Deployment ready, simulating kubelet."""
+    def on_event(event, obj):
+        if event == EVENT_ADDED and obj.ready_replicas < obj.replicas:
+            obj.ready_replicas = obj.replicas
+            cluster.update(obj)
+    return cluster.watch("Deployment", on_event)
