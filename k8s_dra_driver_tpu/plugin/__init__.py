@@ -8,11 +8,14 @@ from .device_state import (DRIVER_NAME, DeviceState, DeviceStateConfig,
                            PrepareError)
 from .sharing import (CoordinatorDaemon, CoordinatorManager, SharingError,
                       TimeSlicingManager)
+from .publisher import PoolSpec, ResourceSlicePublisher
+from .driver import Driver, PLUGIN_SOCKET_NAME, REGISTRAR_SOCKET_NAME
 
 __all__ = [
     "CDI_CLAIM_KIND", "CDI_DEVICE_KIND", "CDIHandler", "CheckpointManager",
     "ChecksumError", "ContainerEdits", "CoordinatorDaemon",
     "CoordinatorManager", "DRIVER_NAME", "DeviceState", "DeviceStateConfig",
     "PrepareError", "SharingError", "TimeSlicingManager",
-    "claim_topology_edits",
+    "claim_topology_edits", "PoolSpec", "ResourceSlicePublisher", "Driver",
+    "PLUGIN_SOCKET_NAME", "REGISTRAR_SOCKET_NAME",
 ]

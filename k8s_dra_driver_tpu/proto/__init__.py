@@ -1,0 +1,12 @@
+"""Wire protocol: generated protobuf messages + hand-written gRPC glue."""
+
+from . import dra_pb2, registration_pb2
+from .services import (DRAPluginServicer, DRAPluginStub, RegistrationServicer,
+                       RegistrationStub, add_dra_servicer,
+                       add_registration_servicer)
+
+__all__ = [
+    "dra_pb2", "registration_pb2", "DRAPluginServicer", "DRAPluginStub",
+    "RegistrationServicer", "RegistrationStub", "add_dra_servicer",
+    "add_registration_servicer",
+]

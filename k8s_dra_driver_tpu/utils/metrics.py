@@ -1,0 +1,42 @@
+"""Driver metrics.
+
+The reference defines *no* custom driver metrics (SURVEY §5 calls this
+out as a gap versus the BASELINE claim→Running-latency metric); here the
+prepare/unprepare path is instrumented directly.  A dedicated registry
+keeps tests hermetic; ``render()`` serves the Prometheus exposition
+format for the HTTP endpoint.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (CollectorRegistry, Counter, Gauge, Histogram,
+                               generate_latest)
+
+_BUCKETS = (.001, .005, .01, .05, .1, .5, 1, 5, 10, 30, 60)
+
+
+class DriverMetrics:
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        self.prepare_seconds = Histogram(
+            "tpu_dra_prepare_seconds",
+            "Latency of NodePrepareResources per claim",
+            ["outcome"], registry=self.registry, buckets=_BUCKETS)
+        self.unprepare_seconds = Histogram(
+            "tpu_dra_unprepare_seconds",
+            "Latency of NodeUnprepareResources per claim",
+            ["outcome"], registry=self.registry, buckets=_BUCKETS)
+        self.prepared_claims = Gauge(
+            "tpu_dra_prepared_claims",
+            "Number of currently prepared claims", registry=self.registry)
+        self.published_devices = Gauge(
+            "tpu_dra_published_devices",
+            "Number of devices currently published in ResourceSlices",
+            registry=self.registry)
+        self.slice_reconciles = Counter(
+            "tpu_dra_resourceslice_reconciles_total",
+            "ResourceSlice reconcile operations", ["op"],
+            registry=self.registry)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -1,0 +1,123 @@
+"""Driver gRPC tests: a fake kubelet drives the real servers over real
+unix-domain sockets — registration handshake, prepare/unprepare, in-band
+errors, ResourceSlice publication."""
+
+import grpc
+import pytest
+
+from k8s_dra_driver_tpu.cluster import FakeCluster
+from k8s_dra_driver_tpu.discovery import FakeHost
+from k8s_dra_driver_tpu.plugin import (Driver, DeviceState, DeviceStateConfig,
+                                       DRIVER_NAME)
+from k8s_dra_driver_tpu.proto import (DRAPluginStub, RegistrationStub,
+                                      dra_pb2, registration_pb2)
+
+from helpers import make_allocated_claim
+
+
+@pytest.fixture
+def rig(tmp_path):
+    backend = FakeHost().materialize(tmp_path / "host")
+    cluster = FakeCluster()
+    cfg = DeviceStateConfig(
+        plugin_root=str(tmp_path / "plugin"),
+        cdi_root=str(tmp_path / "cdi"),
+        node_name="tpu-host-0")
+    state = DeviceState(backend, cluster, cfg)
+    driver = Driver(state, cluster, plugin_dir=str(tmp_path / "plugin"))
+    driver.start()
+    yield driver, cluster
+    driver.shutdown()
+
+
+def dra_stub(driver):
+    return DRAPluginStub(
+        grpc.insecure_channel(f"unix://{driver.plugin_socket}"))
+
+
+class TestRegistration:
+    def test_get_info_and_notify(self, rig):
+        driver, _ = rig
+        stub = RegistrationStub(
+            grpc.insecure_channel(f"unix://{driver.registrar_socket}"))
+        info = stub.GetInfo(registration_pb2.InfoRequest())
+        assert info.name == DRIVER_NAME
+        assert info.type == "DRAPlugin"
+        assert info.endpoint == str(driver.plugin_socket)
+        assert "v1alpha3" in info.supported_versions
+        stub.NotifyRegistrationStatus(
+            registration_pb2.RegistrationStatus(plugin_registered=True))
+        assert driver.registrar.registered.is_set()
+
+
+class TestPublication:
+    def test_node_slice_published_on_start(self, rig):
+        _, cluster = rig
+        slices = cluster.list("ResourceSlice")
+        assert len(slices) == 1
+        s = slices[0]
+        assert s.driver == DRIVER_NAME
+        assert s.node_name == "tpu-host-0"
+        names = {d.name for d in s.devices}
+        assert "chip-0" in names and "slice-2x2-at-0-0-0" in names
+
+    def test_republish_is_stable(self, rig):
+        driver, cluster = rig
+        rv = cluster.list("ResourceSlice")[0].metadata.resource_version
+        driver.publish_resources()   # no device change → no update
+        assert cluster.list("ResourceSlice")[0].metadata.resource_version == rv
+
+
+class TestPrepareOverGrpc:
+    def test_prepare_and_unprepare(self, rig):
+        driver, cluster = rig
+        claim = make_allocated_claim("c1", [("r0", "chip-0")])
+        cluster.create(claim)
+
+        stub = dra_stub(driver)
+        req = dra_pb2.NodePrepareResourcesRequest(claims=[dra_pb2.Claim(
+            uid=claim.metadata.uid, namespace="default", name="c1")])
+        resp = stub.NodePrepareResources(req)
+        result = resp.claims[claim.metadata.uid]
+        assert result.error == ""
+        assert len(result.devices) == 1
+        assert result.devices[0].device_name == "chip-0"
+        assert list(result.devices[0].cdi_device_ids) == [
+            "tpu.google.com/chip=chip-0",
+            f"tpu.google.com/claim={claim.metadata.uid}"]
+
+        unreq = dra_pb2.NodeUnprepareResourcesRequest(claims=[dra_pb2.Claim(
+            uid=claim.metadata.uid, namespace="default", name="c1")])
+        unresp = stub.NodeUnprepareResources(unreq)
+        assert unresp.claims[claim.metadata.uid].error == ""
+        assert claim.metadata.uid not in driver.state.prepared
+
+    def test_missing_claim_in_band_error(self, rig):
+        driver, _ = rig
+        stub = dra_stub(driver)
+        resp = stub.NodePrepareResources(
+            dra_pb2.NodePrepareResourcesRequest(claims=[dra_pb2.Claim(
+                uid="uid-x", namespace="default", name="ghost")]))
+        assert "not found" in resp.claims["uid-x"].error
+
+    def test_uid_mismatch_rejected(self, rig):
+        driver, cluster = rig
+        claim = make_allocated_claim("c1", [("r0", "chip-0")])
+        cluster.create(claim)
+        stub = dra_stub(driver)
+        resp = stub.NodePrepareResources(
+            dra_pb2.NodePrepareResourcesRequest(claims=[dra_pb2.Claim(
+                uid="uid-stale", namespace="default", name="c1")]))
+        assert "UID mismatch" in resp.claims["uid-stale"].error
+
+    def test_metrics_observed(self, rig):
+        driver, cluster = rig
+        claim = make_allocated_claim("c1", [("r0", "chip-1")])
+        cluster.create(claim)
+        stub = dra_stub(driver)
+        stub.NodePrepareResources(
+            dra_pb2.NodePrepareResourcesRequest(claims=[dra_pb2.Claim(
+                uid=claim.metadata.uid, namespace="default", name="c1")]))
+        text = driver.metrics.render().decode()
+        assert 'tpu_dra_prepare_seconds_count{outcome="ok"} 1.0' in text
+        assert "tpu_dra_prepared_claims 1.0" in text
