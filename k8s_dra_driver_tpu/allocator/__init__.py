@@ -1,0 +1,8 @@
+"""In-repo structured-parameters allocator (kube-scheduler stand-in)."""
+
+from .allocator import AllocationError, Allocator
+from .cel import CELError, evaluate, matches_selectors
+from .scheduler import allocate_claim, deallocate_claim
+
+__all__ = ["AllocationError", "Allocator", "CELError", "allocate_claim",
+           "deallocate_claim", "evaluate", "matches_selectors"]

@@ -1,0 +1,215 @@
+"""CEL-subset evaluator for device selectors.
+
+Upstream, DeviceClass/claim selectors are CEL expressions evaluated by
+the kube-scheduler's structured-parameters allocator against each
+candidate device (reference deployments/helm/k8s-dra-driver/templates/
+deviceclass-gpu.yaml:8-10, e.g. ``device.driver == 'gpu.nvidia.com'``).
+The reference ships no evaluator (it delegates to upstream, SURVEY §1);
+this driver carries its own so allocation is testable and runnable
+hermetically.
+
+Supported subset (everything the DeviceClass/demo selectors need):
+
+- ``device.driver``, ``device.attributes[...]``, ``device.capacity[...]``
+  plus dotted sugar ``device.attributes.foo``;
+- literals (string/int/bool), comparisons (== != < <= > >=), ``in``;
+- CEL logic operators ``&&  ||  !`` (also accepted as and/or/not);
+- string calls: ``startsWith endsWith contains matches``;
+- arithmetic + - * % on ints.
+
+Implementation: the CEL operators are token-rewritten to Python, the
+result is parsed with ``ast`` and evaluated by a whitelist walker — no
+``eval``, no attribute access outside the ``device`` namespace.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..api import resource
+
+
+class CELError(ValueError):
+    pass
+
+
+_STRING_METHODS = {
+    "startsWith": lambda s, p: s.startswith(p),
+    "endsWith": lambda s, p: s.endswith(p),
+    "contains": lambda s, p: p in s,
+    "matches": lambda s, p: re.search(p, s) is not None,
+}
+
+_ALLOWED_CMP = {
+    ast.Eq: lambda a, b: a == b,
+    ast.NotEq: lambda a, b: a != b,
+    ast.Lt: lambda a, b: a < b,
+    ast.LtE: lambda a, b: a <= b,
+    ast.Gt: lambda a, b: a > b,
+    ast.GtE: lambda a, b: a >= b,
+    ast.In: lambda a, b: a in b,
+    ast.NotIn: lambda a, b: a not in b,
+}
+
+_ALLOWED_BIN = {
+    ast.Add: lambda a, b: a + b,
+    ast.Sub: lambda a, b: a - b,
+    ast.Mult: lambda a, b: a * b,
+    ast.Mod: lambda a, b: a % b,
+}
+
+_TOKEN_RE = re.compile(r"""
+    (?P<string>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
+  | (?P<and>&&) | (?P<or>\|\|)
+  | (?P<ne>!=) | (?P<not>!)
+""", re.VERBOSE)
+
+
+def _rewrite(expr: str) -> str:
+    """Rewrite CEL operators to Python outside string literals."""
+    def sub(m: re.Match) -> str:
+        if m.group("string") is not None:
+            return m.group("string")
+        if m.group("and"):
+            return " and "
+        if m.group("or"):
+            return " or "
+        if m.group("ne"):
+            return "!="
+        return " not "
+    return _TOKEN_RE.sub(sub, expr).strip()
+
+
+class _Env:
+    """The ``device`` variable exposed to expressions."""
+
+    def __init__(self, device: resource.Device, driver: str):
+        self.device = device
+        self.driver = driver
+
+
+class _Evaluator(ast.NodeVisitor):
+    def __init__(self, env: _Env):
+        self.env = env
+
+    def run(self, node: ast.AST):
+        return self.visit(node)
+
+    # -- leaves -----------------------------------------------------------
+
+    def visit_Expression(self, node):
+        return self.visit(node.body)
+
+    def visit_Constant(self, node):
+        if isinstance(node.value, (str, int, bool)) or node.value is None:
+            return node.value
+        raise CELError(f"unsupported literal {node.value!r}")
+
+    def visit_Name(self, node):
+        if node.id == "device":
+            return self.env
+        if node.id in ("true", "false"):
+            return node.id == "true"
+        raise CELError(f"unknown identifier {node.id!r}")
+
+    def visit_List(self, node):
+        return [self.visit(e) for e in node.elts]
+
+    # -- access -----------------------------------------------------------
+
+    def visit_Attribute(self, node):
+        base = self.visit(node.value)
+        if isinstance(base, _Env):
+            if node.attr == "driver":
+                return base.driver
+            if node.attr == "attributes":
+                return dict(base.device.attributes)
+            if node.attr == "capacity":
+                return dict(base.device.capacity)
+            if node.attr == "name":
+                return base.device.name
+            raise CELError(f"unknown device field {node.attr!r}")
+        if isinstance(base, dict):   # attributes.foo sugar
+            return base.get(node.attr)
+        raise CELError(f"cannot access .{node.attr} on {type(base).__name__}")
+
+    def visit_Subscript(self, node):
+        base = self.visit(node.value)
+        key = self.visit(node.slice)
+        if isinstance(base, dict):
+            return base.get(key)
+        raise CELError("subscript only supported on maps")
+
+    # -- operators --------------------------------------------------------
+
+    def visit_BoolOp(self, node):
+        if isinstance(node.op, ast.And):
+            return all(bool(self.visit(v)) for v in node.values)
+        return any(bool(self.visit(v)) for v in node.values)
+
+    def visit_UnaryOp(self, node):
+        if isinstance(node.op, ast.Not):
+            return not self.visit(node.operand)
+        if isinstance(node.op, ast.USub):
+            return -self.visit(node.operand)
+        raise CELError("unsupported unary operator")
+
+    def visit_Compare(self, node):
+        left = self.visit(node.left)
+        for op, comparator in zip(node.ops, node.comparators):
+            fn = _ALLOWED_CMP.get(type(op))
+            if fn is None:
+                raise CELError(f"unsupported comparison {type(op).__name__}")
+            right = self.visit(comparator)
+            try:
+                if not fn(left, right):
+                    return False
+            except TypeError:
+                return False        # CEL: comparing missing attr → no match
+            left = right
+        return True
+
+    def visit_BinOp(self, node):
+        fn = _ALLOWED_BIN.get(type(node.op))
+        if fn is None:
+            raise CELError(f"unsupported operator {type(node.op).__name__}")
+        return fn(self.visit(node.left), self.visit(node.right))
+
+    def visit_Call(self, node):
+        if not isinstance(node.func, ast.Attribute):
+            raise CELError("only method calls are supported")
+        method = node.func.attr
+        fn = _STRING_METHODS.get(method)
+        if fn is None:
+            raise CELError(f"unsupported method {method!r}")
+        base = self.visit(node.func.value)
+        args = [self.visit(a) for a in node.args]
+        if not isinstance(base, str):
+            return False
+        if len(args) != 1 or not isinstance(args[0], str):
+            raise CELError(f"{method} takes one string argument")
+        return fn(base, args[0])
+
+    def generic_visit(self, node):
+        raise CELError(f"unsupported syntax: {type(node).__name__}")
+
+
+def evaluate(expr: str, device: resource.Device,
+             driver: str = "tpu.google.com") -> bool:
+    """Evaluate a selector expression against one device."""
+    if not expr.strip():
+        return True
+    try:
+        tree = ast.parse(_rewrite(expr), mode="eval")
+    except SyntaxError as e:
+        raise CELError(f"cannot parse selector {expr!r}: {e}") from e
+    result = _Evaluator(_Env(device, driver)).run(tree)
+    return bool(result)
+
+
+def matches_selectors(device: resource.Device,
+                      selectors: list[resource.DeviceSelector],
+                      driver: str = "tpu.google.com") -> bool:
+    """All selectors must match (upstream semantics)."""
+    return all(evaluate(s.cel, device, driver) for s in selectors)

@@ -1,0 +1,275 @@
+"""CEL evaluator + allocator tests: selector matching, shared-token
+overlap enforcement, constraints, multi-claim accounting, node choice."""
+
+import pytest
+
+from k8s_dra_driver_tpu.api import resource
+from k8s_dra_driver_tpu.api.classes import standard_device_classes
+from k8s_dra_driver_tpu.allocator import (AllocationError, Allocator,
+                                          CELError, allocate_claim, evaluate)
+from k8s_dra_driver_tpu.cluster import FakeCluster, Node
+from k8s_dra_driver_tpu.devicemodel import enumerate_host_devices
+from k8s_dra_driver_tpu.discovery import FakeHost
+from k8s_dra_driver_tpu.plugin import PoolSpec, ResourceSlicePublisher
+
+CLASSES = standard_device_classes()
+
+
+def make_device(name="chip-0", **attrs):
+    cap = attrs.pop("capacity", {})
+    base = {"type": "chip", "generation": "v5e"}
+    base.update(attrs)
+    return resource.Device(name=name, attributes=base, capacity=cap)
+
+
+class TestCEL:
+    def test_driver_and_type(self):
+        d = make_device()
+        assert evaluate('device.driver == "tpu.google.com" && '
+                        'device.attributes["type"] == "chip"', d)
+        assert not evaluate('device.driver == "gpu.nvidia.com"', d)
+
+    def test_attribute_sugar_and_methods(self):
+        d = make_device(productName="tpu-v5-lite")
+        assert evaluate('device.attributes.productName.startsWith("tpu-")', d)
+        assert evaluate('device.attributes["productName"].contains("v5")', d)
+        assert not evaluate('device.attributes.productName.endsWith("v4")', d)
+
+    def test_numeric_comparison_and_in(self):
+        d = make_device(index=3, capacity={"hbm": 16})
+        assert evaluate('device.attributes["index"] >= 2', d)
+        assert evaluate('device.capacity["hbm"] == 16', d)
+        assert evaluate('device.attributes["generation"] in ["v5e", "v6e"]', d)
+
+    def test_missing_attribute_no_match(self):
+        d = make_device()
+        assert not evaluate('device.attributes["sliceShape"] == "2x2"', d)
+        assert not evaluate('device.attributes["index"] > 1', d)
+
+    def test_not_operator(self):
+        d = make_device()
+        assert evaluate('!(device.attributes["type"] == "core") && '
+                        'device.attributes["type"] != "slice"', d)
+
+    def test_bang_inside_string_untouched(self):
+        d = make_device(note="hello!world")
+        assert evaluate('device.attributes["note"] == "hello!world"', d)
+
+    def test_rejects_unsafe_syntax(self):
+        d = make_device()
+        for expr in ("__import__('os')", "device.__class__",
+                     "[x for x in []]", "(lambda: 1)()"):
+            with pytest.raises(CELError):
+                evaluate(expr, d)
+
+    def test_empty_selector_matches(self):
+        assert evaluate("", make_device())
+
+
+@pytest.fixture
+def cluster(tmp_path):
+    """Fake cluster with one published 4-chip v5e node + classes."""
+    c = FakeCluster()
+    topo = FakeHost().materialize(tmp_path / "h0").enumerate()
+    devices = [d.to_device()
+               for _, d in sorted(enumerate_host_devices(topo).items())]
+    pub = ResourceSlicePublisher(c, "tpu.google.com")
+    pub.publish([PoolSpec(name="tpu-host-0", devices=devices,
+                          node_name="tpu-host-0")])
+    for cls in CLASSES.values():
+        c.create(cls)
+    c.create(Node(metadata=resource.ObjectMeta(name="tpu-host-0")))
+    return c
+
+
+def claim_for(requests, constraints=(), configs=(), name="c"):
+    return resource.ResourceClaim(
+        metadata=resource.ObjectMeta(name=name, namespace="default"),
+        spec=resource.ResourceClaimSpec(devices=resource.DeviceClaim(
+            requests=requests, constraints=list(constraints),
+            config=list(configs))))
+
+
+def chip_request(name="r0", count=1, cls="tpu.google.com", selectors=()):
+    return resource.DeviceRequest(
+        name=name, device_class_name=cls, count=count,
+        selectors=[resource.DeviceSelector(cel=s) for s in selectors])
+
+
+class TestAllocator:
+    def test_single_chip(self, cluster):
+        claim = cluster.create(claim_for([chip_request()]))
+        allocate_claim(cluster, claim)
+        alloc = claim.status.allocation
+        assert len(alloc.results) == 1
+        assert alloc.results[0].device.startswith("chip-")
+        assert alloc.node_selector == {"kubernetes.io/hostname": "tpu-host-0"}
+
+    def test_prefers_chip_over_slice(self, cluster):
+        claim = cluster.create(claim_for([resource.DeviceRequest(
+            name="r0", count=1)]))  # no class: everything eligible
+        allocate_claim(cluster, claim)
+        # least-blocking preference picks a core partition (1 token)
+        assert "core" in claim.status.allocation.results[0].device
+
+    def test_two_distinct_chips(self, cluster):
+        claim = cluster.create(claim_for([chip_request(count=2)]))
+        allocate_claim(cluster, claim)
+        devs = {r.device for r in claim.status.allocation.results}
+        assert len(devs) == 2
+
+    def test_chips_exhaust(self, cluster):
+        c1 = cluster.create(claim_for([chip_request(count=4)], name="a"))
+        allocate_claim(cluster, c1)
+        c2 = cluster.create(claim_for([chip_request(count=1)], name="b"))
+        with pytest.raises(AllocationError):
+            allocate_claim(cluster, c2)
+
+    def test_slice_blocks_member_chips(self, cluster):
+        c1 = cluster.create(claim_for(
+            [chip_request(cls="tpu-slice.google.com",
+                          selectors=['device.attributes["sliceShape"] == "2x2"'])],
+            name="slice-claim"))
+        allocate_claim(cluster, c1)
+        assert c1.status.allocation.results[0].device == "slice-2x2-at-0-0-0"
+        c2 = cluster.create(claim_for([chip_request()], name="chip-claim"))
+        with pytest.raises(AllocationError):
+            allocate_claim(cluster, c2)
+
+    def test_chip_blocks_overlapping_slice(self, cluster):
+        c1 = cluster.create(claim_for([chip_request()], name="a"))
+        allocate_claim(cluster, c1)
+        c2 = cluster.create(claim_for(
+            [chip_request(cls="tpu-slice.google.com",
+                          selectors=['device.attributes["sliceShape"] == "2x2"'])],
+            name="b"))
+        with pytest.raises(AllocationError):
+            allocate_claim(cluster, c2)
+
+    def test_core_partitions_coexist_on_v5p(self, tmp_path):
+        c = FakeCluster()
+        topo = FakeHost(generation="v5p", hostname="p0").materialize(
+            tmp_path / "p0").enumerate()
+        devices = [d.to_device()
+                   for _, d in sorted(enumerate_host_devices(topo).items())]
+        ResourceSlicePublisher(c, "tpu.google.com").publish(
+            [PoolSpec(name="p0", devices=devices, node_name="p0")])
+        for cls in CLASSES.values():
+            c.create(cls)
+        core_req = lambda n: chip_request(n, cls="tpu-core.google.com")
+        c1 = c.create(claim_for([core_req("r0"), core_req("r1")], name="a"))
+        allocate_claim(c, c1)
+        devs = {r.device for r in c1.status.allocation.results}
+        assert len(devs) == 2
+        # both cores of chip-0 are used; chip-0 itself now unallocatable
+        c2 = c.create(claim_for([chip_request(
+            selectors=['device.attributes["index"] == 0'])], name="b"))
+        with pytest.raises(AllocationError):
+            allocate_claim(c, c2)
+
+    def test_match_attribute_same_parent(self, tmp_path):
+        """gpu-test4 analog: partitions constrained to one parent chip."""
+        c = FakeCluster()
+        topo = FakeHost(generation="v5p", hostname="p0").materialize(
+            tmp_path / "p0").enumerate()
+        devices = [d.to_device()
+                   for _, d in sorted(enumerate_host_devices(topo).items())]
+        ResourceSlicePublisher(c, "tpu.google.com").publish(
+            [PoolSpec(name="p0", devices=devices, node_name="p0")])
+        for cls in CLASSES.values():
+            c.create(cls)
+        claim = c.create(claim_for(
+            [chip_request("r0", cls="tpu-core.google.com"),
+             chip_request("r1", cls="tpu-core.google.com")],
+            constraints=[resource.DeviceConstraint(
+                match_attribute="parentUUID")], name="co"))
+        allocate_claim(c, claim)
+        results = claim.status.allocation.results
+        # both cores must come from the same chip
+        chips = {r.device.rsplit("-core-", 1)[0] for r in results}
+        assert len(chips) == 1
+
+    def test_allocation_mode_all(self, cluster):
+        claim = cluster.create(claim_for([resource.DeviceRequest(
+            name="all", device_class_name="tpu.google.com",
+            allocation_mode=resource.ALLOCATION_MODE_ALL)]))
+        allocate_claim(cluster, claim)
+        assert len(claim.status.allocation.results) == 4
+
+    def test_config_passthrough_order(self, cluster):
+        cls = CLASSES["tpu.google.com"]
+        cls.config = [resource.DeviceClassConfig(
+            opaque=resource.OpaqueConfig(driver="tpu.google.com",
+                                         parameters={"from": "class"}))]
+        cluster.update(cls)
+        claim = cluster.create(claim_for(
+            [chip_request()],
+            configs=[resource.ClaimConfig(opaque=resource.OpaqueConfig(
+                driver="tpu.google.com", parameters={"from": "claim"}))]))
+        allocate_claim(cluster, claim)
+        cfg = claim.status.allocation.config
+        assert [c.source for c in cfg] == ["FromClass", "FromClaim"]
+
+    def test_idempotent(self, cluster):
+        claim = cluster.create(claim_for([chip_request()]))
+        allocate_claim(cluster, claim)
+        first = claim.status.allocation
+        allocate_claim(cluster, claim)
+        assert claim.status.allocation is first
+
+    def test_selector_on_ici_coordinate(self, cluster):
+        claim = cluster.create(claim_for([chip_request(
+            selectors=['device.attributes["ici.x"] == 1 && '
+                       'device.attributes["ici.y"] == 1'])]))
+        allocate_claim(cluster, claim)
+        assert claim.status.allocation.results[0].device == "chip-3"
+
+    def test_unknown_class_rejected(self, cluster):
+        claim = cluster.create(claim_for([chip_request(cls="nope.com")]))
+        with pytest.raises(AllocationError, match="unknown device class"):
+            allocate_claim(cluster, claim)
+
+
+class TestMultiNode:
+    def test_second_node_used_when_first_full(self, tmp_path):
+        c = FakeCluster()
+        pub = ResourceSlicePublisher(c, "tpu.google.com")
+        pools = []
+        for i in range(2):
+            topo = FakeHost(hostname=f"h{i}").materialize(
+                tmp_path / f"h{i}").enumerate()
+            devices = [d.to_device() for _, d in
+                       sorted(enumerate_host_devices(topo).items())]
+            pools.append(PoolSpec(name=f"h{i}", devices=devices,
+                                  node_name=f"h{i}"))
+        pub.publish(pools)
+        for cls in CLASSES.values():
+            c.create(cls)
+        a = c.create(claim_for([chip_request(count=4)], name="a"))
+        allocate_claim(c, a)
+        b = c.create(claim_for([chip_request(count=4)], name="b"))
+        allocate_claim(c, b)
+        node_a = a.status.allocation.node_selector["kubernetes.io/hostname"]
+        node_b = b.status.allocation.node_selector["kubernetes.io/hostname"]
+        assert {node_a, node_b} == {"h0", "h1"}
+
+    def test_all_requests_on_one_node(self, tmp_path):
+        """A claim may not straddle nodes: 3 chips per node, ask for 4+4."""
+        c = FakeCluster()
+        pub = ResourceSlicePublisher(c, "tpu.google.com")
+        pools = []
+        for i in range(2):
+            topo = FakeHost(hostname=f"h{i}").materialize(
+                tmp_path / f"h{i}").enumerate()
+            devices = [d.to_device() for _, d in
+                       sorted(enumerate_host_devices(topo).items())
+                       if d.kind == "chip"]
+            pools.append(PoolSpec(name=f"h{i}", devices=devices,
+                                  node_name=f"h{i}"))
+        pub.publish(pools)
+        for cls in CLASSES.values():
+            c.create(cls)
+        claim = c.create(claim_for(
+            [chip_request("r0", count=3), chip_request("r1", count=3)]))
+        with pytest.raises(AllocationError):
+            allocate_claim(c, claim)
