@@ -36,3 +36,8 @@ A from-scratch rebuild of the capabilities of NVIDIA's k8s-dra-driver
 __version__ = "0.1.0"
 
 DRIVER_NAME = "tpu.google.com"
+
+# Node label carrying multi-host slice identity, value
+# "<sliceId>.<topology>" — the imex-domain label analog (reference
+# cmd/nvidia-dra-controller/imex.go:40-46).
+SLICE_LABEL = "tpu.google.com/slice"

@@ -26,4 +26,6 @@ def standard_device_classes() -> dict[str, resource.DeviceClass]:
         "tpu-slice.google.com": _cls("tpu-slice.google.com", "slice"),
         "tpu-rendezvous.google.com": _cls("tpu-rendezvous.google.com",
                                           "rendezvous"),
+        "tpu-podslice.google.com": _cls("tpu-podslice.google.com",
+                                        "podslice"),
     }

@@ -1,0 +1,11 @@
+"""Cluster controller: multi-host slice gangs + rendezvous channels."""
+
+from .slices import (CHANNELS_PER_SLICE, ChannelOffsets, SLICE_LABEL,
+                     SliceGangController, TOTAL_CHANNELS, parse_slice_label,
+                     slice_label_value)
+
+__all__ = [
+    "CHANNELS_PER_SLICE", "ChannelOffsets", "SLICE_LABEL",
+    "SliceGangController", "TOTAL_CHANNELS", "parse_slice_label",
+    "slice_label_value",
+]

@@ -84,12 +84,30 @@ class Driver(DRAPluginServicer):
         reg_server.start()
 
         self._servers = [plugin_server, reg_server]
+        self._ensure_node_label()
         self.publish_resources()
 
     def shutdown(self, grace: float = 1.0) -> None:
         for s in self._servers:
             s.stop(grace)
         self._servers = []
+
+    def _ensure_node_label(self) -> None:
+        """Self-label this Node with its slice identity so the controller
+        can aggregate the gang (the node-labeling the reference leaves to
+        out-of-band tooling for IMEX domains)."""
+        from .. import SLICE_LABEL
+        sl = self.state.topology.slice
+        if sl is None:
+            return
+        try:
+            node = self.client.get("Node", "", self.state.config.node_name)
+        except NotFoundError:
+            return
+        value = f"{sl.slice_id}.{sl.topology}"
+        if node.metadata.labels.get(SLICE_LABEL) != value:
+            node.metadata.labels[SLICE_LABEL] = value
+            self.client.update(node)
 
     # -- publication ------------------------------------------------------
 

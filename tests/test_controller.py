@@ -1,0 +1,132 @@
+"""Slice-gang controller tests: label watch, ref-counting, channel
+carving, per-slice pools, cleanup, retry."""
+
+import pytest
+
+from k8s_dra_driver_tpu.api import resource
+from k8s_dra_driver_tpu.cluster import FakeCluster, Node
+from k8s_dra_driver_tpu.controller import (ChannelOffsets, SLICE_LABEL,
+                                           SliceGangController,
+                                           parse_slice_label)
+
+
+def make_node(name, slice_value=None):
+    labels = {SLICE_LABEL: slice_value} if slice_value else {}
+    return Node(metadata=resource.ObjectMeta(name=name, labels=labels))
+
+
+@pytest.fixture
+def rig():
+    cluster = FakeCluster()
+    ctrl = SliceGangController(cluster, channels_per_slice=8,
+                               retry_delay_s=0.01)
+    ctrl.start()
+    yield cluster, ctrl
+    ctrl.stop()
+
+
+class TestChannelOffsets:
+    def test_carving_and_reuse(self):
+        offs = ChannelOffsets(total=32, per_slice=8)
+        assert offs.add("a") == 0
+        assert offs.add("b") == 8
+        assert offs.add("a") == 0           # idempotent
+        offs.remove("a")
+        assert offs.add("c") == 0           # freed block reused
+        assert offs.add("d") == 16
+
+    def test_exhaustion(self):
+        offs = ChannelOffsets(total=16, per_slice=8)
+        offs.add("a"); offs.add("b")
+        with pytest.raises(RuntimeError, match="exhausted"):
+            offs.add("c")
+
+
+class TestParseLabel:
+    def test_roundtrip(self):
+        assert parse_slice_label("slice-a.4x4") == ("slice-a", "4x4")
+        assert parse_slice_label("proj.zone.s1.2x2") == ("proj.zone.s1", "2x2")
+
+    def test_rejects(self):
+        for bad in ("", "noslice", "4x4", "id."):
+            with pytest.raises(ValueError):
+                parse_slice_label(bad)
+
+
+class TestController:
+    def test_slice_appears_with_labeled_node(self, rig):
+        cluster, ctrl = rig
+        cluster.create(make_node("w0", "slice-a.4x4"))
+        assert ctrl.active_slices() == {"slice-a.4x4": {"w0"}}
+        slices = cluster.list("ResourceSlice")
+        assert len(slices) == 1
+        s = slices[0]
+        assert s.node_selector == {SLICE_LABEL: "slice-a.4x4"}
+        names = {d.name for d in s.devices}
+        assert "podslice" in names
+        assert "channel-0" in names and "channel-7" in names
+        pod = next(d for d in s.devices if d.name == "podslice")
+        assert pod.attributes["sliceTopology"] == "4x4"
+
+    def test_refcounting_until_last_node(self, rig):
+        cluster, ctrl = rig
+        n0 = cluster.create(make_node("w0", "slice-a.4x4"))
+        cluster.create(make_node("w1", "slice-a.4x4"))
+        assert len(cluster.list("ResourceSlice")) == 1
+        cluster.delete("Node", "", "w0")
+        assert len(cluster.list("ResourceSlice")) == 1   # w1 still member
+        cluster.delete("Node", "", "w1")
+        assert cluster.list("ResourceSlice") == []       # 1→0 transition
+        assert ctrl.active_slices() == {}
+
+    def test_two_slices_get_disjoint_channels(self, rig):
+        cluster, ctrl = rig
+        cluster.create(make_node("a0", "slice-a.2x2"))
+        cluster.create(make_node("b0", "slice-b.2x2"))
+        slices = cluster.list("ResourceSlice")
+        assert len(slices) == 2
+        ids = [sorted(d.attributes["channelId"] for d in s.devices
+                      if d.attributes.get("type") == "rendezvous")
+               for s in slices]
+        assert set(ids[0]).isdisjoint(ids[1])
+
+    def test_node_relabel_moves_slice(self, rig):
+        cluster, ctrl = rig
+        node = cluster.create(make_node("w0", "slice-a.2x2"))
+        node.metadata.labels[SLICE_LABEL] = "slice-b.2x2"
+        cluster.update(node)
+        assert ctrl.active_slices() == {"slice-b.2x2": {"w0"}}
+        slices = cluster.list("ResourceSlice")
+        assert len(slices) == 1
+        assert slices[0].node_selector == {SLICE_LABEL: "slice-b.2x2"}
+
+    def test_stop_cleans_up(self, rig):
+        cluster, ctrl = rig
+        cluster.create(make_node("w0", "slice-a.2x2"))
+        assert len(cluster.list("ResourceSlice")) == 1
+        ctrl.stop()
+        assert cluster.list("ResourceSlice") == []
+
+    def test_unlabeled_nodes_ignored(self, rig):
+        cluster, ctrl = rig
+        cluster.create(make_node("plain"))
+        assert ctrl.active_slices() == {}
+        assert cluster.list("ResourceSlice") == []
+
+    def test_transient_error_retried(self, rig):
+        import time
+        cluster, ctrl = rig
+        fails = {"n": 2}
+        original = ctrl.publisher.publish
+
+        def flaky(pools):
+            if fails["n"] > 0:
+                fails["n"] -= 1
+                raise RuntimeError("api server unavailable")
+            return original(pools)
+        ctrl.publisher.publish = flaky
+        cluster.create(make_node("w0", "slice-a.2x2"))
+        deadline = time.time() + 2
+        while time.time() < deadline and not cluster.list("ResourceSlice"):
+            time.sleep(0.01)
+        assert len(cluster.list("ResourceSlice")) == 1
