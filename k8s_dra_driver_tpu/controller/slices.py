@@ -94,7 +94,8 @@ class SliceGangController:
         self.driver = driver
         self.metrics = metrics
         self.publisher = ResourceSlicePublisher(
-            client, driver, owner=owner, metrics=metrics)
+            client, driver, owner_id="controller", owner=owner,
+            metrics=metrics)
         self.offsets = ChannelOffsets(per_slice=channels_per_slice)
         self.retry_delay_s = retry_delay_s
         self._lock = threading.Lock()

@@ -1,13 +1,13 @@
 """Device model: allocatable + prepared device records."""
 
 from .model import (ALL_DEVICE_KINDS, AllocatableDevice, KIND_CHIP, KIND_CORE,
-                    KIND_RENDEZVOUS, KIND_SLICE, chip_slot, core_slot,
-                    enumerate_host_devices, is_shared_token)
+                    KIND_PODSLICE, KIND_RENDEZVOUS, KIND_SLICE, chip_slot,
+                    core_slot, enumerate_host_devices, is_shared_token)
 from .prepared import PreparedClaim, PreparedDevice
 
 __all__ = [
     "ALL_DEVICE_KINDS", "AllocatableDevice", "KIND_CHIP", "KIND_CORE",
-    "KIND_RENDEZVOUS", "KIND_SLICE", "chip_slot", "core_slot",
+    "KIND_PODSLICE", "KIND_RENDEZVOUS", "KIND_SLICE", "chip_slot", "core_slot",
     "enumerate_host_devices", "is_shared_token", "PreparedClaim",
     "PreparedDevice",
 ]

@@ -37,8 +37,12 @@ KIND_CHIP = "chip"
 KIND_CORE = "core"
 KIND_SLICE = "slice"
 KIND_RENDEZVOUS = "rendezvous"
+# The whole multi-host pod slice as one gang device (controller-published;
+# the node plugin synthesizes it at prepare time).
+KIND_PODSLICE = "podslice"
 
-ALL_DEVICE_KINDS = (KIND_CHIP, KIND_CORE, KIND_SLICE, KIND_RENDEZVOUS)
+ALL_DEVICE_KINDS = (KIND_CHIP, KIND_CORE, KIND_SLICE, KIND_RENDEZVOUS,
+                    KIND_PODSLICE)
 
 
 def chip_slot(index: int) -> str:
@@ -76,6 +80,8 @@ class AllocatableDevice:
             return f"slice-{self.shape}-at-{o.x}-{o.y}-{o.z}"
         if self.kind == KIND_RENDEZVOUS:
             return f"channel-{self.channel_id}"
+        if self.kind == KIND_PODSLICE:
+            return "podslice"
         raise ValueError(self.kind)
 
     @property

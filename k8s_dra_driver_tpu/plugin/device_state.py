@@ -25,8 +25,9 @@ from ..api import resource
 from ..api.config import v1alpha1 as configapi
 from ..cluster import ClusterClient
 from ..devicemodel import (AllocatableDevice, KIND_CHIP, KIND_CORE,
-                           KIND_RENDEZVOUS, KIND_SLICE, PreparedClaim,
-                           PreparedDevice, enumerate_host_devices)
+                           KIND_PODSLICE, KIND_RENDEZVOUS, KIND_SLICE,
+                           PreparedClaim, PreparedDevice,
+                           enumerate_host_devices)
 from ..discovery import DiscoveryBackend
 from .cdi import CDIHandler, ContainerEdits, claim_topology_edits
 from .checkpoint import CheckpointManager
@@ -53,7 +54,7 @@ class DeviceStateConfig:
 _KIND_COMPAT = {
     configapi.TpuChipConfig: {KIND_CHIP, KIND_SLICE},
     configapi.TpuPartitionConfig: {KIND_CORE},
-    configapi.RendezvousConfig: {KIND_RENDEZVOUS},
+    configapi.RendezvousConfig: {KIND_RENDEZVOUS, KIND_PODSLICE},
 }
 
 
@@ -132,25 +133,56 @@ class DeviceState:
             if edits is not None:
                 extra_edits.merge(edits)
             for res, dev in zip(group, devices):
+                if dev.kind in (KIND_RENDEZVOUS, KIND_PODSLICE):
+                    # Controller-published device: it has no entry in this
+                    # node's standard CDI spec, everything it injects rides
+                    # on the per-claim spec.
+                    cdi_ids = [self.cdi.claim_device_id(uid)]
+                else:
+                    cdi_ids = [self.cdi.standard_device_id(dev.name),
+                               self.cdi.claim_device_id(uid)]
                 prepared.devices.append(PreparedDevice(
                     request=res.request, kind=dev.kind,
                     device_name=dev.name, pool=res.pool,
                     uuids=dev.uuids,
                     chip_indices=sorted(c.index for c in dev.chips),
-                    cdi_device_ids=[
-                        self.cdi.standard_device_id(dev.name),
-                        self.cdi.claim_device_id(uid),
-                    ]))
+                    cdi_device_ids=cdi_ids))
         self._pending_edits = extra_edits
         return prepared
 
     def _lookup(self, res) -> AllocatableDevice:
         dev = self.allocatable.get(res.device)
         if dev is None:
+            dev = self._synthesize_cluster_device(res.device)
+        if dev is None:
             raise PrepareError(
                 f"allocated device {res.device!r} does not exist on node "
                 f"{self.config.node_name}")
         return dev
+
+    def _synthesize_cluster_device(self,
+                                   name: str) -> AllocatableDevice | None:
+        """Materialize controller-published gang devices at prepare time.
+
+        Rendezvous channels and podslice gang devices live in
+        slice-scoped pools the *controller* publishes; the node plugin
+        still prepares them — the analog of the reference plugin
+        mknod'ing IMEX channel devices it never published itself
+        (reference device_state.go:430-444, nvlib.go:490-519)."""
+        sl = self.topology.slice
+        if name.startswith("channel-"):
+            try:
+                channel_id = int(name.removeprefix("channel-"))
+            except ValueError:
+                return None
+            return AllocatableDevice(
+                KIND_RENDEZVOUS, (), channel_id=channel_id,
+                slice_id=sl.slice_id if sl else "")
+        if name == "podslice" and sl is not None:
+            return AllocatableDevice(
+                KIND_PODSLICE, tuple(self.topology.chips),
+                slice_id=sl.slice_id)
+        return None
 
     # -- config resolution ------------------------------------------------
 
@@ -254,6 +286,10 @@ class DeviceState:
         for dev in devices:
             if dev.kind == KIND_RENDEZVOUS:
                 edits.env["TPU_RENDEZVOUS_CHANNEL"] = str(dev.channel_id)
+            elif dev.kind == KIND_PODSLICE:
+                # the gang device grants this host's chips
+                for chip in dev.chips:
+                    edits.device_nodes.extend(chip.dev_paths)
         return edits
 
     # -- claim-level CDI edits -------------------------------------------

@@ -118,7 +118,9 @@ class Driver(DRAPluginServicer):
             name=self.state.config.node_name, devices=devices,
             node_name=self.state.config.node_name)
         pub = publisher_mod.ResourceSlicePublisher(
-            self.client, DRIVER_NAME, metrics=self.metrics)
+            self.client, DRIVER_NAME,
+            owner_id=f"node-{self.state.config.node_name}",
+            metrics=self.metrics)
         pub.publish([pool])
 
     # -- DRA service ------------------------------------------------------

@@ -18,6 +18,11 @@ from ..cluster import ClusterClient, ConflictError, NotFoundError
 from ..utils.metrics import DriverMetrics
 
 DRIVER_LABEL = "tpu.google.com/driver"
+# Which publisher instance owns a slice ("node-<name>" or "controller"):
+# scopes reconcile/cleanup so publishers never delete each other's slices
+# (the role owner references play in the reference, draplugin.go:384-389 /
+# imex.go:87-92).
+OWNER_LABEL = "tpu.google.com/owned-by"
 
 
 @dataclasses.dataclass
@@ -42,21 +47,25 @@ def _devices_equal(a: list[resource.Device], b: list[resource.Device]) -> bool:
 
 class ResourceSlicePublisher:
     def __init__(self, client: ClusterClient, driver: str,
+                 owner_id: str = "default",
                  owner: resource.OwnerReference | None = None,
                  metrics: DriverMetrics | None = None):
         self.client = client
         self.driver = driver
+        self.owner_id = owner_id
         self.owner = owner
         self.metrics = metrics
+
+    def _selector(self) -> dict[str, str]:
+        return {DRIVER_LABEL: self.driver, OWNER_LABEL: self.owner_id}
 
     def publish(self, pools: list[PoolSpec]) -> None:
         """Reconcile cluster ResourceSlices to match ``pools``."""
         desired = {_slice_name(self.driver, p.name): p for p in pools}
         existing = {
             s.metadata.name: s
-            for s in self.client.list(
-                "ResourceSlice",
-                label_selector={DRIVER_LABEL: self.driver})}
+            for s in self.client.list("ResourceSlice",
+                                      label_selector=self._selector())}
 
         for name, pool in desired.items():
             old = existing.get(name)
@@ -87,7 +96,7 @@ class ResourceSlicePublisher:
         """Delete every slice owned by this driver (controller-stop
         cleanup analog, reference imex.go:308-326)."""
         for s in self.client.list("ResourceSlice",
-                                  label_selector={DRIVER_LABEL: self.driver}):
+                                  label_selector=self._selector()):
             try:
                 self.client.delete("ResourceSlice", s.metadata.namespace,
                                    s.metadata.name)
@@ -98,7 +107,8 @@ class ResourceSlicePublisher:
     def _build(self, name: str, pool: PoolSpec,
                generation: int) -> resource.ResourceSlice:
         meta = resource.ObjectMeta(
-            name=name, labels={DRIVER_LABEL: self.driver})
+            name=name, labels={DRIVER_LABEL: self.driver,
+                               OWNER_LABEL: self.owner_id})
         if self.owner is not None:
             meta.owner_references.append(self.owner)
         return resource.ResourceSlice(

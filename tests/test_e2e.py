@@ -1,0 +1,232 @@
+"""End-to-end acceptance tests: the five BASELINE configs
+(BASELINE.md "Targets") driven through discovery → publication →
+allocation → gRPC prepare → CDI injection, asserting what the workload
+container would actually see — the hermetic equivalent of the
+reference's gpu-test1..6 demo-spec suite (reference
+demo/specs/quickstart/, expected outputs README.md:104-136)."""
+
+import pytest
+
+from k8s_dra_driver_tpu.api import resource
+from k8s_dra_driver_tpu.api.config.v1alpha1 import API_VERSION
+from k8s_dra_driver_tpu.allocator import AllocationError, allocate_claim
+from k8s_dra_driver_tpu.discovery import FakeHost, fake_slice_hosts
+from k8s_dra_driver_tpu.plugin import DeviceState
+
+from helpers import chip_config
+from testbed import E2EBed
+
+
+@pytest.fixture(autouse=True)
+def no_sleep(monkeypatch):
+    monkeypatch.setattr(DeviceState, "_sleep", staticmethod(lambda s: None))
+
+
+@pytest.fixture
+def single_host(tmp_path):
+    bed = E2EBed(tmp_path, [FakeHost(hostname="tpu-host-0")])
+    yield bed
+    bed.shutdown()
+
+
+@pytest.fixture
+def gang(tmp_path):
+    bed = E2EBed(tmp_path, fake_slice_hosts(4, topology="4x4"))
+    yield bed
+    bed.shutdown()
+
+
+def claim(name, requests, constraints=(), configs=()):
+    return resource.ResourceClaim(
+        metadata=resource.ObjectMeta(name=name, namespace="default"),
+        spec=resource.ResourceClaimSpec(devices=resource.DeviceClaim(
+            requests=requests, constraints=list(constraints),
+            config=list(configs))))
+
+
+def chip_req(name="tpu", count=1, cls="tpu.google.com", selectors=()):
+    return resource.DeviceRequest(
+        name=name, device_class_name=cls, count=count,
+        selectors=[resource.DeviceSelector(cel=s) for s in selectors])
+
+
+def cfg(params, requests=()):
+    return resource.ClaimConfig(
+        requests=list(requests),
+        opaque=resource.OpaqueConfig(driver="tpu.google.com",
+                                     parameters=params))
+
+
+class TestTpuTest1DedicatedChips:
+    """tpu-test1: two pods, each with its own whole-chip claim →
+    distinct chips (reference gpu-test1: distinct UUIDs)."""
+
+    def test_two_pods_get_distinct_chips(self, single_host):
+        bed = single_host
+        c1 = bed.create_claim(claim("pod1-tpu", [chip_req()]))
+        c2 = bed.create_claim(claim("pod2-tpu", [chip_req()]))
+        v1, v2 = bed.run_pod(c1), bed.run_pod(c2)
+        assert v1.visible_chips and v2.visible_chips
+        assert set(v1.visible_chips).isdisjoint(v2.visible_chips)
+        assert v1.device_nodes != v2.device_nodes
+        assert v1.env["TPU_SKIP_MDS_QUERY"] == "true"
+        # libtpu is mounted into both
+        assert any(m["containerPath"] == "/usr/lib/libtpu.so"
+                   for m in v1.mounts)
+
+
+class TestTpuTest23SharedChip:
+    """tpu-test2/3: one claim shared by two containers/pods → same chip
+    (reference gpu-test2/3: same UUID twice), with both sharing
+    strategies."""
+
+    def test_timeslice_shared_claim(self, single_host):
+        bed = single_host
+        shared = bed.create_claim(claim(
+            "shared-tpu", [chip_req()],
+            configs=[cfg(chip_config(
+                "TimeSlicing", timeSlicing={"interval": "Long"}))]))
+        v1 = bed.run_pod(shared)
+        v2 = bed.run_pod(shared)     # second consumer, same claim
+        assert v1.visible_chips == v2.visible_chips
+        assert v1.env["TPU_RUNTIME_PREEMPTION_MS"] == "20"
+
+    def test_coordinated_shared_claim(self, single_host):
+        bed = single_host
+        shared = bed.create_claim(claim(
+            "shared-tpu", [chip_req()],
+            configs=[cfg(chip_config(
+                "Coordinated", coordinated={"dutyCyclePercent": 50}))]))
+        v = bed.run_pod(shared)
+        assert v.env["TPU_COORDINATOR_DUTY_CYCLE_PCT"] == "50"
+        assert any(m["containerPath"] == "/coordination" for m in v.mounts)
+        # exactly one coordinator Deployment exists for the claim
+        assert len(bed.cluster.list("Deployment")) == 1
+
+
+class TestSingleCorePartition:
+    """Config 3: single-core partition claim (MIG-profile analog)."""
+
+    def test_core_partition_env(self, tmp_path):
+        bed = E2EBed(tmp_path, [FakeHost(generation="v5p", hostname="p0")])
+        try:
+            c = bed.create_claim(claim(
+                "core-claim", [chip_req(cls="tpu-core.google.com")]))
+            v = bed.run_pod(c)
+            assert "TPU_VISIBLE_CORES" in v.env
+            chip, core = v.env["TPU_VISIBLE_CORES"].split(":")
+            assert v.visible_chips == [int(chip)]
+            # sibling core still allocatable; whole chip is not
+            c2 = bed.create_claim(claim(
+                "sibling", [chip_req(cls="tpu-core.google.com", selectors=[
+                    f'device.attributes["index"] == {chip}'])]))
+            bed.run_pod(c2)
+            c3 = bed.create_claim(claim(
+                "whole", [chip_req(selectors=[
+                    f'device.attributes["index"] == {chip}'])]))
+            with pytest.raises(AllocationError):
+                allocate_claim(bed.cluster, c3)
+        finally:
+            bed.shutdown()
+
+
+class TestIciContiguousSlice:
+    """Config 4: ICI-contiguous 2x2 slice claim."""
+
+    def test_slice_is_contiguous_and_exclusive(self, single_host):
+        bed = single_host
+        c = bed.create_claim(claim(
+            "slice-claim", [chip_req(cls="tpu-slice.google.com", selectors=[
+                'device.attributes["sliceShape"] == "2x2"'])]))
+        v = bed.run_pod(c)
+        assert v.visible_chips == [0, 1, 2, 3]
+        assert sorted(v.device_nodes) == [f"/dev/accel{i}" for i in range(4)]
+        # whole host consumed: nothing else allocatable
+        c2 = bed.create_claim(claim("leftover", [chip_req()]))
+        with pytest.raises(AllocationError):
+            allocate_claim(bed.cluster, c2)
+
+    def test_unprepare_frees_chips(self, single_host):
+        bed = single_host
+        c = bed.create_claim(claim(
+            "slice-claim", [chip_req(cls="tpu-slice.google.com", selectors=[
+                'device.attributes["sliceShape"] == "2x2"'])]))
+        v = bed.run_pod(c)
+        bed.delete_pod(c, v.node)
+        bed.cluster.delete("ResourceClaim", "default", "slice-claim")
+        c2 = bed.create_claim(claim("after", [chip_req()]))
+        bed.run_pod(c2)   # allocates fine now
+
+
+class TestMultiHostGang:
+    """Config 5: 4-host v5e 4x4 pod-slice gang claim (imex-test1
+    analog: shared rendezvous claim + per-pod chip claims)."""
+
+    def test_controller_published_gang_pool(self, gang):
+        slices = [s for s in gang.cluster.list("ResourceSlice")
+                  if s.node_selector]
+        assert len(slices) == 1
+        s = slices[0]
+        assert s.node_selector == {"tpu.google.com/slice": "slice-a.4x4"}
+        pod = next(d for d in s.devices if d.name == "podslice")
+        assert pod.attributes["numWorkers"] == 4
+        assert pod.attributes["sliceTopology"] == "4x4"
+
+    def test_gang_workers_see_consistent_world(self, gang):
+        bed = gang
+        # one shared rendezvous-channel claim for the whole gang
+        shared = bed.create_claim(claim(
+            "gang-channel",
+            [chip_req("chan", cls="tpu-rendezvous.google.com")],
+            configs=[cfg({"apiVersion": API_VERSION,
+                          "kind": "RendezvousConfig"})]))
+        allocate_claim(bed.cluster, shared)
+
+        views = []
+        for w in range(4):
+            node = f"slice-a-w{w}"
+            # per-pod whole-host slice claim on each worker
+            local = bed.create_claim(claim(
+                f"w{w}-chips", [chip_req(
+                    cls="tpu-slice.google.com",
+                    selectors=['device.attributes["sliceShape"] == "2x2"'])]))
+            chip_view = bed.run_pod(local)
+            assert chip_view.node == node
+            rdv_view = bed.run_pod(shared, node=node)
+            env = dict(chip_view.env)
+            env.update(rdv_view.env)
+            views.append(env)
+
+        # every worker: same topology, same coordinator, same channel,
+        # distinct worker ids — the rendezvous contract JAX needs
+        assert {v["TPU_TOPOLOGY"] for v in views} == {"4x4"}
+        assert len({v["TPU_COORDINATOR_ADDRESS"] for v in views}) == 1
+        assert {v["TPU_WORKER_ID"] for v in views} == {"0", "1", "2", "3"}
+        assert len({v["TPU_RENDEZVOUS_CHANNEL"] for v in views}) == 1
+        assert {v["TPU_SLICE_ID"] for v in views} == {"slice-a"}
+
+    def test_podslice_gang_device_all_or_nothing(self, gang):
+        bed = gang
+        g = bed.create_claim(claim(
+            "whole-slice", [chip_req(cls="tpu-podslice.google.com")]))
+        allocate_claim(bed.cluster, g)
+        res = g.status.allocation.results[0]
+        assert res.device == "podslice"
+        # a second gang claim cannot double-allocate it
+        g2 = bed.create_claim(claim(
+            "whole-slice-2", [chip_req(cls="tpu-podslice.google.com")]))
+        with pytest.raises(AllocationError):
+            allocate_claim(bed.cluster, g2)
+
+
+class TestCELSelectorsDemo:
+    """tpu-test6 analog: CEL selection on product name / index
+    (reference gpu-test6 productName/index selector)."""
+
+    def test_product_and_index_selector(self, single_host):
+        bed = single_host
+        c = bed.create_claim(claim("sel", [chip_req(selectors=[
+            'device.attributes["productName"].startsWith("tpu-v5") && '
+            'device.attributes["index"] == 3'])]))
+        v = bed.run_pod(c)
+        assert v.visible_chips == [3]

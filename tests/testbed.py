@@ -1,0 +1,175 @@
+"""End-to-end bed: fake hosts + fake cluster + real drivers.
+
+Assembles the whole system the way a real cluster would: per-host
+kubelet plugins serving real gRPC on unix sockets, the slice-gang
+controller watching nodes, the in-repo allocator standing in for
+kube-scheduler, and a mini CDI interpreter standing in for the
+container runtime (the reference's acceptance tier is demo specs on a
+kind cluster with real GPUs, SURVEY §4 — this is the hermetic
+equivalent it lacks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from pathlib import Path
+
+import grpc
+
+from k8s_dra_driver_tpu.api import resource
+from k8s_dra_driver_tpu.api.classes import standard_device_classes
+from k8s_dra_driver_tpu.allocator import allocate_claim
+from k8s_dra_driver_tpu.cluster import FakeCluster, Node
+from k8s_dra_driver_tpu.controller import SliceGangController
+from k8s_dra_driver_tpu.discovery import FakeHost
+from k8s_dra_driver_tpu.plugin import (DeviceState, DeviceStateConfig, Driver)
+from k8s_dra_driver_tpu.proto import DRAPluginStub, dra_pb2
+
+from helpers import start_fake_deployment_controller
+
+
+@dataclasses.dataclass
+class PodView:
+    """What a container would observe after CDI injection."""
+
+    node: str
+    env: dict[str, str]
+    device_nodes: list[str]
+    mounts: list[dict]
+
+    @property
+    def visible_chips(self) -> list[int]:
+        v = self.env.get("TPU_VISIBLE_CHIPS", "")
+        return [int(x) for x in v.split(",") if x != ""]
+
+
+def apply_cdi(cdi_root: Path, cdi_device_ids: list[str]) -> PodView:
+    """Mini CDI interpreter: resolve qualified device ids against the
+    spec files in ``cdi_root`` and merge their container edits."""
+    env: dict[str, str] = {}
+    device_nodes: list[str] = []
+    mounts: list[dict] = []
+    specs = [json.loads(p.read_text()) for p in sorted(cdi_root.glob("*.json"))]
+
+    def apply_edits(edits: dict) -> None:
+        for e in edits.get("env", []):
+            k, _, v = e.partition("=")
+            env[k] = v
+        for n in edits.get("deviceNodes", []):
+            if n["path"] not in device_nodes:
+                device_nodes.append(n["path"])
+        mounts.extend(edits.get("mounts", []))
+
+    for qualified in cdi_device_ids:
+        kind, _, name = qualified.partition("=")
+        matched = False
+        for spec in specs:
+            if spec["kind"] != kind:
+                continue
+            for dev in spec["devices"]:
+                if dev["name"] == name:
+                    apply_edits(spec.get("containerEdits", {}))
+                    apply_edits(dev.get("containerEdits", {}))
+                    matched = True
+        if not matched:
+            raise AssertionError(f"CDI device {qualified} not found")
+    return PodView(node="", env=env, device_nodes=device_nodes, mounts=mounts)
+
+
+class E2EBed:
+    def __init__(self, tmp_path: Path, hosts: list[FakeHost],
+                 with_controller: bool = True):
+        self.tmp = Path(tmp_path)
+        self.cluster = FakeCluster()
+        start_fake_deployment_controller(self.cluster)
+        self.classes = standard_device_classes()
+        for cls in self.classes.values():
+            self.cluster.create(cls)
+        self.drivers: dict[str, Driver] = {}
+        self.controller = None
+        if with_controller:
+            self.controller = SliceGangController(self.cluster,
+                                                  retry_delay_s=0.01)
+            self.controller.start()
+        for host in hosts:
+            self.add_host(host)
+
+    def add_host(self, host: FakeHost) -> Driver:
+        name = host.hostname
+        self.cluster.create(Node(metadata=resource.ObjectMeta(name=name)))
+        backend = host.materialize(self.tmp / "hosts" / name)
+        cfg = DeviceStateConfig(
+            plugin_root=str(self.tmp / "plugin" / name),
+            cdi_root=str(self.tmp / "cdi" / name),
+            node_name=name)
+        state = DeviceState(backend, self.cluster, cfg)
+        driver = Driver(state, self.cluster,
+                        plugin_dir=str(self.tmp / "plugin" / name))
+        driver.start()
+        self.drivers[name] = driver
+        return driver
+
+    def shutdown(self) -> None:
+        for d in self.drivers.values():
+            d.shutdown()
+        if self.controller:
+            self.controller.stop()
+
+    # -- the kubelet/scheduler role --------------------------------------
+
+    def create_claim(self, claim: resource.ResourceClaim
+                     ) -> resource.ResourceClaim:
+        return self.cluster.create(claim)
+
+    def schedule(self, claim: resource.ResourceClaim) -> str:
+        """Allocate and return the node the pod will land on."""
+        allocate_claim(self.cluster, claim)
+        selector = claim.status.allocation.node_selector or {}
+        if "kubernetes.io/hostname" in selector:
+            return selector["kubernetes.io/hostname"]
+        # slice-scoped selector: any matching node (pick deterministically)
+        for node in self.cluster.list("Node", label_selector=selector):
+            return node.metadata.name
+        raise AssertionError("no node matches allocation selector")
+
+    def run_pod(self, claim: resource.ResourceClaim,
+                node: str | None = None) -> PodView:
+        """Schedule (if needed), prepare over gRPC, apply CDI."""
+        if claim.status.allocation is None:
+            node = node or self.schedule(claim)
+        elif node is None:
+            node = self.schedule(claim)
+        driver = self.drivers[node]
+        stub = DRAPluginStub(
+            grpc.insecure_channel(f"unix://{driver.plugin_socket}"))
+        resp = stub.NodePrepareResources(
+            dra_pb2.NodePrepareResourcesRequest(claims=[dra_pb2.Claim(
+                uid=claim.metadata.uid,
+                namespace=claim.metadata.namespace,
+                name=claim.metadata.name)]))
+        result = resp.claims[claim.metadata.uid]
+        if result.error:
+            raise RuntimeError(result.error)
+        cdi_ids: list[str] = []
+        for dev in result.devices:
+            for cid in dev.cdi_device_ids:
+                if cid not in cdi_ids:
+                    cdi_ids.append(cid)
+        view = apply_cdi(Path(driver.state.cdi.cdi_root), cdi_ids)
+        view.node = node
+        return view
+
+    def delete_pod(self, claim: resource.ResourceClaim,
+                   node: str) -> None:
+        driver = self.drivers[node]
+        stub = DRAPluginStub(
+            grpc.insecure_channel(f"unix://{driver.plugin_socket}"))
+        resp = stub.NodeUnprepareResources(
+            dra_pb2.NodeUnprepareResourcesRequest(claims=[dra_pb2.Claim(
+                uid=claim.metadata.uid,
+                namespace=claim.metadata.namespace,
+                name=claim.metadata.name)]))
+        err = resp.claims[claim.metadata.uid].error
+        if err:
+            raise RuntimeError(err)
