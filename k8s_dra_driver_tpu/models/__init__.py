@@ -1,0 +1,9 @@
+"""Workload models (proof-of-function for allocated TPUs)."""
+
+from .transformer import (TransformerConfig, forward, init_params, loss_fn,
+                          make_optimizer, make_train_step, param_specs,
+                          shard_params)
+
+__all__ = ["TransformerConfig", "forward", "init_params", "loss_fn",
+           "make_optimizer", "make_train_step", "param_specs",
+           "shard_params"]

@@ -1,0 +1,7 @@
+"""TPU compute ops: ring attention, collectives, benchmarks."""
+
+from .collectives import allreduce_bandwidth, matmul_tflops
+from .ring_attention import attention_reference, ring_attention
+
+__all__ = ["allreduce_bandwidth", "attention_reference", "matmul_tflops",
+           "ring_attention"]

@@ -1,0 +1,71 @@
+"""Collective helpers + bandwidth measurement for allocated devices.
+
+The measurable half of the BASELINE metric ("JAX allreduce GB/s inside
+a DRA-allocated pod"): a psum over the full device mesh, timed, with
+algorithmic bus bandwidth reported the way collective benchmarks do
+(2*(n-1)/n scaling for ring allreduce).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def allreduce_bandwidth(size_mb: float = 64.0, iters: int = 10,
+                        devices: list | None = None) -> dict:
+    """Time an all-reduce over all devices; returns GB/s + latency."""
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    mesh = Mesh(np.asarray(devices), ("all",))
+    nelems = int(size_mb * 1e6 / 4 / max(n, 1)) * n
+    x = jnp.arange(nelems, dtype=jnp.float32)
+    x = jax.device_put(x, NamedSharding(mesh, P("all")))
+
+    @jax.jit
+    def ar(x):
+        return jax.shard_map(
+            lambda s: jax.lax.psum(s, "all"), mesh=mesh,
+            in_specs=P("all"), out_specs=P(None))(x)
+
+    ar(x).block_until_ready()                       # compile
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = ar(x)
+    out.block_until_ready()
+    elapsed = (time.perf_counter() - start) / iters
+
+    bytes_moved = nelems * 4
+    # ring allreduce moves 2*(n-1)/n of the payload per device
+    algo_factor = 2 * (n - 1) / n if n > 1 else 1.0
+    return {
+        "devices": n,
+        "size_mb": bytes_moved / 1e6,
+        "seconds": elapsed,
+        "gbps": bytes_moved * algo_factor / elapsed / 1e9,
+    }
+
+
+def matmul_tflops(dim: int = 4096, iters: int = 10,
+                  dtype=jnp.bfloat16) -> dict:
+    """MXU utilization probe: timed square matmul."""
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (dim, dim), dtype)
+    b = jax.random.normal(key, (dim, dim), dtype)
+
+    @jax.jit
+    def mm(a, b):
+        return a @ b
+
+    mm(a, b).block_until_ready()
+    start = time.perf_counter()
+    for _ in range(iters):
+        out = mm(a, b)
+    out.block_until_ready()
+    elapsed = (time.perf_counter() - start) / iters
+    return {"dim": dim, "seconds": elapsed,
+            "tflops": 2 * dim ** 3 / elapsed / 1e12}

@@ -1,0 +1,7 @@
+"""Mesh & sharding utilities for DRA-allocated devices."""
+
+from .mesh import (BATCH_AXES, MESH_AXES, MeshSpec, batch_sharding,
+                   make_mesh, replicated, visible_chip_count)
+
+__all__ = ["BATCH_AXES", "MESH_AXES", "MeshSpec", "batch_sharding",
+           "make_mesh", "replicated", "visible_chip_count"]

@@ -6,11 +6,17 @@ multi-device sharding without TPU hardware.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"       # override any TPU platform env
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Site hooks (e.g. a preinstalled PJRT plugin) may have pinned
+# jax_platforms at interpreter start; force CPU through jax.config too.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

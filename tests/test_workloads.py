@@ -1,0 +1,110 @@
+"""Workload-layer tests on the virtual 8-device CPU mesh: mesh building,
+ring attention vs reference, sharded MoE transformer train step."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import (TransformerConfig, forward,
+                                       init_params, make_train_step,
+                                       shard_params)
+from k8s_dra_driver_tpu.ops import (allreduce_bandwidth,
+                                    attention_reference, ring_attention)
+from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
+
+
+class TestMesh:
+    def test_infer_factorization(self):
+        assert MeshSpec.infer(8).num_devices == 8
+        assert MeshSpec.infer(1) == MeshSpec(1, 1, 1, 1)
+        assert MeshSpec.infer(4).num_devices == 4
+
+    def test_make_mesh(self):
+        mesh = make_mesh(MeshSpec(dp=2, ep=1, sp=2, tp=2))
+        assert mesh.shape == {"dp": 2, "ep": 1, "sp": 2, "tp": 2}
+
+    def test_mismatch_rejected(self):
+        with pytest.raises(ValueError):
+            make_mesh(MeshSpec(dp=3, tp=1))
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_reference(self, causal):
+        mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        key = jax.random.PRNGKey(0)
+        b, t, h, d = 4, 32, 4, 16
+        q, k, v = (jax.random.normal(k_, (b, t, h, d), jnp.float32)
+                   for k_ in jax.random.split(key, 3))
+        out = ring_attention(q, k, v, mesh, causal=causal)
+        want = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_sp4(self):
+        mesh = make_mesh(MeshSpec(dp=1, sp=4, tp=2))
+        key = jax.random.PRNGKey(1)
+        q, k, v = (jax.random.normal(k_, (2, 64, 2, 8), jnp.float32)
+                   for k_ in jax.random.split(key, 3))
+        out = ring_attention(q, k, v, mesh, causal=True)
+        want = attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   atol=2e-5, rtol=2e-5)
+
+
+SMALL = TransformerConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                          d_head=16, d_ff=128, max_seq=64,
+                          dtype=jnp.float32)
+SMALL_MOE = TransformerConfig(vocab=128, d_model=64, n_layers=2, n_heads=4,
+                              d_head=16, d_ff=128, n_experts=4, top_k=2,
+                              max_seq=64, dtype=jnp.float32)
+
+
+class TestTransformer:
+    def test_forward_shapes(self):
+        params = init_params(SMALL, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = forward(params, tokens, SMALL)
+        assert logits.shape == (2, 16, 128)
+
+    def test_sharded_equals_unsharded(self):
+        mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        params = init_params(SMALL, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
+        plain = forward(params, tokens, SMALL, mesh=None)
+        sharded = forward(shard_params(params, SMALL, mesh), tokens, SMALL,
+                          mesh=mesh)
+        np.testing.assert_allclose(np.asarray(plain), np.asarray(sharded),
+                                   atol=2e-4, rtol=2e-4)
+
+    @pytest.mark.parametrize("cfg,spec", [
+        (SMALL, MeshSpec(dp=2, sp=2, tp=2)),
+        (SMALL_MOE, MeshSpec(dp=1, ep=2, sp=2, tp=2)),
+    ])
+    def test_train_step_reduces_loss(self, cfg, spec):
+        mesh = make_mesh(spec)
+        step, init_state = make_train_step(cfg, mesh)
+        params, opt_state = init_state(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab)
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        assert np.isfinite(losses).all()
+
+    def test_moe_params_sharded_on_ep(self):
+        mesh = make_mesh(MeshSpec(dp=1, ep=2, sp=2, tp=2))
+        params = shard_params(init_params(SMALL_MOE, jax.random.PRNGKey(0)),
+                              SMALL_MOE, mesh)
+        spec = params["layers"][0]["w_in"].sharding.spec
+        assert spec[0] == "ep"
+
+
+class TestCollectives:
+    def test_allreduce_bandwidth_runs(self):
+        out = allreduce_bandwidth(size_mb=1, iters=2)
+        assert out["devices"] == 8
+        assert out["gbps"] > 0
