@@ -18,6 +18,11 @@ from ..api.resource import ObjectMeta
 class Node:
     metadata: ObjectMeta
     ready: bool = True
+    # Full wire object as last read from a real API server; updates
+    # merge into this so unmodeled fields (spec.podCIDR, taints, …)
+    # survive the round-trip instead of being wiped by a sparse PUT.
+    raw: dict[str, Any] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False)
 
 
 @dataclasses.dataclass
@@ -26,6 +31,8 @@ class Deployment:
     spec: dict[str, Any] = dataclasses.field(default_factory=dict)
     ready_replicas: int = 0
     replicas: int = 1
+    raw: dict[str, Any] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False)
 
     @property
     def ready(self) -> bool:
@@ -38,3 +45,5 @@ class Pod:
     spec: dict[str, Any] = dataclasses.field(default_factory=dict)
     node_name: str = ""
     phase: str = "Pending"
+    raw: dict[str, Any] = dataclasses.field(
+        default_factory=dict, repr=False, compare=False)

@@ -1,0 +1,11 @@
+"""Command-line entrypoints: the two driver binaries.
+
+The analog of the reference's cmd/ tree — ``tpu-dra-plugin``
+(cmd/nvidia-dra-plugin/main.go) and ``tpu-dra-controller``
+(cmd/nvidia-dra-controller/main.go) — exposed as console scripts.
+"""
+
+from .controller import main as controller_main
+from .plugin import main as plugin_main
+
+__all__ = ["plugin_main", "controller_main"]

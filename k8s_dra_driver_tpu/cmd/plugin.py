@@ -1,0 +1,179 @@
+"""``tpu-dra-plugin`` — the per-node kubelet plugin binary.
+
+The analog of the reference's plugin entrypoint (reference
+cmd/nvidia-dra-plugin/main.go:36-206): flag parsing with env mirrors,
+plugin/CDI directory creation, driver construction, and a signal loop.
+Differences are deliberate TPU-first choices:
+
+- discovery is sysfs/env (``--driver-root`` prefixes a host mount), not
+  a driver-library path hunt;
+- ``--device-classes`` gates which device *kinds* are enumerated
+  (chip/core/slice — the gpu/mig gating analog, main.go:117-123 and
+  nvlib.go:113-133);
+- the plugin serves Prometheus metrics too (``--http-endpoint``), a gap
+  SURVEY §5 calls out in the reference.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import sys
+import threading
+from pathlib import Path
+
+from ..devicemodel import KIND_CHIP, KIND_CORE, KIND_SLICE
+from ..utils import info
+from ..utils.flags import KubeClientConfig, LoggingConfig, env_default
+from ..utils.metrics import DriverMetrics
+
+log = logging.getLogger("tpu-dra-plugin")
+
+DEFAULT_PLUGIN_ROOT = "/var/lib/kubelet/plugins/tpu.google.com"
+DEFAULT_REGISTRAR_ROOT = "/var/lib/kubelet/plugins_registry"
+DEFAULT_CDI_ROOT = "/var/run/cdi"
+
+_KIND_BY_CLASS = {"chip": KIND_CHIP, "core": KIND_CORE, "slice": KIND_SLICE}
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-dra-plugin",
+        description="TPU DRA kubelet plugin (tpu.google.com)")
+    p.add_argument("--version", action="version",
+                   version=info.get_version_string())
+    p.add_argument("--node-name",
+                   default=env_default("NODE_NAME"),
+                   help="name of the Node this plugin runs on "
+                        "[env NODE_NAME] (required)")
+    p.add_argument("--plugin-root",
+                   default=env_default("PLUGIN_ROOT", DEFAULT_PLUGIN_ROOT),
+                   help="kubelet plugin dir for socket + checkpoint "
+                        "[env PLUGIN_ROOT]")
+    p.add_argument("--registrar-root",
+                   default=env_default("REGISTRAR_ROOT",
+                                       DEFAULT_REGISTRAR_ROOT),
+                   help="kubelet plugin-registry dir for the registration "
+                        "socket [env REGISTRAR_ROOT]")
+    p.add_argument("--cdi-root",
+                   default=env_default("CDI_ROOT", DEFAULT_CDI_ROOT),
+                   help="directory CDI spec files are written to "
+                        "[env CDI_ROOT]")
+    p.add_argument("--driver-root",
+                   default=env_default("DRIVER_ROOT", "/"),
+                   help="host filesystem mount prefix for sysfs/dev probing "
+                        "when containerized [env DRIVER_ROOT]")
+    p.add_argument("--device-classes",
+                   default=env_default("DEVICE_CLASSES", "chip,core,slice"),
+                   help="comma list of device kinds to enumerate: "
+                        "chip,core,slice [env DEVICE_CLASSES]")
+    p.add_argument("--coordinator-namespace",
+                   default=env_default("COORDINATOR_NAMESPACE",
+                                       "tpu-dra-driver"),
+                   help="namespace coordinator daemons are created in "
+                        "[env COORDINATOR_NAMESPACE]")
+    p.add_argument("--http-endpoint",
+                   default=env_default("HTTP_ENDPOINT", ""),
+                   help="host:port for /metrics + /healthz; empty disables "
+                        "[env HTTP_ENDPOINT]")
+    p.add_argument("--fake-topology",
+                   default=env_default("FAKE_TOPOLOGY", ""),
+                   help="path to a fake-host JSON spec; uses the hermetic "
+                        "discovery backend [env FAKE_TOPOLOGY]")
+    KubeClientConfig.add_flags(p)
+    LoggingConfig.add_flags(p)
+    return p
+
+
+def validate(args: argparse.Namespace) -> None:
+    if not args.node_name:
+        raise SystemExit("--node-name (or NODE_NAME) is required")
+    kinds = [k.strip() for k in args.device_classes.split(",") if k.strip()]
+    bad = [k for k in kinds if k not in _KIND_BY_CLASS]
+    if bad:
+        raise SystemExit(f"unknown device class(es) {bad}; "
+                         f"valid: {sorted(_KIND_BY_CLASS)}")
+    if not kinds:
+        raise SystemExit("--device-classes must name at least one class")
+    args.device_kinds = tuple(_KIND_BY_CLASS[k] for k in kinds)
+
+
+def build_backend(args: argparse.Namespace):
+    if args.fake_topology:
+        import json
+        import tempfile
+        from ..discovery import FakeHost
+        spec = json.loads(Path(args.fake_topology).read_text())
+        if "worker_hostnames" in spec:
+            spec["worker_hostnames"] = tuple(spec["worker_hostnames"])
+        host = FakeHost(**spec)
+        return host.materialize(Path(tempfile.mkdtemp(prefix="tpu-fake-")))
+    from ..discovery import SysfsBackend
+    return SysfsBackend(host_root=args.driver_root)
+
+
+def run(args: argparse.Namespace, client=None, backend=None,
+        ready_event: threading.Event | None = None,
+        stop_event: threading.Event | None = None) -> int:
+    """Build and run the plugin until signalled.  ``client``/``backend``
+    injection keeps this path hermetically testable (SURVEY §4)."""
+    from ..plugin import DeviceState, DeviceStateConfig, Driver
+
+    validate(args)
+    LoggingConfig.apply(args)
+    log.info("%s starting (version %s) on node %s",
+             "tpu-dra-plugin", info.get_version_string(), args.node_name)
+
+    # mkdir plugin + cdi dirs up front (StartPlugin analog, main.go:171-181)
+    for d in (args.plugin_root, args.registrar_root, args.cdi_root):
+        Path(d).mkdir(parents=True, exist_ok=True)
+
+    client = client or KubeClientConfig.build_client(args)
+    backend = backend or build_backend(args)
+
+    state = DeviceState(backend, client, DeviceStateConfig(
+        plugin_root=args.plugin_root, cdi_root=args.cdi_root,
+        node_name=args.node_name, driver_root=args.driver_root,
+        device_kinds=args.device_kinds,
+        coordinator_namespace=args.coordinator_namespace))
+    metrics = DriverMetrics()
+    driver = Driver(state, client, args.plugin_root, metrics=metrics,
+                    registrar_dir=args.registrar_root)
+
+    endpoint = None
+    if args.http_endpoint:
+        from ..utils.httpendpoint import HTTPEndpoint
+        endpoint = HTTPEndpoint(args.http_endpoint, metrics)
+        endpoint.start()
+        log.info("serving metrics on %s", endpoint.address)
+
+    stop = stop_event or threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(sig, lambda *_: stop.set())
+        except ValueError:
+            pass  # not on the main thread (tests)
+
+    driver.start()
+    log.info("driver started: %d allocatable devices, sockets at %s",
+             len(state.allocatable), driver.plugin_socket)
+    if ready_event is not None:
+        ready_event.set()
+    try:
+        stop.wait()
+    finally:
+        log.info("shutting down")
+        driver.shutdown()
+        if endpoint:
+            endpoint.stop()
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    return run(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

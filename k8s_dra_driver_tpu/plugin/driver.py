@@ -58,7 +58,8 @@ class _Registrar(RegistrationServicer):
 
 class Driver(DRAPluginServicer):
     def __init__(self, state: DeviceState, client: ClusterClient,
-                 plugin_dir: str, metrics: DriverMetrics | None = None):
+                 plugin_dir: str, metrics: DriverMetrics | None = None,
+                 registrar_dir: str | None = None):
         self.state = state
         self.client = client
         self.plugin_dir = Path(plugin_dir)
@@ -67,7 +68,11 @@ class Driver(DRAPluginServicer):
         self._lock = threading.Lock()   # serializes all prepares on a node
         self._servers: list[grpc.Server] = []
         self.plugin_socket = self.plugin_dir / PLUGIN_SOCKET_NAME
-        self.registrar_socket = self.plugin_dir / REGISTRAR_SOCKET_NAME
+        # Real kubelets discover plugins via a separate registry dir
+        # (/var/lib/kubelet/plugins_registry); default next to the plugin
+        # socket for hermetic runs.
+        self.registrar_socket = (Path(registrar_dir or plugin_dir)
+                                 / REGISTRAR_SOCKET_NAME)
         self.registrar = _Registrar(DRIVER_NAME, str(self.plugin_socket))
 
     # -- lifecycle --------------------------------------------------------

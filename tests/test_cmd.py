@@ -1,0 +1,174 @@
+"""CLI entrypoint tests: flag parsing, env mirrors, wiring, shutdown.
+
+Covers the entrypoint surface the reference leaves untested
+(cmd/nvidia-dra-plugin/main.go, cmd/nvidia-dra-controller/main.go).
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_dra_driver_tpu.cluster import FakeCluster, Node
+from k8s_dra_driver_tpu.cmd import controller as controller_cmd
+from k8s_dra_driver_tpu.cmd import plugin as plugin_cmd
+from k8s_dra_driver_tpu.api.resource import ObjectMeta
+from k8s_dra_driver_tpu.discovery import FakeHost
+from k8s_dra_driver_tpu.utils import info
+
+
+def _parse_plugin(argv):
+    return plugin_cmd.build_parser().parse_args(argv)
+
+
+class TestPluginFlags:
+    def test_defaults(self):
+        args = _parse_plugin(["--node-name", "n1"])
+        assert args.plugin_root == plugin_cmd.DEFAULT_PLUGIN_ROOT
+        assert args.cdi_root == plugin_cmd.DEFAULT_CDI_ROOT
+        assert args.kube_api_qps == 5.0 and args.kube_api_burst == 10
+        plugin_cmd.validate(args)
+        assert set(args.device_kinds) == {"chip", "core", "slice"}
+
+    def test_env_mirrors(self, monkeypatch):
+        monkeypatch.setenv("NODE_NAME", "from-env")
+        monkeypatch.setenv("CDI_ROOT", "/tmp/cdi-env")
+        monkeypatch.setenv("KUBE_API_QPS", "50")
+        args = _parse_plugin([])
+        assert args.node_name == "from-env"
+        assert args.cdi_root == "/tmp/cdi-env"
+        assert args.kube_api_qps == 50.0
+
+    def test_cli_beats_env(self, monkeypatch):
+        monkeypatch.setenv("NODE_NAME", "from-env")
+        args = _parse_plugin(["--node-name", "from-cli"])
+        assert args.node_name == "from-cli"
+
+    def test_node_name_required(self):
+        with pytest.raises(SystemExit):
+            plugin_cmd.validate(_parse_plugin([]))
+
+    def test_bad_device_class(self):
+        with pytest.raises(SystemExit):
+            plugin_cmd.validate(_parse_plugin(
+                ["--node-name", "n", "--device-classes", "chip,gpu"]))
+
+    def test_device_class_gating(self):
+        args = _parse_plugin(["--node-name", "n",
+                              "--device-classes", "chip"])
+        plugin_cmd.validate(args)
+        assert args.device_kinds == ("chip",)
+
+
+class TestPluginRun:
+    def test_end_to_end_with_fake_topology(self, tmp_path):
+        """main-path smoke: fake topology file -> devices published,
+        metrics served, clean shutdown."""
+        spec = {"generation": "v5e", "num_chips": 4, "hostname": "n1"}
+        topo_file = tmp_path / "topo.json"
+        topo_file.write_text(json.dumps(spec))
+        args = _parse_plugin([
+            "--node-name", "n1",
+            "--plugin-root", str(tmp_path / "plugin"),
+            "--registrar-root", str(tmp_path / "registry"),
+            "--cdi-root", str(tmp_path / "cdi"),
+            "--fake-topology", str(topo_file),
+            "--http-endpoint", "127.0.0.1:0",
+            "--fake-cluster",
+        ])
+        client = FakeCluster()
+        client.create(Node(metadata=ObjectMeta(name="n1")))
+        ready, stop = threading.Event(), threading.Event()
+        t = threading.Thread(
+            target=plugin_cmd.run, args=(args,),
+            kwargs=dict(client=client, ready_event=ready, stop_event=stop),
+            daemon=True)
+        t.start()
+        assert ready.wait(20), "plugin did not become ready"
+        try:
+            slices = client.list("ResourceSlice")
+            assert slices, "no ResourceSlices published"
+            names = {d.name for s in slices for d in s.devices}
+            assert "chip-0" in names
+            # registration socket lives in the registrar root
+            assert (tmp_path / "registry").exists()
+            assert (tmp_path / "cdi").is_dir()
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not t.is_alive()
+
+
+class TestControllerRun:
+    def test_gating_and_metrics(self):
+        args = controller_cmd.build_parser().parse_args(
+            ["--fake-cluster", "--http-endpoint", "127.0.0.1:0",
+             "--device-classes", "chip"])
+        client = FakeCluster()
+        ready, stop = threading.Event(), threading.Event()
+        t = threading.Thread(
+            target=controller_cmd.run, args=(args,),
+            kwargs=dict(client=client, ready_event=ready, stop_event=stop),
+            daemon=True)
+        t.start()
+        assert ready.wait(10)
+        stop.set()
+        t.join(timeout=10)
+        # no podslice class -> no gang slices even with labeled nodes
+        assert client.list("ResourceSlice") == []
+
+    def test_gang_manager_with_owner(self):
+        from k8s_dra_driver_tpu.cluster.objects import Pod
+        from k8s_dra_driver_tpu import SLICE_LABEL
+        args = controller_cmd.build_parser().parse_args(
+            ["--fake-cluster", "--pod-name", "ctrl-0",
+             "--namespace", "tpu-dra-driver"])
+        client = FakeCluster()
+        client.create(Pod(metadata=ObjectMeta(
+            name="ctrl-0", namespace="tpu-dra-driver")))
+        client.create(Node(metadata=ObjectMeta(
+            name="host-0", labels={SLICE_LABEL: "slice-a.4x4"})))
+        ready, stop = threading.Event(), threading.Event()
+        t = threading.Thread(
+            target=controller_cmd.run, args=(args,),
+            kwargs=dict(client=client, ready_event=ready, stop_event=stop),
+            daemon=True)
+        t.start()
+        assert ready.wait(10)
+        try:
+            slices = client.list("ResourceSlice")
+            assert slices, "gang manager published nothing"
+            owners = {o.name for s in slices
+                      for o in s.metadata.owner_references}
+            assert owners == {"ctrl-0"}
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        # stop() cleans up owned slices (cleanupResourceSlices analog)
+        assert client.list("ResourceSlice") == []
+
+
+class TestHTTPEndpoint:
+    def test_serves_metrics_health_and_stacks(self):
+        from k8s_dra_driver_tpu.utils.httpendpoint import HTTPEndpoint
+        from k8s_dra_driver_tpu.utils.metrics import DriverMetrics
+        ep = HTTPEndpoint("127.0.0.1:0", DriverMetrics())
+        ep.start()
+        try:
+            base = f"http://{ep.address}"
+            body = urllib.request.urlopen(f"{base}/metrics").read()
+            assert b"tpu_dra_prepared_claims" in body
+            assert urllib.request.urlopen(f"{base}/healthz").read() == b"ok"
+            stacks = urllib.request.urlopen(
+                f"{base}/debug/pprof/goroutine").read().decode()
+            assert "thread MainThread" in stacks
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(f"{base}/nope")
+        finally:
+            ep.stop()
+
+
+def test_version_string():
+    assert info.get_version_string().startswith(info.version)
