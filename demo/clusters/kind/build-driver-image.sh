@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Build the driver image and load it into the kind cluster — the analog
+# of the reference's build-driver-image.sh + load-driver-image-into-kind.sh
+# (reference demo/clusters/kind/scripts/).
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+IMAGE="${IMAGE:-tpu-dra-driver:dev}"
+
+docker build -t "$IMAGE" -f "$REPO_ROOT/deployments/container/Dockerfile" \
+  "$REPO_ROOT"
+kind load docker-image --name "$CLUSTER_NAME" "$IMAGE"
+echo "loaded $IMAGE into kind cluster $CLUSTER_NAME"

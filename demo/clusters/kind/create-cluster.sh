@@ -1,0 +1,36 @@
+#!/usr/bin/env bash
+# Create a kind cluster wired for DRA, with fake TPU sysfs trees on the
+# workers — the analog of the reference's create-cluster.sh (reference
+# demo/clusters/kind/create-cluster.sh + common.sh:43-44), minus real
+# hardware: workers get a synthetic /sys/class/accel tree so the driver
+# runs end-to-end hermetically.
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+FAKE_ROOT=/tmp/tpu-dra-kind
+
+command -v kind >/dev/null || { echo "kind not found" >&2; exit 1; }
+
+# Materialize one fake 4-chip v5e host tree per worker.
+for i in 0 1; do
+  rm -rf "$FAKE_ROOT/worker-$i"
+  mkdir -p "$FAKE_ROOT/worker-$i"
+  python - "$REPO_ROOT" "$FAKE_ROOT/worker-$i" "$i" <<'EOF'
+import sys
+sys.path.insert(0, sys.argv[1])
+from pathlib import Path
+from k8s_dra_driver_tpu.discovery import FakeHost
+root, idx = Path(sys.argv[2]), sys.argv[3]
+FakeHost(generation="v5e", num_chips=4,
+         hostname=f"kind-worker-{idx}").materialize(root)
+print("fake TPU tree:", root)
+EOF
+done
+
+kind create cluster --name "$CLUSTER_NAME" \
+  --config "$(dirname "$0")/kind-cluster-config.yaml"
+
+echo "Cluster ready. Next:"
+echo "  $(dirname "$0")/build-driver-image.sh   # build + load the image"
+echo "  $(dirname "$0")/install-dra-driver.sh   # helm install"

@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+set -euo pipefail
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+kind delete cluster --name "$CLUSTER_NAME"
+rm -rf /tmp/tpu-dra-kind

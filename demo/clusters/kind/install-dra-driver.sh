@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Install the driver chart into the kind cluster — the analog of the
+# reference's install-dra-driver.sh (reference demo/clusters/kind/
+# scripts/install-dra-driver.sh). The kind workers expose the fake TPU
+# tree at /faketpu, so driverRoot points there.
+set -euo pipefail
+
+REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
+IMAGE_REPO="${IMAGE_REPO:-tpu-dra-driver}"
+IMAGE_TAG="${IMAGE_TAG:-dev}"
+
+helm upgrade --install tpu-dra-driver \
+  "$REPO_ROOT/deployments/helm/tpu-dra-driver" \
+  --namespace tpu-dra-driver --create-namespace \
+  --set image.repository="$IMAGE_REPO" \
+  --set image.tag="$IMAGE_TAG" \
+  --set image.pullPolicy=Never \
+  --set kubeletPlugin.driverRoot=/faketpu \
+  --set "kubeletPlugin.nodeSelector=null" \
+  --set "kubeletPlugin.tolerations=null"
+
+kubectl -n tpu-dra-driver rollout status ds/tpu-dra-driver-kubelet-plugin

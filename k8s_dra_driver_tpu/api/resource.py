@@ -94,9 +94,17 @@ class ResourceSlice:
 
 @dataclasses.dataclass
 class DeviceSelector:
-    """A CEL selector over device attributes/capacity."""
+    """A CEL selector over device attributes/capacity.
+
+    ``cel`` is the expression string; upstream wire format nests it as
+    ``{"cel": {"expression": "..."}}``, which is accepted on input.
+    """
 
     cel: str = ""
+
+    def __post_init__(self):
+        if isinstance(self.cel, dict):
+            self.cel = self.cel.get("expression", "")
 
 
 @dataclasses.dataclass

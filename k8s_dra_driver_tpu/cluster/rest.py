@@ -191,6 +191,13 @@ def _claim_from_wire(d: dict) -> resource.ResourceClaim:
     return claim
 
 
+def _wrap_cel_selectors(selectors: list) -> None:
+    """In-place: flat `cel: "expr"` → upstream `cel: {expression}`."""
+    for sel in selectors:
+        if isinstance(sel.get("cel"), str):
+            sel["cel"] = {"expression": sel["cel"]}
+
+
 def _claim_to_wire(c: resource.ResourceClaim) -> dict:
     """Main-resource body: spec only — a real API server strips status
     from writes to the main resource (it is a subresource); see
@@ -200,6 +207,8 @@ def _claim_to_wire(c: resource.ResourceClaim) -> dict:
     out["apiVersion"] = RESOURCE_API
     out["kind"] = "ResourceClaim"
     out["metadata"] = _meta_to_wire(c.metadata)
+    for req in out.get("spec", {}).get("devices", {}).get("requests", []):
+        _wrap_cel_selectors(req.get("selectors", []))
     return out
 
 
@@ -223,6 +232,7 @@ def _class_from_wire(d: dict) -> resource.DeviceClass:
 def _class_to_wire(c: resource.DeviceClass) -> dict:
     spec = resource.to_dict(c)
     spec.pop("metadata", None)
+    _wrap_cel_selectors(spec.get("selectors", []))
     return {"apiVersion": RESOURCE_API, "kind": "DeviceClass",
             "metadata": _meta_to_wire(c.metadata), "spec": spec}
 
@@ -573,10 +583,7 @@ class RestClusterClient(ClusterClient):
                               query=f"watch=true&resourceVersion={rv}"
                                     "&allowWatchBookmarks=false"),
                     stream=True, timeout=330)
-                # Only a successfully opened stream resets the backoff —
-                # resetting after the relist would hot-loop full relists
-                # when the watch endpoint persistently fails.
-                backoff = 1.0
+                delivered = False
                 with resp:
                     for line in resp:
                         if stop.is_set() or self._stop.is_set():
@@ -586,6 +593,13 @@ class RestClusterClient(ClusterClient):
                         ev = json.loads(line)
                         etype = ev.get("type", "")
                         if etype in ("ADDED", "MODIFIED", "DELETED"):
+                            # A delivered event is the only success
+                            # signal that resets the backoff: resetting
+                            # on relist or stream-open would hot-loop
+                            # when the watch persistently fails or
+                            # immediately returns ERROR (410 Gone).
+                            delivered = True
+                            backoff = 1.0
                             obj = _FROM_WIRE[kind](ev["object"])
                             key = (obj.metadata.namespace,
                                    obj.metadata.name)
@@ -595,7 +609,13 @@ class RestClusterClient(ClusterClient):
                                 known[key] = obj
                             handler(etype, obj)
                         elif etype == "ERROR":
-                            break
+                            raise RuntimeError(
+                                f"watch ERROR event: {ev.get('object')}")
+                if not delivered:
+                    # stream ended without a single event: back off so a
+                    # server that instantly EOFs can't drive a relist
+                    # hot loop
+                    raise RuntimeError("watch stream ended with no events")
             except Exception as e:
                 if stop.is_set() or self._stop.is_set():
                     return

@@ -35,6 +35,10 @@ DEFAULT_REGISTRAR_ROOT = "/var/lib/kubelet/plugins_registry"
 DEFAULT_CDI_ROOT = "/var/run/cdi"
 
 _KIND_BY_CLASS = {"chip": KIND_CHIP, "core": KIND_CORE, "slice": KIND_SLICE}
+# Cluster-level classes the controller handles; the plugin accepts and
+# ignores them so one DEVICE_CLASSES value serves both binaries (the
+# chart wires the same list into each).
+_CONTROLLER_CLASSES = {"rendezvous", "podslice"}
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -90,13 +94,17 @@ def validate(args: argparse.Namespace) -> None:
     if not args.node_name:
         raise SystemExit("--node-name (or NODE_NAME) is required")
     kinds = [k.strip() for k in args.device_classes.split(",") if k.strip()]
-    bad = [k for k in kinds if k not in _KIND_BY_CLASS]
+    bad = [k for k in kinds
+           if k not in _KIND_BY_CLASS and k not in _CONTROLLER_CLASSES]
     if bad:
-        raise SystemExit(f"unknown device class(es) {bad}; "
-                         f"valid: {sorted(_KIND_BY_CLASS)}")
-    if not kinds:
-        raise SystemExit("--device-classes must name at least one class")
-    args.device_kinds = tuple(_KIND_BY_CLASS[k] for k in kinds)
+        raise SystemExit(
+            f"unknown device class(es) {bad}; valid: "
+            f"{sorted(_KIND_BY_CLASS) + sorted(_CONTROLLER_CLASSES)}")
+    node_kinds = [k for k in kinds if k in _KIND_BY_CLASS]
+    if not node_kinds:
+        raise SystemExit("--device-classes must name at least one "
+                         "node-level class (chip, core, slice)")
+    args.device_kinds = tuple(_KIND_BY_CLASS[k] for k in node_kinds)
 
 
 def build_backend(args: argparse.Namespace):

@@ -22,6 +22,8 @@ class PreparedDevice:
     uuids: list[str] = dataclasses.field(default_factory=list)
     chip_indices: list[int] = dataclasses.field(default_factory=list)
     cdi_device_ids: list[str] = dataclasses.field(default_factory=list)
+    core_index: int = -1         # for kind == core (default keeps old
+                                 # checkpoints loadable)
 
     def to_json(self) -> dict[str, Any]:
         return dataclasses.asdict(self)

@@ -93,11 +93,10 @@ class CDIHandler:
         for chip in dev.chips:
             for path in chip.dev_paths:
                 edits.device_nodes.append(path)
-        if dev.kind == KIND_CORE:
-            # Sub-chip visibility: the runtime restricts the process to one
-            # TensorCore of the injected chip.
-            chip = dev.chips[0]
-            edits.env["TPU_VISIBLE_CORES"] = f"{chip.index}:{dev.core_index}"
+        # Core visibility env (TPU_VISIBLE_CORES) is claim-level only
+        # (claim_topology_edits): env merge across CDI devices is
+        # last-wins, so per-device values would drop cores whenever a
+        # claim holds more than one.
         return edits
 
     def _host_path(self, path: str) -> str:
@@ -180,6 +179,15 @@ def claim_topology_edits(prepared: PreparedClaim,
     edits = ContainerEdits()
     indices = sorted({i for d in prepared.devices for i in d.chip_indices})
     edits.env["TPU_VISIBLE_CHIPS"] = ",".join(str(i) for i in indices)
+    # Aggregate core visibility at claim level: per-device env would
+    # last-write-wins when a claim holds several cores (tpu-test4's
+    # matchAttribute-paired cores), so the claim spec carries the union.
+    cores = sorted({(d.chip_indices[0], d.core_index)
+                    for d in prepared.devices
+                    if d.kind == KIND_CORE and d.core_index >= 0})
+    if cores:
+        edits.env["TPU_VISIBLE_CORES"] = ",".join(
+            f"{c}:{j}" for c, j in cores)
     if host_bounds:
         edits.env["TPU_CHIPS_PER_HOST_BOUNDS"] = host_bounds
     for k, v in (slice_env or {}).items():

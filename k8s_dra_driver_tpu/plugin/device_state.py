@@ -146,7 +146,8 @@ class DeviceState:
                     device_name=dev.name, pool=res.pool,
                     uuids=dev.uuids,
                     chip_indices=sorted(c.index for c in dev.chips),
-                    cdi_device_ids=cdi_ids))
+                    cdi_device_ids=cdi_ids,
+                    core_index=dev.core_index))
         self._pending_edits = extra_edits
         return prepared
 

@@ -138,7 +138,9 @@ class TokenBucket:
         self._lock = threading.Lock()
 
     def acquire(self) -> None:
-        if self.qps <= 0:       # k8s convention: non-positive = unlimited
+        # k8s convention: non-positive qps = unlimited; burst < 1 would
+        # otherwise pin the bucket at zero tokens and spin forever.
+        if self.qps <= 0 or self.burst < 1:
             return
         while True:
             with self._lock:

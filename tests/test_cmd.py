@@ -61,6 +61,20 @@ class TestPluginFlags:
         plugin_cmd.validate(args)
         assert args.device_kinds == ("chip",)
 
+    def test_controller_classes_accepted_and_ignored(self):
+        """The chart wires one DEVICE_CLASSES list into both binaries;
+        the plugin must tolerate the controller-level entries."""
+        args = _parse_plugin(
+            ["--node-name", "n",
+             "--device-classes", "chip,core,slice,rendezvous,podslice"])
+        plugin_cmd.validate(args)
+        assert set(args.device_kinds) == {"chip", "core", "slice"}
+
+    def test_only_controller_classes_rejected(self):
+        with pytest.raises(SystemExit):
+            plugin_cmd.validate(_parse_plugin(
+                ["--node-name", "n", "--device-classes", "podslice"]))
+
 
 class TestPluginRun:
     def test_end_to_end_with_fake_topology(self, tmp_path):

@@ -1,0 +1,150 @@
+"""Deployment-layer checks: helm chart structure + demo tooling.
+
+helm itself isn't available hermetically, so templates are written to
+be YAML-parseable (templating only inside string values) and asserted
+structurally — catching the class of chart rot the reference only
+finds at install time.
+"""
+
+import re
+import subprocess
+from pathlib import Path
+
+import pytest
+import yaml
+
+REPO = Path(__file__).parent.parent
+CHART = REPO / "deployments" / "helm" / "tpu-dra-driver"
+
+
+def load_template(name: str) -> list[dict]:
+    """Parse a template: drop pure-template control lines, neutralize
+    inline {{ }} expressions into placeholder scalars."""
+    text = (CHART / "templates" / name).read_text()
+    kept = [re.sub(r"\{\{[^}]*\}\}", "TPL", l)
+            for l in text.splitlines()
+            if not re.match(r"^\s*\{\{", l)]
+    return [d for d in yaml.safe_load_all("\n".join(kept)) if d]
+
+
+def test_chart_metadata():
+    chart = yaml.safe_load((CHART / "Chart.yaml").read_text())
+    assert chart["name"] == "tpu-dra-driver"
+    values = yaml.safe_load((CHART / "values.yaml").read_text())
+    assert set(values["deviceClasses"]) == {
+        "chip", "core", "slice", "rendezvous", "podslice"}
+    assert values["namespace"] == "tpu-dra-driver"
+
+
+def test_daemonset_mounts_kubelet_contract():
+    (ds,) = load_template("kubeletplugin.yaml")
+    assert ds["kind"] == "DaemonSet"
+    spec = ds["spec"]["template"]["spec"]
+    (ctr,) = spec["containers"]
+    assert ctr["command"] == ["tpu-dra-plugin"]
+    assert ctr["securityContext"]["privileged"] is True
+    mount_paths = {m["mountPath"] for m in ctr["volumeMounts"]}
+    # kubelet plugin dir + registry + CDI + host view
+    assert "/var/lib/kubelet/plugins/tpu.google.com" in mount_paths
+    assert "/var/lib/kubelet/plugins_registry" in mount_paths
+    assert "/var/run/cdi" in mount_paths
+    assert "/host" in mount_paths
+    env = {e["name"] for e in ctr["env"]}
+    # every flag the binary reads from env is wired
+    for name in ("NODE_NAME", "PLUGIN_ROOT", "REGISTRAR_ROOT", "CDI_ROOT",
+                 "DRIVER_ROOT", "DEVICE_CLASSES", "COORDINATOR_NAMESPACE",
+                 "HTTP_ENDPOINT", "KUBE_API_QPS", "KUBE_API_BURST"):
+        assert name in env, f"DaemonSet missing env {name}"
+    host = {m["mountPath"]: m for m in ctr["volumeMounts"]}["/host"]
+    assert host.get("readOnly") is True
+
+
+def test_controller_deployment():
+    (dep,) = load_template("controller.yaml")
+    (ctr,) = dep["spec"]["template"]["spec"]["containers"]
+    assert ctr["command"] == ["tpu-dra-controller"]
+    env = {e["name"] for e in ctr["env"]}
+    for name in ("NAMESPACE", "POD_NAME", "DEVICE_CLASSES",
+                 "CHANNELS_PER_SLICE", "RETRY_DELAY_SECONDS"):
+        assert name in env
+
+
+def test_deviceclasses_match_code():
+    from k8s_dra_driver_tpu.api.classes import standard_device_classes
+    docs = load_template("deviceclasses.yaml")
+    in_chart = {d["metadata"]["name"]:
+                d["spec"]["selectors"][0]["cel"]["expression"]
+                for d in docs}
+    in_code = {name: cls.selectors[0].cel
+               for name, cls in standard_device_classes().items()}
+    assert set(in_chart) == set(in_code)
+    for name, cel in in_code.items():
+        # identical selector semantics, modulo whitespace
+        assert " ".join(in_chart[name].split()) == " ".join(cel.split()), \
+            f"chart CEL for {name} drifted from api/classes.py"
+
+
+def test_rbac_is_scoped_not_wildcard():
+    docs = load_template("rbac.yaml")
+    roles = [d for d in docs if d["kind"] in ("ClusterRole", "Role")]
+    assert roles
+    for role in roles:
+        for rule in role["rules"]:
+            assert rule["apiGroups"] != ["*"], "wildcard RBAC forbidden"
+            assert rule["resources"] != ["*"], "wildcard RBAC forbidden"
+            assert rule["verbs"] != ["*"], "wildcard RBAC forbidden"
+
+
+def test_demo_scripts_are_valid_bash():
+    scripts = list((REPO / "demo").rglob("*.sh"))
+    assert scripts, "demo scripts missing"
+    for script in scripts:
+        out = subprocess.run(["bash", "-n", str(script)],
+                             capture_output=True, text=True)
+        assert out.returncode == 0, f"{script}: {out.stderr}"
+
+
+def test_kind_config_enables_dra():
+    cfg = yaml.safe_load(
+        (REPO / "demo/clusters/kind/kind-cluster-config.yaml").read_text())
+    assert cfg["featureGates"]["DynamicResourceAllocation"] is True
+    assert cfg["runtimeConfig"]["resource.k8s.io/v1alpha3"] == "true"
+    assert any("enable_cdi = true" in p
+               for p in cfg["containerdConfigPatches"])
+    workers = [n for n in cfg["nodes"] if n["role"] == "worker"]
+    assert len(workers) == 2
+    for w in workers:
+        assert any(m["containerPath"] == "/faketpu"
+                   for m in w["extraMounts"])
+
+
+def test_all_quickstart_specs_parse_and_reference_claims():
+    spec_dir = REPO / "demo" / "specs" / "quickstart"
+    specs = sorted(spec_dir.glob("*.yaml"))
+    assert len(specs) >= 8
+    for path in specs:
+        docs = [d for d in yaml.safe_load_all(path.read_text()) if d]
+        claims = {d["metadata"]["name"] for d in docs
+                  if d["kind"] == "ResourceClaim"}
+        templates = {d["metadata"]["name"] for d in docs
+                     if d["kind"] == "ResourceClaimTemplate"}
+        pods = [d for d in docs if d["kind"] == "Pod"]
+        deps = [d for d in docs if d["kind"] == "Deployment"]
+        pod_specs = ([p["spec"] for p in pods]
+                     + [d["spec"]["template"]["spec"] for d in deps])
+        assert pod_specs, f"{path.name}: no workloads"
+        for ps in pod_specs:
+            for ref in ps.get("resourceClaims", []):
+                if "resourceClaimName" in ref:
+                    assert ref["resourceClaimName"] in claims, \
+                        f"{path.name}: dangling claim ref"
+                else:
+                    assert ref["resourceClaimTemplateName"] in templates, \
+                        f"{path.name}: dangling template ref"
+            # every container claim name is declared on the pod
+            declared = {r["name"] for r in ps.get("resourceClaims", [])}
+            for ctr in ps["containers"]:
+                for c in ctr.get("resources", {}).get("claims", []):
+                    assert c["name"] in declared, \
+                        f"{path.name}: container references undeclared " \
+                        f"claim {c['name']}"

@@ -53,7 +53,12 @@ class TestStandardSpec:
         spec = state.cdi.read_spec("tpu.google.com-chip.json")
         core = next(d for d in spec["devices"]
                     if d["name"] == "chip-1-core-0")
-        assert "TPU_VISIBLE_CORES=1:0" in core["containerEdits"]["env"]
+        # device node injected; TPU_VISIBLE_CORES is claim-level only
+        # (CDI env merge is last-wins across devices, so multi-core
+        # claims would otherwise lose cores)
+        assert {"path": "/dev/accel1"} in \
+            core["containerEdits"]["deviceNodes"]
+        assert "env" not in core["containerEdits"]
 
 
 class TestPrepareExclusive:

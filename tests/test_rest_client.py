@@ -415,6 +415,12 @@ class TestReviewRegressions:
         for _ in range(50):
             tb.acquire()   # would ZeroDivisionError before the fix
 
+    def test_token_bucket_zero_burst_does_not_hang(self):
+        from k8s_dra_driver_tpu.utils.flags import TokenBucket
+        tb = TokenBucket(qps=5, burst=0)
+        for _ in range(10):
+            tb.acquire()   # would spin forever before the fix
+
 
 class TestWatch:
     def test_watch_sees_initial_and_live_events(self, client):

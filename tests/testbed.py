@@ -137,7 +137,8 @@ class E2EBed:
                 node: str | None = None) -> PodView:
         """Schedule (if needed), prepare over gRPC, apply CDI."""
         if claim.status.allocation is None:
-            node = node or self.schedule(claim)
+            scheduled = self.schedule(claim)   # always allocate first
+            node = node or scheduled
         elif node is None:
             node = self.schedule(claim)
         driver = self.drivers[node]
