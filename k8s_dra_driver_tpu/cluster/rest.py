@@ -584,6 +584,7 @@ class RestClusterClient(ClusterClient):
                                     "&allowWatchBookmarks=false"),
                     stream=True, timeout=330)
                 delivered = False
+                stream_started = time.monotonic()
                 with resp:
                     for line in resp:
                         if stop.is_set() or self._stop.is_set():
@@ -611,11 +612,17 @@ class RestClusterClient(ClusterClient):
                         elif etype == "ERROR":
                             raise RuntimeError(
                                 f"watch ERROR event: {ev.get('object')}")
-                if not delivered:
-                    # stream ended without a single event: back off so a
-                    # server that instantly EOFs can't drive a relist
-                    # hot loop
-                    raise RuntimeError("watch stream ended with no events")
+                if delivered or \
+                        time.monotonic() - stream_started >= 30.0:
+                    # A long-lived stream is healthy even when idle (a
+                    # quiet cluster times out watch windows with zero
+                    # events); only an instant EOF indicates a broken
+                    # watch endpoint.
+                    backoff = 1.0
+                else:
+                    raise RuntimeError(
+                        "watch stream ended almost immediately with no "
+                        "events")
             except Exception as e:
                 if stop.is_set() or self._stop.is_set():
                     return

@@ -85,6 +85,11 @@ def build_parser() -> argparse.ArgumentParser:
                    default=env_default("FAKE_TOPOLOGY", ""),
                    help="path to a fake-host JSON spec; uses the hermetic "
                         "discovery backend [env FAKE_TOPOLOGY]")
+    p.add_argument("--discovery", choices=("sysfs", "native", "auto"),
+                   default=env_default("DISCOVERY", "sysfs"),
+                   help="enumeration backend: pure-Python sysfs parser, "
+                        "the C++ shim, or auto (native with sysfs "
+                        "fallback) [env DISCOVERY]")
     KubeClientConfig.add_flags(p)
     LoggingConfig.add_flags(p)
     return p
@@ -117,6 +122,15 @@ def build_backend(args: argparse.Namespace):
             spec["worker_hostnames"] = tuple(spec["worker_hostnames"])
         host = FakeHost(**spec)
         return host.materialize(Path(tempfile.mkdtemp(prefix="tpu-fake-")))
+    if args.discovery in ("native", "auto"):
+        from ..discovery.native import NativeBackend, NativeUnavailableError
+        try:
+            return NativeBackend(host_root=args.driver_root)
+        except NativeUnavailableError:
+            if args.discovery == "native":
+                raise
+            log.warning("native discovery unavailable; falling back to "
+                        "sysfs backend")
     from ..discovery import SysfsBackend
     return SysfsBackend(host_root=args.driver_root)
 

@@ -30,7 +30,15 @@ class PreparedDevice:
 
     @classmethod
     def from_json(cls, d: dict[str, Any]) -> "PreparedDevice":
-        return cls(**d)
+        dev = cls(**d)
+        if dev.kind == "core" and dev.core_index < 0:
+            # Checkpoint written before core_index existed: recover it
+            # from the device name ("chip-<i>-core-<j>") so restarted
+            # claims keep their TPU_VISIBLE_CORES injection.
+            _, _, tail = dev.device_name.rpartition("-core-")
+            if tail.isdigit():
+                dev.core_index = int(tail)
+        return dev
 
 
 @dataclasses.dataclass

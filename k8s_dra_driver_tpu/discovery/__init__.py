@@ -5,10 +5,12 @@ from .topology import (GENERATIONS, GiB, GenerationSpec, ICICoord, MeshShape,
 from .types import (ChipInfo, DiscoveryBackend, HostTopology, SliceMembership)
 from .sysfs import SysfsBackend, host_origin, parse_bounds
 from .fake import FakeHost, StaticBackend, fake_slice_hosts
+from .native import NativeBackend, NativeUnavailableError
 
 __all__ = [
     "GENERATIONS", "GiB", "GenerationSpec", "ICICoord", "MeshShape",
     "standard_slice_shapes", "ChipInfo", "DiscoveryBackend", "HostTopology",
     "SliceMembership", "SysfsBackend", "host_origin", "parse_bounds",
     "FakeHost", "StaticBackend", "fake_slice_hosts",
+    "NativeBackend", "NativeUnavailableError",
 ]

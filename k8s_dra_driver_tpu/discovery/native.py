@@ -1,0 +1,133 @@
+"""Native discovery backend: ctypes wrapper over native/tpudiscovery.cc.
+
+The C++ shim is the analog of the reference's native enumeration
+boundary (NVML via go-nvml, reference cmd/nvidia-dra-plugin/nvlib.go:
+59-63) — here it's a dependency-free sysfs/env parser compiled to
+``libtpudiscovery.so``. It must produce byte-identical facts to the
+pure-Python ``SysfsBackend``; tests/test_native_discovery.py enforces
+that. The generation table is passed in from ``topology.GENERATIONS``
+so Python stays the single source of truth.
+
+The wrapper builds the library on demand with g++ when no prebuilt one
+is found (override with ``TPU_DISCOVERY_LIB``); environments without a
+toolchain simply keep using ``SysfsBackend``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+from pathlib import Path
+
+from .topology import GENERATIONS, ICICoord, MeshShape
+from .types import ChipInfo, DiscoveryBackend, HostTopology, SliceMembership
+
+NATIVE_DIR = Path(__file__).parent.parent.parent / "native"
+DEFAULT_LIB = NATIVE_DIR / "build" / "libtpudiscovery.so"
+
+
+class NativeUnavailableError(RuntimeError):
+    pass
+
+
+def generations_spec() -> str:
+    """Serialize GENERATIONS for the shim (one `name|product|cores|hbm|
+    pci,...` line per generation)."""
+    lines = []
+    for g in GENERATIONS.values():
+        lines.append("|".join([
+            g.name, g.product_name, str(g.cores_per_chip),
+            str(g.hbm_bytes_per_chip), ",".join(g.pci_ids)]))
+    return "\n".join(lines)
+
+
+def ensure_built(source: Path | None = None,
+                 lib_path: Path | None = None) -> Path:
+    """Return a usable shared library, compiling it if needed."""
+    explicit = os.environ.get("TPU_DISCOVERY_LIB")
+    if explicit:
+        return Path(explicit)
+    source = source or (NATIVE_DIR / "tpudiscovery.cc")
+    lib_path = lib_path or DEFAULT_LIB
+    if lib_path.exists() and (not source.exists() or
+                              lib_path.stat().st_mtime >=
+                              source.stat().st_mtime):
+        return lib_path
+    if not source.exists():
+        raise NativeUnavailableError(f"shim source missing: {source}")
+    cmd = ["g++", "-O2", "-Wall", "-std=c++17", "-fPIC", "-shared",
+           "-o", str(lib_path), str(source)]
+    try:
+        lib_path.parent.mkdir(parents=True, exist_ok=True)
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        # read-only filesystems etc. must fall through to the sysfs
+        # backend under --discovery auto
+        raise NativeUnavailableError(f"cannot build shim: {e}") from e
+    if out.returncode != 0:
+        raise NativeUnavailableError(
+            f"shim compile failed:\n{out.stderr[-2000:]}")
+    return lib_path
+
+
+class NativeBackend(DiscoveryBackend):
+    def __init__(self, host_root: str = "/",
+                 env: dict[str, str] | None = None,
+                 hostname: str | None = None,
+                 lib_path: str | Path | None = None):
+        self.root = str(host_root)
+        self.env = dict(os.environ) if env is None else dict(env)
+        if hostname:
+            self.env["HOSTNAME"] = hostname
+        path = Path(lib_path) if lib_path else ensure_built()
+        try:
+            self._lib = ctypes.CDLL(str(path))
+        except OSError as e:
+            raise NativeUnavailableError(f"cannot load {path}: {e}") from e
+        self._lib.tpu_discover.restype = ctypes.c_int
+        self._lib.tpu_discover.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_char_p, ctypes.c_size_t]
+
+    def _call(self) -> dict:
+        gens = generations_spec().encode()
+        env = "\n".join(f"{k}={v}" for k, v in self.env.items()).encode()
+        size = 1 << 16
+        for _ in range(2):
+            buf = ctypes.create_string_buffer(size)
+            rc = self._lib.tpu_discover(self.root.encode(), gens, env,
+                                        buf, size)
+            if rc < 0:
+                raise RuntimeError(
+                    f"tpu_discover: {buf.value.decode(errors='replace')}")
+            if rc <= size:
+                return json.loads(buf.value.decode())
+            size = rc           # buffer too small: retry at needed size
+        raise RuntimeError("tpu_discover: buffer negotiation failed")
+
+    def enumerate(self) -> HostTopology:
+        data = self._call()
+        slice_info = None
+        if data["slice"] is not None:
+            s = data["slice"]
+            slice_info = SliceMembership(
+                slice_id=s["slice_id"],
+                topology=MeshShape(*s["topology"]),
+                worker_id=s["worker_id"],
+                num_workers=s["num_workers"],
+                host_bounds=MeshShape(*s["host_bounds"]),
+                coordinator_address=s["coordinator_address"])
+        chips = tuple(
+            ChipInfo(index=c["index"], uuid=c["uuid"],
+                     generation=GENERATIONS[c["generation"]],
+                     coord=ICICoord(*c["coord"]),
+                     dev_paths=tuple(c["dev_paths"]),
+                     pci_address=c["pci_address"],
+                     numa_node=c["numa_node"])
+            for c in data["chips"])
+        return HostTopology(hostname=data["hostname"], chips=chips,
+                            libtpu_path=data["libtpu_path"],
+                            slice=slice_info)

@@ -132,15 +132,16 @@ class TokenBucket:
 
     def __init__(self, qps: float = 5.0, burst: int = 10):
         self.qps = qps
-        self.burst = burst
-        self._tokens = float(burst)
+        # burst < 1 would pin the bucket at zero tokens and spin
+        # forever; clamp to 1 so the qps limit still applies
+        # (client-go rejects burst<1 outright).
+        self.burst = max(burst, 1) if qps > 0 else burst
+        self._tokens = float(self.burst)
         self._last = time.monotonic()
         self._lock = threading.Lock()
 
     def acquire(self) -> None:
-        # k8s convention: non-positive qps = unlimited; burst < 1 would
-        # otherwise pin the bucket at zero tokens and spin forever.
-        if self.qps <= 0 or self.burst < 1:
+        if self.qps <= 0:       # k8s convention: non-positive = unlimited
             return
         while True:
             with self._lock:

@@ -1,0 +1,462 @@
+// TPU sysfs/env discovery — native implementation.
+//
+// The TPU-native analog of the reference's NVML boundary: where the
+// reference dlopen's libnvidia-ml.so.1 for enumeration (reference
+// cmd/nvidia-dra-plugin/nvlib.go:59-63, root.go:29-45), TPU chips are
+// plain Linux accel devices, so the native layer is a self-contained
+// sysfs/env parser. This shim exists for agents that cannot embed the
+// Python backend (future native runtimes, early-boot checks) and must
+// produce byte-identical facts to discovery/sysfs.py — the conformance
+// test (tests/test_native_discovery.py) diffs the two outputs field by
+// field.
+//
+// Contract (C ABI, see tpu_discover below):
+//   host_root  — filesystem prefix ("/" or a /host mount)
+//   gens_spec  — generation table, one per line:
+//                name|product|cores|hbm_bytes|pci_id[,pci_id...]
+//                (canonical source: discovery/topology.py GENERATIONS)
+//   env_spec   — environment, KEY=VALUE lines (only TPU_* + HOSTNAME
+//                are read)
+//   out/out_len— JSON result buffer; returns required length, or -1 on
+//                error (error text in out)
+//
+// Output JSON mirrors HostTopology: {hostname, libtpu_path, slice:
+// {...}|null, chips: [{index, uuid, generation, coord:[x,y,z],
+// dev_paths, pci_address, numa_node}]}.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <dirent.h>
+#include <fstream>
+#include <map>
+#include <sstream>
+#include <string>
+#include <sys/stat.h>
+#include <vector>
+
+#include <limits.h>
+#include <stdlib.h>
+#include <unistd.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// SHA-256 (for serial-less UUID fallback; must match Python hashlib)
+// ---------------------------------------------------------------------------
+
+struct Sha256 {
+  uint32_t h[8] = {0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+                   0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19};
+  uint64_t bits = 0;
+  unsigned char block[64];
+  size_t fill = 0;
+
+  static uint32_t rotr(uint32_t v, int n) {
+    return (v >> n) | (v << (32 - n));
+  }
+
+  void compress(const unsigned char *p) {
+    static const uint32_t K[64] = {
+        0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b,
+        0x59f111f1, 0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01,
+        0x243185be, 0x550c7dc3, 0x72be5d74, 0x80deb1fe, 0x9bdc06a7,
+        0xc19bf174, 0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+        0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da, 0x983e5152,
+        0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+        0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc,
+        0x53380d13, 0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+        0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3, 0xd192e819,
+        0xd6990624, 0xf40e3585, 0x106aa070, 0x19a4c116, 0x1e376c08,
+        0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f,
+        0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+        0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2};
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++)
+      w[i] = (uint32_t(p[i * 4]) << 24) | (uint32_t(p[i * 4 + 1]) << 16) |
+             (uint32_t(p[i * 4 + 2]) << 8) | uint32_t(p[i * 4 + 3]);
+    for (int i = 16; i < 64; i++) {
+      uint32_t s0 = rotr(w[i - 15], 7) ^ rotr(w[i - 15], 18) ^ (w[i - 15] >> 3);
+      uint32_t s1 = rotr(w[i - 2], 17) ^ rotr(w[i - 2], 19) ^ (w[i - 2] >> 10);
+      w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3], e = h[4], f = h[5],
+             g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+      uint32_t S1 = rotr(e, 6) ^ rotr(e, 11) ^ rotr(e, 25);
+      uint32_t ch = (e & f) ^ (~e & g);
+      uint32_t t1 = hh + S1 + ch + K[i] + w[i];
+      uint32_t S0 = rotr(a, 2) ^ rotr(a, 13) ^ rotr(a, 22);
+      uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+      uint32_t t2 = S0 + maj;
+      hh = g; g = f; f = e; e = d + t1;
+      d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+  }
+
+  void update(const void *data, size_t len) {
+    const unsigned char *p = static_cast<const unsigned char *>(data);
+    bits += uint64_t(len) * 8;
+    while (len > 0) {
+      size_t take = std::min(len, sizeof(block) - fill);
+      memcpy(block + fill, p, take);
+      fill += take; p += take; len -= take;
+      if (fill == sizeof(block)) { compress(block); fill = 0; }
+    }
+  }
+
+  std::string hexdigest() {
+    uint64_t total = bits;
+    block[fill++] = 0x80;
+    if (fill > 56) {
+      memset(block + fill, 0, sizeof(block) - fill);
+      compress(block);
+      fill = 0;
+    }
+    memset(block + fill, 0, 56 - fill);
+    for (int i = 0; i < 8; i++)
+      block[56 + i] = (total >> (56 - 8 * i)) & 0xff;
+    compress(block);
+    char out[65];
+    for (int i = 0; i < 8; i++) snprintf(out + i * 8, 9, "%08x", h[i]);
+    return std::string(out, 64);
+  }
+};
+
+std::string sha256_hex(const std::string &s) {
+  Sha256 d;
+  d.update(s.data(), s.size());
+  return d.hexdigest();
+}
+
+// ---------------------------------------------------------------------------
+// small helpers
+// ---------------------------------------------------------------------------
+
+const char *kGooglePciVendor = "0x1ae0";
+
+std::string read_file_trim(const std::string &path) {
+  std::ifstream f(path);
+  if (!f.good()) return "";
+  std::stringstream ss;
+  ss << f.rdbuf();
+  std::string s = ss.str();
+  while (!s.empty() && (s.back() == '\n' || s.back() == '\r' ||
+                        s.back() == ' ' || s.back() == '\t'))
+    s.pop_back();
+  size_t i = 0;
+  while (i < s.size() && (s[i] == ' ' || s[i] == '\t')) i++;
+  return s.substr(i);
+}
+
+std::string lower(std::string s) {
+  std::transform(s.begin(), s.end(), s.begin(), ::tolower);
+  return s;
+}
+
+bool starts_with(const std::string &s, const std::string &pre) {
+  return s.rfind(pre, 0) == 0;
+}
+
+std::vector<std::string> split(const std::string &s, char sep) {
+  std::vector<std::string> out;
+  std::stringstream ss(s);
+  std::string item;
+  while (std::getline(ss, item, sep)) out.push_back(item);
+  return out;
+}
+
+std::string json_escape(const std::string &s) {
+  std::string out;
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
+struct Generation {
+  std::string name, product;
+  int cores = 1;
+  long long hbm = 0;
+  std::vector<std::string> pci_ids;
+};
+
+struct Shape { int x = 1, y = 1, z = 1; int n() const { return x * y * z; } };
+
+bool parse_bounds(const std::string &s, Shape *out) {
+  // "2,2,1" style
+  auto parts = split(s, ',');
+  if (parts.empty() || parts.size() > 3) return false;
+  int v[3] = {1, 1, 1};
+  for (size_t i = 0; i < parts.size(); i++) {
+    v[i] = atoi(parts[i].c_str());
+    if (v[i] < 1) return false;
+  }
+  out->x = v[0]; out->y = v[1]; out->z = v[2];
+  return true;
+}
+
+bool parse_shape(const std::string &s, Shape *out) {
+  // "4x4" / "2x2x4" style, else bounds style
+  if (s.find('x') == std::string::npos) return parse_bounds(s, out);
+  auto parts = split(s, 'x');
+  if (parts.empty() || parts.size() > 3) return false;
+  int v[3] = {1, 1, 1};
+  for (size_t i = 0; i < parts.size(); i++) {
+    v[i] = atoi(parts[i].c_str());
+    if (v[i] < 1) return false;
+  }
+  out->x = v[0]; out->y = v[1]; out->z = v[2];
+  return true;
+}
+
+// Worker's host-box origin; x-fastest tiling, same as
+// discovery/sysfs.py host_origin.
+void host_origin(int worker_id, const Shape &hb, const Shape &topo,
+                 int *ox, int *oy, int *oz) {
+  int hx = std::max(topo.x / hb.x, 1);
+  int hy = std::max(topo.y / hb.y, 1);
+  *ox = (worker_id % hx) * hb.x;
+  *oy = ((worker_id / hx) % hy) * hb.y;
+  *oz = (worker_id / (hx * hy)) * hb.z;
+}
+
+const char *kLibtpuSearch[] = {
+    "usr/lib/libtpu.so",
+    "usr/local/lib/libtpu.so",
+    "lib/libtpu.so",
+    "home/kubernetes/bin/libtpu.so",
+};
+
+bool file_exists(const std::string &p) {
+  struct stat st;
+  return stat(p.c_str(), &st) == 0;
+}
+
+bool dir_exists(const std::string &p) {
+  struct stat st;
+  return stat(p.c_str(), &st) == 0 && S_ISDIR(st.st_mode);
+}
+
+}  // namespace
+
+extern "C" int tpu_discover(const char *host_root_c, const char *gens_spec,
+                            const char *env_spec, char *out,
+                            size_t out_len) {
+  std::string root = host_root_c ? host_root_c : "/";
+  while (root.size() > 1 && root.back() == '/') root.pop_back();
+  if (root.empty()) root = "/";
+  auto rooted = [&](const std::string &rel) {
+    return (root == "/" ? "" : root) + "/" + rel;
+  };
+
+  // -- parse inputs --------------------------------------------------------
+  std::vector<Generation> gens;
+  for (const auto &line : split(gens_spec ? gens_spec : "", '\n')) {
+    if (line.empty()) continue;
+    auto f = split(line, '|');
+    if (f.size() != 5) {
+      snprintf(out, out_len, "bad generation line: %s", line.c_str());
+      return -1;
+    }
+    Generation g;
+    g.name = f[0]; g.product = f[1];
+    g.cores = atoi(f[2].c_str());
+    g.hbm = atoll(f[3].c_str());
+    for (auto &id : split(f[4], ',')) g.pci_ids.push_back(lower(id));
+    gens.push_back(g);
+  }
+  std::map<std::string, std::string> env;
+  for (const auto &line : split(env_spec ? env_spec : "", '\n')) {
+    auto eq = line.find('=');
+    if (eq == std::string::npos) continue;
+    env[line.substr(0, eq)] = line.substr(eq + 1);
+  }
+  auto getenv_s = [&](const char *k) -> std::string {
+    auto it = env.find(k);
+    return it == env.end() ? "" : it->second;
+  };
+
+  std::string hostname = getenv_s("HOSTNAME");
+  if (hostname.empty()) {
+    char buf[256] = {0};
+    gethostname(buf, sizeof(buf) - 1);
+    hostname = buf;
+  }
+
+  // -- slice membership (sysfs.py _slice_membership) -----------------------
+  bool have_slice = false;
+  std::string slice_id = getenv_s("TPU_SLICE_ID");
+  if (slice_id.empty()) slice_id = getenv_s("MEGASCALE_SLICE_ID");
+  std::string topo_s = getenv_s("TPU_TOPOLOGY");
+  if (topo_s.empty()) topo_s = getenv_s("TPU_HOST_BOUNDS");
+  Shape topology, host_bounds{2, 2, 1};
+  int worker_id = 0, num_workers = 1;
+  std::vector<std::string> worker_hostnames;
+  std::string coordinator;
+  std::string hb_env = getenv_s("TPU_CHIPS_PER_HOST_BOUNDS");
+  if (!hb_env.empty() && !parse_bounds(hb_env, &host_bounds)) {
+    snprintf(out, out_len, "bad TPU_CHIPS_PER_HOST_BOUNDS: %s",
+             hb_env.c_str());
+    return -1;
+  }
+  if (!topo_s.empty() && !slice_id.empty()) {
+    if (!parse_shape(topo_s, &topology)) {
+      snprintf(out, out_len, "bad TPU_TOPOLOGY: %s", topo_s.c_str());
+      return -1;
+    }
+    have_slice = true;
+    worker_id = atoi(getenv_s("TPU_WORKER_ID").c_str());
+    for (auto &h : split(getenv_s("TPU_WORKER_HOSTNAMES"), ','))
+      if (!h.empty()) worker_hostnames.push_back(h);
+    num_workers = worker_hostnames.empty()
+                      ? std::max(topology.n() / host_bounds.n(), 1)
+                      : int(worker_hostnames.size());
+    if (!worker_hostnames.empty()) coordinator = worker_hostnames[0];
+  }
+  int ox = 0, oy = 0, oz = 0;
+  if (have_slice) host_origin(worker_id, host_bounds, topology, &ox, &oy, &oz);
+
+  // -- libtpu (sysfs.py _libtpu_path) --------------------------------------
+  std::string libtpu = getenv_s("LIBTPU_INIT_PATH");
+  if (libtpu.empty()) libtpu = getenv_s("TPU_LIBRARY_PATH");
+  if (libtpu.empty()) {
+    for (const char *rel : kLibtpuSearch) {
+      if (file_exists(rooted(rel))) {
+        libtpu = std::string("/") + rel;
+        break;
+      }
+    }
+  }
+
+  // -- chip enumeration (sysfs.py enumerate) -------------------------------
+  struct Chip {
+    int index; std::string uuid, gen; int cx, cy, cz;
+    std::vector<std::string> dev_paths;
+    std::string pci; int numa;
+  };
+  std::vector<Chip> chips;
+  std::string accel_base = rooted("sys/class/accel");
+  if (dir_exists(accel_base)) {
+    std::vector<int> indices;
+    DIR *d = opendir(accel_base.c_str());
+    if (d) {
+      while (dirent *e = readdir(d)) {
+        std::string name = e->d_name;
+        if (starts_with(name, "accel") && name.size() > 5)
+          indices.push_back(atoi(name.c_str() + 5));
+      }
+      closedir(d);
+    }
+    std::sort(indices.begin(), indices.end());
+
+    std::string decl = getenv_s("TPU_ACCELERATOR_TYPE");
+    for (int index : indices) {
+      std::string device_dir =
+          accel_base + "/accel" + std::to_string(index) + "/device";
+      std::string vendor = lower(read_file_trim(device_dir + "/vendor"));
+      if (!vendor.empty() && vendor != kGooglePciVendor) continue;
+      std::string dev_id = lower(read_file_trim(device_dir + "/device"));
+      const Generation *gen = nullptr;
+      for (const auto &g : gens)
+        for (const auto &id : g.pci_ids)
+          if (id == dev_id) { gen = &g; break; }
+      if (!gen && !decl.empty()) {
+        for (const auto &g : gens)
+          if (starts_with(decl, g.name) || starts_with(decl, g.product)) {
+            gen = &g;
+            break;
+          }
+      }
+      if (!gen) continue;
+
+      char resolved[PATH_MAX];
+      std::string pci;
+      if (realpath(device_dir.c_str(), resolved)) {
+        pci = resolved;
+        auto slash = pci.find_last_of('/');
+        if (slash != std::string::npos) pci = pci.substr(slash + 1);
+      }
+      std::string numa_s = read_file_trim(device_dir + "/numa_node");
+      int numa = numa_s.empty() ? -1 : atoi(numa_s.c_str());
+      std::string serial = read_file_trim(device_dir + "/serial_number");
+      std::string uuid;
+      if (!serial.empty()) {
+        uuid = "TPU-" + gen->name + "-" + serial;
+      } else {
+        std::string key =
+            hostname + "/" + pci + "/" + std::to_string(index);
+        uuid = "TPU-" + gen->name + "-" + sha256_hex(key).substr(0, 16);
+      }
+      int lx = index % host_bounds.x;
+      int ly = (index / host_bounds.x) % host_bounds.y;
+      int lz = index / (host_bounds.x * host_bounds.y);
+      Chip c;
+      c.index = index; c.uuid = uuid; c.gen = gen->name;
+      c.cx = ox + lx; c.cy = oy + ly; c.cz = oz + lz;
+      c.dev_paths.push_back("/dev/accel" + std::to_string(index));
+      if (file_exists(rooted("dev/vfio/" + std::to_string(index))))
+        c.dev_paths.push_back("/dev/vfio/" + std::to_string(index));
+      c.pci = pci; c.numa = numa;
+      chips.push_back(c);
+    }
+  }
+
+  // -- JSON out -------------------------------------------------------------
+  std::ostringstream js;
+  js << "{\"hostname\":\"" << json_escape(hostname) << "\","
+     << "\"libtpu_path\":\"" << json_escape(libtpu) << "\",";
+  if (have_slice) {
+    js << "\"slice\":{\"slice_id\":\"" << json_escape(slice_id) << "\","
+       << "\"topology\":[" << topology.x << "," << topology.y << ","
+       << topology.z << "],"
+       << "\"worker_id\":" << worker_id << ","
+       << "\"num_workers\":" << num_workers << ","
+       << "\"host_bounds\":[" << host_bounds.x << "," << host_bounds.y
+       << "," << host_bounds.z << "],"
+       << "\"coordinator_address\":\"" << json_escape(coordinator)
+       << "\"},";
+  } else {
+    js << "\"slice\":null,";
+  }
+  js << "\"chips\":[";
+  for (size_t i = 0; i < chips.size(); i++) {
+    const Chip &c = chips[i];
+    if (i) js << ",";
+    js << "{\"index\":" << c.index << ",\"uuid\":\"" << json_escape(c.uuid)
+       << "\",\"generation\":\"" << json_escape(c.gen) << "\","
+       << "\"coord\":[" << c.cx << "," << c.cy << "," << c.cz << "],"
+       << "\"dev_paths\":[";
+    for (size_t j = 0; j < c.dev_paths.size(); j++) {
+      if (j) js << ",";
+      js << "\"" << json_escape(c.dev_paths[j]) << "\"";
+    }
+    js << "],\"pci_address\":\"" << json_escape(c.pci) << "\","
+       << "\"numa_node\":" << c.numa << "}";
+  }
+  js << "]}";
+
+  std::string result = js.str();
+  if (result.size() + 1 > out_len)
+    return static_cast<int>(result.size() + 1);
+  memcpy(out, result.c_str(), result.size() + 1);
+  return static_cast<int>(result.size() + 1);
+}
+
+extern "C" const char *tpu_discover_version() { return "tpudiscovery/0.1.0"; }
