@@ -1,0 +1,35 @@
+#!/usr/bin/env bash
+# Create a GKE alpha cluster with DRA enabled and a v5e TPU node pool —
+# the analog of the reference's GKE tooling (reference
+# demo/clusters/gke/create-cluster.sh: --enable-kubernetes-alpha,
+# node version 1.31), re-cut for TPU node pools.
+set -euo pipefail
+
+PROJECT="${PROJECT:?set PROJECT}"
+ZONE="${ZONE:-us-east5-b}"
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+CLUSTER_VERSION="${CLUSTER_VERSION:-1.31}"
+# v5e 4x4 pod slice: 4 hosts x 4 chips (ct5lp-hightpu-4t)
+TPU_MACHINE="${TPU_MACHINE:-ct5lp-hightpu-4t}"
+TPU_TOPOLOGY="${TPU_TOPOLOGY:-4x4}"
+
+gcloud container clusters create "$CLUSTER_NAME" \
+  --project "$PROJECT" --zone "$ZONE" \
+  --cluster-version "$CLUSTER_VERSION" \
+  --enable-kubernetes-alpha \
+  --no-enable-autorepair --no-enable-autoupgrade \
+  --release-channel rapid \
+  --machine-type e2-standard-4 \
+  --num-nodes 1
+
+gcloud container node-pools create tpu-pool \
+  --project "$PROJECT" --zone "$ZONE" \
+  --cluster "$CLUSTER_NAME" \
+  --machine-type "$TPU_MACHINE" \
+  --tpu-topology "$TPU_TOPOLOGY" \
+  --num-nodes 4
+
+echo "Cluster ready. Install the driver:"
+echo "  helm upgrade --install tpu-dra-driver \\"
+echo "    deployments/helm/tpu-dra-driver \\"
+echo "    --namespace tpu-dra-driver --create-namespace"

@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+set -euo pipefail
+PROJECT="${PROJECT:?set PROJECT}"
+ZONE="${ZONE:-us-east5-b}"
+CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
+gcloud container clusters delete "$CLUSTER_NAME" \
+  --project "$PROJECT" --zone "$ZONE" --quiet

@@ -577,11 +577,16 @@ class RestClusterClient(ClusterClient):
                     if key not in seen:
                         handler("DELETED", obj)
                 known = seen
+                # timeoutSeconds makes the server end quiet watch
+                # windows gracefully (EOF) before our 330s client read
+                # timeout — otherwise an idle stream always surfaces as
+                # socket.timeout and takes the failure path below.
                 resp = self._request(
                     "GET",
                     self._url(kind,
                               query=f"watch=true&resourceVersion={rv}"
-                                    "&allowWatchBookmarks=false"),
+                                    "&allowWatchBookmarks=false"
+                                    "&timeoutSeconds=300"),
                     stream=True, timeout=330)
                 delivered = False
                 stream_started = time.monotonic()

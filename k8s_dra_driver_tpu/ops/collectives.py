@@ -16,9 +16,33 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def allreduce_bandwidth(size_mb: float = 64.0, iters: int = 10,
+def _best_time(fn, arg, reps: int = 4) -> float:
+    """Best-of-N wall time of ``float(fn(arg + k))``.
+
+    Scalar readback is the only reliable synchronization point
+    (remote-relay PJRT backends complete block_until_ready early), and
+    a fresh input each rep defeats whole-execution memoization.
+    """
+    float(fn(arg))                      # compile + warm
+    best = None
+    for rep in range(reps):
+        a2 = arg + float(rep + 1)
+        start = time.perf_counter()
+        float(fn(a2))
+        t = time.perf_counter() - start
+        best = t if best is None else min(best, t)
+    return best
+
+
+def allreduce_bandwidth(size_mb: float = 64.0, iters: int = 16,
                         devices: list | None = None) -> dict:
-    """Time an all-reduce over all devices; returns GB/s + latency."""
+    """Time an all-reduce over all devices; returns GB/s + latency.
+
+    Differential timing: two chained programs of different lengths are
+    timed and the marginal per-op cost taken from their difference, so
+    the fixed per-dispatch overhead (large on tunneled/remote backends)
+    cancels instead of polluting the bandwidth number.
+    """
     devices = devices if devices is not None else jax.devices()
     n = len(devices)
     mesh = Mesh(np.asarray(devices), ("all",))
@@ -28,32 +52,41 @@ def allreduce_bandwidth(size_mb: float = 64.0, iters: int = 10,
 
     inv = jnp.float32(1.0 / max(n, 1))
 
-    # iters dependent all-reduces inside one program (see matmul_tflops
-    # for why chaining is required for honest timing).
-    def local(s):
-        def body(_, y):
-            return jax.lax.psum(y, "all") * inv
-        return jax.lax.fori_loop(0, iters, body, s)
+    def make(iters):
+        def local(s):
+            def body(_, y):
+                return jax.lax.psum(y, "all") * inv
+            return jax.lax.fori_loop(0, iters, body, s)
 
-    shard_fn = jax.shard_map(local, mesh=mesh, in_specs=P("all"),
-                             out_specs=P("all"), check_vma=False)
+        shard_fn = jax.shard_map(local, mesh=mesh, in_specs=P("all"),
+                                 out_specs=P("all"), check_vma=False)
 
-    # The timed program returns a scalar that the host reads back:
-    # device→host readback is the only reliable synchronization point
-    # (remote-relay PJRT backends complete block_until_ready early), and
-    # a fresh input defeats whole-execution memoization.
-    @jax.jit
-    def ar(x):
-        return jnp.sum(shard_fn(x))
+        @jax.jit
+        def ar(x):
+            return jnp.sum(shard_fn(x))
+        return ar
 
-    float(ar(x))                        # compile + warm
-    elapsed = None
-    for rep in range(3):                # best-of-3 to shed transport noise
-        x2 = x + float(rep + 1)
-        start = time.perf_counter()
-        float(ar(x2))
-        t = (time.perf_counter() - start) / iters
-        elapsed = t if elapsed is None else min(elapsed, t)
+    short = max(iters // 4, 1)
+    long_fn, short_fn = make(iters), make(short)
+    # Median of 3 differential trials (like matmul_tflops): a single
+    # difference over few ops can go negative under transport jitter,
+    # which would otherwise clamp into an absurd bandwidth.
+    marginals, t_short_last, t_long_last = [], 0.0, 0.0
+    for _ in range(3):
+        t_short_last = _best_time(short_fn, x)
+        t_long_last = _best_time(long_fn, x)
+        if iters > short:
+            marginals.append((t_long_last - t_short_last)
+                             / (iters - short))
+        else:
+            marginals.append(t_long_last / iters)
+    marginals.sort()
+    elapsed = marginals[len(marginals) // 2]
+    valid = elapsed > 0
+    if not valid:
+        # jitter swamped the differential: fall back to the absolute
+        # (overhead-included, conservative) per-op time
+        elapsed = t_long_last / iters
 
     bytes_moved = nelems * 4
     # ring allreduce moves 2*(n-1)/n of the payload per device
@@ -62,37 +95,53 @@ def allreduce_bandwidth(size_mb: float = 64.0, iters: int = 10,
         "devices": n,
         "size_mb": bytes_moved / 1e6,
         "seconds": elapsed,
+        "valid": valid,
+        "dispatch_overhead_ms": max(
+            (t_short_last - elapsed * short) * 1000, 0.0),
         "gbps": bytes_moved * algo_factor / elapsed / 1e9,
     }
 
 
-def matmul_tflops(dim: int = 4096, iters: int = 50,
+def matmul_tflops(dim: int = 4096, iters: int = 400,
                   dtype=jnp.bfloat16) -> dict:
-    """MXU utilization probe: timed square matmul."""
+    """MXU utilization probe: timed square matmul.
+
+    Each chain is one jit program with data dependencies between
+    iterations (no dedupe/overlap possible; the per-iteration rescale
+    keeps bf16 finite without changing the matmul count), and the
+    reported rate is the *marginal* cost between a long and a short
+    chain — fixed per-dispatch overhead, ~100 ms on tunneled backends,
+    cancels in the difference instead of capping the result at a few
+    percent of peak.
+    """
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (dim, dim), dtype)
     b = jax.random.normal(key, (dim, dim), dtype)
 
-    # The whole timed chain is one jit program with data dependencies
-    # between iterations, so the backend can neither dedupe identical
-    # dispatches nor overlap them; rescaling keeps bf16 finite without
-    # changing the matmul count.
-    @jax.jit
-    def chain(a, b):
-        def body(_, x):
-            y = x @ b
-            return y * (jnp.float32(1.0) / dim).astype(y.dtype)
-        return jnp.sum(jax.lax.fori_loop(0, iters, body, a))
+    def make(iters):
+        @jax.jit
+        def chain(a):            # b closed over: _best_time feeds one arg
+            def body(_, x):
+                y = x @ b
+                return y * (jnp.float32(1.0) / dim).astype(y.dtype)
+            return jnp.sum(jax.lax.fori_loop(0, iters, body, a))
+        return chain
 
-    # scalar readback = true sync; fresh input = no memoized execution
-    # (see allreduce_bandwidth); best-of-3 sheds transport noise
-    float(chain(a, b))
-    elapsed = None
-    for rep in range(3):
-        a2 = a + float(rep + 1)
-        start = time.perf_counter()
-        float(chain(a2, b))
-        t = (time.perf_counter() - start) / iters
-        elapsed = t if elapsed is None else min(elapsed, t)
+    short = max(iters // 4, 1)
+    long_fn, short_fn = make(iters), make(short)
+
+    # Median of several differential trials: single differences are
+    # noisy when transport jitter is comparable to the compute delta.
+    marginals = []
+    for _ in range(3):
+        t_short = _best_time(short_fn, a, reps=3)
+        t_long = _best_time(long_fn, a, reps=3)
+        if iters > short:
+            marginals.append(max((t_long - t_short) / (iters - short),
+                                 1e-9))
+        else:
+            marginals.append(t_long / iters)
+    marginals.sort()
+    elapsed = marginals[len(marginals) // 2]
     return {"dim": dim, "seconds": elapsed,
             "tflops": 2 * dim ** 3 / elapsed / 1e12}
