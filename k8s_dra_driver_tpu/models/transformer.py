@@ -165,6 +165,10 @@ def _attention(x, layer, cfg: TransformerConfig, mesh: Mesh | None):
     v = jnp.einsum("btd,dhk->bthk", x, layer["wv"])
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
         o = ring_attention(q, k, v, mesh, causal=True)
+    elif jax.default_backend() == "tpu":
+        # fused pallas kernel on hardware (ops/flash_attention.py)
+        from ..ops.flash_attention import flash_attention
+        o = flash_attention(q, k, v, causal=True)
     else:
         o = attention_reference(q, k, v, causal=True).astype(x.dtype)
     return jnp.einsum("bthk,hkd->btd", o, layer["wo"])

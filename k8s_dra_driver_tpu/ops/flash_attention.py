@@ -1,0 +1,208 @@
+"""Pallas TPU flash-attention block kernel.
+
+The MXU-resident inner loop of (ring) attention: one fused kernel
+computes unnormalized attention of a Q shard against one K/V block with
+flash-style online softmax, so the ``[B,H,Tq,Tk]`` score tensor never
+touches HBM — scores live in VMEM tiles, the two matmuls hit the MXU,
+and the kernel returns the running statistics ``(o_unnorm, m, l)`` that
+ring attention merges across ICI hops (ops/ring_attention.py).
+
+Grid: one program per (batch*head, q-block); the K/V block is streamed
+through VMEM in ``block_k`` tiles inside a ``fori_loop`` carrying the
+(acc, m, l) statistics as values. Causal masking uses absolute
+positions (``q_offset``/``k_offset``) so the same kernel serves every
+ring step. Tile sizes respect the bf16 (16,128)/f32 (8,128) minimums
+(pallas_guide.md "Tiling Constraints").
+
+On non-TPU backends the kernel runs in interpreter mode, so the
+hermetic CPU test suite exercises the exact same code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref,
+                  o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr, *,
+                  n_k: int, scale: float, causal: bool):
+    """One (batch*head, q-block, k-block) program.
+
+    K is a grid dimension so pallas double-buffers the K/V block DMAs
+    against compute (pallas_guide.md "Patterns: Double Buffering" — the
+    in-kernel fori_loop variant stalls on each tile fetch). The flash
+    statistics persist across the sequential innermost k dimension in
+    VMEM scratch; outputs are written on the last k step.
+
+    Ref shapes: q [1, bq, D]; k/v [1, bk, D]; qoff/koff [1, 1] scalar
+    offsets in SMEM; outputs o [1, bq, D] (f32, unnormalized),
+    m/l [1, bq, 128] (f32, lane-broadcast stats); scratch acc [bq, D],
+    m/l [bq, 128].
+    """
+    j = pl.program_id(2)
+    bq = q_ref.shape[1]
+    block_k = k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # absolute positions: shard offset + block start + row/col
+    q_start = qoff_ref[0, 0] + pl.program_id(1) * bq
+    k_start = koff_ref[0, 0] + j * block_k
+
+    # Causal fast path: skip blocks entirely above the diagonal.
+    run = (q_start + bq - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _update():
+        # MXU inputs stay in the source dtype (bf16 runs at full MXU
+        # rate); accumulation is f32 via preferred_element_type.
+        s = jax.lax.dot_general(
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            mask = q_pos >= k_pos
+            s = jnp.where(mask, s, _NEG_INF)
+        m = m_scr[:, :1]                              # [bq, 1]
+        l = l_scr[:, :1]
+        m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        if causal:
+            p = jnp.where(mask, p, 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == n_k - 1)
+    def _done():
+        o_ref[0] = acc_scr[:]
+        m_ref[0] = m_scr[:]
+        l_ref[0] = l_scr[:]
+
+
+def _pick_block(t: int, target: int) -> int:
+    """Largest divisor of ``t`` that is <= target (>=1)."""
+    b = min(target, t)
+    while t % b:
+        b -= 1
+    return b
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def flash_block_attention(q, k, v, q_offset, k_offset, *,
+                          causal: bool = True, scale: float | None = None,
+                          block_q: int = 256, block_k: int = 512,
+                          interpret: bool | None = None):
+    """Unnormalized flash attention of q against one K/V block.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; q_offset/k_offset: scalar
+    absolute positions of the blocks (for causal masking across ring
+    steps). Returns ``(o_unnorm [B,Tq,H,D] f32, m [B,H,Tq] f32,
+    l [B,H,Tq] f32)`` — the flash running statistics, mergeable with
+    other blocks' outputs.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    b_, tq, h, d = q.shape
+    tk = k.shape[1]
+    bq = _pick_block(tq, block_q)
+    bk = _pick_block(tk, block_k)
+
+    # [B,T,H,D] -> [B*H, T, D]
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(b_ * h, x.shape[1], d)
+
+    qf, kf, vf = flat(q), flat(k), flat(v)
+    # scalar offsets ride in SMEM (same for every program)
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
+    koff = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
+
+    n_k = tk // bk
+    grid = (b_ * h, tq // bq, n_k)
+    kernel = functools.partial(_flash_kernel, n_k=n_k, scale=scale,
+                               causal=causal)
+    o, m, l = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda bh, i, j: (bh, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b_ * h, tq, d), jnp.float32),
+            jax.ShapeDtypeStruct((b_ * h, tq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b_ * h, tq, 128), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, qoff, koff)
+
+    # [B*H, Tq, D] -> [B, Tq, H, D];  stats -> [B, H, Tq]
+    o = o.reshape(b_, h, tq, d).transpose(0, 2, 1, 3)
+    m = m[:, :, 0].reshape(b_, h, tq)
+    l = l[:, :, 0].reshape(b_, h, tq)
+    return o, m, l
+
+
+def merge_flash_stats(o, m, l, o_blk, m_blk, l_blk):
+    """Merge a block's (o_unnorm, m, l) into the running statistics —
+    the cross-block half of online softmax (ring step merge).
+
+    o/o_blk: [B,Tq,H,D] f32 (unnormalized); m/l: [B,H,Tq] f32.
+    """
+    m_new = jnp.maximum(m, m_blk)
+    corr = jnp.exp(m - m_new)
+    corr_blk = jnp.exp(m_blk - m_new)
+    l_new = l * corr + l_blk * corr_blk
+    o_new = (o * corr.transpose(0, 2, 1)[..., None]
+             + o_blk * corr_blk.transpose(0, 2, 1)[..., None])
+    return o_new, m_new, l_new
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: float | None = None,
+                    interpret: bool | None = None):
+    """Full single-device flash attention, normalized.
+
+    Drop-in for attention_reference without the HBM score tensor.
+    """
+    o, m, l = flash_block_attention(q, k, v, 0, 0, causal=causal,
+                                    scale=scale, interpret=interpret)
+    l = jnp.maximum(l, 1e-30)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)

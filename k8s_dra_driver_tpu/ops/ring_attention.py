@@ -56,7 +56,7 @@ def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale):
 
 
 def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
-                          scale: float):
+                          scale: float, use_flash: bool):
     """Per-shard body; call inside shard_map with sequence sharded on
     ``axis_name``."""
     ring_size = jax.lax.psum(1, axis_name)
@@ -75,8 +75,18 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
     def body(step, carry):
         o, m, l, k_blk, v_blk = carry
         k_idx = (my_idx + step) % ring_size
-        o, m, l = _block_update(q, k_blk, v_blk, o, m, l,
-                                q_offset, k_idx * t_local, causal, scale)
+        if use_flash:
+            # fused pallas kernel for the block compute: scores stay in
+            # VMEM, matmuls on the MXU (ops/flash_attention.py)
+            from .flash_attention import (flash_block_attention,
+                                          merge_flash_stats)
+            o_blk, m_blk, l_blk = flash_block_attention(
+                q, k_blk, v_blk, q_offset, k_idx * t_local,
+                causal=causal, scale=scale)
+            o, m, l = merge_flash_stats(o, m, l, o_blk, m_blk, l_blk)
+        else:
+            o, m, l = _block_update(q, k_blk, v_blk, o, m, l, q_offset,
+                                    k_idx * t_local, causal, scale)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return (o, m, l, k_blk, v_blk)
@@ -90,19 +100,27 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    *, axis_name: str = "sp", causal: bool = True,
                    scale: float | None = None,
                    batch_axes=("dp", "ep"),
-                   head_axis: str | None = "tp") -> jax.Array:
+                   head_axis: str | None = "tp",
+                   use_flash: bool | None = None) -> jax.Array:
     """Exact attention with sequence sharded over ``axis_name``.
 
     q/k/v: [batch, seq, heads, head_dim] global shapes.  Batch is
     sharded over ``batch_axes``, heads over ``head_axis``, sequence over
     ``axis_name`` — the full dp/ep × sp × tp layout.
+
+    ``use_flash`` selects the pallas block kernel for the per-step
+    compute (default: on for TPU backends; the pure-XLA path elsewhere —
+    pallas interpret mode is exercised by tests but too slow for real
+    CPU workloads).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if use_flash is None:
+        use_flash = jax.default_backend() == "tpu"
     spec = P(batch_axes, axis_name, head_axis, None)
     fn = jax.shard_map(
         functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, use_flash=use_flash),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
