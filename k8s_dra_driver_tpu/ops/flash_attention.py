@@ -1,4 +1,4 @@
-"""Pallas TPU flash-attention block kernel.
+"""Pallas TPU flash-attention block kernel + differentiable wrapper.
 
 The MXU-resident inner loop of (ring) attention: one fused kernel
 computes unnormalized attention of a Q shard against one K/V block with
@@ -12,7 +12,20 @@ through VMEM in ``block_k`` tiles inside a ``fori_loop`` carrying the
 (acc, m, l) statistics as values. Causal masking uses absolute
 positions (``q_offset``/``k_offset``) so the same kernel serves every
 ring step. Tile sizes respect the bf16 (16,128)/f32 (8,128) minimums
-(pallas_guide.md "Tiling Constraints").
+(pallas_guide.md "Tiling Constraints"); sequence lengths that are not
+tile multiples are zero-padded up and the padded key columns masked
+in-kernel, so odd/prime lengths compile instead of degenerating to
+1-wide blocks.
+
+Differentiation: ``pl.pallas_call`` has no JVP rule, so the pallas
+kernel is forward-only. ``flash_attention`` (the normalized public
+entry point) carries a ``jax.custom_vjp`` implementing the standard
+flash backward — recompute ``p = exp(s - L)`` from the saved logsumexp
+``L = m + log l``, then the five backward matmuls — chunked over K so
+the full score matrix never materializes. The per-block kernel's ``m``
+is a numerical stabilizer only (the normalized output is invariant to
+it), so the backward treats it as ``stop_gradient`` exactly like the
+max-shift in a stable softmax.
 
 On non-TPU backends the kernel runs in interpreter mode, so the
 hermetic CPU test suite exercises the exact same code path.
@@ -29,10 +42,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 
+# Minimum second-to-last-dim tiles (pallas_guide.md): bf16 wants 16
+# sublanes, f32 wants 8; the lane dim is always 128. Q blocks are
+# (bq, d) tiles, K blocks appear as the 128-lane dim of the score tile.
+_Q_TILE = 16
+_K_TILE = 128
+
 
 def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref,
                   o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr, *,
-                  n_k: int, scale: float, causal: bool):
+                  n_k: int, scale: float, causal: bool, k_valid: int):
     """One (batch*head, q-block, k-block) program.
 
     K is a grid dimension so pallas double-buffers the K/V block DMAs
@@ -44,11 +63,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref,
     Ref shapes: q [1, bq, D]; k/v [1, bk, D]; qoff/koff [1, 1] scalar
     offsets in SMEM; outputs o [1, bq, D] (f32, unnormalized),
     m/l [1, bq, 128] (f32, lane-broadcast stats); scratch acc [bq, D],
-    m/l [bq, 128].
+    m/l [bq, 128]. ``k_valid`` is the unpadded key count: local key
+    indices >= k_valid are zero padding and masked out.
     """
     j = pl.program_id(2)
     bq = q_ref.shape[1]
     block_k = k_ref.shape[1]
+    padded = k_valid < n_k * block_k
 
     @pl.when(j == 0)
     def _init():
@@ -70,18 +91,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref,
         s = jax.lax.dot_general(
             q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
+        mask = None
         if causal:
             q_pos = q_start + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 0)
             k_pos = k_start + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             mask = q_pos >= k_pos
+        if padded:
+            k_local = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (bq, block_k), 1)
+            valid = k_local < k_valid
+            mask = valid if mask is None else (mask & valid)
+        if mask is not None:
             s = jnp.where(mask, s, _NEG_INF)
         m = m_scr[:, :1]                              # [bq, 1]
         l = l_scr[:, :1]
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=1, keepdims=True)
@@ -98,12 +126,31 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref,
         l_ref[0] = l_scr[:]
 
 
-def _pick_block(t: int, target: int) -> int:
-    """Largest divisor of ``t`` that is <= target (>=1)."""
-    b = min(target, t)
-    while t % b:
-        b -= 1
-    return b
+def _round_up(n: int, k: int) -> int:
+    return -(-n // k) * k
+
+
+def _block_and_pad(t: int, target: int, tile: int) -> tuple[int, int]:
+    """Pick a tile-aligned block size and the padded length it divides.
+
+    Returns ``(block, t_padded)`` with ``block`` a multiple of ``tile``
+    (<= target) and ``t_padded`` a multiple of ``block`` — so odd/prime
+    ``t`` pads up to a tileable shape instead of degenerating to a
+    1-wide block that violates the TPU minimum-tile constraints.
+    """
+    if target % tile:
+        raise ValueError(f"block target {target} not a multiple of "
+                         f"min tile {tile}")
+    block = min(target, _round_up(t, tile))
+    return block, _round_up(t, block)
+
+
+def _pad_seq(x, t_pad: int):
+    """Zero-pad [B, T, H, D] to T=t_pad."""
+    t = x.shape[1]
+    if t == t_pad:
+        return x
+    return jnp.pad(x, ((0, 0), (0, t_pad - t), (0, 0), (0, 0)))
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
@@ -119,6 +166,9 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
     steps). Returns ``(o_unnorm [B,Tq,H,D] f32, m [B,H,Tq] f32,
     l [B,H,Tq] f32)`` — the flash running statistics, mergeable with
     other blocks' outputs.
+
+    Forward-only (no autodiff rule): differentiate through
+    ``flash_attention`` / ``ring_attention`` which carry custom VJPs.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -127,8 +177,11 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
 
     b_, tq, h, d = q.shape
     tk = k.shape[1]
-    bq = _pick_block(tq, block_q)
-    bk = _pick_block(tk, block_k)
+    bq, tq_pad = _block_and_pad(tq, block_q, _Q_TILE)
+    bk, tk_pad = _block_and_pad(tk, block_k, _K_TILE)
+    q = _pad_seq(q, tq_pad)
+    k = _pad_seq(k, tk_pad)
+    v = _pad_seq(v, tk_pad)
 
     # [B,T,H,D] -> [B*H, T, D]
     def flat(x):
@@ -139,10 +192,10 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
     qoff = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
     koff = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
 
-    n_k = tk // bk
-    grid = (b_ * h, tq // bq, n_k)
+    n_k = tk_pad // bk
+    grid = (b_ * h, tq_pad // bq, n_k)
     kernel = functools.partial(_flash_kernel, n_k=n_k, scale=scale,
-                               causal=causal)
+                               causal=causal, k_valid=tk)
     o, m, l = pl.pallas_call(
         kernel,
         grid=grid,
@@ -159,9 +212,9 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
             pl.BlockSpec((1, bq, 128), lambda bh, i, j: (bh, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b_ * h, tq, d), jnp.float32),
-            jax.ShapeDtypeStruct((b_ * h, tq, 128), jnp.float32),
-            jax.ShapeDtypeStruct((b_ * h, tq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b_ * h, tq_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((b_ * h, tq_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((b_ * h, tq_pad, 128), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -173,10 +226,10 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
         interpret=interpret,
     )(qf, kf, vf, qoff, koff)
 
-    # [B*H, Tq, D] -> [B, Tq, H, D];  stats -> [B, H, Tq]
-    o = o.reshape(b_, h, tq, d).transpose(0, 2, 1, 3)
-    m = m[:, :, 0].reshape(b_, h, tq)
-    l = l[:, :, 0].reshape(b_, h, tq)
+    # [B*H, Tq, D] -> [B, Tq, H, D];  stats -> [B, H, Tq]; drop padding
+    o = o.reshape(b_, h, tq_pad, d).transpose(0, 2, 1, 3)[:, :tq]
+    m = m[:, :, 0].reshape(b_, h, tq_pad)[:, :, :tq]
+    l = l[:, :, 0].reshape(b_, h, tq_pad)[:, :, :tq]
     return o, m, l
 
 
@@ -195,14 +248,142 @@ def merge_flash_stats(o, m, l, o_blk, m_blk, l_blk):
     return o_new, m_new, l_new
 
 
-def flash_attention(q, k, v, *, causal: bool = True,
-                    scale: float | None = None,
-                    interpret: bool | None = None):
-    """Full single-device flash attention, normalized.
+# --------------------------------------------------------------------------
+# Backward (shared with ring_attention): standard flash backward on one
+# K/V block, p recomputed from the saved logsumexp.
+# --------------------------------------------------------------------------
 
-    Drop-in for attention_reference without the HBM score tensor.
+def attention_block_grads(q, k, v, do, delta, lse, q_offset, k_offset,
+                          causal: bool, scale: float,
+                          k_valid_end: int | None = None):
+    """Flash backward against one K/V block (pure XLA, f32 math).
+
+    q/do [B,Tq,H,D]; k/v [B,Tk,H,D]; delta [B,H,Tq] = rowsum(do*o)
+    with o the *normalized* output; lse [B,H,Tq] = m + log(l) over the
+    FULL key range (not just this block). Offsets are the blocks'
+    absolute positions. Returns (dq, dk, dv) f32 contributions of this
+    block — dq partial over K blocks, dk/dv complete for this block.
+    ``k_valid_end``: absolute key positions >= this are zero padding
+    and masked out (for tail-padded chunking).
+
+    Math (stabilizer max treated as stop_gradient, standard for
+    softmax): p = exp(s - lse); dv = p^T do; dp = do v^T;
+    ds = p * (dp - delta) * scale; dq = ds k; dk = ds^T q.
     """
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    p = jnp.exp(s - lse[..., None])                       # [B,H,Tq,Tk]
+    tq, tk = q.shape[1], k.shape[1]
+    k_pos = k_offset + jnp.arange(tk)
+    mask = None
+    if causal:
+        q_pos = q_offset + jnp.arange(tq)
+        mask = q_pos[:, None] >= k_pos[None, :]
+    if k_valid_end is not None:
+        valid = (k_pos < k_valid_end)[None, :]
+        mask = valid if mask is None else (mask & valid)
+    if mask is not None:
+        p = jnp.where(mask[None, None], p, 0.0)
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vf)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, kf)
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+    return dq, dk, dv
+
+
+def normalize_flash_stats(o, m, l):
+    """Flash epilogue: (o_unnorm, m, l) -> (o_normalized f32, lse).
+
+    Shared by flash_attention and ring_attention so the l-clamp and
+    the lse definition cannot diverge between them.
+    """
+    l = jnp.maximum(l, 1e-30)
+    out = o / l.transpose(0, 2, 1)[..., None]
+    return out, m + jnp.log(l)
+
+
+def attention_delta(do, out):
+    """delta_i = rowsum(do_i * o_i), the softmax-jacobian correction
+    term of the flash backward; [B,Tq,H,D] x2 -> [B,H,Tq] f32."""
+    return jnp.einsum("bqhd,bqhd->bhq", do.astype(jnp.float32),
+                      out.astype(jnp.float32))
+
+
+# --------------------------------------------------------------------------
+# Normalized single-device flash attention, differentiable.
+# --------------------------------------------------------------------------
+
+def _flash_forward(q, k, v, causal, scale, interpret):
+    """Normalized output + logsumexp (the flash residual pair)."""
     o, m, l = flash_block_attention(q, k, v, 0, 0, causal=causal,
                                     scale=scale, interpret=interpret)
-    l = jnp.maximum(l, 1e-30)
-    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    out, lse = normalize_flash_stats(o, m, l)
+    return out.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention(q, k, v, causal, scale, interpret, block_k):
+    return _flash_forward(q, k, v, causal, scale, interpret)[0]
+
+
+def _flash_attention_fwd(q, k, v, causal, scale, interpret, block_k):
+    out, lse = _flash_forward(q, k, v, causal, scale, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_attention_bwd(causal, scale, interpret, block_k, res, do):
+    q, k, v, out, lse = res
+    tk = k.shape[1]
+    delta = attention_delta(do, out)
+    # Tail-pad K/V to a chunk multiple and mask the padded key columns
+    # (k_valid_end) so non-divisible lengths stay chunked instead of
+    # collapsing to one full-width score matrix.
+    ck = min(block_k, _round_up(tk, _K_TILE))
+    tk_pad = _round_up(tk, ck)
+    kp, vp = _pad_seq(k, tk_pad), _pad_seq(v, tk_pad)
+    n_chunks = tk_pad // ck
+    k_valid_end = tk if tk_pad != tk else None
+
+    def body(carry, idx):
+        dq = carry
+        k_blk = jax.lax.dynamic_slice_in_dim(kp, idx * ck, ck, axis=1)
+        v_blk = jax.lax.dynamic_slice_in_dim(vp, idx * ck, ck, axis=1)
+        dq_c, dk_c, dv_c = attention_block_grads(
+            q, k_blk, v_blk, do, delta, lse, 0, idx * ck, causal, scale,
+            k_valid_end=k_valid_end)
+        return dq + dq_c, (dk_c, dv_c)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq, (dk_chunks, dv_chunks) = jax.lax.scan(
+        body, dq0, jnp.arange(n_chunks))
+    # [n_chunks, B, ck, H, D] -> [B, Tk_pad, H, D] -> drop tail padding
+    dk = jnp.moveaxis(dk_chunks, 0, 1).reshape(
+        k.shape[0], tk_pad, *k.shape[2:])[:, :tk]
+    dv = jnp.moveaxis(dv_chunks, 0, 1).reshape(
+        v.shape[0], tk_pad, *v.shape[2:])[:, :tk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    scale: float | None = None,
+                    interpret: bool | None = None,
+                    block_k: int = 512):
+    """Full single-device flash attention, normalized + differentiable.
+
+    Drop-in for attention_reference without the HBM score tensor:
+    forward is the pallas kernel, backward the K-chunked flash backward
+    via ``jax.custom_vjp`` (fixes round-1 `_pallas_call_jvp_rule`
+    crash — pallas has no autodiff rule of its own).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    return _flash_attention(q, k, v, causal, scale, interpret, block_k)

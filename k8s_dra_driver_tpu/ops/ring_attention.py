@@ -13,8 +13,19 @@ IMEX channels exist to serve (cross-device memory export for big
 models): instead of exporting memory, shard the sequence and move K/V
 blocks over ICI.
 
-No data-dependent Python control flow — the ring loop is a
-``lax.fori_loop`` with static trip count, jit/pjit-safe.
+Differentiation is a ``jax.custom_vjp`` on the per-shard body: the
+forward ring saves only the normalized output and the logsumexp
+``L = m + log l`` per query row; the backward is a SECOND ring pass in
+which the (k, v, dk, dv) quartet rotates — each step recomputes
+``p = exp(s - L)`` against the visiting block (standard flash
+backward, ops/flash_attention.py:attention_block_grads) and after S
+hops the dk/dv accumulators arrive back home complete.  Memory stays
+O(T/S) per device; plain autodiff through the forward loop would have
+saved every visiting K/V block (O(T) per device) — and would crash
+anyway, since the pallas forward kernel has no JVP rule.
+
+No data-dependent Python control flow — the ring loops are
+``lax.fori_loop``s with static trip count, jit/pjit-safe.
 """
 
 from __future__ import annotations
@@ -23,7 +34,12 @@ import functools
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import mesh_platform
+from .flash_attention import (attention_block_grads, attention_delta,
+                              flash_block_attention, merge_flash_stats,
+                              normalize_flash_stats)
 
 _NEG_INF = -1e30
 
@@ -55,10 +71,14 @@ def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale):
     return o_new, m_new, l_new
 
 
-def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
-                          scale: float, use_flash: bool):
-    """Per-shard body; call inside shard_map with sequence sharded on
-    ``axis_name``."""
+def _ring_perm(ring_size: int) -> list[tuple[int, int]]:
+    # device i receives the block of device (i+1) each step, so after
+    # `step` hops it holds block (i + step) % S.
+    return [(j, (j - 1) % ring_size) for j in range(ring_size)]
+
+
+def _ring_forward(q, k, v, axis_name, causal, scale, use_flash, interpret):
+    """Forward ring pass. Returns (o [B,Tq,H,D] q.dtype, lse [B,H,Tq])."""
     ring_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
@@ -67,10 +87,7 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
     o = jnp.zeros(q.shape, jnp.float32)
     m = jnp.full((q.shape[0], q.shape[2], q.shape[1]), _NEG_INF, jnp.float32)
     l = jnp.zeros((q.shape[0], q.shape[2], q.shape[1]), jnp.float32)
-
-    # device i receives the block of device (i+1) each step, so after
-    # `step` hops it holds block (i + step) % S.
-    perm = [(j, (j - 1) % ring_size) for j in range(ring_size)]
+    perm = _ring_perm(ring_size)
 
     def body(step, carry):
         o, m, l, k_blk, v_blk = carry
@@ -78,11 +95,9 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
         if use_flash:
             # fused pallas kernel for the block compute: scores stay in
             # VMEM, matmuls on the MXU (ops/flash_attention.py)
-            from .flash_attention import (flash_block_attention,
-                                          merge_flash_stats)
             o_blk, m_blk, l_blk = flash_block_attention(
                 q, k_blk, v_blk, q_offset, k_idx * t_local,
-                causal=causal, scale=scale)
+                causal=causal, scale=scale, interpret=interpret)
             o, m, l = merge_flash_stats(o, m, l, o_blk, m_blk, l_blk)
         else:
             o, m, l = _block_update(q, k_blk, v_blk, o, m, l, q_offset,
@@ -92,8 +107,81 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool,
         return (o, m, l, k_blk, v_blk)
 
     o, m, l, _, _ = jax.lax.fori_loop(0, ring_size, body, (o, m, l, k, v))
-    l = jnp.maximum(l, 1e-30)
-    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+    out, lse = normalize_flash_stats(o, m, l)
+    return out.astype(q.dtype), lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def _ring_attention_local(axis_name, causal, scale, use_flash, interpret,
+                          q, k, v):
+    """Per-shard body; call inside shard_map with sequence sharded on
+    ``axis_name``."""
+    return _ring_forward(q, k, v, axis_name, causal, scale, use_flash,
+                         interpret)[0]
+
+
+def _ring_attention_local_fwd(axis_name, causal, scale, use_flash,
+                              interpret, q, k, v):
+    out, lse = _ring_forward(q, k, v, axis_name, causal, scale, use_flash,
+                             interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_attention_local_bwd(axis_name, causal, scale, use_flash,
+                              interpret, res, do):
+    q, k, v, out, lse = res
+    ring_size = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    t_local = q.shape[1]
+    q_offset = my_idx * t_local
+    perm = _ring_perm(ring_size)
+
+    delta = attention_delta(do, out)
+
+    def body(step, carry):
+        dq, k_blk, v_blk, dk_blk, dv_blk = carry
+        k_idx = (my_idx + step) % ring_size
+        k_offset = k_idx * t_local
+
+        def block(args):
+            k_blk, v_blk = args
+            return attention_block_grads(q, k_blk, v_blk, do, delta, lse,
+                                         q_offset, k_offset, causal, scale)
+
+        def skip(args):
+            return (jnp.zeros(q.shape, jnp.float32),
+                    jnp.zeros(k_blk.shape, jnp.float32),
+                    jnp.zeros(v_blk.shape, jnp.float32))
+
+        if causal:
+            # visiting blocks entirely above the diagonal contribute
+            # all-zero grads — skip their five matmuls (the backward
+            # mirror of the forward kernel's `run` fast path)
+            dq_c, dk_c, dv_c = jax.lax.cond(
+                q_offset + t_local - 1 >= k_offset, block, skip,
+                (k_blk, v_blk))
+        else:
+            dq_c, dk_c, dv_c = block((k_blk, v_blk))
+        dq = dq + dq_c
+        dk_blk = dk_blk + dk_c
+        dv_blk = dv_blk + dv_c
+        # rotate the quartet together: after ring_size hops the dk/dv
+        # accumulators land back on the block's home device, complete.
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        dk_blk = jax.lax.ppermute(dk_blk, axis_name, perm)
+        dv_blk = jax.lax.ppermute(dv_blk, axis_name, perm)
+        return (dq, k_blk, v_blk, dk_blk, dv_blk)
+
+    zeros = jnp.zeros(k.shape, jnp.float32)
+    dq, _, _, dk, dv = jax.lax.fori_loop(
+        0, ring_size, body,
+        (jnp.zeros(q.shape, jnp.float32), k, v, zeros, zeros))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attention_local.defvjp(_ring_attention_local_fwd,
+                             _ring_attention_local_bwd)
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
@@ -109,18 +197,22 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     ``axis_name`` — the full dp/ep × sp × tp layout.
 
     ``use_flash`` selects the pallas block kernel for the per-step
-    compute (default: on for TPU backends; the pure-XLA path elsewhere —
-    pallas interpret mode is exercised by tests but too slow for real
-    CPU workloads).
+    forward compute (default: on when the *mesh's devices* are TPUs —
+    not the process default backend; the pure-XLA path elsewhere.
+    Pallas interpret mode is exercised by tests but too slow for real
+    CPU workloads).  Fully differentiable either way via the ring
+    custom VJP.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    platform = mesh_platform(mesh)
     if use_flash is None:
-        use_flash = jax.default_backend() == "tpu"
+        use_flash = platform == "tpu"
+    interpret = platform != "tpu"
     spec = P(batch_axes, axis_name, head_axis, None)
     fn = jax.shard_map(
-        functools.partial(_ring_attention_local, axis_name=axis_name,
-                          causal=causal, scale=scale, use_flash=use_flash),
+        functools.partial(_ring_attention_local, axis_name, causal, scale,
+                          use_flash, interpret),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)
