@@ -8,6 +8,7 @@ algorithmic bus bandwidth reported the way collective benchmarks do
 
 from __future__ import annotations
 
+import functools
 import time
 
 import jax
@@ -32,6 +33,32 @@ def _best_time(fn, arg, reps: int = 4) -> float:
         t = time.perf_counter() - start
         best = t if best is None else min(best, t)
     return best
+
+
+def _differential_median(long_fn, short_fn, arg, iters: int, short: int,
+                         trials: int = 3, reps: int = 3):
+    """Median marginal per-op time between a long and a short chain.
+
+    Fixed per-dispatch overhead (large on tunneled/remote backends)
+    cancels in the difference. A non-positive median means transport
+    jitter swamped the differential; fall back to the absolute
+    (overhead-included, conservative) per-op time and flag it
+    ``valid=False``. Returns (elapsed_seconds, valid, t_short_last).
+    """
+    marginals, t_short, t_long = [], 0.0, 0.0
+    for _ in range(trials):
+        t_short = _best_time(short_fn, arg, reps=reps)
+        t_long = _best_time(long_fn, arg, reps=reps)
+        if iters > short:
+            marginals.append((t_long - t_short) / (iters - short))
+        else:
+            marginals.append(t_long / iters)
+    marginals.sort()
+    elapsed = marginals[len(marginals) // 2]
+    valid = elapsed > 0
+    if not valid:
+        elapsed = t_long / iters
+    return elapsed, valid, t_short
 
 
 def allreduce_bandwidth(size_mb: float = 64.0, iters: int = 16,
@@ -68,25 +95,8 @@ def allreduce_bandwidth(size_mb: float = 64.0, iters: int = 16,
 
     short = max(iters // 4, 1)
     long_fn, short_fn = make(iters), make(short)
-    # Median of 3 differential trials (like matmul_tflops): a single
-    # difference over few ops can go negative under transport jitter,
-    # which would otherwise clamp into an absurd bandwidth.
-    marginals, t_short_last, t_long_last = [], 0.0, 0.0
-    for _ in range(3):
-        t_short_last = _best_time(short_fn, x)
-        t_long_last = _best_time(long_fn, x)
-        if iters > short:
-            marginals.append((t_long_last - t_short_last)
-                             / (iters - short))
-        else:
-            marginals.append(t_long_last / iters)
-    marginals.sort()
-    elapsed = marginals[len(marginals) // 2]
-    valid = elapsed > 0
-    if not valid:
-        # jitter swamped the differential: fall back to the absolute
-        # (overhead-included, conservative) per-op time
-        elapsed = t_long_last / iters
+    elapsed, valid, t_short_last = _differential_median(
+        long_fn, short_fn, x, iters, short, reps=4)
 
     bytes_moved = nelems * 4
     # ring allreduce moves 2*(n-1)/n of the payload per device
@@ -99,6 +109,60 @@ def allreduce_bandwidth(size_mb: float = 64.0, iters: int = 16,
         "dispatch_overhead_ms": max(
             (t_short_last - elapsed * short) * 1000, 0.0),
         "gbps": bytes_moved * algo_factor / elapsed / 1e9,
+    }
+
+
+def attention_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
+                    head_dim: int = 64, iters: int = 32,
+                    dtype=jnp.bfloat16, interpret: bool | None = None) -> dict:
+    """Flash (pallas) vs naive (XLA) causal attention on the device.
+
+    The fused-kernel half of the BASELINE workload story: same chained
+    differential-timing scheme as matmul_tflops so per-dispatch
+    overhead cancels. Reports ms/call and achieved TFLOPs for both
+    paths plus the speedup ratio.
+    """
+    from .flash_attention import flash_attention
+    from .ring_attention import attention_reference
+
+    key = jax.random.PRNGKey(0)
+    shape = (batch, seq, heads, head_dim)
+    q = jax.random.normal(key, shape, dtype)
+    k = jax.random.normal(jax.random.PRNGKey(1), shape, dtype)
+    v = jax.random.normal(jax.random.PRNGKey(2), shape, dtype)
+
+    def make(attn, iters):
+        @jax.jit
+        def chain(q):
+            def body(_, x):
+                y = attn(x, k, v)
+                return (y * (jnp.float32(0.5)).astype(y.dtype)
+                        + x * (jnp.float32(0.5)).astype(x.dtype))
+            return jnp.sum(jax.lax.fori_loop(0, iters, body, q)
+                           .astype(jnp.float32))
+        return chain
+
+    def measure(attn):
+        short = max(iters // 4, 1)
+        long_fn, short_fn = make(attn, iters), make(attn, short)
+        elapsed, valid, _ = _differential_median(
+            long_fn, short_fn, q, iters, short)
+        return elapsed, valid
+
+    flash = functools.partial(flash_attention, causal=True,
+                              interpret=interpret)
+    naive = functools.partial(attention_reference, causal=True)
+    t_flash, flash_valid = measure(flash)
+    t_naive, naive_valid = measure(naive)
+    # causal attention: 2 matmuls x B*H*T^2*D MACs, half masked out
+    flops = 2 * 2 * batch * heads * seq * seq * head_dim * 0.5
+    return {
+        "batch": batch, "seq": seq, "heads": heads, "head_dim": head_dim,
+        "flash_ms": t_flash * 1000, "naive_ms": t_naive * 1000,
+        "flash_tflops": flops / t_flash / 1e12,
+        "naive_tflops": flops / t_naive / 1e12,
+        "speedup": t_naive / t_flash,
+        "valid": flash_valid and naive_valid,
     }
 
 
@@ -129,19 +193,7 @@ def matmul_tflops(dim: int = 4096, iters: int = 400,
 
     short = max(iters // 4, 1)
     long_fn, short_fn = make(iters), make(short)
-
-    # Median of several differential trials: single differences are
-    # noisy when transport jitter is comparable to the compute delta.
-    marginals = []
-    for _ in range(3):
-        t_short = _best_time(short_fn, a, reps=3)
-        t_long = _best_time(long_fn, a, reps=3)
-        if iters > short:
-            marginals.append(max((t_long - t_short) / (iters - short),
-                                 1e-9))
-        else:
-            marginals.append(t_long / iters)
-    marginals.sort()
-    elapsed = marginals[len(marginals) // 2]
-    return {"dim": dim, "seconds": elapsed,
+    elapsed, valid, _ = _differential_median(
+        long_fn, short_fn, a, iters, short)
+    return {"dim": dim, "seconds": elapsed, "valid": valid,
             "tflops": 2 * dim ** 3 / elapsed / 1e12}
