@@ -1,0 +1,255 @@
+"""``tpu-coordinatord`` — the per-claim runtime coordinator daemon.
+
+The TPU-native analog of ``nvidia-cuda-mps-control`` (the reference
+launches it inside a templated Deployment,
+reference templates/mps-control-daemon.tmpl.yaml:26-42, lifecycle
+cmd/nvidia-dra-plugin/sharing.go:185-366). Where MPS arbitrates SM
+access through a control pipe, the TPU coordinator arbitrates chip
+access through the claim's *coordination directory* (bind-mounted into
+every workload container by the per-claim CDI spec):
+
+- **readiness** — writes ``<dir>/ready`` once serving; the Deployment's
+  readiness probe checks that file, so the plugin's ``assert_ready``
+  poll (plugin/sharing.py) observes real daemon liveness instead of
+  bare pod scheduling.
+- **policy consumption** — merges the claim-level settings (flags) with
+  the node-level per-chip time-slicing policy files written by
+  ``TimeSlicingManager`` (plugin/sharing.py:_write_policy) under the
+  plugin policy dir; this is the consumer those files previously
+  lacked.
+- **worker arbitration** — workloads register by dropping
+  ``ctl/<worker>.json``; the daemon assigns round-robin duty-cycle
+  slots and publishes ``schedule.json`` (the moral equivalent of MPS
+  ``set_active_thread_percentage`` flowing through the control pipe,
+  sharing.go:260-271).
+- **heartbeat/status** — ``status.json`` carries pid, seq and the
+  effective policy for debugging and tests.
+
+All files are written atomically (tmp + rename) so workload readers
+never observe torn JSON.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import sys
+import time
+from pathlib import Path
+
+from ..utils import info
+from ..utils.flags import LoggingConfig, env_default
+
+log = logging.getLogger("tpu-coordinatord")
+
+READY_FILE = "ready"
+SCHEDULE_FILE = "schedule.json"
+STATUS_FILE = "status.json"
+
+
+def _atomic_write(path: Path, text: str) -> None:
+    tmp = path.with_name(f".{path.name}.tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)
+
+
+def _parse_hbm_limits(spec: str) -> dict[str, int]:
+    """``uuid=bytes,uuid=bytes`` (as rendered by CoordinatorDaemon.start)."""
+    out: dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(f"bad --hbm-limits entry {part!r}")
+        uuid, _, byts = part.partition("=")
+        out[uuid] = int(byts)
+    return out
+
+
+def _parse_chips(spec: str) -> list[int]:
+    return [int(x) for x in spec.split(",") if x.strip() != ""]
+
+
+class Coordinator:
+    """One claim's coordinator state machine.
+
+    Separated from the CLI loop so tests can drive ``step()``
+    synchronously; the binary calls ``serve()`` which loops it.
+    """
+
+    def __init__(self, coordination_dir: Path, *, duty_cycle_percent: int,
+                 preemption_ms: int, hbm_limits: dict[str, int],
+                 visible_chips: list[int], policy_dir: Path | None):
+        self.dir = Path(coordination_dir)
+        self.duty_cycle_percent = duty_cycle_percent
+        self.claim_preemption_ms = preemption_ms
+        self.hbm_limits = hbm_limits
+        self.visible_chips = visible_chips
+        self.policy_dir = Path(policy_dir) if policy_dir else None
+        self.seq = 0
+        self._last_schedule: str | None = None
+
+    # -- lifecycle -----------------------------------------------------
+
+    def start(self) -> None:
+        (self.dir / "ctl").mkdir(parents=True, exist_ok=True)
+        (self.dir / "log").mkdir(parents=True, exist_ok=True)
+        self.step()                      # publish an initial schedule
+        _atomic_write(self.dir / READY_FILE,
+                      json.dumps({"pid": os.getpid(),
+                                  "startedSeq": self.seq}))
+        log.info("coordinator ready: dir=%s chips=%s duty=%d%%",
+                 self.dir, self.visible_chips, self.duty_cycle_percent)
+
+    def stop(self) -> None:
+        (self.dir / READY_FILE).unlink(missing_ok=True)
+        log.info("coordinator stopped")
+
+    # -- one arbitration round ----------------------------------------
+
+    def effective_preemption_ms(self) -> int:
+        """Claim-level quantum, overridden by node-level per-chip policy
+        (the TimeSlicingManager files — their consumer)."""
+        quantum = self.claim_preemption_ms
+        if self.policy_dir is not None:
+            for chip in self.visible_chips:
+                path = self.policy_dir / f"chip{chip}.json"
+                try:
+                    node_ms = json.loads(path.read_text()).get(
+                        "preemptionMs", 0)
+                except (FileNotFoundError, ValueError):
+                    continue
+                quantum = max(quantum, node_ms)
+        return quantum
+
+    def workers(self) -> list[dict]:
+        """Registered workloads: ``ctl/<name>.json`` drop-files."""
+        found = []
+        ctl = self.dir / "ctl"
+        if not ctl.is_dir():
+            return found
+        for path in sorted(ctl.glob("*.json")):
+            try:
+                reg = json.loads(path.read_text())
+            except (OSError, ValueError):
+                continue             # partially-written registration
+            reg["name"] = path.stem
+            found.append(reg)
+        return found
+
+    def step(self) -> bool:
+        """Recompute + publish the schedule; True if it changed."""
+        quantum = self.effective_preemption_ms()
+        workers = self.workers()
+        slots = [{
+            "worker": w["name"],
+            "slot": i,
+            "dutyCyclePercent": (self.duty_cycle_percent // len(workers)
+                                 if workers else self.duty_cycle_percent),
+        } for i, w in enumerate(workers)]
+        schedule = {
+            "chips": self.visible_chips,
+            "preemptionMs": quantum,
+            "dutyCyclePercent": self.duty_cycle_percent,
+            "hbmLimits": self.hbm_limits,
+            "slots": slots,
+        }
+        text = json.dumps(schedule, sort_keys=True)
+        changed = text != self._last_schedule
+        if changed:
+            self.seq += 1
+            self._last_schedule = text
+            _atomic_write(self.dir / SCHEDULE_FILE, text)
+        _atomic_write(self.dir / STATUS_FILE, json.dumps({
+            "pid": os.getpid(),
+            "seq": self.seq,
+            "workers": len(workers),
+            "preemptionMs": quantum,
+            "updatedAt": time.time(),
+        }))
+        return changed
+
+    def serve(self, poll_interval: float, stop_event) -> None:
+        self.start()
+        try:
+            while not stop_event.is_set():
+                stop_event.wait(poll_interval)
+                self.step()
+        finally:
+            self.stop()
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-coordinatord",
+        description="Per-claim TPU runtime coordinator "
+                    "(MPS control-daemon analog)")
+    p.add_argument("--version", action="version",
+                   version=info.get_version_string())
+    p.add_argument("--coordination-dir",
+                   default=env_default("COORDINATION_DIR", "/coordination"),
+                   help="claim coordination directory (bind-mounted into "
+                        "workloads) [env COORDINATION_DIR]")
+    p.add_argument("--duty-cycle-percent", type=int,
+                   default=env_default("DUTY_CYCLE_PERCENT", 100, int),
+                   help="claim compute share [env DUTY_CYCLE_PERCENT]")
+    p.add_argument("--preemption-ms", type=int,
+                   default=env_default("PREEMPTION_MS", 0, int),
+                   help="claim-level preemption quantum; node policy "
+                        "files may raise it [env PREEMPTION_MS]")
+    p.add_argument("--hbm-limits",
+                   default=env_default("HBM_LIMITS", ""),
+                   help="per-device HBM caps, uuid=bytes csv "
+                        "[env HBM_LIMITS]")
+    p.add_argument("--visible-chips",
+                   default=env_default("VISIBLE_CHIPS", ""),
+                   help="chip indices this claim spans [env VISIBLE_CHIPS]")
+    p.add_argument("--policy-dir",
+                   default=env_default("POLICY_DIR", "/policy"),
+                   help="node time-slicing policy dir (written by the "
+                        "plugin's TimeSlicingManager) [env POLICY_DIR]")
+    p.add_argument("--poll-interval", type=float,
+                   default=env_default("POLL_INTERVAL", 1.0, float),
+                   help="arbitration loop period seconds "
+                        "[env POLL_INTERVAL] (default 1)")
+    LoggingConfig.add_flags(p)
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    import threading
+
+    args = build_parser().parse_args(argv)
+    LoggingConfig.apply(args)
+
+    policy_dir = Path(args.policy_dir) if args.policy_dir else None
+    if policy_dir is not None and not policy_dir.is_dir():
+        log.warning("policy dir %s absent; claim-level settings only",
+                    policy_dir)
+        policy_dir = None
+    coord = Coordinator(
+        Path(args.coordination_dir),
+        duty_cycle_percent=args.duty_cycle_percent,
+        preemption_ms=args.preemption_ms,
+        hbm_limits=_parse_hbm_limits(args.hbm_limits),
+        visible_chips=_parse_chips(args.visible_chips),
+        policy_dir=policy_dir)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("signal %d: shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, on_signal)
+    signal.signal(signal.SIGINT, on_signal)
+    coord.serve(args.poll_interval, stop)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

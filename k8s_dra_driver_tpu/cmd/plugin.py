@@ -77,6 +77,12 @@ def build_parser() -> argparse.ArgumentParser:
                                        "tpu-dra-driver"),
                    help="namespace coordinator daemons are created in "
                         "[env COORDINATOR_NAMESPACE]")
+    p.add_argument("--coordinator-image",
+                   default=env_default("COORDINATOR_IMAGE", ""),
+                   help="image for per-claim coordinator Deployments; "
+                        "empty uses the built-in default (the driver "
+                        "image, which ships tpu-coordinatord) "
+                        "[env COORDINATOR_IMAGE]")
     p.add_argument("--http-endpoint",
                    default=env_default("HTTP_ENDPOINT", ""),
                    help="host:port for /metrics + /healthz; empty disables "
@@ -158,7 +164,8 @@ def run(args: argparse.Namespace, client=None, backend=None,
         plugin_root=args.plugin_root, cdi_root=args.cdi_root,
         node_name=args.node_name, driver_root=args.driver_root,
         device_kinds=args.device_kinds,
-        coordinator_namespace=args.coordinator_namespace))
+        coordinator_namespace=args.coordinator_namespace,
+        coordinator_image=args.coordinator_image))
     metrics = DriverMetrics()
     driver = Driver(state, client, args.plugin_root, metrics=metrics,
                     registrar_dir=args.registrar_root)

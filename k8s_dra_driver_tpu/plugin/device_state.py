@@ -48,6 +48,7 @@ class DeviceStateConfig:
     driver_root: str = "/"
     device_kinds: tuple[str, ...] = (KIND_CHIP, KIND_CORE, KIND_SLICE)
     coordinator_namespace: str = "tpu-dra-driver"
+    coordinator_image: str = ""      # empty = sharing.py default
 
 
 # Which config kinds may govern which device kinds.
@@ -82,9 +83,12 @@ class DeviceState:
                                       self.topology.libtpu_path)
         self.checkpoints = CheckpointManager(config.plugin_root)
         self.timeslicing = TimeSlicingManager(config.plugin_root)
+        coord_kwargs = {}
+        if config.coordinator_image:
+            coord_kwargs["image"] = config.coordinator_image
         self.coordinators = CoordinatorManager(
             client, config.plugin_root, config.node_name,
-            namespace=config.coordinator_namespace)
+            namespace=config.coordinator_namespace, **coord_kwargs)
         self._lock = threading.Lock()
         self.prepared: dict[str, PreparedClaim] = self.checkpoints.load()
 

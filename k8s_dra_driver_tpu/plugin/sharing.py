@@ -37,7 +37,11 @@ from .cdi import ContainerEdits
 
 TEMPLATE_PATH = Path(__file__).parent / "templates/coordinator-daemon.yaml"
 
-DEFAULT_COORDINATOR_IMAGE = "gcr.io/tpu-dra-driver/coordinator:latest"
+# The driver image carries all three entrypoints (plugin, controller,
+# tpu-coordinatord — deployments/container/Dockerfile), so coordinator
+# pods run the same image the DaemonSet does; the chart overrides this
+# with its release image/tag.
+DEFAULT_COORDINATOR_IMAGE = "ghcr.io/example/tpu-dra-driver:0.1.0"
 
 
 class SharingError(RuntimeError):
@@ -129,6 +133,7 @@ class CoordinatorDaemon:
             hbm_limits=",".join(f"{u}={b}" for u, b in sorted(limits.items())),
             visible_chips=",".join(str(c) for c in chips),
             coordination_dir=str(cdir),
+            policy_dir=str(self.manager.policy_dir),
         )
         manifest = yaml.safe_load(spec_text)
         deployment = Deployment(
@@ -195,6 +200,9 @@ class CoordinatorManager:
         self.client = client
         self.coordination_root = Path(plugin_root) / "coordinator"
         self.coordination_root.mkdir(parents=True, exist_ok=True)
+        # Same dir TimeSlicingManager writes: rendered daemons mount it
+        # read-only and consume the per-chip policy files.
+        self.policy_dir = Path(plugin_root) / "policy"
         self.node_name = node_name
         self.namespace = namespace
         self.image = image
