@@ -35,7 +35,6 @@ REFERENCE_MPS_BACKOFF_FLOOR_MS = 1000.0
 def bench_driver_path(rounds: int = 20) -> dict:
     """p50 claim→ready over the five baseline configs (hermetic node)."""
     from k8s_dra_driver_tpu.api import resource
-    from k8s_dra_driver_tpu.api.config.v1alpha1 import API_VERSION
     from k8s_dra_driver_tpu.discovery import FakeHost
     from k8s_dra_driver_tpu.plugin import DeviceState
 

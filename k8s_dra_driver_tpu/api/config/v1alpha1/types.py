@@ -16,8 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 
-from .sharing import (ConfigError, Sharing, STRATEGY_EXCLUSIVE,
-                      STRATEGY_TIME_SLICING)
+from .sharing import ConfigError, Sharing, STRATEGY_TIME_SLICING
 
 API_GROUP = "tpu.google.com"
 API_VERSION = "tpu.google.com/v1alpha1"

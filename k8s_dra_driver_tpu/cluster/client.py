@@ -15,7 +15,7 @@ works in environments without a cluster.
 
 from __future__ import annotations
 
-import dataclasses
+
 import fnmatch
 import threading
 from typing import Any, Callable

@@ -557,6 +557,16 @@ class RestClusterClient(ClusterClient):
 
         return unsubscribe
 
+    @staticmethod
+    def _same_version(a: Any, b: Any) -> bool:
+        """Unchanged across a relist = same resourceVersion (or, when a
+        server omits it, equal objects)."""
+        rv_a = getattr(a.metadata, "resource_version", 0)
+        rv_b = getattr(b.metadata, "resource_version", 0)
+        if rv_a or rv_b:
+            return rv_a == rv_b
+        return a == b
+
     def _watch_loop(self, kind: str, handler: WatchHandler,
                     stop: threading.Event) -> None:
         backoff = 1.0
@@ -571,8 +581,18 @@ class RestClusterClient(ClusterClient):
                 seen: dict[tuple[str, str], Any] = {}
                 for item in out.get("items", []):
                     obj = _FROM_WIRE[kind](item)
-                    seen[(obj.metadata.namespace, obj.metadata.name)] = obj
-                    handler("ADDED", obj)
+                    key = (obj.metadata.namespace, obj.metadata.name)
+                    seen[key] = obj
+                    # Diff against the previous window instead of
+                    # re-emitting ADDED for the whole world on every
+                    # 300s relist: new objects are ADDED, changed ones
+                    # MODIFIED, unchanged ones silent (client-go
+                    # reflector replace semantics).
+                    prev = known.get(key)
+                    if prev is None:
+                        handler("ADDED", obj)
+                    elif not self._same_version(prev, obj):
+                        handler("MODIFIED", obj)
                 for key, obj in known.items():
                     if key not in seen:
                         handler("DELETED", obj)

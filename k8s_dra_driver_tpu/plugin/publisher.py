@@ -14,7 +14,7 @@ from __future__ import annotations
 import dataclasses
 
 from ..api import resource
-from ..cluster import ClusterClient, ConflictError, NotFoundError
+from ..cluster import ClusterClient, NotFoundError
 from ..utils.metrics import DriverMetrics
 
 DRIVER_LABEL = "tpu.google.com/driver"

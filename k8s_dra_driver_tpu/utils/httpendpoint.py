@@ -77,6 +77,7 @@ class HTTPEndpoint:
                  pprof_prefix: str = "/debug/pprof"):
         host, _, port = address.rpartition(":")
         self.metrics = metrics
+        self._profile_lock = threading.Lock()
         prefix = pprof_prefix.rstrip("/")
         endpoint = self
 
@@ -109,15 +110,28 @@ class HTTPEndpoint:
                         return self._send(b"bad seconds", "text/plain",
                                           400)
                     secs = min(max(secs, 0.1), 60.0)
-                    body = _cpu_profile(
-                        secs, own_ident=threading.get_ident())
+                    # one profiler at a time: each request occupies a
+                    # handler thread sampling at 100 Hz for up to 60s,
+                    # so concurrent requests would pile up unboundedly
+                    if not endpoint._profile_lock.acquire(blocking=False):
+                        return self._send(b"profile already running",
+                                          "text/plain", 429)
+                    try:
+                        body = _cpu_profile(
+                            secs, own_ident=threading.get_ident())
+                    finally:
+                        endpoint._profile_lock.release()
                     self._send(body.encode(), "text/plain")
                 elif path == prefix:
                     self._send(b"goroutine\nprofile\n", "text/plain")
                 else:
                     self._send(b"not found", "text/plain", 404)
 
-        self.server = ThreadingHTTPServer((host or "0.0.0.0", int(port)),
+        # Empty host binds loopback: the debug surface (60s stack
+        # sampling per /profile hit) must be opted into a wide bind by
+        # an explicit address — the chart passes "0.0.0.0:8080" so
+        # Prometheus can scrape pods, a standalone run stays local.
+        self.server = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
                                           Handler)
         self.address = (f"{self.server.server_address[0]}:"
                         f"{self.server.server_address[1]}")

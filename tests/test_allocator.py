@@ -5,8 +5,8 @@ import pytest
 
 from k8s_dra_driver_tpu.api import resource
 from k8s_dra_driver_tpu.api.classes import standard_device_classes
-from k8s_dra_driver_tpu.allocator import (AllocationError, Allocator,
-                                          CELError, allocate_claim, evaluate)
+from k8s_dra_driver_tpu.allocator import (AllocationError, CELError,
+                                          allocate_claim, evaluate)
 from k8s_dra_driver_tpu.cluster import FakeCluster, Node
 from k8s_dra_driver_tpu.devicemodel import enumerate_host_devices
 from k8s_dra_driver_tpu.discovery import FakeHost

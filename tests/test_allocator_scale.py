@@ -1,0 +1,167 @@
+"""Allocator scale/perf tier: 64-host, 256-chip pool with slices.
+
+SURVEY hard part #1 warns the overlap-token model's shape enumeration
+is combinatorial; round 1 shipped an unbounded
+``itertools.combinations`` search (VERDICT weak #7). These tests pin
+the bounded-DFS behavior: realistic allocations stay fast at fleet
+scale, pathological claims hit the expansion budget and fail cleanly
+instead of hanging.
+"""
+
+import time
+
+import pytest
+
+from k8s_dra_driver_tpu.allocator import AllocationError, Allocator
+from k8s_dra_driver_tpu.api import resource
+from k8s_dra_driver_tpu.api.classes import standard_device_classes
+from k8s_dra_driver_tpu.cluster import Node
+from k8s_dra_driver_tpu.devicemodel import enumerate_host_devices
+from k8s_dra_driver_tpu.discovery import FakeHost
+
+CLASSES = standard_device_classes()
+N_HOSTS = 64
+
+
+def _pool(tmp_path_factory):
+    """64 v5p hosts x (4 chips + 8 cores + slice shapes) published.
+
+    v5p chips carry 2 cores each, so same-parent core constraints are
+    satisfiable (v5e chips are single-core)."""
+    tmp = tmp_path_factory.mktemp("pool")
+    slices, nodes = [], []
+    # One materialized fake host provides the device shapes; per-host
+    # pools only differ in pool/node names, so enumerate once.
+    topo = FakeHost(hostname="h", generation="v5p").materialize(
+        tmp).enumerate()
+    devices = [d.to_device()
+               for _, d in sorted(enumerate_host_devices(topo).items())]
+    for i in range(N_HOSTS):
+        name = f"host-{i:03d}"
+        slices.append(resource.ResourceSlice(
+            metadata=resource.ObjectMeta(name=f"slice-{name}"),
+            driver="tpu.google.com",
+            pool=resource.ResourcePool(name=name),
+            node_name=name,
+            devices=devices))
+        nodes.append(Node(metadata=resource.ObjectMeta(name=name)))
+    return slices, nodes
+
+
+@pytest.fixture(scope="module")
+def pool(tmp_path_factory):
+    return _pool(tmp_path_factory)
+
+
+def claim_for(requests, constraints=(), name="c"):
+    return resource.ResourceClaim(
+        metadata=resource.ObjectMeta(name=name, namespace="default"),
+        spec=resource.ResourceClaimSpec(devices=resource.DeviceClaim(
+            requests=requests, constraints=list(constraints))))
+
+
+def req(name="r0", count=1, cls="tpu.google.com", selectors=()):
+    return resource.DeviceRequest(
+        name=name, device_class_name=cls, count=count,
+        selectors=[resource.DeviceSelector(cel=s) for s in selectors])
+
+
+class TestScale:
+    def test_sequence_of_claims_under_1s(self, pool):
+        """A burst of mixed realistic claims across the fleet completes
+        well under the 1s target (VERDICT next-round #7)."""
+        slices, nodes = pool
+        alloc = Allocator()
+        allocated: list[resource.ResourceClaim] = []
+        t0 = time.perf_counter()
+        for i in range(20):
+            kind = i % 4
+            if kind == 0:
+                c = claim_for([req(count=1)], name=f"chip-{i}")
+            elif kind == 1:
+                c = claim_for([req(count=4)], name=f"quad-{i}")
+            elif kind == 2:
+                c = claim_for(
+                    [req(cls="tpu-slice.google.com",
+                         selectors=['device.attributes["sliceShape"]'
+                                    ' == "2x2"'])],
+                    name=f"slice-{i}")
+            else:
+                c = claim_for(
+                    [req(count=2, cls="tpu-core.google.com")],
+                    [resource.DeviceConstraint(
+                        requests=["r0"], match_attribute="parentUUID")],
+                    name=f"cores-{i}")
+            result = alloc.allocate(c, slices, CLASSES, nodes=nodes,
+                                    allocated_claims=allocated)
+            c.status.allocation = result
+            allocated.append(c)
+        elapsed = time.perf_counter() - t0
+        assert len(allocated) == 20
+        assert elapsed < 1.0, f"20 fleet allocations took {elapsed:.2f}s"
+
+    def test_constrained_quad_fast(self, pool):
+        """4 cores constrained to one parent chip: the grouped candidate
+        order finds a same-chip quad without roaming 512 cores."""
+        slices, nodes = pool
+        alloc = Allocator()
+        c = claim_for(
+            [req(count=2, cls="tpu-core.google.com")],
+            [resource.DeviceConstraint(requests=["r0"],
+                                       match_attribute="parentUUID")])
+        t0 = time.perf_counter()
+        result = alloc.allocate(c, slices, CLASSES, nodes=nodes)
+        assert len(result.results) == 2
+        assert time.perf_counter() - t0 < 1.0
+
+    def test_unsatisfiable_fails_fast_not_hangs(self, pool):
+        """A symmetric unsatisfiable claim (more chips than any host
+        has) must fail in bounded time — the exact shape that made the
+        round-1 combinations enumeration exponential."""
+        slices, nodes = pool
+        alloc = Allocator()
+        c = claim_for(
+            [req(count=3)],
+            # chips on one host share no attribute value that differs,
+            # so demand an attribute no chip carries -> unsatisfiable
+            [resource.DeviceConstraint(requests=["r0"],
+                                       match_attribute="nonexistent")])
+        t0 = time.perf_counter()
+        with pytest.raises(AllocationError):
+            alloc.allocate(c, slices, CLASSES, nodes=nodes)
+        assert time.perf_counter() - t0 < 2.0
+
+    def test_budget_exhaustion_is_clean(self, pool):
+        """With a tiny budget the search degrades to a clean error."""
+        slices, nodes = pool
+        alloc = Allocator(search_budget=3)
+        c = claim_for(
+            [req(count=4)],
+            [resource.DeviceConstraint(requests=["r0"],
+                                       match_attribute="nonexistent")])
+        with pytest.raises(AllocationError):
+            alloc.allocate(c, slices, CLASSES, nodes=nodes)
+
+    def test_fleet_fillup_whole_chips(self, pool):
+        """Allocate every chip on the first 8 hosts; token accounting
+        stays correct across 32 sequential claims."""
+        slices, nodes = pool
+        sub_slices = slices[:8]
+        sub_nodes = nodes[:8]
+        alloc = Allocator()
+        allocated = []
+        seen = set()
+        for i in range(32):
+            c = claim_for([req(count=1)], name=f"fill-{i}")
+            result = alloc.allocate(c, sub_slices, CLASSES, nodes=sub_nodes,
+                                    allocated_claims=allocated)
+            key = (result.results[0].pool, result.results[0].device)
+            assert key not in seen
+            seen.add(key)
+            c.status.allocation = result
+            allocated.append(c)
+        # pool is now chip-exhausted
+        c = claim_for([req(count=1)], name="overflow")
+        with pytest.raises(AllocationError):
+            alloc.allocate(c, sub_slices, CLASSES, nodes=sub_nodes,
+                           allocated_claims=allocated)

@@ -15,7 +15,6 @@ from k8s_dra_driver_tpu.cluster import FakeCluster, Node
 from k8s_dra_driver_tpu.cmd import controller as controller_cmd
 from k8s_dra_driver_tpu.cmd import plugin as plugin_cmd
 from k8s_dra_driver_tpu.api.resource import ObjectMeta
-from k8s_dra_driver_tpu.discovery import FakeHost
 from k8s_dra_driver_tpu.utils import info
 
 

@@ -8,7 +8,7 @@ prepares, checkpoint consistency across a simulated restart, and
 bounded sharing-manager state.
 """
 
-import threading
+
 from concurrent.futures import ThreadPoolExecutor
 
 import grpc

@@ -7,8 +7,7 @@ import pytest
 from k8s_dra_driver_tpu.api.config.v1alpha1 import (
     API_VERSION, ConfigError, CoordinatedSettings,
     InvalidDeviceSelectorError, InvalidLimitError, RendezvousConfig,
-    STRATEGY_COORDINATED, STRATEGY_EXCLUSIVE, STRATEGY_TIME_SLICING,
-    TpuChipConfig, TpuPartitionConfig, decode)
+    STRATEGY_EXCLUSIVE, TpuChipConfig, TpuPartitionConfig, decode)
 from k8s_dra_driver_tpu.utils import parse_quantity, format_quantity
 
 UUIDS = ["TPU-v5e-aaaa", "TPU-v5e-bbbb", "TPU-v5e-cccc"]

@@ -10,7 +10,7 @@ and real-process signal handling.
 """
 
 import json
-import os
+
 import signal
 import string
 import subprocess
@@ -18,7 +18,7 @@ import sys
 import time
 from pathlib import Path
 
-import pytest
+
 import yaml
 
 from k8s_dra_driver_tpu.cmd import coordinatord

@@ -10,7 +10,7 @@ import re
 import subprocess
 from pathlib import Path
 
-import pytest
+
 import yaml
 
 REPO = Path(__file__).parent.parent

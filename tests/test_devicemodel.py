@@ -4,7 +4,7 @@ overlap-token collisions."""
 import pytest
 
 from k8s_dra_driver_tpu.devicemodel import (
-    KIND_CHIP, KIND_CORE, KIND_SLICE, PreparedClaim, PreparedDevice,
+    KIND_CHIP, PreparedClaim, PreparedDevice,
     enumerate_host_devices, is_shared_token)
 from k8s_dra_driver_tpu.discovery import FakeHost, fake_slice_hosts
 

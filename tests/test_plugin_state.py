@@ -6,7 +6,7 @@ import json
 import pytest
 
 from k8s_dra_driver_tpu.api.config.v1alpha1 import API_VERSION
-from k8s_dra_driver_tpu.cluster import FakeCluster, NotFoundError
+from k8s_dra_driver_tpu.cluster import FakeCluster
 from k8s_dra_driver_tpu.discovery import FakeHost, fake_slice_hosts
 from k8s_dra_driver_tpu.plugin import (CheckpointManager, ChecksumError,
                                        DeviceState, DeviceStateConfig,

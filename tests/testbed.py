@@ -87,6 +87,7 @@ class E2EBed:
         for cls in self.classes.values():
             self.cluster.create(cls)
         self.drivers: dict[str, Driver] = {}
+        self.hosts: dict[str, FakeHost] = {}
         self.controller = None
         if with_controller:
             self.controller = SliceGangController(self.cluster,
@@ -95,20 +96,43 @@ class E2EBed:
         for host in hosts:
             self.add_host(host)
 
-    def add_host(self, host: FakeHost) -> Driver:
+    def _spawn_driver(self, host: FakeHost) -> Driver:
+        """Construct+start a driver for a host over its standing plugin
+        dirs — shared by first start and restart so both always build
+        the identically-configured stack."""
         name = host.hostname
-        self.cluster.create(Node(metadata=resource.ObjectMeta(name=name)))
         backend = host.materialize(self.tmp / "hosts" / name)
-        cfg = DeviceStateConfig(
+        state = DeviceState(backend, self.cluster, DeviceStateConfig(
             plugin_root=str(self.tmp / "plugin" / name),
             cdi_root=str(self.tmp / "cdi" / name),
-            node_name=name)
-        state = DeviceState(backend, self.cluster, cfg)
+            node_name=name))
         driver = Driver(state, self.cluster,
                         plugin_dir=str(self.tmp / "plugin" / name))
         driver.start()
         self.drivers[name] = driver
         return driver
+
+    def add_host(self, host: FakeHost) -> Driver:
+        self.hosts[host.hostname] = host
+        self.cluster.create(Node(metadata=resource.ObjectMeta(
+            name=host.hostname)))
+        return self._spawn_driver(host)
+
+    def restart_driver(self, name: str) -> Driver:
+        """Simulate a kubelet-plugin pod restart on one node: tear the
+        driver down and bring a fresh DeviceState/Driver up over the
+        same plugin dir (checkpoint) and host backend."""
+        self.drivers[name].shutdown()
+        return self._spawn_driver(self.hosts[name])
+
+    def restart_controller(self) -> None:
+        """Simulate a controller pod restart (stop cleans up owned
+        slices, imex.go:308-326 analog; the new instance re-publishes)."""
+        assert self.controller is not None
+        self.controller.stop()
+        self.controller = SliceGangController(self.cluster,
+                                              retry_delay_s=0.01)
+        self.controller.start()
 
     def shutdown(self) -> None:
         for d in self.drivers.values():
