@@ -4,19 +4,40 @@
 # demo/clusters/kind/create-cluster.sh + common.sh:43-44), minus real
 # hardware: workers get a synthetic /sys/class/accel tree so the driver
 # runs end-to-end hermetically.
+#
+# GANG=1 builds the 4-worker pod-slice variant instead (nvkind analog,
+# reference values.yaml:40-49): each worker mounts one host of a fake
+# 4-host v5e 4x4 slice, exercising node self-labeling, the slice-gang
+# controller and slice-test1 against a real API server.
 set -euo pipefail
 
 REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
 CLUSTER_NAME="${CLUSTER_NAME:-tpu-dra-driver-cluster}"
 FAKE_ROOT=/tmp/tpu-dra-kind
+GANG="${GANG:-0}"
 
 command -v kind >/dev/null || { echo "kind not found" >&2; exit 1; }
 
-# Materialize one fake 4-chip v5e host tree per worker.
-for i in 0 1; do
-  rm -rf "$FAKE_ROOT/worker-$i"
-  mkdir -p "$FAKE_ROOT/worker-$i"
-  python - "$REPO_ROOT" "$FAKE_ROOT/worker-$i" "$i" <<'EOF'
+if [ "$GANG" = "1" ]; then
+  # One fake 4x4 v5e slice split across 4 single-host trees.
+  rm -rf "$FAKE_ROOT"/gang-w*
+  python - "$REPO_ROOT" "$FAKE_ROOT" <<'EOF'
+import sys
+sys.path.insert(0, sys.argv[1])
+from pathlib import Path
+from k8s_dra_driver_tpu.discovery import fake_slice_hosts
+root = Path(sys.argv[2])
+for i, host in enumerate(fake_slice_hosts(4, topology="4x4")):
+    host.materialize(root / f"gang-w{i}")
+    print("fake slice host tree:", root / f"gang-w{i}")
+EOF
+  CONFIG="kind-cluster-config-gang.yaml"
+else
+  # Independent 4-chip hosts (quickstart tier).
+  for i in 0 1; do
+    rm -rf "$FAKE_ROOT/worker-$i"
+    mkdir -p "$FAKE_ROOT/worker-$i"
+    python - "$REPO_ROOT" "$FAKE_ROOT/worker-$i" "$i" <<'EOF'
 import sys
 sys.path.insert(0, sys.argv[1])
 from pathlib import Path
@@ -26,11 +47,14 @@ FakeHost(generation="v5e", num_chips=4,
          hostname=f"kind-worker-{idx}").materialize(root)
 print("fake TPU tree:", root)
 EOF
-done
+  done
+  CONFIG="kind-cluster-config.yaml"
+fi
 
 kind create cluster --name "$CLUSTER_NAME" \
-  --config "$(dirname "$0")/kind-cluster-config.yaml"
+  --config "$(dirname "$0")/$CONFIG"
 
 echo "Cluster ready. Next:"
 echo "  $(dirname "$0")/build-driver-image.sh   # build + load the image"
 echo "  $(dirname "$0")/install-dra-driver.sh   # helm install"
+echo "  $(dirname "$0")/run-acceptance.sh       # apply + assert demo specs"

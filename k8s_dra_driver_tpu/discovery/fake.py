@@ -14,6 +14,7 @@ levels:
 from __future__ import annotations
 
 import dataclasses
+import json
 from pathlib import Path
 
 from .sysfs import GOOGLE_PCI_VENDOR, SysfsBackend
@@ -81,6 +82,12 @@ class FakeHost:
             lib = root / "usr/lib/libtpu.so"
             lib.parent.mkdir(parents=True, exist_ok=True)
             lib.write_text("fake libtpu")
+        # Persist the libtpu env contract in the tree: a containerized
+        # plugin probing this tree as --driver-root (kind acceptance)
+        # has no TPU_* in its own environment, so SysfsBackend overlays
+        # this file — the hermetic stand-in for GKE's instance metadata.
+        (root / "tpu-env.json").write_text(json.dumps(self.env(),
+                                                      sort_keys=True))
         return SysfsBackend(host_root=str(root), env=self.env(),
                             hostname=self.hostname)
 

@@ -20,6 +20,7 @@ under that prefix while the *published* device paths stay host-absolute.
 from __future__ import annotations
 
 import hashlib
+import json
 import os
 from pathlib import Path
 
@@ -76,7 +77,24 @@ class SysfsBackend(DiscoveryBackend):
                  env: dict[str, str] | None = None,
                  hostname: str | None = None):
         self.root = Path(host_root)
-        self.env = dict(os.environ) if env is None else env
+        if env is None:
+            env = dict(os.environ)
+            # A fake host tree (kind acceptance tier) carries its libtpu
+            # env contract as a file — the process env of a DaemonSet
+            # pod knows nothing about the fake host it probes. Only
+            # honored for a non-"/" driver root: on a real node the
+            # instance metadata env is authoritative and a stray
+            # /tpu-env.json must not be able to override it.
+            env_file = self.root / "tpu-env.json"
+            if self.root != Path("/") and env_file.is_file():
+                try:
+                    overlay = json.loads(env_file.read_text())
+                except ValueError:
+                    overlay = None
+                if isinstance(overlay, dict):
+                    env.update({str(k): str(v)
+                                for k, v in overlay.items()})
+        self.env = env
         self.hostname = hostname or self.env.get("HOSTNAME") or os.uname().nodename
 
     # -- pieces -----------------------------------------------------------

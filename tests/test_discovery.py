@@ -117,3 +117,16 @@ class TestMultiHostSlice:
         topo = host.materialize(tmp_path).enumerate()
         assert topo.chips[0].coord == ICICoord(2, 2)
         assert topo.slice.worker_id == 3
+
+    def test_env_contract_persisted_in_tree(self, tmp_path):
+        """A backend constructed WITHOUT explicit env (the kind
+        DaemonSet case: the pod's own environ has no TPU_*) recovers
+        the slice identity from the tree's tpu-env.json."""
+        from k8s_dra_driver_tpu.discovery.sysfs import SysfsBackend
+        host = fake_slice_hosts(4, topology="4x4")[2]
+        host.materialize(tmp_path)
+        topo = SysfsBackend(host_root=str(tmp_path)).enumerate()
+        assert topo.slice is not None
+        assert topo.slice.worker_id == 2
+        assert topo.slice.slice_id == "slice-a"
+        assert len(topo.chips) == 4
