@@ -16,6 +16,7 @@ helm upgrade --install tpu-dra-driver \
   --set image.tag="$IMAGE_TAG" \
   --set image.pullPolicy=Never \
   --set kubeletPlugin.driverRoot=/faketpu \
+  --set kubeletPlugin.allowEnvFile=true \
   --set "kubeletPlugin.nodeSelector=null" \
   --set "kubeletPlugin.tolerations=null"
 

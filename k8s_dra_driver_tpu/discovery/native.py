@@ -79,7 +79,11 @@ class NativeBackend(DiscoveryBackend):
                  hostname: str | None = None,
                  lib_path: str | Path | None = None):
         self.root = str(host_root)
-        self.env = dict(os.environ) if env is None else dict(env)
+        if env is None:
+            from .sysfs import load_env_overlay
+            env = dict(os.environ)
+            env.update(load_env_overlay(self.root, env))
+        self.env = dict(env)
         if hostname:
             self.env["HOSTNAME"] = hostname
         path = Path(lib_path) if lib_path else ensure_built()

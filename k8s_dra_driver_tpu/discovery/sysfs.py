@@ -46,6 +46,35 @@ def _read(path: Path) -> str | None:
         return None
 
 
+# Opt-in for reading a tree-carried env contract. Deliberately NOT
+# inferred from the driver root: production runs with --driver-root
+# /host, and a stray host /tpu-env.json must never be able to override
+# the node's authoritative instance-metadata env. The kind acceptance
+# install (fake trees) sets this via the chart's
+# kubeletPlugin.allowEnvFile value.
+ENV_FILE_FLAG = "TPU_DISCOVERY_ENV_FILE"
+ENV_FILE_NAME = "tpu-env.json"
+
+
+def load_env_overlay(root: Path | str,
+                     base_env: dict[str, str]) -> dict[str, str]:
+    """Env contract persisted in a (fake) host tree, gated on the
+    explicit ``TPU_DISCOVERY_ENV_FILE`` opt-in; shared by the sysfs
+    and native backends so both enumerate identical topologies."""
+    if base_env.get(ENV_FILE_FLAG, "").lower() not in ("1", "true"):
+        return {}
+    env_file = Path(root) / ENV_FILE_NAME
+    if not env_file.is_file():
+        return {}
+    try:
+        overlay = json.loads(env_file.read_text())
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(overlay, dict):
+        return {}
+    return {str(k): str(v) for k, v in overlay.items()}
+
+
 def parse_bounds(s: str) -> MeshShape:
     """Parse "2,2,1"-style bounds env values."""
     parts = [int(p) for p in s.split(",")]
@@ -79,21 +108,7 @@ class SysfsBackend(DiscoveryBackend):
         self.root = Path(host_root)
         if env is None:
             env = dict(os.environ)
-            # A fake host tree (kind acceptance tier) carries its libtpu
-            # env contract as a file — the process env of a DaemonSet
-            # pod knows nothing about the fake host it probes. Only
-            # honored for a non-"/" driver root: on a real node the
-            # instance metadata env is authoritative and a stray
-            # /tpu-env.json must not be able to override it.
-            env_file = self.root / "tpu-env.json"
-            if self.root != Path("/") and env_file.is_file():
-                try:
-                    overlay = json.loads(env_file.read_text())
-                except ValueError:
-                    overlay = None
-                if isinstance(overlay, dict):
-                    env.update({str(k): str(v)
-                                for k, v in overlay.items()})
+            env.update(load_env_overlay(self.root, env))
         self.env = env
         self.hostname = hostname or self.env.get("HOSTNAME") or os.uname().nodename
 

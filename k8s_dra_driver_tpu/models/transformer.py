@@ -47,6 +47,11 @@ class TransformerConfig:
     top_k: int = 2
     max_seq: int = 2048
     dtype: Any = jnp.bfloat16
+    # Rematerialize each layer under autodiff: activations are
+    # recomputed in the backward pass instead of living in HBM for the
+    # whole step — the standard FLOPs-for-memory trade on TPU where
+    # HBM, not compute, bounds batch x sequence.
+    remat: bool = False
 
     @property
     def is_moe(self) -> bool:
@@ -196,17 +201,23 @@ def _moe_mlp(x, layer, cfg: TransformerConfig):
     return jnp.einsum("bted,bte->btd", y, gates)
 
 
+def _layer_forward(x, layer, cfg: TransformerConfig, mesh: Mesh | None):
+    x = x + _attention(rms_norm(x, layer["ln1"]), layer, cfg, mesh)
+    mlp_in = rms_norm(x, layer["ln2"])
+    if cfg.is_moe:
+        return x + _moe_mlp(mlp_in, layer, cfg)
+    return x + _dense_mlp(mlp_in, layer)
+
+
 def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             mesh: Mesh | None = None) -> jax.Array:
     """tokens [B, T] int32 -> logits [B, T, vocab]."""
     x = params["embed"][tokens]
+    layer_fn = functools.partial(_layer_forward, cfg=cfg, mesh=mesh)
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn)
     for layer in params["layers"]:
-        x = x + _attention(rms_norm(x, layer["ln1"]), layer, cfg, mesh)
-        mlp_in = rms_norm(x, layer["ln2"])
-        if cfg.is_moe:
-            x = x + _moe_mlp(mlp_in, layer, cfg)
-        else:
-            x = x + _dense_mlp(mlp_in, layer)
+        x = layer_fn(x, layer)
     x = rms_norm(x, params["ln_f"])
     return jnp.einsum("btd,dv->btv", x, params["unembed"])
 
