@@ -252,7 +252,10 @@ class Allocator:
             return result
 
         def sibling_sig(c: _Candidate):
-            return (c.tokens, tuple(str(c.device.attributes.get(a))
+            # Raw attribute values, not str(): _constraints_ok compares
+            # raw values, so 1 and "1" must NOT share a signature or the
+            # failed-sibling prune could skip a satisfying candidate.
+            return (c.tokens, tuple(c.device.attributes.get(a)
                                     for a in match_attrs))
 
         def pick(start: int, partial: list[_Candidate], tokens):

@@ -56,6 +56,16 @@ def _atomic_write(path: Path, text: str) -> None:
     os.replace(tmp, path)
 
 
+def _read_json_dict(path: Path) -> dict | None:
+    """Read a JSON object from an untrusted drop-file; None on any
+    failure (torn write, non-JSON, valid-but-non-object payload)."""
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    return payload if isinstance(payload, dict) else None
+
+
 def _parse_hbm_limits(spec: str) -> dict[str, int]:
     """``uuid=bytes,uuid=bytes`` (as rendered by CoordinatorDaemon.start)."""
     out: dict[str, int] = {}
@@ -117,13 +127,14 @@ class Coordinator:
         quantum = self.claim_preemption_ms
         if self.policy_dir is not None:
             for chip in self.visible_chips:
-                path = self.policy_dir / f"chip{chip}.json"
-                try:
-                    node_ms = json.loads(path.read_text()).get(
-                        "preemptionMs", 0)
-                except (FileNotFoundError, ValueError):
-                    continue
-                quantum = max(quantum, node_ms)
+                policy = _read_json_dict(self.policy_dir / f"chip{chip}.json")
+                if policy is None:
+                    continue             # malformed node policy: degrade
+                node_ms = policy.get("preemptionMs", 0)
+                if not isinstance(node_ms, (int, float)) \
+                        or isinstance(node_ms, bool):
+                    continue             # non-numeric quantum: degrade
+                quantum = max(quantum, int(node_ms))
         return quantum
 
     def workers(self) -> list[dict]:
@@ -133,10 +144,9 @@ class Coordinator:
         if not ctl.is_dir():
             return found
         for path in sorted(ctl.glob("*.json")):
-            try:
-                reg = json.loads(path.read_text())
-            except (OSError, ValueError):
-                continue             # partially-written registration
+            reg = _read_json_dict(path)
+            if reg is None:
+                continue             # torn write or non-object payload
             reg["name"] = path.stem
             found.append(reg)
         return found

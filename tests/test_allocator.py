@@ -273,3 +273,27 @@ class TestMultiNode:
             [chip_request("r0", count=3), chip_request("r1", count=3)]))
         with pytest.raises(AllocationError):
             allocate_claim(c, claim)
+
+    def test_sibling_prune_distinguishes_raw_attribute_types(self):
+        """Regression (round-2 advisor, low): the failed-sibling prune
+        signature must use *raw* attribute values, as _constraints_ok
+        does.  Devices whose ``rank`` differs in type but stringifies
+        equally (1 vs "1") must not share a signature, or the prune
+        skips the candidate that would satisfy the constraint."""
+        from k8s_dra_driver_tpu.allocator.allocator import Allocator
+        slice_ = resource.ResourceSlice(
+            metadata=resource.ObjectMeta(name="s0"),
+            driver="tpu.google.com",
+            pool=resource.ResourcePool(name="p0"),
+            node_name="n0",
+            devices=[
+                resource.Device(name="d0", attributes={"rank": 1}),
+                resource.Device(name="d1", attributes={"rank": "1"}),
+                resource.Device(name="d2", attributes={"rank": 1}),
+            ])
+        claim = claim_for(
+            [resource.DeviceRequest(name="r0", count=2)],
+            constraints=[resource.DeviceConstraint(match_attribute="rank")])
+        alloc = Allocator().allocate(claim, [slice_], classes={})
+        devs = sorted(r.device for r in alloc.results)
+        assert devs == ["d0", "d2"]

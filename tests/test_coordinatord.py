@@ -90,6 +90,19 @@ class TestCoordinator:
         sched = json.loads((tmp_path / "coord/schedule.json").read_text())
         assert sched["slots"] == []
 
+    def test_non_object_registration_ignored(self, tmp_path):
+        """Valid JSON that isn't an object (e.g. ``42``) comes from an
+        untrusted workload container and must not crash the daemon
+        (round-2 advisor, medium)."""
+        c = make_coord(tmp_path)
+        c.start()
+        (tmp_path / "coord/ctl/evil.json").write_text("42")
+        (tmp_path / "coord/ctl/list.json").write_text("[1, 2]")
+        (tmp_path / "coord/ctl/good.json").write_text(json.dumps({"pid": 7}))
+        c.step()                       # must not raise
+        sched = json.loads((tmp_path / "coord/schedule.json").read_text())
+        assert [s["worker"] for s in sched["slots"]] == ["good"]
+
 
 class TestPolicyConsumption:
     """The daemon consumes TimeSlicingManager's per-chip policy files —
@@ -118,6 +131,21 @@ class TestPolicyConsumption:
             json.dumps({"preemptionMs": 99}))
         c = make_coord(tmp_path, policy_dir=tmp_path / "policy")
         assert c.effective_preemption_ms() == 0
+
+    def test_non_object_policy_degrades_to_claim_quantum(self, tmp_path):
+        """A policy file parsing to a non-dict (e.g. ``[1,2]``) must not
+        crash the arbitration loop (round-2 advisor, low)."""
+        (tmp_path / "policy").mkdir()
+        (tmp_path / "policy/chip0.json").write_text("[1, 2]")
+        (tmp_path / "policy/chip1.json").write_text(
+            json.dumps({"preemptionMs": 30}))
+        # a dict policy with a non-numeric quantum must also degrade
+        (tmp_path / "policy/chip2.json").write_text(
+            json.dumps({"preemptionMs": "999"}))
+        c = make_coord(tmp_path, preemption_ms=5,
+                       visible_chips=[0, 1, 2],
+                       policy_dir=tmp_path / "policy")
+        assert c.effective_preemption_ms() == 30
 
 
 class TestTemplateBuildCoherence:

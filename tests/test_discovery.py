@@ -118,11 +118,14 @@ class TestMultiHostSlice:
         assert topo.chips[0].coord == ICICoord(2, 2)
         assert topo.slice.worker_id == 3
 
-    def test_env_contract_persisted_in_tree(self, tmp_path):
+    def test_env_contract_persisted_in_tree(self, tmp_path, monkeypatch):
         """A backend constructed WITHOUT explicit env (the kind
         DaemonSet case: the pod's own environ has no TPU_*) recovers
-        the slice identity from the tree's tpu-env.json."""
-        from k8s_dra_driver_tpu.discovery.sysfs import SysfsBackend
+        the slice identity from the tree's tpu-env.json — but only
+        under the explicit TPU_DISCOVERY_ENV_FILE opt-in, which the
+        kind install sets via the chart's kubeletPlugin.allowEnvFile."""
+        from k8s_dra_driver_tpu.discovery.sysfs import ENV_FILE_FLAG, SysfsBackend
+        monkeypatch.setenv(ENV_FILE_FLAG, "1")
         host = fake_slice_hosts(4, topology="4x4")[2]
         host.materialize(tmp_path)
         topo = SysfsBackend(host_root=str(tmp_path)).enumerate()
@@ -130,3 +133,18 @@ class TestMultiHostSlice:
         assert topo.slice.worker_id == 2
         assert topo.slice.slice_id == "slice-a"
         assert len(topo.chips) == 4
+
+    def test_env_file_ignored_without_opt_in(self, tmp_path, monkeypatch):
+        """Security property behind the gating: a planted tpu-env.json
+        in the (host-root) tree must NOT override discovery unless the
+        operator explicitly opted in. A stray host /tpu-env.json on a
+        production node (--driver-root /host) would otherwise be able
+        to forge slice identity."""
+        from k8s_dra_driver_tpu.discovery.sysfs import ENV_FILE_FLAG, SysfsBackend
+        monkeypatch.delenv(ENV_FILE_FLAG, raising=False)
+        host = fake_slice_hosts(4, topology="4x4")[2]
+        host.materialize(tmp_path)
+        assert (tmp_path / "tpu-env.json").is_file()  # the plant exists
+        topo = SysfsBackend(host_root=str(tmp_path)).enumerate()
+        assert topo.slice is None  # ...and is ignored
+        assert len(topo.chips) == 4  # sysfs enumeration itself unaffected
