@@ -40,6 +40,7 @@ import sys
 import time
 from pathlib import Path
 
+from ..coordclient import schedule as sched
 from ..utils import info
 from ..utils.flags import LoggingConfig, env_default
 
@@ -48,6 +49,9 @@ log = logging.getLogger("tpu-coordinatord")
 READY_FILE = "ready"
 SCHEDULE_FILE = "schedule.json"
 STATUS_FILE = "status.json"
+
+HBM_ACTION_REPORT = "report"
+HBM_ACTION_TERMINATE = "terminate"
 
 
 def _atomic_write(path: Path, text: str) -> None:
@@ -93,15 +97,27 @@ class Coordinator:
 
     def __init__(self, coordination_dir: Path, *, duty_cycle_percent: int,
                  preemption_ms: int, hbm_limits: dict[str, int],
-                 visible_chips: list[int], policy_dir: Path | None):
+                 visible_chips: list[int], policy_dir: Path | None,
+                 enforce: bool = False,
+                 hbm_action: str = HBM_ACTION_REPORT,
+                 now_ms=lambda: time.time() * 1000.0):
         self.dir = Path(coordination_dir)
         self.duty_cycle_percent = duty_cycle_percent
         self.claim_preemption_ms = preemption_ms
         self.hbm_limits = hbm_limits
         self.visible_chips = visible_chips
         self.policy_dir = Path(policy_dir) if policy_dir else None
+        self.enforce = enforce
+        self.hbm_action = hbm_action
+        self.now_ms = now_ms
         self.seq = 0
         self._last_schedule: str | None = None
+        # Timebase every participant's window math is phased against;
+        # fixed at construction so republishing never shifts windows.
+        self.epoch_ms = now_ms()
+        self._stopped_pids: set[int] = set()
+        self._terminated: set[str] = set()
+        self.violations: list[dict] = []
 
     # -- lifecycle -----------------------------------------------------
 
@@ -155,17 +171,24 @@ class Coordinator:
         """Recompute + publish the schedule; True if it changed."""
         quantum = self.effective_preemption_ms()
         workers = self.workers()
+        cycle = sched.cycle_ms_for(quantum)
+        windows = sched.compute_windows(workers, self.duty_cycle_percent,
+                                        cycle)
         slots = [{
-            "worker": w["name"],
+            "worker": win.worker,
             "slot": i,
+            "offsetMs": round(win.offset_ms, 3),
+            "windowMs": round(win.window_ms, 3),
             "dutyCyclePercent": (self.duty_cycle_percent // len(workers)
                                  if workers else self.duty_cycle_percent),
-        } for i, w in enumerate(workers)]
+        } for i, win in enumerate(windows)]
         schedule = {
             "chips": self.visible_chips,
             "preemptionMs": quantum,
             "dutyCyclePercent": self.duty_cycle_percent,
             "hbmLimits": self.hbm_limits,
+            "epochMs": self.epoch_ms,
+            "cycleMs": cycle,
             "slots": slots,
         }
         text = json.dumps(schedule, sort_keys=True)
@@ -174,14 +197,95 @@ class Coordinator:
             self.seq += 1
             self._last_schedule = text
             _atomic_write(self.dir / SCHEDULE_FILE, text)
+        self.violations = self._check_hbm(workers)
         _atomic_write(self.dir / STATUS_FILE, json.dumps({
             "pid": os.getpid(),
             "seq": self.seq,
             "workers": len(workers),
             "preemptionMs": quantum,
+            "enforce": self.enforce,
+            "violations": self.violations,
             "updatedAt": time.time(),
         }))
         return changed
+
+    # -- HBM limit supervision ----------------------------------------
+
+    def _worker_limit(self, reg: dict) -> int | None:
+        limit = reg.get("hbmLimitBytes")
+        if isinstance(limit, (int, float)) and not isinstance(limit, bool):
+            return int(limit)
+        if self.hbm_limits:
+            return sum(self.hbm_limits.values())
+        return None
+
+    def _check_hbm(self, workers: list[dict]) -> list[dict]:
+        """Compare heartbeat-reported HBM usage against limits — the
+        detection half the round-2 verdict asked for; ``terminate``
+        additionally SIGTERMs the violator (once) when enforcing."""
+        out = []
+        for reg in workers:
+            used = reg.get("hbmBytesInUse")
+            if not isinstance(used, (int, float)) or isinstance(used, bool):
+                continue
+            limit = self._worker_limit(reg)
+            if limit is None or used <= limit:
+                continue
+            record = {"worker": reg["name"], "usedBytes": int(used),
+                      "limitBytes": limit, "action": self.hbm_action}
+            out.append(record)
+            log.warning("HBM violation: worker %s uses %d > limit %d",
+                        reg["name"], used, limit)
+            if (self.hbm_action == HBM_ACTION_TERMINATE and self.enforce
+                    and reg["name"] not in self._terminated):
+                pid = reg.get("pid")
+                if isinstance(pid, int) and pid > 1:
+                    try:
+                        os.kill(pid, signal.SIGTERM)
+                        self._terminated.add(reg["name"])
+                        log.warning("terminated worker %s (pid %d)",
+                                    reg["name"], pid)
+                    except (ProcessLookupError, PermissionError) as e:
+                        log.warning("cannot terminate pid %d: %s", pid, e)
+        return out
+
+    # -- duty-cycle enforcement ---------------------------------------
+
+    def enforce_tick(self) -> None:
+        """Signal registered worker pids to match the schedule: SIGCONT
+        whoever's window is open, SIGSTOP everyone else.  Only
+        meaningful when the daemon shares a PID namespace with the
+        workloads (hostPID DaemonSet or in-pod sidecar); cross-pod
+        deployments get the same behavior from each workload's own
+        ``tpu-coordclient exec`` gate."""
+        if self._last_schedule is None:
+            return
+        schedule = json.loads(self._last_schedule)
+        active = sched.active_worker(schedule, self.now_ms())
+        for reg in self.workers():
+            pid = reg.get("pid")
+            if not isinstance(pid, int) or pid <= 1 or pid == os.getpid():
+                continue
+            run = reg["name"] == active
+            try:
+                if run and pid in self._stopped_pids:
+                    os.kill(pid, signal.SIGCONT)
+                    self._stopped_pids.discard(pid)
+                elif not run and pid not in self._stopped_pids:
+                    os.kill(pid, signal.SIGSTOP)
+                    self._stopped_pids.add(pid)
+            except (ProcessLookupError, PermissionError):
+                self._stopped_pids.discard(pid)
+
+    def release_all(self) -> None:
+        """SIGCONT every pid we froze (shutdown path — never leave
+        workloads stopped behind a dead coordinator)."""
+        for pid in list(self._stopped_pids):
+            try:
+                os.kill(pid, signal.SIGCONT)
+            except (ProcessLookupError, PermissionError):
+                pass
+        self._stopped_pids.clear()
 
     def serve(self, poll_interval: float, stop_event) -> None:
         self.start()

@@ -1,0 +1,20 @@
+"""Workload-side coordination: the consumer half of shared TPU claims.
+
+``CoordinatorClient`` + the ``tpu-coordclient`` gate give coordinated
+claims real duty-cycle arbitration; ``TimeshareGate`` gives plain
+time-sliced claims kernel-enforced mutual exclusion per preemption
+quantum.  Schedule math lives in ``schedule`` and is shared with the
+daemon (cmd/coordinatord.py)."""
+
+from .client import ENV_COORDINATION_DIR, CoordinatorClient
+from .gate import ENV_PREEMPTION_MS, ENV_TIMESHARE_DIR, TimeshareGate, main
+from .schedule import (DEFAULT_CYCLE_MS, SlotWindow, active_worker,
+                       compute_windows, cycle_ms_for, ms_left_in_turn,
+                       ms_until_turn)
+
+__all__ = [
+    "ENV_COORDINATION_DIR", "ENV_PREEMPTION_MS", "ENV_TIMESHARE_DIR",
+    "CoordinatorClient", "TimeshareGate", "main",
+    "DEFAULT_CYCLE_MS", "SlotWindow", "active_worker", "compute_windows",
+    "cycle_ms_for", "ms_left_in_turn", "ms_until_turn",
+]

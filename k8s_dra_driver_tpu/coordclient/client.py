@@ -1,0 +1,203 @@
+"""Workload-side coordinator client.
+
+The half of coordinated sharing the reference gets for free from the
+CUDA runtime: an MPS client library links against the daemon's control
+pipe, so ``set_active_thread_percentage`` is *enforced* inside every
+cooperating process (reference cmd/nvidia-dra-plugin/sharing.go:260-271).
+On TPU there is no vendor client runtime to piggyback on, so this module
+is that client: workloads (or the ``tpu-coordclient`` gate wrapping
+them) register with the per-claim coordinator daemon through the
+bind-mounted coordination directory, then gate their compute on the
+published duty-cycle schedule.
+
+Three usage tiers, strongest first:
+
+1. **Gate process** (``tpu-coordclient exec -- cmd``): runs the workload
+   as a child and SIGSTOP/SIGCONTs it outside its window — mandatory
+   for the wrapped process, needs no shared PID namespace because every
+   pod gates its own child (see gate.py).
+2. **Cooperative library** (``CoordinatorClient.duty_cycles()``): a JAX
+   training loop yields between steps only while its window is open.
+3. **Daemon-side enforcement** (``tpu-coordinatord --enforce``): when
+   the daemon shares a PID namespace with the workloads it signals the
+   registered pids itself (cmd/coordinatord.py).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from pathlib import Path
+
+from . import schedule as sched
+
+ENV_COORDINATION_DIR = "TPU_COORDINATOR_DIR"
+SCHEDULE_FILE = "schedule.json"
+READY_FILE = "ready"
+
+
+def _atomic_write(path: Path, text: str) -> None:
+    tmp = path.with_name(f".{path.name}.tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)
+
+
+def _now_ms() -> float:
+    return time.time() * 1000.0
+
+
+class CoordinatorClient:
+    """One workload's connection to its claim's coordinator daemon.
+
+    ``name`` identifies the worker across restarts (slot assignment is
+    name-ordered in the daemon); ``weight`` biases this worker's share
+    of the claim's duty cycle relative to its siblings.
+    """
+
+    def __init__(self, coordination_dir: str | Path | None = None, *,
+                 name: str | None = None, weight: float = 1.0,
+                 now_ms=_now_ms, sleep=time.sleep):
+        if coordination_dir is None:
+            coordination_dir = os.environ.get(ENV_COORDINATION_DIR)
+        if not coordination_dir:
+            raise ValueError(
+                f"no coordination dir: pass one or set {ENV_COORDINATION_DIR}")
+        self.dir = Path(coordination_dir)
+        self.name = name or f"w{os.getpid()}"
+        self.weight = weight
+        self._now_ms = now_ms
+        self._sleep = sleep
+        self._registered: dict | None = None
+
+    # -- registration --------------------------------------------------
+
+    @property
+    def _reg_path(self) -> Path:
+        return self.dir / "ctl" / f"{self.name}.json"
+
+    def register(self, pid: int | None = None,
+                 hbm_limit_bytes: int | None = None) -> None:
+        """Drop this worker's registration file; the daemon folds it
+        into the next published schedule."""
+        reg = {"pid": pid if pid is not None else os.getpid(),
+               "weight": self.weight,
+               "registeredAtMs": self._now_ms()}
+        if hbm_limit_bytes is not None:
+            reg["hbmLimitBytes"] = int(hbm_limit_bytes)
+        self._reg_path.parent.mkdir(parents=True, exist_ok=True)
+        _atomic_write(self._reg_path, json.dumps(reg))
+        self._registered = reg
+
+    def heartbeat(self, hbm_bytes_in_use: int | None = None) -> None:
+        """Refresh the registration; reporting HBM usage here is what
+        lets the daemon detect limit violations (status.json
+        ``violations``)."""
+        if self._registered is None:
+            self.register()
+        reg = dict(self._registered)
+        reg["heartbeatAtMs"] = self._now_ms()
+        if hbm_bytes_in_use is not None:
+            reg["hbmBytesInUse"] = int(hbm_bytes_in_use)
+        _atomic_write(self._reg_path, json.dumps(reg))
+        self._registered = reg
+
+    def unregister(self) -> None:
+        self._reg_path.unlink(missing_ok=True)
+        self._registered = None
+
+    # -- daemon state --------------------------------------------------
+
+    def daemon_ready(self) -> bool:
+        return (self.dir / READY_FILE).exists()
+
+    def wait_ready(self, timeout_s: float = 30.0) -> None:
+        deadline = self._now_ms() + timeout_s * 1000
+        while not self.daemon_ready():
+            if self._now_ms() >= deadline:
+                raise TimeoutError(
+                    f"coordinator at {self.dir} not ready in {timeout_s}s")
+            self._sleep(0.05)
+
+    def read_schedule(self) -> dict:
+        try:
+            payload = json.loads((self.dir / SCHEDULE_FILE).read_text())
+        except (OSError, ValueError):
+            return {}
+        return payload if isinstance(payload, dict) else {}
+
+    def wait_scheduled(self, timeout_s: float = 30.0) -> dict:
+        """Block until the published schedule contains our slot."""
+        deadline = self._now_ms() + timeout_s * 1000
+        while True:
+            schedule = self.read_schedule()
+            if any(s.get("worker") == self.name
+                   for s in schedule.get("slots", [])):
+                return schedule
+            if self._now_ms() >= deadline:
+                raise TimeoutError(
+                    f"worker {self.name} never appeared in schedule")
+            self._sleep(0.05)
+
+    # -- duty-cycle gating ---------------------------------------------
+
+    def my_turn(self, schedule: dict | None = None) -> bool:
+        schedule = schedule if schedule is not None else self.read_schedule()
+        return sched.active_worker(schedule, self._now_ms()) == self.name
+
+    def wait_turn(self, timeout_s: float | None = None) -> float:
+        """Block until our window opens; returns ms left in the window."""
+        deadline = (self._now_ms() + timeout_s * 1000
+                    if timeout_s is not None else None)
+        while True:
+            schedule = self.read_schedule()
+            now = self._now_ms()
+            wait = sched.ms_until_turn(schedule, self.name, now)
+            if wait == 0.0:
+                return sched.ms_left_in_turn(schedule, self.name, now)
+            if deadline is not None and now >= deadline:
+                raise TimeoutError(f"worker {self.name}: window never opened")
+            # Unscheduled yet: poll; scheduled: sleep out the gap.
+            self._sleep(0.02 if wait is None else min(wait / 1000.0, 0.5))
+
+    def duty_cycles(self, duration_s: float | None = None):
+        """Generator for cooperative loops::
+
+            for ms_left in client.duty_cycles():
+                run_one_step()   # sized well under the window
+
+        Yields (ms left in the current window) only while our window is
+        open, sleeping between windows; stops after ``duration_s``.
+        """
+        end = self._now_ms() + duration_s * 1000 if duration_s else None
+        while True:
+            if end is not None and self._now_ms() >= end:
+                return
+            left = self.wait_turn()
+            yield left
+
+    # -- HBM limits ----------------------------------------------------
+
+    def hbm_limit_bytes(self) -> int | None:
+        """This worker's HBM budget: its registered limit if any, else
+        the claim-wide limit from the schedule (sum over devices)."""
+        if self._registered and "hbmLimitBytes" in self._registered:
+            return self._registered["hbmLimitBytes"]
+        limits = self.read_schedule().get("hbmLimits") or {}
+        if not limits:
+            return None
+        return sum(int(v) for v in limits.values())
+
+    def apply_hbm_env(self, total_hbm_bytes: int,
+                      environ: dict | None = None) -> dict:
+        """Translate the HBM budget into the JAX/XLA client env that
+        must be set *before* jax initializes; returns the edits made."""
+        env = environ if environ is not None else os.environ
+        limit = self.hbm_limit_bytes()
+        edits: dict[str, str] = {}
+        if limit and total_hbm_bytes > 0:
+            frac = max(0.01, min(1.0, limit / total_hbm_bytes))
+            edits["XLA_PYTHON_CLIENT_MEM_FRACTION"] = f"{frac:.3f}"
+            edits["XLA_PYTHON_CLIENT_PREALLOCATE"] = "false"
+        env.update(edits)
+        return edits
