@@ -64,6 +64,29 @@ if [ "$GANG" != "1" ]; then
   [ -n "$c1" ] && [ "$c1" = "$c2" ] \
     || { echo "FAIL: pods disagree on shared claim"; exit 1; }
 
+  echo "=== tpu-test-enforced: duty-cycle gate on a shared chip ==="
+  kubectl apply -f "$SPECS/tpu-test-enforced.yaml"
+  # The coordinator Deployment must exist while the claim is prepared
+  # (checked BEFORE the pods finish: unprepare deletes it on teardown).
+  found_coord=0
+  for _ in $(seq 1 60); do
+    if kubectl -n tpu-dra-driver get deploy \
+      -l app.kubernetes.io/name=tpu-coordinator -o name | grep -q .; then
+      found_coord=1; break
+    fi
+    sleep 2
+  done
+  [ "$found_coord" = "1" ] \
+    || { echo "FAIL: no coordinator deployment for the shared claim"; exit 1; }
+  wait_done tpu-test-enforced pod1 pod2
+  t1=$(kubectl -n tpu-test-enforced logs pod1 \
+    | sed -n 's/^ticks=\([0-9]*\)$/\1/p' | head -1)
+  t2=$(kubectl -n tpu-test-enforced logs pod2 \
+    | sed -n 's/^ticks=\([0-9]*\)$/\1/p' | head -1)
+  echo "pod1 ticks=$t1  pod2 ticks=$t2"
+  [ -n "$t1" ] && [ "$t1" -gt 0 ] && [ -n "$t2" ] && [ "$t2" -gt 0 ] \
+    || { echo "FAIL: a gated workload made no progress"; exit 1; }
+
   echo "ACCEPTANCE OK (quickstart)"
 else
   echo "=== slice-test1: 4-host gang on one pod slice ==="

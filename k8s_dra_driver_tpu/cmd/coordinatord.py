@@ -42,6 +42,7 @@ from pathlib import Path
 
 from ..coordclient import schedule as sched
 from ..utils import info
+from ..utils.files import atomic_write
 from ..utils.flags import LoggingConfig, env_default
 
 log = logging.getLogger("tpu-coordinatord")
@@ -53,11 +54,12 @@ STATUS_FILE = "status.json"
 HBM_ACTION_REPORT = "report"
 HBM_ACTION_TERMINATE = "terminate"
 
-
-def _atomic_write(path: Path, text: str) -> None:
-    tmp = path.with_name(f".{path.name}.tmp")
-    tmp.write_text(text)
-    os.replace(tmp, path)
+#: registrations whose newest timestamp is older than this are evicted
+#: (a SIGKILLed workload never runs its gate's unregister; without
+#: eviction its slot wastes chip time forever and — worse — its pid
+#: gets signaled after kernel pid reuse).  Clients heartbeat at
+#: coordclient.client.HEARTBEAT_INTERVAL_S, well inside this.
+DEFAULT_STALE_AFTER_S = 15.0
 
 
 def _read_json_dict(path: Path) -> dict | None:
@@ -100,6 +102,7 @@ class Coordinator:
                  visible_chips: list[int], policy_dir: Path | None,
                  enforce: bool = False,
                  hbm_action: str = HBM_ACTION_REPORT,
+                 stale_after_s: float = DEFAULT_STALE_AFTER_S,
                  now_ms=lambda: time.time() * 1000.0):
         self.dir = Path(coordination_dir)
         self.duty_cycle_percent = duty_cycle_percent
@@ -109,6 +112,7 @@ class Coordinator:
         self.policy_dir = Path(policy_dir) if policy_dir else None
         self.enforce = enforce
         self.hbm_action = hbm_action
+        self.stale_after_s = stale_after_s
         self.now_ms = now_ms
         self.seq = 0
         self._last_schedule: str | None = None
@@ -116,8 +120,15 @@ class Coordinator:
         # fixed at construction so republishing never shifts windows.
         self.epoch_ms = now_ms()
         self._stopped_pids: set[int] = set()
-        self._terminated: set[str] = set()
+        # worker name -> pid we SIGTERMed; a re-registration with a NEW
+        # pid is a fresh process and gets fresh enforcement.
+        self._terminated: dict[str, int] = {}
         self.violations: list[dict] = []
+        # step()-refreshed caches so enforce_tick (which runs at
+        # sub-quantum frequency) does no disk IO of its own.
+        self._schedule_cache: dict = {}
+        self._workers_cache: list[dict] = []
+        self._quantum_cache: int = 0
 
     # -- lifecycle -----------------------------------------------------
 
@@ -125,7 +136,7 @@ class Coordinator:
         (self.dir / "ctl").mkdir(parents=True, exist_ok=True)
         (self.dir / "log").mkdir(parents=True, exist_ok=True)
         self.step()                      # publish an initial schedule
-        _atomic_write(self.dir / READY_FILE,
+        atomic_write(self.dir / READY_FILE,
                       json.dumps({"pid": os.getpid(),
                                   "startedSeq": self.seq}))
         log.info("coordinator ready: dir=%s chips=%s duty=%d%%",
@@ -154,23 +165,50 @@ class Coordinator:
         return quantum
 
     def workers(self) -> list[dict]:
-        """Registered workloads: ``ctl/<name>.json`` drop-files."""
+        """Registered workloads: ``ctl/<name>.json`` drop-files.
+        Evicts registrations that stopped heartbeating ``stale_after_s``
+        ago — a SIGKILLed gate never unregisters, and keeping its slot
+        both wastes chip time and risks signaling a recycled pid."""
         found = []
         ctl = self.dir / "ctl"
         if not ctl.is_dir():
             return found
+        now = self.now_ms()
         for path in sorted(ctl.glob("*.json")):
             reg = _read_json_dict(path)
             if reg is None:
                 continue             # torn write or non-object payload
             reg["name"] = path.stem
+            last = reg.get("heartbeatAtMs", reg.get("registeredAtMs"))
+            if self.stale_after_s > 0 and isinstance(last, (int, float)) \
+                    and not isinstance(last, bool) \
+                    and now - last > self.stale_after_s * 1000:
+                log.warning("evicting stale worker %s (last seen %.1fs ago)",
+                            reg["name"], (now - last) / 1000)
+                self._forget_worker(reg)
+                path.unlink(missing_ok=True)
+                continue
             found.append(reg)
         return found
+
+    def _forget_worker(self, reg: dict) -> None:
+        """Never leave an evicted worker's pid frozen, and let a future
+        re-registration get fresh HBM enforcement."""
+        pid = reg.get("pid")
+        if isinstance(pid, int) and pid in self._stopped_pids:
+            try:
+                self._signal_worker(reg, signal.SIGCONT)
+            except (ProcessLookupError, PermissionError):
+                pass
+            self._stopped_pids.discard(pid)
+        self._terminated.pop(reg["name"], None)
 
     def step(self) -> bool:
         """Recompute + publish the schedule; True if it changed."""
         quantum = self.effective_preemption_ms()
         workers = self.workers()
+        self._workers_cache = workers
+        self._quantum_cache = quantum
         cycle = sched.cycle_ms_for(quantum)
         windows = sched.compute_windows(workers, self.duty_cycle_percent,
                                         cycle)
@@ -192,13 +230,14 @@ class Coordinator:
             "slots": slots,
         }
         text = json.dumps(schedule, sort_keys=True)
+        self._schedule_cache = schedule
         changed = text != self._last_schedule
         if changed:
             self.seq += 1
             self._last_schedule = text
-            _atomic_write(self.dir / SCHEDULE_FILE, text)
+            atomic_write(self.dir / SCHEDULE_FILE, text)
         self.violations = self._check_hbm(workers)
-        _atomic_write(self.dir / STATUS_FILE, json.dumps({
+        atomic_write(self.dir / STATUS_FILE, json.dumps({
             "pid": os.getpid(),
             "seq": self.seq,
             "workers": len(workers),
@@ -236,17 +275,20 @@ class Coordinator:
             out.append(record)
             log.warning("HBM violation: worker %s uses %d > limit %d",
                         reg["name"], used, limit)
+            pid = reg.get("pid")
+            # Terminate once per PROCESS: a worker that re-registers
+            # under the same name with a new pid (container restart) is
+            # a fresh violator and gets enforced again.
             if (self.hbm_action == HBM_ACTION_TERMINATE and self.enforce
-                    and reg["name"] not in self._terminated):
-                pid = reg.get("pid")
-                if isinstance(pid, int) and pid > 1:
-                    try:
-                        os.kill(pid, signal.SIGTERM)
-                        self._terminated.add(reg["name"])
-                        log.warning("terminated worker %s (pid %d)",
-                                    reg["name"], pid)
-                    except (ProcessLookupError, PermissionError) as e:
-                        log.warning("cannot terminate pid %d: %s", pid, e)
+                    and isinstance(pid, int) and pid > 1
+                    and self._terminated.get(reg["name"]) != pid):
+                try:
+                    self._signal_worker(reg, signal.SIGTERM)
+                    self._terminated[reg["name"]] = pid
+                    log.warning("terminated worker %s (pid %d)",
+                                reg["name"], pid)
+                except (ProcessLookupError, PermissionError) as e:
+                    log.warning("cannot terminate pid %d: %s", pid, e)
         return out
 
     # -- duty-cycle enforcement ---------------------------------------
@@ -258,42 +300,80 @@ class Coordinator:
         workloads (hostPID DaemonSet or in-pod sidecar); cross-pod
         deployments get the same behavior from each workload's own
         ``tpu-coordclient exec`` gate."""
-        if self._last_schedule is None:
+        if not self._schedule_cache:
             return
-        schedule = json.loads(self._last_schedule)
-        active = sched.active_worker(schedule, self.now_ms())
-        for reg in self.workers():
+        active = sched.active_worker(self._schedule_cache, self.now_ms())
+        # Cached worker list: this runs at sub-quantum frequency and
+        # must not re-read ctl/ every tick; registration changes land
+        # at the next step() (≤ one poll interval away).
+        for reg in self._workers_cache:
             pid = reg.get("pid")
             if not isinstance(pid, int) or pid <= 1 or pid == os.getpid():
                 continue
             run = reg["name"] == active
             try:
                 if run and pid in self._stopped_pids:
-                    os.kill(pid, signal.SIGCONT)
+                    self._signal_worker(reg, signal.SIGCONT)
                     self._stopped_pids.discard(pid)
                 elif not run and pid not in self._stopped_pids:
-                    os.kill(pid, signal.SIGSTOP)
+                    self._signal_worker(reg, signal.SIGSTOP)
                     self._stopped_pids.add(pid)
             except (ProcessLookupError, PermissionError):
                 self._stopped_pids.discard(pid)
 
+    @staticmethod
+    def _signal_worker(reg: dict, sig: int) -> None:
+        """Signal the worker's whole process group when its
+        registration vouches the pid is a group leader (the gate's
+        children are session leaders) — otherwise a forked workload
+        would escape daemon-side enforcement; fall back to the pid."""
+        pid = reg["pid"]
+        if reg.get("pidIsGroup") is True:
+            try:
+                os.killpg(pid, sig)
+                return
+            except (ProcessLookupError, PermissionError):
+                pass
+        os.kill(pid, sig)
+
     def release_all(self) -> None:
-        """SIGCONT every pid we froze (shutdown path — never leave
-        workloads stopped behind a dead coordinator)."""
+        """SIGCONT everything we froze (shutdown path — never leave
+        workloads stopped behind a dead coordinator).  Uses the cached
+        registrations so group-frozen workers (pidIsGroup) get their
+        whole group resumed, not just the leader."""
+        regs = {reg.get("pid"): reg for reg in self._workers_cache
+                if isinstance(reg.get("pid"), int)}
         for pid in list(self._stopped_pids):
             try:
-                os.kill(pid, signal.SIGCONT)
+                self._signal_worker(regs.get(pid, {"pid": pid}),
+                                    signal.SIGCONT)
             except (ProcessLookupError, PermissionError):
                 pass
         self._stopped_pids.clear()
 
     def serve(self, poll_interval: float, stop_event) -> None:
+        """Arbitration loop.  Schedule recomputation runs every
+        ``poll_interval``; when ``enforce`` is on, the signal-based
+        duty-cycle enforcer ticks much faster (a fraction of the
+        preemption quantum) so window boundaries are honored with
+        sub-quantum latency."""
         self.start()
         try:
+            next_step = time.monotonic()
             while not stop_event.is_set():
-                stop_event.wait(poll_interval)
-                self.step()
+                now = time.monotonic()
+                if now >= next_step:
+                    self.step()
+                    next_step = now + poll_interval
+                if self.enforce:
+                    self.enforce_tick()
+                    quantum = self._quantum_cache or sched.DEFAULT_CYCLE_MS
+                    tick = min(poll_interval, max(0.002, quantum / 1000 / 8))
+                    stop_event.wait(tick)
+                else:
+                    stop_event.wait(max(0.0, next_step - time.monotonic()))
         finally:
+            self.release_all()
             self.stop()
 
 
@@ -330,6 +410,22 @@ def build_parser() -> argparse.ArgumentParser:
                    default=env_default("POLL_INTERVAL", 1.0, float),
                    help="arbitration loop period seconds "
                         "[env POLL_INTERVAL] (default 1)")
+    p.add_argument("--stale-after", type=float,
+                   default=env_default("STALE_AFTER", DEFAULT_STALE_AFTER_S,
+                                       float),
+                   help="evict registrations silent this many seconds "
+                        "(0 disables) [env STALE_AFTER]")
+    p.add_argument("--enforce", action="store_true",
+                   default=env_default("ENFORCE", "", str) == "true",
+                   help="SIGSTOP/SIGCONT registered worker pids to the "
+                        "schedule (requires a shared PID namespace: "
+                        "in-pod sidecar or hostPID) [env ENFORCE=true]")
+    p.add_argument("--hbm-action",
+                   choices=[HBM_ACTION_REPORT, HBM_ACTION_TERMINATE],
+                   default=env_default("HBM_ACTION", HBM_ACTION_REPORT),
+                   help="on HBM-limit violation: report in status.json, "
+                        "or additionally SIGTERM the violator when "
+                        "--enforce [env HBM_ACTION]")
     LoggingConfig.add_flags(p)
     return p
 
@@ -351,7 +447,10 @@ def main(argv: list[str] | None = None) -> int:
         preemption_ms=args.preemption_ms,
         hbm_limits=_parse_hbm_limits(args.hbm_limits),
         visible_chips=_parse_chips(args.visible_chips),
-        policy_dir=policy_dir)
+        policy_dir=policy_dir,
+        enforce=args.enforce,
+        hbm_action=args.hbm_action,
+        stale_after_s=args.stale_after)
 
     stop = threading.Event()
 

@@ -79,9 +79,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "[env COORDINATOR_NAMESPACE]")
     p.add_argument("--coordinator-image",
                    default=env_default("COORDINATOR_IMAGE", ""),
-                   help="image for per-claim coordinator Deployments; "
-                        "empty uses the built-in default (the driver "
-                        "image, which ships tpu-coordinatord) "
+                   help="image for per-claim coordinator Deployments "
+                        "(the driver image — it ships tpu-coordinatord); "
+                        "REQUIRED before Coordinated claims can prepare: "
+                        "left empty, such claims fail in-band "
                         "[env COORDINATOR_IMAGE]")
     p.add_argument("--http-endpoint",
                    default=env_default("HTTP_ENDPOINT", ""),

@@ -31,16 +31,16 @@ import time
 from pathlib import Path
 
 from . import schedule as sched
+from ..utils.files import atomic_write
 
 ENV_COORDINATION_DIR = "TPU_COORDINATOR_DIR"
 SCHEDULE_FILE = "schedule.json"
 READY_FILE = "ready"
 
-
-def _atomic_write(path: Path, text: str) -> None:
-    tmp = path.with_name(f".{path.name}.tmp")
-    tmp.write_text(text)
-    os.replace(tmp, path)
+#: how often a live client refreshes its registration; the daemon
+#: evicts anything silent for coordinatord.DEFAULT_STALE_AFTER_S (15s),
+#: so this must stay comfortably inside that.
+HEARTBEAT_INTERVAL_S = 3.0
 
 
 def _now_ms() -> float:
@@ -69,6 +69,7 @@ class CoordinatorClient:
         self._now_ms = now_ms
         self._sleep = sleep
         self._registered: dict | None = None
+        self._last_heartbeat_ms: float = 0.0
 
     # -- registration --------------------------------------------------
 
@@ -77,17 +78,23 @@ class CoordinatorClient:
         return self.dir / "ctl" / f"{self.name}.json"
 
     def register(self, pid: int | None = None,
-                 hbm_limit_bytes: int | None = None) -> None:
+                 hbm_limit_bytes: int | None = None,
+                 pid_is_group: bool = False) -> None:
         """Drop this worker's registration file; the daemon folds it
-        into the next published schedule."""
+        into the next published schedule.  ``pid_is_group`` tells a
+        daemon-side enforcer it may signal the whole process group
+        (the gate sets it: its children are session leaders)."""
         reg = {"pid": pid if pid is not None else os.getpid(),
                "weight": self.weight,
                "registeredAtMs": self._now_ms()}
+        if pid_is_group:
+            reg["pidIsGroup"] = True
         if hbm_limit_bytes is not None:
             reg["hbmLimitBytes"] = int(hbm_limit_bytes)
         self._reg_path.parent.mkdir(parents=True, exist_ok=True)
-        _atomic_write(self._reg_path, json.dumps(reg))
+        atomic_write(self._reg_path, json.dumps(reg))
         self._registered = reg
+        self._last_heartbeat_ms = self._now_ms()
 
     def heartbeat(self, hbm_bytes_in_use: int | None = None) -> None:
         """Refresh the registration; reporting HBM usage here is what
@@ -99,8 +106,19 @@ class CoordinatorClient:
         reg["heartbeatAtMs"] = self._now_ms()
         if hbm_bytes_in_use is not None:
             reg["hbmBytesInUse"] = int(hbm_bytes_in_use)
-        _atomic_write(self._reg_path, json.dumps(reg))
+        atomic_write(self._reg_path, json.dumps(reg))
         self._registered = reg
+        self._last_heartbeat_ms = self._now_ms()
+
+    def maybe_heartbeat(self) -> None:
+        """Heartbeat if ``HEARTBEAT_INTERVAL_S`` has elapsed — called
+        from the gating loops so a live worker is never mistaken for a
+        SIGKILLed one and evicted by the daemon."""
+        if self._registered is None:
+            return
+        if self._now_ms() - self._last_heartbeat_ms >= \
+                HEARTBEAT_INTERVAL_S * 1000:
+            self.heartbeat()
 
     def unregister(self) -> None:
         self._reg_path.unlink(missing_ok=True)
@@ -114,6 +132,9 @@ class CoordinatorClient:
     def wait_ready(self, timeout_s: float = 30.0) -> None:
         deadline = self._now_ms() + timeout_s * 1000
         while not self.daemon_ready():
+            # keep the registration fresh while we wait: a slow-to-
+            # start daemon must not evict us as stale on first sight
+            self.maybe_heartbeat()
             if self._now_ms() >= deadline:
                 raise TimeoutError(
                     f"coordinator at {self.dir} not ready in {timeout_s}s")
@@ -130,6 +151,9 @@ class CoordinatorClient:
         """Block until the published schedule contains our slot."""
         deadline = self._now_ms() + timeout_s * 1000
         while True:
+            # re-drop the registration if the daemon evicted it while
+            # we waited (restart, slow start) — else this livelocks
+            self.maybe_heartbeat()
             schedule = self.read_schedule()
             if any(s.get("worker") == self.name
                    for s in schedule.get("slots", [])):
@@ -150,6 +174,7 @@ class CoordinatorClient:
         deadline = (self._now_ms() + timeout_s * 1000
                     if timeout_s is not None else None)
         while True:
+            self.maybe_heartbeat()
             schedule = self.read_schedule()
             now = self._now_ms()
             wait = sched.ms_until_turn(schedule, self.name, now)

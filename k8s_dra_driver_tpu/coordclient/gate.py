@@ -105,27 +105,66 @@ class TimeshareGate:
 
 
 class _ChildGate:
-    """SIGSTOP/SIGCONT a child process according to a turn oracle."""
+    """SIGSTOP/SIGCONT a child process *group* according to a turn
+    oracle.
+
+    The child is spawned with ``start_new_session=True`` (see
+    ``_spawn``) so its pid is also its process-group id: signaling the
+    group catches workloads that fork — ``sh -c``, launcher scripts,
+    ``multiprocessing`` — which a single-pid gate would let escape
+    enforcement entirely."""
 
     def __init__(self, proc: subprocess.Popen):
         self.proc = proc
         self.stopped = False
 
+    def _signal(self, sig: int) -> None:
+        try:
+            os.killpg(self.proc.pid, sig)
+        except (ProcessLookupError, PermissionError):
+            try:
+                self.proc.send_signal(sig)
+            except ProcessLookupError:
+                pass
+
     def allow(self, run: bool) -> None:
         if self.proc.poll() is not None:
-            return
-        try:
-            if run and self.stopped:
-                self.proc.send_signal(signal.SIGCONT)
-                self.stopped = False
-            elif not run and not self.stopped:
-                self.proc.send_signal(signal.SIGSTOP)
-                self.stopped = True
-        except ProcessLookupError:
-            pass
+            # reap done; still signal the group so forked stragglers
+            # of an exited wrapper aren't left frozen
+            if not run:
+                return
+        if run and self.stopped:
+            self._signal(signal.SIGCONT)
+            self.stopped = False
+        elif not run and not self.stopped:
+            self._signal(signal.SIGSTOP)
+            self.stopped = True
 
     def resume(self) -> None:
         self.allow(True)
+
+
+def _spawn(cmd: list[str]) -> subprocess.Popen:
+    """Launch the workload in its own session/process group so gating
+    and teardown signals reach every process it forks."""
+    return subprocess.Popen(cmd, start_new_session=True)
+
+
+def _teardown(proc: subprocess.Popen) -> None:
+    """Terminate the workload's whole group; escalate to SIGKILL."""
+    if proc.poll() is None:
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            proc.terminate()
+        try:
+            proc.wait(10)
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                proc.kill()
+            proc.wait()
 
 
 def _run_coordinated(args, cmd: list[str]) -> int:
@@ -134,13 +173,14 @@ def _run_coordinated(args, cmd: list[str]) -> int:
     client.wait_ready(args.ready_timeout)
     # Start the child stopped-equivalent: launched, then immediately
     # gated before it can reach the chip out of turn.
-    proc = subprocess.Popen(cmd)
-    client.register(pid=proc.pid)
+    proc = _spawn(cmd)
+    client.register(pid=proc.pid, pid_is_group=True)
     gate = _ChildGate(proc)
     gate.allow(False)
     try:
         client.wait_scheduled(args.ready_timeout)
         while proc.poll() is None:
+            client.maybe_heartbeat()
             schedule = client.read_schedule()
             now = client._now_ms()
             my_turn = sched.active_worker(schedule, now) == client.name
@@ -156,17 +196,12 @@ def _run_coordinated(args, cmd: list[str]) -> int:
         return proc.returncode
     finally:
         gate.resume()                 # never leave a frozen child behind
-        if proc.poll() is None:
-            proc.terminate()
-            try:
-                proc.wait(10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        _teardown(proc)
         client.unregister()
 
 
 def _run_timeshared(gate: TimeshareGate, cmd: list[str]) -> int:
-    proc = subprocess.Popen(cmd)
+    proc = _spawn(cmd)
     child = _ChildGate(proc)
     child.allow(False)
     try:
@@ -183,12 +218,7 @@ def _run_timeshared(gate: TimeshareGate, cmd: list[str]) -> int:
         return proc.returncode
     finally:
         child.resume()
-        if proc.poll() is None:
-            proc.terminate()
-            try:
-                proc.wait(10)
-            except subprocess.TimeoutExpired:
-                proc.kill()
+        _teardown(proc)
 
 
 def build_parser() -> argparse.ArgumentParser:

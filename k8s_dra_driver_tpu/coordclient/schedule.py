@@ -39,6 +39,16 @@ class SlotWindow:
         return self.offset_ms <= phase_ms < self.offset_ms + self.window_ms
 
 
+def _weight_of(reg: dict) -> float:
+    """Registration weight, hardened: ctl/*.json is written by
+    untrusted workload containers, so a non-numeric weight degrades to
+    the default 1 instead of crashing the daemon's arbitration loop."""
+    w = reg.get("weight", 1)
+    if isinstance(w, bool) or not isinstance(w, (int, float)):
+        return 1.0
+    return max(0.0, float(w))
+
+
 def cycle_ms_for(preemption_ms: int) -> int:
     """The cycle length: the configured preemption quantum, or a
     default short enough that alternation is imperceptible."""
@@ -53,7 +63,7 @@ def compute_windows(workers: list[dict], duty_cycle_percent: int,
     optional, default 1).  Non-positive weights get no window.
     """
     active_ms = cycle_ms * max(0, min(100, duty_cycle_percent)) / 100.0
-    weights = [max(0.0, float(w.get("weight", 1) or 0)) for w in workers]
+    weights = [_weight_of(w) for w in workers]
     total = sum(weights)
     out: list[SlotWindow] = []
     offset = 0.0

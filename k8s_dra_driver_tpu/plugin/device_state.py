@@ -48,7 +48,9 @@ class DeviceStateConfig:
     driver_root: str = "/"
     device_kinds: tuple[str, ...] = (KIND_CHIP, KIND_CORE, KIND_SLICE)
     coordinator_namespace: str = "tpu-dra-driver"
-    coordinator_image: str = ""      # empty = sharing.py default
+    coordinator_image: str = ""      # required before a coordinated
+                                     # claim can prepare (sharing.py
+                                     # raises in-band otherwise)
 
 
 # Which config kinds may govern which device kinds.
@@ -264,6 +266,16 @@ class DeviceState:
             edits = ContainerEdits()
             edits.env["TPU_RUNTIME_PREEMPTION_MS"] = str(
                 sharing.time_slicing.interval_ms)
+            # The quantum's enforcement point: tpu-coordclient contends
+            # for per-chip flocks in the node timeshare dir, so claims
+            # sharing a chip get kernel-enforced alternation (the GPU
+            # scheduler-knob analog, nvlib.go:521-539).
+            edits.env["TPU_TIMESHARE_DIR"] = \
+                TimeSlicingManager.CONTAINER_TIMESHARE_DIR
+            edits.mounts.append(
+                (str(self.timeslicing.timeshare_dir),
+                 TimeSlicingManager.CONTAINER_TIMESHARE_DIR,
+                 ("rw", "bind")))
             return edits
         if sharing.strategy == configapi.STRATEGY_COORDINATED:
             daemon = self.coordinators.new_daemon(

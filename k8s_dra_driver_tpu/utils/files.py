@@ -1,0 +1,15 @@
+"""Small file-IO helpers shared across daemon and client sides."""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def atomic_write(path: Path, text: str) -> None:
+    """Write-then-rename so concurrent readers never observe torn
+    content (the coordination-dir contract: every published file is
+    either absent or complete)."""
+    tmp = path.with_name(f".{path.name}.tmp")
+    tmp.write_text(text)
+    os.replace(tmp, path)

@@ -181,7 +181,8 @@ class TestRestartUnderLoad:
             state2 = DeviceState(backend, bed.cluster, DeviceStateConfig(
                 plugin_root=str(tmp_path / "plugin" / "h0"),
                 cdi_root=str(tmp_path / "cdi" / "h0"),
-                node_name="h0"))
+                node_name="h0",
+                coordinator_image="registry.local/tpu-dra-driver:test"))
             assert set(state2.prepared) == set(before)
             # idempotent re-prepare over the restarted driver
             driver2 = Driver(state2, bed.cluster,

@@ -23,8 +23,7 @@ import yaml
 
 from k8s_dra_driver_tpu.cmd import coordinatord
 from k8s_dra_driver_tpu.cmd.coordinatord import Coordinator
-from k8s_dra_driver_tpu.plugin.sharing import (DEFAULT_COORDINATOR_IMAGE,
-                                               TEMPLATE_PATH,
+from k8s_dra_driver_tpu.plugin.sharing import (TEMPLATE_PATH,
                                                TimeSlicingManager)
 
 REPO = Path(__file__).parent.parent
@@ -157,7 +156,8 @@ class TestTemplateBuildCoherence:
         text = string.Template(TEMPLATE_PATH.read_text()).substitute(
             name="tpu-coordinator-x", namespace="tpu-dra-driver",
             claim_uid="uid-1", id="x", node_name="node-1",
-            image=DEFAULT_COORDINATOR_IMAGE, duty_cycle_percent="50",
+            image="registry.local/tpu-dra-driver:test",
+            duty_cycle_percent="50",
             preemption_ms="0", hbm_limits="", visible_chips="0",
             coordination_dir=str(tmp_path / "c"),
             policy_dir=str(tmp_path / "p"))

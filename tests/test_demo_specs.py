@@ -167,6 +167,35 @@ def test_tpu_test_coordinator_shared(single_host):
     assert len(single_host.cluster.list("Deployment")) == 1
 
 
+def test_tpu_test_enforced_gated_workloads(single_host):
+    """The enforcement demo: both pods share the chip through the
+    coordinator AND their entrypoints are the real tpu-coordclient
+    gate (its statistics are pinned in test_coordclient.py — here we
+    pin that the spec actually wires it)."""
+    from k8s_dra_driver_tpu.coordclient import gate
+
+    docs = load("tpu-test-enforced.yaml")
+    r = SpecRunner(single_host, docs)
+    v1, v2 = (r.run(p) for p in r.pods)
+    assert v1.visible_chips == v2.visible_chips
+    assert v1.env["TPU_COORDINATOR_DIR"] == "/coordination"
+    assert len(single_host.cluster.list("Deployment")) == 1
+    for pod in r.pods:
+        ctr = pod["spec"]["containers"][0]
+        # the entrypoint is the gate binary the driver image ships
+        assert ctr["command"] == ["tpu-coordclient"]
+        assert ctr["image"] == "tpu-dra-driver:dev"
+        scripts = (Path(__file__).parent.parent / "pyproject.toml").read_text()
+        assert "tpu-coordclient = " in scripts
+        # and its args parse with the real gate parser
+        args = list(ctr["args"])
+        sep = args.index("--")
+        ns = gate.build_parser().parse_args(args[:sep])
+        assert ns.command == "exec"
+        assert ns.name in ("pod1", "pod2")
+        assert args[sep + 1 :][0] == "python"
+
+
 def test_tpu_test_slice_contiguous(single_host):
     r = SpecRunner(single_host, load("tpu-test-slice.yaml"))
     (pod,) = r.pods

@@ -22,7 +22,8 @@ def rig(tmp_path):
     cfg = DeviceStateConfig(
         plugin_root=str(tmp_path / "plugin"),
         cdi_root=str(tmp_path / "cdi"),
-        node_name="tpu-host-0")
+        node_name="tpu-host-0",
+        coordinator_image="registry.local/tpu-dra-driver:test")
     state = DeviceState(backend, cluster, cfg)
     driver = Driver(state, cluster, plugin_dir=str(tmp_path / "plugin"))
     driver.start()

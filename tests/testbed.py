@@ -105,7 +105,8 @@ class E2EBed:
         state = DeviceState(backend, self.cluster, DeviceStateConfig(
             plugin_root=str(self.tmp / "plugin" / name),
             cdi_root=str(self.tmp / "cdi" / name),
-            node_name=name))
+            node_name=name,
+            coordinator_image="registry.local/tpu-dra-driver:test"))
         driver = Driver(state, self.cluster,
                         plugin_dir=str(self.tmp / "plugin" / name))
         driver.start()
