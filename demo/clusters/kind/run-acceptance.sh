@@ -9,6 +9,47 @@ set -euo pipefail
 
 SPECS="$(cd "$(dirname "$0")/../../specs/quickstart" && pwd)"
 GANG="${GANG:-0}"
+# Real-kubelet latency artifact (BASELINE metric: claim→pod-Running on
+# a live cluster): one JSON object per pod, aggregated at the end.
+LATENCY_OUT="${LATENCY_OUT:-acceptance-latency.json}"
+: > "$LATENCY_OUT.records"
+
+record_latency() {   # ns pod: append claim->running / create->running
+  local ns="$1" pod="$2"
+  local created started claim_created claim
+  created=$(kubectl -n "$ns" get pod "$pod" \
+    -o jsonpath='{.metadata.creationTimestamp}' 2>/dev/null || echo "")
+  # when the first container actually entered Running (terminal pods
+  # keep it under terminated.startedAt)
+  started=$(kubectl -n "$ns" get pod "$pod" -o jsonpath\
+='{.status.containerStatuses[0].state.terminated.startedAt}' \
+    2>/dev/null || echo "")
+  [ -n "$started" ] || started=$(kubectl -n "$ns" get pod "$pod" \
+    -o jsonpath='{.status.containerStatuses[0].state.running.startedAt}' \
+    2>/dev/null || echo "")
+  claim=$(kubectl -n "$ns" get pod "$pod" \
+    -o jsonpath='{.spec.resourceClaims[0].resourceClaimName}' \
+    2>/dev/null || echo "")
+  claim_created=""
+  [ -n "$claim" ] && claim_created=$(kubectl -n "$ns" get resourceclaim \
+    "$claim" -o jsonpath='{.metadata.creationTimestamp}' \
+    2>/dev/null || echo "")
+  if [ -n "$created" ] && [ -n "$started" ]; then
+    local t_pod t_run t_claim pod_s claim_s
+    t_pod=$(date -d "$created" +%s)
+    t_run=$(date -d "$started" +%s)
+    pod_s=$((t_run - t_pod))
+    claim_s=null
+    if [ -n "$claim_created" ]; then
+      t_claim=$(date -d "$claim_created" +%s)
+      claim_s=$((t_run - t_claim))
+    fi
+    echo "{\"ns\": \"$ns\", \"pod\": \"$pod\"," \
+         "\"pod_create_to_running_s\": $pod_s," \
+         "\"claim_create_to_running_s\": $claim_s}" \
+      >> "$LATENCY_OUT.records"
+  fi
+}
 
 wait_done() {   # ns, pod...: wait for terminal Succeeded
   local ns="$1"; shift
@@ -16,7 +57,7 @@ wait_done() {   # ns, pod...: wait for terminal Succeeded
     for _ in $(seq 1 90); do
       phase=$(kubectl -n "$ns" get pod "$pod" \
         -o jsonpath='{.status.phase}' 2>/dev/null || echo "")
-      [ "$phase" = "Succeeded" ] && continue 2
+      [ "$phase" = "Succeeded" ] && { record_latency "$ns" "$pod"; continue 2; }
       [ "$phase" = "Failed" ] && {
         echo "FAIL: $ns/$pod failed"; kubectl -n "$ns" logs "$pod" || true
         kubectl -n "$ns" describe pod "$pod" | tail -20; exit 1; }
@@ -26,6 +67,58 @@ wait_done() {   # ns, pod...: wait for terminal Succeeded
     kubectl -n "$ns" describe pod "$pod" | tail -30
     exit 1
   done
+}
+
+finalize_latency() {  # aggregate records -> $LATENCY_OUT (p50 etc.)
+  python3 - "$LATENCY_OUT" <<'PYEOF'
+import json, statistics, sys
+out = sys.argv[1]
+records = []
+with open(out + ".records") as f:
+    for line in f:
+        if line.strip():
+            records.append(json.loads(line))
+claim = sorted(r["claim_create_to_running_s"] for r in records
+               if isinstance(r.get("claim_create_to_running_s"), int))
+pod = sorted(r["pod_create_to_running_s"] for r in records
+             if isinstance(r.get("pod_create_to_running_s"), int))
+summary = {
+    "metric": "claim_to_pod_running_on_live_kubelet",
+    "unit": "s",
+    "samples": len(records),
+    "claim_create_to_running_p50_s":
+        statistics.median(claim) if claim else None,
+    "pod_create_to_running_p50_s":
+        statistics.median(pod) if pod else None,
+    "note": ("1s timestamp resolution (kube RFC3339); includes image "
+             "start + kubelet scheduling, i.e. the full user-visible "
+             "path the hermetic bench.py excludes"),
+    "records": records,
+}
+with open(out, "w") as f:
+    json.dump(summary, f, indent=2)
+print("latency artifact:", out)
+print(json.dumps({k: v for k, v in summary.items() if k != "records"}))
+PYEOF
+}
+
+assert_prepare_metrics() {  # the Prometheus prepare histogram must be live
+  local pod
+  pod=$(kubectl -n tpu-dra-driver get pods \
+    -l app.kubernetes.io/component=kubelet-plugin \
+    -o jsonpath='{.items[0].metadata.name}' 2>/dev/null || echo "")
+  [ -n "$pod" ] || { echo "FAIL: no kubelet-plugin pod for metrics"; exit 1; }
+  local metrics
+  metrics=$(kubectl -n tpu-dra-driver exec "$pod" -- python3 -c \
+    "import urllib.request; print(urllib.request.urlopen('http://127.0.0.1:8080/metrics', timeout=5).read().decode())" \
+    2>/dev/null || echo "")
+  echo "$metrics" | grep -q "tpu_dra_prepare_seconds_count" \
+    || { echo "FAIL: prepare histogram absent from /metrics"; exit 1; }
+  local count
+  count=$(echo "$metrics" | sed -n 's/^tpu_dra_prepare_seconds_count \([0-9.e+]*\)$/\1/p' | head -1)
+  python3 -c "import sys; sys.exit(0 if float('$count' or 0) > 0 else 1)" \
+    || { echo "FAIL: prepare histogram never observed a prepare"; exit 1; }
+  echo "prepare histogram populated: count=$count"
 }
 
 chips_of() {    # ns pod [container]
@@ -87,6 +180,8 @@ if [ "$GANG" != "1" ]; then
   [ -n "$t1" ] && [ "$t1" -gt 0 ] && [ -n "$t2" ] && [ "$t2" -gt 0 ] \
     || { echo "FAIL: a gated workload made no progress"; exit 1; }
 
+  assert_prepare_metrics
+  finalize_latency
   echo "ACCEPTANCE OK (quickstart)"
 else
   echo "=== slice-test1: 4-host gang on one pod slice ==="
@@ -113,10 +208,13 @@ else
     wk=$(echo "$log" | sed -n 's/^worker: *\([0-9]*\).*/\1/p' | head -1)
     echo "$pod channel=$ch worker=$wk"
     channels="$channels $ch"; workers="$workers $wk"
+    record_latency slice-test1 "$pod"
   done
   n_ch=$(echo $channels | tr ' ' '\n' | sort -u | grep -c . || true)
   n_wk=$(echo $workers | tr ' ' '\n' | sort -u | grep -c . || true)
   [ "$n_ch" = "1" ] || { echo "FAIL: gang saw $n_ch channels"; exit 1; }
   [ "$n_wk" = "4" ] || { echo "FAIL: expected 4 distinct worker ids, got $n_wk"; exit 1; }
+  assert_prepare_metrics
+  finalize_latency
   echo "ACCEPTANCE OK (gang)"
 fi

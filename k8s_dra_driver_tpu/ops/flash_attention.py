@@ -15,11 +15,13 @@ ring step. Tile sizes respect the bf16 (16,128)/f32 (8,128) minimums
 (pallas_guide.md "Tiling Constraints"); sequence lengths that are not
 tile multiples are zero-padded up and the padded key columns masked
 in-kernel, so odd/prime lengths compile instead of degenerating to
-1-wide blocks. Default blocks (512, 512): a v5e sweep at
-B4/T2048/H8/D64 bf16 put (512, 512) and (256, 512) within transport
-jitter of each other (~0.6-1.5 ms), both consistently ~2-3x faster
-than naive XLA attention (~2.1 ms); the larger q-block halves grid
-programs at identical VMEM residency, so it is the default.
+1-wide blocks. Default blocks come from the shape-keyed autotune table
+(``pick_blocks``), derived from a recorded v5e sweep
+(tools/sweep_attention.py → tools/attention_sweep_v5e.json, bf16
+causal, differential-median timing with artifact rejection): 3.0-6.3x
+naive XLA at T=2048-4096 rising to 7-9.4x at T=8192 (133 achieved
+TFLOPs at T8192/D128), because naive attention's [B,H,T,T] f32 score
+tensor is HBM-bandwidth-bound while these scores never leave VMEM.
 
 Differentiation: ``pl.pallas_call`` has no JVP rule, so the pallas
 kernel is forward-only. ``flash_attention`` (the normalized public
@@ -321,28 +323,58 @@ def attention_delta(do, out):
 # Normalized single-device flash attention, differentiable.
 # --------------------------------------------------------------------------
 
-def _flash_forward(q, k, v, causal, scale, interpret):
+def pick_blocks(tq: int, tk: int, head_dim: int) -> tuple[int, int]:
+    """Autotuned ``(block_q, block_k)`` by shape.
+
+    Derived from a v5e sweep (bf16, causal, tools/sweep_attention.py,
+    recorded in tools/attention_sweep_v5e.json): big blocks win —
+    (1024, 1024) is best or within noise of best at every swept shape
+    (T ∈ {2048, 4096, 8192} × D ∈ {64, 128}), 3.0-9.4x naive XLA,
+    because each grid program amortizes its K/V DMA over more MXU work
+    while staying VMEM-resident (~10 MB at D=128).  The one consistent
+    exception: short sequences at D=64 prefer (512, 1024) — at
+    T=2048/D=64 the halved q-block keeps enough programs in flight to
+    cover DMA latency (6.25x vs 4.86x).
+    """
+    bq = 512 if (head_dim < 128 and tq <= 2048) else 1024
+    bq = min(bq, _round_up(tq, _Q_TILE))
+    bk = min(1024, _round_up(tk, _K_TILE))
+    return bq, bk
+
+
+def _flash_forward(q, k, v, causal, scale, interpret, block_q, block_k):
     """Normalized output + logsumexp (the flash residual pair)."""
+    if block_q is None or block_k is None:
+        auto_q, auto_k = pick_blocks(q.shape[1], k.shape[1], q.shape[-1])
+        block_q = block_q if block_q is not None else auto_q
+        block_k = block_k if block_k is not None else auto_k
     o, m, l = flash_block_attention(q, k, v, 0, 0, causal=causal,
-                                    scale=scale, interpret=interpret)
+                                    scale=scale, interpret=interpret,
+                                    block_q=block_q, block_k=block_k)
     out, lse = normalize_flash_stats(o, m, l)
     return out.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention(q, k, v, causal, scale, interpret, block_k):
-    return _flash_forward(q, k, v, causal, scale, interpret)[0]
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention(q, k, v, causal, scale, interpret, block_q, block_k):
+    return _flash_forward(q, k, v, causal, scale, interpret,
+                          block_q, block_k)[0]
 
 
-def _flash_attention_fwd(q, k, v, causal, scale, interpret, block_k):
-    out, lse = _flash_forward(q, k, v, causal, scale, interpret)
+def _flash_attention_fwd(q, k, v, causal, scale, interpret, block_q,
+                         block_k):
+    out, lse = _flash_forward(q, k, v, causal, scale, interpret,
+                              block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
-def _flash_attention_bwd(causal, scale, interpret, block_k, res, do):
+def _flash_attention_bwd(causal, scale, interpret, block_q, block_k,
+                         res, do):
     q, k, v, out, lse = res
     tk = k.shape[1]
     delta = attention_delta(do, out)
+    if block_k is None:
+        block_k = pick_blocks(q.shape[1], tk, q.shape[-1])[1]
     # Tail-pad K/V to a chunk multiple and mask the padded key columns
     # (k_valid_end) so non-divisible lengths stay chunked instead of
     # collapsing to one full-width score matrix.
@@ -378,16 +410,19 @@ _flash_attention.defvjp(_flash_attention_fwd, _flash_attention_bwd)
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: float | None = None,
                     interpret: bool | None = None,
-                    block_k: int = 512):
+                    block_q: int | None = None,
+                    block_k: int | None = None):
     """Full single-device flash attention, normalized + differentiable.
 
     Drop-in for attention_reference without the HBM score tensor:
     forward is the pallas kernel, backward the K-chunked flash backward
     via ``jax.custom_vjp`` (fixes round-1 `_pallas_call_jvp_rule`
-    crash — pallas has no autodiff rule of its own).
+    crash — pallas has no autodiff rule of its own).  Block sizes
+    default to the shape-keyed autotune table (``pick_blocks``).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
-    return _flash_attention(q, k, v, causal, scale, interpret, block_k)
+    return _flash_attention(q, k, v, causal, scale, interpret,
+                            block_q, block_k)

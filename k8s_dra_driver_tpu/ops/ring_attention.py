@@ -39,6 +39,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from ..parallel.mesh import mesh_platform
 from .flash_attention import (attention_block_grads, attention_delta,
                               flash_block_attention, merge_flash_stats,
+                              pick_blocks,
                               normalize_flash_stats)
 
 _NEG_INF = -1e30
@@ -95,9 +96,11 @@ def _ring_forward(q, k, v, axis_name, causal, scale, use_flash, interpret):
         if use_flash:
             # fused pallas kernel for the block compute: scores stay in
             # VMEM, matmuls on the MXU (ops/flash_attention.py)
+            bq, bk = pick_blocks(q.shape[1], k_blk.shape[1], q.shape[-1])
             o_blk, m_blk, l_blk = flash_block_attention(
                 q, k_blk, v_blk, q_offset, k_idx * t_local,
-                causal=causal, scale=scale, interpret=interpret)
+                causal=causal, scale=scale, interpret=interpret,
+                block_q=bq, block_k=bk)
             o, m, l = merge_flash_stats(o, m, l, o_blk, m_blk, l_blk)
         else:
             o, m, l = _block_update(q, k_blk, v_blk, o, m, l, q_offset,

@@ -14,13 +14,39 @@ from jax.sharding import Mesh
 
 from k8s_dra_driver_tpu.ops.flash_attention import (flash_attention,
                                                     flash_block_attention,
-                                                    merge_flash_stats)
+                                                    merge_flash_stats,
+                                                    pick_blocks)
 from k8s_dra_driver_tpu.ops.ring_attention import (attention_reference,
                                                    ring_attention)
 
 
 def rand(shape, key, dtype=jnp.float32):
     return jax.random.normal(jax.random.PRNGKey(key), shape, dtype)
+
+
+def test_pick_blocks_tile_aligned():
+    """The autotune table must always return tile-aligned blocks for
+    every shape class (odd/prime lengths included)."""
+    for tq, tk, d in [(2048, 2048, 64), (8192, 8192, 128), (96, 96, 64),
+                      (17, 33, 128), (4096, 512, 64)]:
+        bq, bk = pick_blocks(tq, tk, d)
+        assert bq % 16 == 0 and bk % 128 == 0, (tq, tk, d, bq, bk)
+        assert bq >= 16 and bk >= 128
+
+
+def test_explicit_blocks_exact():
+    """Explicit block sizes flow through the custom-vjp wrapper and
+    still match the reference."""
+    q, k, v = (rand((1, 64, 2, 32), i) for i in range(3))
+    out = flash_attention(q, k, v, causal=True, block_q=16, block_k=128)
+    ref = attention_reference(q, k, v, causal=True)
+    np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+def test_pick_blocks_d128_halves_q_block():
+    bq64, _ = pick_blocks(8192, 8192, 64)
+    bq128, _ = pick_blocks(8192, 8192, 128)
+    assert bq128 <= bq64
 
 
 @pytest.mark.parametrize("causal", [False, True])

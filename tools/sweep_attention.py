@@ -1,0 +1,129 @@
+"""Flash-attention block/shape sweep on the real device.
+
+Measures the pallas flash kernel against naive XLA attention across
+long-context shapes and (block_q, block_k) tilings with the
+differential-median harness (fixed dispatch overhead cancels), and
+prints a JSON report.  The autotune table in
+ops/flash_attention.py:pick_blocks is derived from this sweep; re-run
+after kernel changes:
+
+    python tools/sweep_attention.py [--quick]
+
+Token budget is held constant (B*T = 8192 at H8) so the naive
+baseline's [B,H,T,T] f32 score tensor stays inside v5e HBM at every
+sequence length.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+import jax
+import jax.numpy as jnp
+
+from k8s_dra_driver_tpu.ops.collectives import (_PEAK_TFLOPS_CEILING,
+                                                measure_chain)
+from k8s_dra_driver_tpu.ops.flash_attention import flash_attention
+from k8s_dra_driver_tpu.ops.ring_attention import attention_reference
+
+# (batch, seq, heads, head_dim); B*T constant so naive fits in HBM
+SHAPES = [
+    (4, 2048, 8, 64),
+    (2, 4096, 8, 64),
+    (1, 8192, 8, 64),
+    (4, 2048, 8, 128),
+    (2, 4096, 8, 128),
+    (1, 8192, 8, 128),
+]
+
+BLOCKS = [(256, 256), (256, 512), (512, 512), (512, 1024),
+          (1024, 512), (1024, 1024), (2048, 512)]
+
+
+def measure(attn, q, k, v, iters: int, flops: float) -> tuple[float, bool]:
+    """Differential-median timing via the hardened shared harness:
+    retried while the differential is non-positive (jitter swamped it —
+    the round-2 1.02x artifact) or impossibly fast (below the physical
+    floor — the same artifact in the flattering direction)."""
+    def make(n):
+        @jax.jit
+        def chain(q):
+            def body(_, x):
+                y = attn(x, k, v)
+                return (y * (jnp.float32(0.5)).astype(y.dtype)
+                        + x * (jnp.float32(0.5)).astype(x.dtype))
+            return jnp.sum(jax.lax.fori_loop(0, n, body, q)
+                           .astype(jnp.float32))
+        return chain
+
+    floor_s = flops / (_PEAK_TFLOPS_CEILING * 1e12)
+    return measure_chain(make, q, iters, floor_s)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="first shape + three blockings only")
+    ap.add_argument("--iters", type=int, default=24)
+    args = ap.parse_args()
+
+    shapes = SHAPES[:1] if args.quick else SHAPES
+    blocks = BLOCKS[1:4] if args.quick else BLOCKS
+    report = {"device": str(jax.devices()[0]), "shapes": []}
+    for b, t, h, d in shapes:
+        key = jax.random.PRNGKey(0)
+        shape = (b, t, h, d)
+        q = jax.random.normal(key, shape, jnp.bfloat16)
+        k = jax.random.normal(jax.random.PRNGKey(1), shape, jnp.bfloat16)
+        v = jax.random.normal(jax.random.PRNGKey(2), shape, jnp.bfloat16)
+        flops = 2 * 2 * b * h * t * t * d * 0.5
+
+        naive_s, naive_ok = measure(
+            functools.partial(attention_reference, causal=True),
+            q, k, v, args.iters, flops)
+        entry = {
+            "shape": f"b{b}_t{t}_h{h}_d{d}",
+            "naive_ms": round(naive_s * 1000, 3),
+            "naive_tflops": round(flops / naive_s / 1e12, 2),
+            "naive_valid": naive_ok,
+            "blocks": [],
+        }
+        for bq, bk in blocks:
+            if bq > t or bk > t:
+                continue
+            try:
+                flash_s, ok = measure(
+                    functools.partial(flash_attention, causal=True,
+                                      block_q=bq, block_k=bk),
+                    q, k, v, args.iters, flops)
+            except Exception as e:
+                entry["blocks"].append({"bq": bq, "bk": bk,
+                                        "error": f"{type(e).__name__}: {e}"})
+                continue
+            entry["blocks"].append({
+                "bq": bq, "bk": bk,
+                "flash_ms": round(flash_s * 1000, 3),
+                "flash_tflops": round(flops / flash_s / 1e12, 2),
+                "speedup_vs_naive": round(naive_s / flash_s, 2),
+                "valid": ok,
+            })
+            print(f"  {entry['shape']} bq={bq} bk={bk}: "
+                  f"{flash_s*1000:.3f} ms "
+                  f"({naive_s/flash_s:.2f}x naive)", file=sys.stderr)
+        good = [blk for blk in entry["blocks"] if blk.get("valid")]
+        if good:
+            best = min(good, key=lambda blk: blk["flash_ms"])
+            entry["best"] = {"bq": best["bq"], "bk": best["bk"],
+                             "speedup_vs_naive": best["speedup_vs_naive"]}
+        report["shapes"].append(entry)
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
