@@ -175,6 +175,7 @@ def bench_tpu_compute() -> dict:
     try:
         import jax
         from k8s_dra_driver_tpu.ops import (allreduce_bandwidth,
+                                            attention_grad_probe,
                                             attention_probe, matmul_tflops)
         devs = jax.devices()
         platform = devs[0].platform if devs else "none"
@@ -235,10 +236,10 @@ def bench_tpu_compute() -> dict:
     # uses a tiny interpret-mode shape purely to keep the code path
     # exercised hermetically. Two entries: the standard shape and a
     # long-context one (the regime the kernel exists for).
-    def run_attention(key, shapes):
+    def run_attention(key, shapes, probe=attention_probe):
         label, res, errs = _retry_probe(
             [(f"b{b}_t{t}_h{h}",
-              lambda b=b, t=t, h=h, i=i: attention_probe(
+              lambda b=b, t=t, h=h, i=i: probe(
                   batch=b, seq=t, heads=h, iters=i))
              for b, t, h, i in shapes])
         if res is not None:
@@ -261,6 +262,18 @@ def bench_tpu_compute() -> dict:
     if on_accel:
         run_attention("attention_long_context",
                       [(1, 8192, 8, 24), (1, 4096, 8, 24)])
+
+    # Training path: fwd+bwd through the pallas flash backward vs
+    # naive XLA autodiff.
+    run_attention("attention_grad",
+                  [(4, 2048, 8, 12), (1, 1024, 4, 8)]
+                  if on_accel else [(1, 128, 2, 2)],
+                  probe=attention_grad_probe)
+    if on_accel:
+        # the long-context regime behind the README's headline claim
+        run_attention("attention_grad_long_context",
+                      [(1, 8192, 8, 6), (1, 4096, 8, 8)],
+                      probe=attention_grad_probe)
     return out
 
 

@@ -30,6 +30,10 @@ record_latency() {   # ns pod: append claim->running / create->running
   claim=$(kubectl -n "$ns" get pod "$pod" \
     -o jsonpath='{.spec.resourceClaims[0].resourceClaimName}' \
     2>/dev/null || echo "")
+  # template-instantiated claims carry the generated name in status
+  [ -n "$claim" ] || claim=$(kubectl -n "$ns" get pod "$pod" \
+    -o jsonpath='{.status.resourceClaimStatuses[0].resourceClaimName}' \
+    2>/dev/null || echo "")
   claim_created=""
   [ -n "$claim" ] && claim_created=$(kubectl -n "$ns" get resourceclaim \
     "$claim" -o jsonpath='{.metadata.creationTimestamp}' \

@@ -1,10 +1,12 @@
 """TPU compute ops: ring/flash attention, collectives, benchmarks."""
 
-from .collectives import allreduce_bandwidth, attention_probe, matmul_tflops
+from .collectives import (allreduce_bandwidth, attention_grad_probe,
+                          attention_probe, matmul_tflops)
 from .flash_attention import (flash_attention, flash_block_attention,
                               merge_flash_stats)
 from .ring_attention import attention_reference, ring_attention
 
-__all__ = ["allreduce_bandwidth", "attention_probe", "attention_reference",
+__all__ = ["allreduce_bandwidth", "attention_grad_probe",
+           "attention_probe", "attention_reference",
            "flash_attention", "flash_block_attention", "matmul_tflops",
            "merge_flash_stats", "ring_attention"]

@@ -141,41 +141,35 @@ def measure_chain(make, arg, iters: int, floor_s: float = 0.0,
     return elapsed, valid
 
 
-def attention_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
-                    head_dim: int = 64, iters: int = 32,
-                    dtype=jnp.bfloat16, interpret: bool | None = None,
-                    block_q: int | None = None,
-                    block_k: int | None = None) -> dict:
-    """Flash (pallas) vs naive (XLA) causal attention on the device.
+def _attention_differential(batch, seq, heads, head_dim, iters, dtype,
+                            interpret, block_q, block_k,
+                            matmuls, make_body) -> dict:
+    """Shared flash-vs-naive harness behind both attention probes.
 
-    The fused-kernel half of the BASELINE workload story: same chained
-    differential-timing scheme as matmul_tflops so per-dispatch
-    overhead cancels, plus a physical-floor check so an artifact can't
-    record the kernel impossibly fast. Reports ms/call and achieved
-    TFLOPs for both paths plus the speedup ratio.
+    Identical q/k/v generation, physical-floor computation, chain
+    construction, and result dict; the probes differ only in the
+    per-iteration body (``make_body(attn, k, v) -> fori body``) and the
+    matmul count that sets the FLOP model.
     """
     from .flash_attention import flash_attention
     from .ring_attention import attention_reference
 
-    key = jax.random.PRNGKey(0)
     shape = (batch, seq, heads, head_dim)
-    q = jax.random.normal(key, shape, dtype)
+    q = jax.random.normal(jax.random.PRNGKey(0), shape, dtype)
     k = jax.random.normal(jax.random.PRNGKey(1), shape, dtype)
     v = jax.random.normal(jax.random.PRNGKey(2), shape, dtype)
 
-    # causal attention: 2 matmuls x B*H*T^2*D MACs, half masked out
-    flops = 2 * 2 * batch * heads * seq * seq * head_dim * 0.5
+    # matmuls x 2 x B*H*T^2*D MACs, causal masking halves the work
+    flops = matmuls * 2 * batch * heads * seq * seq * head_dim * 0.5
     on_accel = jax.devices()[0].platform not in ("cpu",)
     floor_s = flops / (_PEAK_TFLOPS_CEILING * 1e12) if on_accel else 0.0
 
     def make_chain(attn):
+        body = make_body(attn, k, v)
+
         def make(n):
             @jax.jit
             def chain(q):
-                def body(_, x):
-                    y = attn(x, k, v)
-                    return (y * (jnp.float32(0.5)).astype(y.dtype)
-                            + x * (jnp.float32(0.5)).astype(x.dtype))
                 return jnp.sum(jax.lax.fori_loop(0, n, body, q)
                                .astype(jnp.float32))
             return chain
@@ -197,6 +191,59 @@ def attention_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
         "speedup": t_naive / t_flash,
         "valid": flash_valid and naive_valid,
     }
+
+
+def attention_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
+                    head_dim: int = 64, iters: int = 32,
+                    dtype=jnp.bfloat16, interpret: bool | None = None,
+                    block_q: int | None = None,
+                    block_k: int | None = None) -> dict:
+    """Flash (pallas) vs naive (XLA) causal attention on the device.
+
+    The fused-kernel half of the BASELINE workload story: same chained
+    differential-timing scheme as matmul_tflops so per-dispatch
+    overhead cancels, plus a physical-floor check so an artifact can't
+    record the kernel impossibly fast. Reports ms/call and achieved
+    TFLOPs for both paths plus the speedup ratio.
+    """
+    def make_body(attn, k, v):
+        def body(_, x):
+            y = attn(x, k, v)
+            return (y * (jnp.float32(0.5)).astype(y.dtype)
+                    + x * (jnp.float32(0.5)).astype(x.dtype))
+        return body
+
+    # forward only: 2 matmuls
+    return _attention_differential(batch, seq, heads, head_dim, iters,
+                                   dtype, interpret, block_q, block_k,
+                                   2, make_body)
+
+
+def attention_grad_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
+                         head_dim: int = 64, iters: int = 16,
+                         dtype=jnp.bfloat16,
+                         interpret: bool | None = None,
+                         block_q: int | None = None,
+                         block_k: int | None = None) -> dict:
+    """Training-path probe: full fwd+bwd attention, pallas flash
+    (forward kernel + pallas flash backward) vs naive XLA autodiff.
+    Same hardened differential harness as attention_probe."""
+    def make_body(attn, k, v):
+        def loss(x):
+            return jnp.sum(attn(x, k, v).astype(jnp.float32))
+
+        grad = jax.grad(loss)
+
+        def body(_, x):
+            g = grad(x)
+            return x + g.astype(x.dtype) * \
+                jnp.float32(1e-3).astype(x.dtype)
+        return body
+
+    # fwd 2 matmuls + bwd 5 matmuls
+    return _attention_differential(batch, seq, heads, head_dim, iters,
+                                   dtype, interpret, block_q, block_k,
+                                   7, make_body)
 
 
 def matmul_tflops(dim: int = 4096, iters: int = 400,

@@ -23,14 +23,21 @@ naive XLA at T=2048-4096 rising to 7-9.4x at T=8192 (133 achieved
 TFLOPs at T8192/D128), because naive attention's [B,H,T,T] f32 score
 tensor is HBM-bandwidth-bound while these scores never leave VMEM.
 
-Differentiation: ``pl.pallas_call`` has no JVP rule, so the pallas
-kernel is forward-only. ``flash_attention`` (the normalized public
-entry point) carries a ``jax.custom_vjp`` implementing the standard
-flash backward — recompute ``p = exp(s - L)`` from the saved logsumexp
-``L = m + log l``, then the five backward matmuls — chunked over K so
-the full score matrix never materializes. The per-block kernel's ``m``
-is a numerical stabilizer only (the normalized output is invariant to
-it), so the backward treats it as ``stop_gradient`` exactly like the
+Differentiation: ``pl.pallas_call`` has no JVP rule, so the kernels
+are forward-only; ``flash_attention`` (the normalized public entry
+point) carries a ``jax.custom_vjp``.  The backward is pallas too
+(``flash_block_grads``): recompute ``p = exp(s - L)`` from the saved
+logsumexp ``L = m + log l`` inside VMEM, then the five backward
+matmuls as two kernels — one accumulating dq over k-blocks, one
+accumulating dk/dv over q-blocks — so neither the score matrix nor its
+gradient ever touches HBM.  Measured on v5e (bf16 causal, hardened
+differential harness): full fwd+bwd 3.4x naive XLA autodiff at
+B4/T2048/H8/D64 and 72x at T=8192, where naive autodiff is
+HBM-bound on the [T,T] score+gradient tensors (234 ms vs 3.2 ms).
+``attention_block_grads`` keeps the XLA reference implementation
+(tests diff the two paths).  The per-block kernel's ``m`` is a
+numerical stabilizer only (the normalized output is invariant to it),
+so the backward treats it as ``stop_gradient`` exactly like the
 max-shift in a stable softmax.
 
 On non-TPU backends the kernel runs in interpreter mode, so the
@@ -301,6 +308,227 @@ def attention_block_grads(q, k, v, do, delta, lse, q_offset, k_offset,
     return dq, dk, dv
 
 
+# --------------------------------------------------------------------------
+# Pallas flash backward: two kernels (dk/dv and dq), scores recomputed
+# from the saved logsumexp so the [Tq, Tk] matrix never leaves VMEM —
+# the training-path twin of the forward kernel.  attention_block_grads
+# above stays as the XLA reference (tests diff the two) and the ring
+# backward's per-hop fallback.
+# --------------------------------------------------------------------------
+
+def _bwd_common(q, k, lse_col, scale, causal,
+                q_start, k_start, bq, bk, k_valid, j, block_k):
+    """Shared recompute: returns p [bq, bk] f32.
+
+    ``lse_col`` is the [bq, 1] f32 row logsumexp; masking matches the
+    forward kernel exactly (causal by absolute position, padded key
+    columns dropped).
+    """
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale          # [bq, bk]
+    p = jnp.exp(s - lse_col)
+    mask = None
+    if causal:
+        q_pos = q_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        k_pos = k_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        mask = q_pos >= k_pos
+    if k_valid is not None:
+        k_local = j * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (bq, bk), 1)
+        valid = k_local < k_valid
+        mask = valid if mask is None else (mask & valid)
+    if mask is not None:
+        p = jnp.where(mask, p, 0.0)
+    return p
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         qoff_ref, koff_ref, dq_ref, dq_scr, *,
+                         n_k: int, scale: float, causal: bool,
+                         k_valid: int | None, block_k: int):
+    """grid (bh, i_q, j_k): j_k sequential innermost, dq accumulated in
+    VMEM scratch and written once on the last k step."""
+    j = pl.program_id(2)
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q_start = qoff_ref[0, 0] + pl.program_id(1) * bq
+    k_start = koff_ref[0, 0] + j * bk
+    run = (q_start + bq - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _update():
+        qf = q_ref[0]
+        kf = k_ref[0]
+        p = _bwd_common(qf, kf, lse_ref[0][:, :1], scale, causal,
+                        q_start, k_start, bq, bk, k_valid, j, block_k)
+        # dp = do v^T;  ds = p * (dp - delta) * scale;  dq += ds k
+        dp = jax.lax.dot_general(
+            do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dq_scr[:] += jax.lax.dot_general(
+            ds.astype(kf.dtype), kf, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == n_k - 1)
+    def _done():
+        dq_ref[0] = dq_scr[:]
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          qoff_ref, koff_ref, dk_ref, dv_ref,
+                          dk_scr, dv_scr, *,
+                          n_q: int, scale: float, causal: bool,
+                          k_valid: int | None, block_k: int):
+    """grid (bh, j_k, i_q): i_q sequential innermost, dk/dv accumulated
+    in VMEM scratch per k-block and written on the last q step."""
+    i = pl.program_id(2)
+    j = pl.program_id(1)
+    bq, bk = q_ref.shape[1], k_ref.shape[1]
+
+    @pl.when(i == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    q_start = qoff_ref[0, 0] + i * bq
+    k_start = koff_ref[0, 0] + j * bk
+    run = (q_start + bq - 1 >= k_start) if causal else True
+
+    @pl.when(run)
+    def _update():
+        qf = q_ref[0]
+        kf = k_ref[0]
+        dof = do_ref[0]
+        p = _bwd_common(qf, kf, lse_ref[0][:, :1], scale, causal,
+                        q_start, k_start, bq, bk, k_valid, j, block_k)
+        # dv += p^T do;  ds = p * (do v^T - delta) * scale;  dk += ds^T q
+        dv_scr[:] += jax.lax.dot_general(
+            p.astype(dof.dtype), dof, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            dof, v_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta_ref[0][:, :1]) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds.astype(qf.dtype), qf, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(i == n_q - 1)
+    def _done():
+        dk_ref[0] = dk_scr[:]
+        dv_ref[0] = dv_scr[:]
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k", "interpret"))
+def flash_block_grads(q, k, v, do, delta, lse, q_offset, k_offset, *,
+                      causal: bool = True, scale: float | None = None,
+                      block_q: int | None = None,
+                      block_k: int | None = None,
+                      interpret: bool | None = None):
+    """Pallas flash backward against one K/V block.
+
+    Same contract as ``attention_block_grads`` (q/do [B,Tq,H,D], k/v
+    [B,Tk,H,D], delta/lse [B,H,Tq] over the FULL key range; returns
+    f32 (dq, dk, dv) with dk/dv complete for this block) — but the
+    score recompute stays in VMEM: two kernels, one accumulating dq
+    over k-blocks, one accumulating dk/dv over q-blocks.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b_, tq, h, d = q.shape
+    tk = k.shape[1]
+    if block_q is None or block_k is None:
+        auto_q, auto_k = pick_blocks(tq, tk, d)
+        block_q = block_q if block_q is not None else auto_q
+        block_k = block_k if block_k is not None else auto_k
+    bq, tq_pad = _block_and_pad(tq, block_q, _Q_TILE)
+    bk, tk_pad = _block_and_pad(tk, block_k, _K_TILE)
+    q_p, do_p = _pad_seq(q, tq_pad), _pad_seq(do, tq_pad)
+    k_p, v_p = _pad_seq(k, tk_pad), _pad_seq(v, tk_pad)
+
+    def flat(x):
+        return x.transpose(0, 2, 1, 3).reshape(b_ * h, x.shape[1], d)
+
+    qf, kf, vf, dof = flat(q_p), flat(k_p), flat(v_p), flat(do_p)
+    # Row stats ride as [B*H, Tq_pad, 128] lane-broadcast tiles (the
+    # same layout the forward emits its m/l in).  Padded q rows get
+    # lse=+big so p = exp(s - big) = 0: they contribute nothing to
+    # dk/dv, and their dq rows are dropped below.
+    def stats(x, pad_value):
+        x = x.reshape(b_ * h, tq)
+        if tq_pad != tq:
+            x = jnp.pad(x, ((0, 0), (0, tq_pad - tq)),
+                        constant_values=pad_value)
+        return jnp.broadcast_to(x[:, :, None], (b_ * h, tq_pad, 128)
+                                ).astype(jnp.float32)
+
+    lse_b = stats(lse, 1e30)
+    delta_b = stats(delta, 0.0)
+    qoff = jnp.asarray(q_offset, jnp.int32).reshape(1, 1)
+    koff = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
+    k_valid = tk if tk_pad != tk else None
+    n_q, n_k = tq_pad // bq, tk_pad // bk
+
+    q_spec_i = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
+    k_spec_j = pl.BlockSpec((1, bk, d), lambda bh, i, j: (bh, j, 0))
+    stat_spec_i = pl.BlockSpec((1, bq, 128), lambda bh, i, j: (bh, i, 0))
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, n_k=n_k, scale=scale,
+                          causal=causal, k_valid=k_valid, block_k=bk),
+        grid=(b_ * h, n_q, n_k),
+        in_specs=[q_spec_i, k_spec_j, k_spec_j, q_spec_i,
+                  stat_spec_i, stat_spec_i, smem, smem],
+        out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((b_ * h, tq_pad, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_b, delta_b, qoff, koff)[0]
+
+    # dkv grid: (bh, j_k, i_q) — q-dim sequential innermost
+    q_spec_kv = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0))
+    k_spec_kv = pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0))
+    stat_spec_kv = pl.BlockSpec((1, bq, 128), lambda bh, j, i: (bh, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, n_q=n_q, scale=scale,
+                          causal=causal, k_valid=k_valid, block_k=bk),
+        grid=(b_ * h, n_k, n_q),
+        in_specs=[q_spec_kv, k_spec_kv, k_spec_kv, q_spec_kv,
+                  stat_spec_kv, stat_spec_kv, smem, smem],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b_ * h, tk_pad, d), jnp.float32),
+            jax.ShapeDtypeStruct((b_ * h, tk_pad, d), jnp.float32),
+        ],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(qf, kf, vf, dof, lse_b, delta_b, qoff, koff)
+
+    def unflat(x, t_pad, t):
+        return x.reshape(b_, h, t_pad, d).transpose(0, 2, 1, 3)[:, :t]
+
+    return (unflat(dq, tq_pad, tq), unflat(dk, tk_pad, tk),
+            unflat(dv, tk_pad, tk))
+
+
 def normalize_flash_stats(o, m, l):
     """Flash epilogue: (o_unnorm, m, l) -> (o_normalized f32, lse).
 
@@ -328,13 +556,16 @@ def pick_blocks(tq: int, tk: int, head_dim: int) -> tuple[int, int]:
 
     Derived from a v5e sweep (bf16, causal, tools/sweep_attention.py,
     recorded in tools/attention_sweep_v5e.json): big blocks win —
-    (1024, 1024) is best or within noise of best at every swept shape
-    (T ∈ {2048, 4096, 8192} × D ∈ {64, 128}), 3.0-9.4x naive XLA,
-    because each grid program amortizes its K/V DMA over more MXU work
-    while staying VMEM-resident (~10 MB at D=128).  The one consistent
-    exception: short sequences at D=64 prefer (512, 1024) — at
-    T=2048/D=64 the halved q-block keeps enough programs in flight to
-    cover DMA latency (6.25x vs 4.86x).
+    (1024, 1024) is best at every swept shape (T ∈ {2048, 4096, 8192}
+    × D ∈ {64, 128}), 3.0-9.4x naive XLA, because each grid program
+    amortizes its K/V DMA over more MXU work while staying
+    VMEM-resident (~10 MB at D=128).  The sweep's one dissenting entry
+    — (1024, 512) apparently fastest at T=8192/D=64 — did not
+    reproduce under 3x re-measurement (see the artifact's
+    ``remeasurement`` note); (1024, 1024) is the true best there too.
+    The one real exception: short sequences at D=64 prefer (512, 1024)
+    — at T=2048/D=64 the halved q-block keeps enough programs in
+    flight to cover DMA latency (6.25x vs 4.86x).
     """
     bq = 512 if (head_dim < 128 and tq <= 2048) else 1024
     bq = min(bq, _round_up(tq, _Q_TILE))
@@ -371,36 +602,13 @@ def _flash_attention_fwd(q, k, v, causal, scale, interpret, block_q,
 def _flash_attention_bwd(causal, scale, interpret, block_q, block_k,
                          res, do):
     q, k, v, out, lse = res
-    tk = k.shape[1]
     delta = attention_delta(do, out)
-    if block_k is None:
-        block_k = pick_blocks(q.shape[1], tk, q.shape[-1])[1]
-    # Tail-pad K/V to a chunk multiple and mask the padded key columns
-    # (k_valid_end) so non-divisible lengths stay chunked instead of
-    # collapsing to one full-width score matrix.
-    ck = min(block_k, _round_up(tk, _K_TILE))
-    tk_pad = _round_up(tk, ck)
-    kp, vp = _pad_seq(k, tk_pad), _pad_seq(v, tk_pad)
-    n_chunks = tk_pad // ck
-    k_valid_end = tk if tk_pad != tk else None
-
-    def body(carry, idx):
-        dq = carry
-        k_blk = jax.lax.dynamic_slice_in_dim(kp, idx * ck, ck, axis=1)
-        v_blk = jax.lax.dynamic_slice_in_dim(vp, idx * ck, ck, axis=1)
-        dq_c, dk_c, dv_c = attention_block_grads(
-            q, k_blk, v_blk, do, delta, lse, 0, idx * ck, causal, scale,
-            k_valid_end=k_valid_end)
-        return dq + dq_c, (dk_c, dv_c)
-
-    dq0 = jnp.zeros(q.shape, jnp.float32)
-    dq, (dk_chunks, dv_chunks) = jax.lax.scan(
-        body, dq0, jnp.arange(n_chunks))
-    # [n_chunks, B, ck, H, D] -> [B, Tk_pad, H, D] -> drop tail padding
-    dk = jnp.moveaxis(dk_chunks, 0, 1).reshape(
-        k.shape[0], tk_pad, *k.shape[2:])[:, :tk]
-    dv = jnp.moveaxis(dv_chunks, 0, 1).reshape(
-        v.shape[0], tk_pad, *v.shape[2:])[:, :tk]
+    # Pallas flash backward: the score recompute never leaves VMEM
+    # (flash_block_grads streams K/V blocks through the grid the same
+    # way the forward does).
+    dq, dk, dv = flash_block_grads(
+        q, k, v, do, delta, lse, 0, 0, causal=causal, scale=scale,
+        block_q=block_q, block_k=block_k, interpret=interpret)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 

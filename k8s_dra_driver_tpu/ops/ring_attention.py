@@ -38,8 +38,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import mesh_platform
 from .flash_attention import (attention_block_grads, attention_delta,
-                              flash_block_attention, merge_flash_stats,
-                              pick_blocks,
+                              flash_block_attention, flash_block_grads,
+                              merge_flash_stats, pick_blocks,
                               normalize_flash_stats)
 
 _NEG_INF = -1e30
@@ -148,6 +148,15 @@ def _ring_attention_local_bwd(axis_name, causal, scale, use_flash,
 
         def block(args):
             k_blk, v_blk = args
+            if use_flash:
+                # pallas flash backward: the per-hop score recompute
+                # stays in VMEM, same as the forward kernel
+                bq, bk = pick_blocks(q.shape[1], k_blk.shape[1],
+                                     q.shape[-1])
+                return flash_block_grads(
+                    q, k_blk, v_blk, do, delta, lse, q_offset, k_offset,
+                    causal=causal, scale=scale, block_q=bq, block_k=bk,
+                    interpret=interpret)
             return attention_block_grads(q, k_blk, v_blk, do, delta, lse,
                                          q_offset, k_offset, causal, scale)
 

@@ -12,9 +12,13 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh
 
-from k8s_dra_driver_tpu.ops.flash_attention import (flash_attention,
+from k8s_dra_driver_tpu.ops.flash_attention import (attention_block_grads,
+                                                    attention_delta,
+                                                    flash_attention,
                                                     flash_block_attention,
+                                                    flash_block_grads,
                                                     merge_flash_stats,
+                                                    normalize_flash_stats,
                                                     pick_blocks)
 from k8s_dra_driver_tpu.ops.ring_attention import (attention_reference,
                                                    ring_attention)
@@ -32,6 +36,50 @@ def test_pick_blocks_tile_aligned():
         bq, bk = pick_blocks(tq, tk, d)
         assert bq % 16 == 0 and bk % 128 == 0, (tq, tk, d, bq, bk)
         assert bq >= 16 and bk >= 128
+
+
+@pytest.mark.parametrize("t,causal", [(128, True), (128, False),
+                                      (100, True)])
+def test_pallas_bwd_matches_xla_block_grads(t, causal):
+    """flash_block_grads (pallas, VMEM-resident recompute) must agree
+    with attention_block_grads (XLA reference) — including ring-style
+    offsets and non-tile-aligned lengths."""
+    B, H, D = 2, 2, 32
+    q, k, v, do = (rand((B, t, H, D), i) for i in range(4))
+    scale = D ** -0.5
+    o, m, l = flash_block_attention(q, k, v, 0, 0, causal=causal,
+                                    scale=scale, block_q=64, block_k=128)
+    out, lse = normalize_flash_stats(o, m, l)
+    delta = attention_delta(do, out)
+    want = attention_block_grads(q, k, v, do, delta, lse, 0, 0,
+                                 causal, scale)
+    got = flash_block_grads(q, k, v, do, delta, lse, 0, 0,
+                            causal=causal, scale=scale,
+                            block_q=64, block_k=128)
+    for g, w, name in zip(got, want, "dq dk dv".split()):
+        np.testing.assert_allclose(g, w, atol=2e-4, rtol=2e-4,
+                                   err_msg=name)
+
+
+def test_pallas_bwd_ring_offsets():
+    """Absolute-position causal masking must hold when the K block sits
+    at a different ring offset than the Q shard."""
+    B, T, H, D = 1, 64, 2, 32
+    q, k, v, do = (rand((B, T, H, D), i) for i in range(4))
+    scale = D ** -0.5
+    q_off, k_off = 64, 0          # q shard is the second ring position
+    o, m, l = flash_block_attention(q, k, v, q_off, k_off, causal=True,
+                                    scale=scale, block_q=16, block_k=128)
+    out, lse = normalize_flash_stats(o, m, l)
+    delta = attention_delta(do, out)
+    want = attention_block_grads(q, k, v, do, delta, lse, q_off, k_off,
+                                 True, scale)
+    got = flash_block_grads(q, k, v, do, delta, lse, q_off, k_off,
+                            causal=True, scale=scale,
+                            block_q=16, block_k=128)
+    for g, w, name in zip(got, want, "dq dk dv".split()):
+        np.testing.assert_allclose(g, w, atol=2e-4, rtol=2e-4,
+                                   err_msg=name)
 
 
 def test_explicit_blocks_exact():
