@@ -32,16 +32,11 @@ sys.path.insert(0, str(REPO / "tests"))
 REFERENCE_MPS_BACKOFF_FLOOR_MS = 1000.0
 
 
-def bench_driver_path(rounds: int = 20) -> dict:
-    """p50 claim→ready over the five baseline configs (hermetic node)."""
+def _baseline_claim_makers(prefix: str = "c"):
+    """The five BASELINE.md claim configs as name → make(i) callables."""
     from k8s_dra_driver_tpu.api import resource
-    from k8s_dra_driver_tpu.discovery import FakeHost
-    from k8s_dra_driver_tpu.plugin import DeviceState
 
     from helpers import chip_config
-    from testbed import E2EBed
-
-    DeviceState._sleep = staticmethod(lambda s: None)
 
     def claim(name, requests, configs=()):
         return resource.ResourceClaim(
@@ -58,24 +53,45 @@ def bench_driver_path(rounds: int = 20) -> dict:
         return resource.ClaimConfig(opaque=resource.OpaqueConfig(
             driver="tpu.google.com", parameters=params))
 
-    configs = {
-        "exclusive_chip": lambda i: claim(f"c-ex-{i}", [req()]),
+    return {
+        "exclusive_chip": lambda i: claim(f"{prefix}-ex-{i}", [req()]),
         "timeslice_shared": lambda i: claim(
-            f"c-ts-{i}", [req()],
+            f"{prefix}-ts-{i}", [req()],
             [cfg(chip_config("TimeSlicing",
                              timeSlicing={"interval": "Short"}))]),
         "coordinated_shared": lambda i: claim(
-            f"c-co-{i}", [req()],
+            f"{prefix}-co-{i}", [req()],
             [cfg(chip_config("Coordinated",
                              coordinated={"dutyCyclePercent": 50}))]),
         "core_partition": lambda i: claim(
-            f"c-core-{i}", [req(cls="tpu-core.google.com")]),
+            f"{prefix}-core-{i}", [req(cls="tpu-core.google.com")]),
         "slice_2x2": lambda i: claim(
-            f"c-sl-{i}", [req(cls="tpu-slice.google.com",
-                              selectors=['device.attributes["sliceShape"]'
+            f"{prefix}-sl-{i}", [req(cls="tpu-slice.google.com",
+                                     selectors=[
+                                         'device.attributes["sliceShape"]'
                                          ' == "2x2"'])]),
     }
 
+
+def _summarize(latencies: dict[str, list[float]]) -> dict:
+    p50 = {k: statistics.median(v) for k, v in latencies.items()}
+    all_lat = [x for v in latencies.values() for x in v]
+    return {"p50_ms": statistics.median(all_lat),
+            "p90_ms": statistics.quantiles(all_lat, n=10)[8],
+            "per_config_p50_ms": {k: round(v, 3) for k, v in p50.items()},
+            "samples": len(all_lat)}
+
+
+def bench_driver_path(rounds: int = 20) -> dict:
+    """p50 claim→ready over the five baseline configs (hermetic node)."""
+    from k8s_dra_driver_tpu.discovery import FakeHost
+    from k8s_dra_driver_tpu.plugin import DeviceState
+
+    from testbed import E2EBed
+
+    DeviceState._sleep = staticmethod(lambda s: None)
+
+    configs = _baseline_claim_makers()
     latencies: dict[str, list[float]] = {k: [] for k in configs}
     with tempfile.TemporaryDirectory() as tmp:
         bed = E2EBed(Path(tmp), [FakeHost(hostname="bench-host")],
@@ -93,13 +109,39 @@ def bench_driver_path(rounds: int = 20) -> dict:
                                        c.metadata.name)
         finally:
             bed.shutdown()
+    return _summarize(latencies)
 
-    p50 = {k: statistics.median(v) for k, v in latencies.items()}
-    all_lat = [x for v in latencies.values() for x in v]
-    return {"p50_ms": statistics.median(all_lat),
-            "p90_ms": statistics.quantiles(all_lat, n=10)[8],
-            "per_config_p50_ms": {k: round(v, 3) for k, v in p50.items()},
-            "samples": len(all_lat)}
+
+def bench_driver_path_oop(rounds: int = 10) -> dict:
+    """p50 claim→ready through the REAL binary across real boundaries.
+
+    The out-of-process tier (tests/oopbed.py): the actual
+    ``tpu-dra-plugin`` subprocess discovers a fake topology, publishes
+    ResourceSlices to a live HTTP API server over a kubeconfig, and
+    serves prepares on its UDS gRPC socket — process, HTTP, and UDS
+    boundaries all real, so these latencies include everything a
+    kubelet would see except containerd itself.
+    """
+    from oopbed import OOPBed
+
+    configs = _baseline_claim_makers(prefix="o")
+    latencies: dict[str, list[float]] = {k: [] for k in configs}
+    with tempfile.TemporaryDirectory() as tmp:
+        bed = OOPBed(Path(tmp), verbosity=0)
+        try:
+            for i in range(rounds):
+                for kind, make in configs.items():
+                    c = bed.create_claim(make(i))
+                    t0 = time.perf_counter()
+                    bed.run_pod(c)
+                    latencies[kind].append(
+                        (time.perf_counter() - t0) * 1000)
+                    bed.delete_pod(c)
+                    bed.client.delete("ResourceClaim", "default",
+                                      c.metadata.name)
+        finally:
+            bed.shutdown()
+    return _summarize(latencies)
 
 
 def _retry_probe(attempts, retries_per_shape: int = 2,
@@ -279,6 +321,10 @@ def bench_tpu_compute() -> dict:
 
 def main() -> None:
     driver = bench_driver_path()
+    try:
+        driver_oop = bench_driver_path_oop()
+    except Exception as e:     # the hermetic tier stays the headline
+        driver_oop = {"error": f"{type(e).__name__}: {e}"}
     compute = bench_tpu_compute()
     shared_p50 = driver["per_config_p50_ms"]["coordinated_shared"]
     result = {
@@ -289,6 +335,7 @@ def main() -> None:
         "vs_baseline_kind": "floor_comparison",
         "detail": {
             "driver": driver,
+            "driver_oop": driver_oop,
             "tpu": compute,
             "baseline_note": (
                 "FLOOR comparison, not like-for-like: the reference "

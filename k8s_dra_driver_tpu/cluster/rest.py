@@ -82,6 +82,11 @@ def _meta_to_wire(m: resource.ObjectMeta) -> dict:
     out: dict[str, Any] = {"name": m.name}
     if m.namespace:
         out["namespace"] = m.namespace
+    # uid is deliberately never sent: it is server-authoritative and
+    # immutable — a real API server preserves it on sparse PUTs and
+    # rejects a mismatched one (422), which the apply() upsert path
+    # would trip over since locally constructed objects carry a fresh
+    # client-side uid.
     if m.labels:
         out["labels"] = m.labels
     if m.annotations:
@@ -224,7 +229,12 @@ def _claim_status_wire(c: resource.ResourceClaim) -> dict:
 
 
 def _class_from_wire(d: dict) -> resource.DeviceClass:
-    cls = resource.from_dict(resource.DeviceClass, d.get("spec", d))
+    # upstream shape nests selectors/config under spec, which carries
+    # no metadata of its own — decode with a placeholder, then attach
+    # the real object metadata
+    spec = dict(d.get("spec", d))
+    spec.setdefault("metadata", {})
+    cls = resource.from_dict(resource.DeviceClass, spec)
     cls.metadata = _meta_from_wire(d.get("metadata", {}))
     return cls
 

@@ -273,7 +273,15 @@ class CoordinatorManager:
         self.node_name = node_name
         self.namespace = namespace
         self.image = image
-        self.backoff = backoff or Backoff()
+        # The reference polls MPS daemons starting at 1s (sharing.go:
+        # 290-296) because nvidia-cuda-mps-control starts slowly; our
+        # coordinatord publishes its ready file in tens of ms, so a 1s
+        # first step would be pure claim→Running critical-path waste.
+        # Fast 50 ms ramp; ~23 s base patience, inside the reference's
+        # 15-30 s jittered envelope.
+        self.backoff = backoff or Backoff(duration_s=0.05, factor=2.0,
+                                          jitter=0.1, steps=9,
+                                          cap_s=10.0)
 
     def new_daemon(self, claim_uid: str, devices: list[AllocatableDevice],
                    settings: CoordinatedSettings,

@@ -1,0 +1,211 @@
+"""A miniature in-process Kubernetes API server.
+
+Enough of the REST surface for the in-repo client and binaries: typed
+paths, JSON CRUD, resourceVersion bump-on-write, status subresources,
+streaming chunked watches.  Used by the REST-client tests and by the
+out-of-process plugin bed (a real plugin subprocess pointed at this
+server through a kubeconfig).
+"""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+
+class MiniAPIServer:
+    """Enough of the Kubernetes REST surface for the client: typed
+    paths, JSON CRUD, resourceVersion bump-on-write, streaming watch."""
+
+    STATUS_SUBRESOURCE = {"resourceclaims", "deployments", "pods",
+                          "nodes"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rv = 0
+        self.last_auth = ""
+        # path-key -> object dict
+        self.objects: dict[str, dict] = {}
+        self.watchers: list = []  # (plural, wfile, event)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _send_json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _collection(self, path):
+                # /apis/group/version/[namespaces/ns/]plural[/name[/sub]]
+                parts = [p for p in path.split("/") if p]
+                if parts[0] == "api":
+                    parts = parts[2:]          # strip api/v1
+                else:
+                    parts = parts[3:]          # strip apis/group/version
+                ns = ""
+                if parts and parts[0] == "namespaces":
+                    ns = parts[1]
+                    parts = parts[2:]
+                plural = parts[0] if parts else ""
+                name = parts[1] if len(parts) > 1 else ""
+                sub = parts[2] if len(parts) > 2 else ""
+                return plural, ns, name, sub
+
+            def do_GET(self):
+                server.last_auth = self.headers.get("Authorization", "")
+                url = urlparse(self.path)
+                q = parse_qs(url.query)
+                plural, ns, name, _sub = self._collection(url.path)
+                if q.get("watch") == ["true"]:
+                    return self._serve_watch(plural)
+                with server._lock:
+                    if name:
+                        obj = server.objects.get(f"{plural}/{ns}/{name}")
+                        if obj is None:
+                            return self._send_json(
+                                {"reason": "NotFound"}, 404)
+                        return self._send_json(obj)
+                    items = [o for k, o in sorted(server.objects.items())
+                             if k.startswith(f"{plural}/")
+                             and (not ns or f"/{ns}/" in k)]
+                    if q.get("labelSelector"):
+                        want = dict(
+                            kv.split("=", 1)
+                            for kv in q["labelSelector"][0].split(","))
+                        items = [
+                            o for o in items
+                            if all(o.get("metadata", {})
+                                    .get("labels", {}).get(k) == v
+                                   for k, v in want.items())]
+                    return self._send_json({
+                        "kind": "List",
+                        "metadata": {"resourceVersion": str(server._rv)},
+                        "items": items})
+
+            def _serve_watch(self, plural):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                done = threading.Event()
+                with server._lock:
+                    server.watchers.append((plural, self, done))
+                done.wait(30)
+
+            def _write_chunk(self, data: bytes):
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                obj = json.loads(self.rfile.read(n))
+                url = urlparse(self.path)
+                plural, ns, _, _sub = self._collection(url.path)
+                name = obj["metadata"]["name"]
+                key = f"{plural}/{ns}/{name}"
+                with server._lock:
+                    if key in server.objects:
+                        return self._send_json(
+                            {"reason": "AlreadyExists"}, 409)
+                    server._rv += 1
+                    obj["metadata"]["resourceVersion"] = str(server._rv)
+                    obj["metadata"].setdefault("uid", f"uid-{server._rv}")
+                    if ns:
+                        obj["metadata"]["namespace"] = ns
+                    # real API servers strip status on main-resource
+                    # writes for kinds with a status subresource
+                    if plural in server.STATUS_SUBRESOURCE:
+                        obj.pop("status", None)
+                    server.objects[key] = obj
+                server.notify(plural, "ADDED", obj)
+                return self._send_json(obj, 201)
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                obj = json.loads(self.rfile.read(n))
+                url = urlparse(self.path)
+                plural, ns, name, sub = self._collection(url.path)
+                key = f"{plural}/{ns}/{name}"
+                with server._lock:
+                    current = server.objects.get(key)
+                    if current is None:
+                        return self._send_json({"reason": "NotFound"}, 404)
+                    server._rv += 1
+                    # uid is immutable on a real API server: preserve
+                    # it even when the PUT body omits or changes it
+                    if current.get("metadata", {}).get("uid"):
+                        obj.setdefault("metadata", {})["uid"] = \
+                            current["metadata"]["uid"]
+                    if sub == "status":
+                        # subresource write: only status is applied
+                        merged = dict(current)
+                        merged["status"] = obj.get("status", {})
+                        obj = merged
+                    elif plural in server.STATUS_SUBRESOURCE:
+                        obj.pop("status", None)
+                        if "status" in current:
+                            obj["status"] = current["status"]
+                    obj["metadata"]["resourceVersion"] = str(server._rv)
+                    server.objects[key] = obj
+                server.notify(plural, "MODIFIED", obj)
+                return self._send_json(obj)
+
+            def do_DELETE(self):
+                url = urlparse(self.path)
+                plural, ns, name, _sub = self._collection(url.path)
+                key = f"{plural}/{ns}/{name}"
+                with server._lock:
+                    obj = server.objects.pop(key, None)
+                if obj is None:
+                    return self._send_json({"reason": "NotFound"}, 404)
+                server.notify(plural, "DELETED", obj)
+                return self._send_json({"status": "Success"})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = (f"http://{self.httpd.server_address[0]}:"
+                    f"{self.httpd.server_address[1]}")
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+
+    def notify(self, plural, etype, obj):
+        with self._lock:
+            watchers = list(self.watchers)
+        for wplural, handler, done in watchers:
+            if wplural != plural:
+                continue
+            try:
+                handler._write_chunk(
+                    (json.dumps({"type": etype, "object": obj}) + "\n")
+                    .encode())
+            except OSError:
+                done.set()
+
+    def drop_watchers(self):
+        """Kill all live watch connections (API-server restart analog)."""
+        with self._lock:
+            watchers, self.watchers = self.watchers, []
+        for _, handler, done in watchers:
+            done.set()
+            try:
+                handler.connection.close()
+            except OSError:
+                pass
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        with self._lock:
+            for _, _, done in self.watchers:
+                done.set()
+        self.httpd.shutdown()
+        self.httpd.server_close()

@@ -1,0 +1,234 @@
+"""Out-of-process plugin bed: the REAL binary behind every boundary.
+
+The hermetic ``E2EBed`` runs drivers in-process (real gRPC over UDS,
+but one process).  This bed closes the remaining gap to a live kubelet
+path without docker/kind: the actual ``tpu-dra-plugin`` binary runs as
+a subprocess, discovers a fake topology, talks to a real HTTP API
+server (``MiniAPIServer``) through a kubeconfig — publishing its
+ResourceSlices over the wire — and serves NodePrepareResources on its
+UDS socket to this process, which plays kubelet (gRPC client) and
+container runtime (CDI interpreter).  Coordinator Deployments the
+plugin creates via REST are picked up by a deployment-controller
+thread that executes the rendered ``tpu-coordinatord`` command, so
+readiness is earned, not granted.
+
+Boundaries that are real here: process (fork/exec), HTTP (API server),
+UDS gRPC (prepare path), filesystem (CDI specs, checkpoints,
+coordinator ctl dirs).  Only kube-scheduler (in-repo allocator) and
+kubelet/containerd themselves are played by the caller — the same
+substitutions the reference's kind tier makes for the control plane it
+doesn't run (reference demo/clusters/kind/create-cluster.sh).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import grpc
+
+from k8s_dra_driver_tpu.allocator import allocate_claim
+from k8s_dra_driver_tpu.api import resource
+from k8s_dra_driver_tpu.api.classes import standard_device_classes
+from k8s_dra_driver_tpu.cluster.objects import Node
+from k8s_dra_driver_tpu.cluster.rest import RestClusterClient
+from k8s_dra_driver_tpu.proto import DRAPluginStub, dra_pb2
+
+from helpers import _run_coordinator_container
+from miniapi import MiniAPIServer
+from testbed import PodView, apply_cdi
+
+REPO = Path(__file__).resolve().parent.parent
+
+KUBECONFIG_TEMPLATE = """\
+apiVersion: v1
+kind: Config
+clusters:
+- name: mini
+  cluster:
+    server: {server}
+contexts:
+- name: mini
+  context:
+    cluster: mini
+    user: bench
+current-context: mini
+users:
+- name: bench
+  user: {{}}
+"""
+
+
+def _start_deployment_controller(server: MiniAPIServer,
+                                 stop: threading.Event) -> threading.Thread:
+    """Kubelet stand-in for coordinator pods: run the Deployment's
+    rendered command in-process and mark it ready only if its
+    readiness probe would pass (same contract as the fake-cluster
+    controller in helpers.py, over the REST server's store)."""
+
+    def loop():
+        while not stop.is_set():
+            todo = []
+            with server._lock:
+                for key, obj in server.objects.items():
+                    if not key.startswith("deployments/"):
+                        continue
+                    replicas = obj.get("spec", {}).get("replicas", 1)
+                    ready = obj.get("status", {}).get("readyReplicas", 0)
+                    if ready < replicas:
+                        todo.append((key, obj, replicas))
+            for key, obj, replicas in todo:
+                pod_spec = (obj.get("spec", {}).get("template", {})
+                            .get("spec", {}))
+                if not _run_coordinator_container(pod_spec):
+                    continue        # crash-loop analog: never ready
+                with server._lock:
+                    cur = server.objects.get(key)
+                    if cur is None:
+                        continue
+                    server._rv += 1
+                    cur.setdefault("status", {})["readyReplicas"] = replicas
+                    cur["metadata"]["resourceVersion"] = str(server._rv)
+                server.notify("deployments", "MODIFIED", cur)
+            stop.wait(0.05)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return t
+
+
+class OOPBed:
+    """One fake-topology node, one real plugin subprocess."""
+
+    def __init__(self, tmp_path: Path, topo: dict | None = None,
+                 node_name: str = "oop-node", verbosity: int = 1):
+        self.tmp = Path(tmp_path)
+        self.node = node_name
+        self.api = MiniAPIServer()
+        self.api.start()
+        self._stop = threading.Event()
+        self._dc_thread = _start_deployment_controller(self.api, self._stop)
+        self.client = RestClusterClient(self.api.url, auth={},
+                                        qps=0, burst=1)
+
+        self.client.create(Node(metadata=resource.ObjectMeta(
+            name=node_name)))
+        self.classes = standard_device_classes()
+        for cls in self.classes.values():
+            self.client.create(cls)
+
+        kubeconfig = self.tmp / "kubeconfig.yaml"
+        kubeconfig.write_text(
+            KUBECONFIG_TEMPLATE.format(server=self.api.url))
+        topo = dict(topo or {"generation": "v5e", "num_chips": 4})
+        topo.setdefault("hostname", node_name)
+        topo_file = self.tmp / "topology.json"
+        import json as _json
+        topo_file.write_text(_json.dumps(topo))
+
+        self.plugin_root = self.tmp / "plugin"
+        self.cdi_root = self.tmp / "cdi"
+        self.log_path = self.tmp / "plugin.log"
+        self._log_file = open(self.log_path, "w")
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "k8s_dra_driver_tpu.cmd.plugin",
+             "--node-name", node_name,
+             "--plugin-root", str(self.plugin_root),
+             "--registrar-root", str(self.tmp / "registrar"),
+             "--cdi-root", str(self.cdi_root),
+             "--fake-topology", str(topo_file),
+             "--kubeconfig", str(kubeconfig),
+             "--kube-api-qps", "0", "--kube-api-burst", "1",
+             "--coordinator-namespace", "tpu-dra-driver",
+             "--coordinator-image", "registry.local/tpu-dra-driver:test",
+             "-v", str(verbosity)],
+            cwd=REPO, stdout=self._log_file, stderr=subprocess.STDOUT,
+            env={**os.environ, "JAX_PLATFORMS": ""})
+        self.socket = self.plugin_root / "plugin.sock"
+        self._stub: DRAPluginStub | None = None
+        try:
+            self._await_ready()
+        except Exception:
+            # no caller holds a handle yet: reap the subprocess and
+            # server here or they outlive the bench/pytest process
+            self.shutdown()
+            raise
+
+    # -- lifecycle -------------------------------------------------------
+
+    def _await_ready(self, timeout_s: float = 30.0) -> None:
+        """Up when the UDS socket exists AND slices are published."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"plugin exited rc={self.proc.returncode}:\n"
+                    + self.log_path.read_text()[-2000:])
+            if self.socket.exists() and \
+                    self.client.list("ResourceSlice"):
+                return
+            time.sleep(0.05)
+        raise TimeoutError("plugin never became ready:\n"
+                           + self.log_path.read_text()[-2000:])
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+                self.proc.wait(5)
+        self._log_file.close()
+        self.client.close()
+        self.api.stop()
+
+    # -- the kubelet role ------------------------------------------------
+
+    def stub(self) -> DRAPluginStub:
+        if self._stub is None:
+            self._stub = DRAPluginStub(
+                grpc.insecure_channel(f"unix://{self.socket}"))
+        return self._stub
+
+    def create_claim(self, claim: resource.ResourceClaim
+                     ) -> resource.ResourceClaim:
+        return self.client.create(claim)
+
+    def run_pod(self, claim: resource.ResourceClaim) -> PodView:
+        """Allocate (scheduler role, over REST) + prepare (kubelet
+        role, over the subprocess's UDS gRPC) + CDI apply (runtime
+        role)."""
+        if claim.status.allocation is None:
+            allocate_claim(self.client, claim)
+        resp = self.stub().NodePrepareResources(
+            dra_pb2.NodePrepareResourcesRequest(claims=[dra_pb2.Claim(
+                uid=claim.metadata.uid,
+                namespace=claim.metadata.namespace,
+                name=claim.metadata.name)]))
+        result = resp.claims[claim.metadata.uid]
+        if result.error:
+            raise RuntimeError(result.error)
+        cdi_ids: list[str] = []
+        for dev in result.devices:
+            for cid in dev.cdi_device_ids:
+                if cid not in cdi_ids:
+                    cdi_ids.append(cid)
+        view = apply_cdi(self.cdi_root, cdi_ids)
+        view.node = self.node
+        return view
+
+    def delete_pod(self, claim: resource.ResourceClaim) -> None:
+        resp = self.stub().NodeUnprepareResources(
+            dra_pb2.NodeUnprepareResourcesRequest(claims=[dra_pb2.Claim(
+                uid=claim.metadata.uid,
+                namespace=claim.metadata.namespace,
+                name=claim.metadata.name)]))
+        err = resp.claims[claim.metadata.uid].error
+        if err:
+            raise RuntimeError(err)
