@@ -316,6 +316,10 @@ def bench_tpu_compute() -> dict:
         run_attention("attention_grad_long_context",
                       [(1, 8192, 8, 6), (1, 4096, 8, 8)],
                       probe=attention_grad_probe)
+        # grouped-query attention: same MXU work, 1/4 the K/V traffic
+        run_attention("attention_gqa",
+                      [(4, 2048, 8, 16)],
+                      probe=lambda **kw: attention_probe(kv_heads=2, **kw))
     return out
 
 

@@ -143,21 +143,25 @@ def measure_chain(make, arg, iters: int, floor_s: float = 0.0,
 
 def _attention_differential(batch, seq, heads, head_dim, iters, dtype,
                             interpret, block_q, block_k,
-                            matmuls, make_body) -> dict:
+                            matmuls, make_body,
+                            kv_heads: int | None = None) -> dict:
     """Shared flash-vs-naive harness behind both attention probes.
 
     Identical q/k/v generation, physical-floor computation, chain
     construction, and result dict; the probes differ only in the
     per-iteration body (``make_body(attn, k, v) -> fori body``) and the
-    matmul count that sets the FLOP model.
+    matmul count that sets the FLOP model.  ``kv_heads`` < heads
+    probes the grouped-query path (score/output FLOPs are unchanged —
+    GQA trims K/V HBM traffic, not MXU work).
     """
     from .flash_attention import flash_attention
     from .ring_attention import attention_reference
 
     shape = (batch, seq, heads, head_dim)
+    kv_shape = (batch, seq, kv_heads or heads, head_dim)
     q = jax.random.normal(jax.random.PRNGKey(0), shape, dtype)
-    k = jax.random.normal(jax.random.PRNGKey(1), shape, dtype)
-    v = jax.random.normal(jax.random.PRNGKey(2), shape, dtype)
+    k = jax.random.normal(jax.random.PRNGKey(1), kv_shape, dtype)
+    v = jax.random.normal(jax.random.PRNGKey(2), kv_shape, dtype)
 
     # matmuls x 2 x B*H*T^2*D MACs, causal masking halves the work
     flops = matmuls * 2 * batch * heads * seq * seq * head_dim * 0.5
@@ -185,6 +189,7 @@ def _attention_differential(batch, seq, heads, head_dim, iters, dtype,
                                          floor_s)
     return {
         "batch": batch, "seq": seq, "heads": heads, "head_dim": head_dim,
+        "kv_heads": kv_heads or heads,
         "flash_ms": t_flash * 1000, "naive_ms": t_naive * 1000,
         "flash_tflops": flops / t_flash / 1e12,
         "naive_tflops": flops / t_naive / 1e12,
@@ -197,7 +202,8 @@ def attention_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
                     head_dim: int = 64, iters: int = 32,
                     dtype=jnp.bfloat16, interpret: bool | None = None,
                     block_q: int | None = None,
-                    block_k: int | None = None) -> dict:
+                    block_k: int | None = None,
+                    kv_heads: int | None = None) -> dict:
     """Flash (pallas) vs naive (XLA) causal attention on the device.
 
     The fused-kernel half of the BASELINE workload story: same chained
@@ -216,7 +222,7 @@ def attention_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
     # forward only: 2 matmuls
     return _attention_differential(batch, seq, heads, head_dim, iters,
                                    dtype, interpret, block_q, block_k,
-                                   2, make_body)
+                                   2, make_body, kv_heads)
 
 
 def attention_grad_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
@@ -224,7 +230,8 @@ def attention_grad_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
                          dtype=jnp.bfloat16,
                          interpret: bool | None = None,
                          block_q: int | None = None,
-                         block_k: int | None = None) -> dict:
+                         block_k: int | None = None,
+                         kv_heads: int | None = None) -> dict:
     """Training-path probe: full fwd+bwd attention, pallas flash
     (forward kernel + pallas flash backward) vs naive XLA autodiff.
     Same hardened differential harness as attention_probe."""
@@ -243,7 +250,7 @@ def attention_grad_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
     # fwd 2 matmuls + bwd 5 matmuls
     return _attention_differential(batch, seq, heads, head_dim, iters,
                                    dtype, interpret, block_q, block_k,
-                                   7, make_body)
+                                   7, make_body, kv_heads)
 
 
 def matmul_tflops(dim: int = 4096, iters: int = 400,

@@ -37,10 +37,10 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import mesh_platform
-from .flash_attention import (attention_block_grads, attention_delta,
-                              flash_block_attention, flash_block_grads,
-                              merge_flash_stats, pick_blocks,
-                              normalize_flash_stats)
+from .flash_attention import (_kv_heads, attention_block_grads,
+                              attention_delta, flash_block_attention,
+                              flash_block_grads, merge_flash_stats,
+                              pick_blocks, normalize_flash_stats)
 
 _NEG_INF = -1e30
 
@@ -48,9 +48,14 @@ _NEG_INF = -1e30
 def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale):
     """One online-softmax accumulation step against a K/V block.
 
-    Shapes: q [B,Tq,H,D], k/v [B,Tk,H,D]; o [B,Tq,H,D] f32;
-    m,l [B,H,Tq] f32.  Returns updated (o, m, l).
+    Shapes: q [B,Tq,H,D], k/v [B,Tk,H_kv,D] (GQA via broadcast —
+    this is the pure-XLA fallback, so the repeat materializes here);
+    o [B,Tq,H,D] f32; m,l [B,H,Tq] f32.  Returns updated (o, m, l).
     """
+    _, group = _kv_heads(q.shape[2], k)
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
@@ -231,9 +236,18 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
 
 
 def attention_reference(q, k, v, *, causal=True, scale=None):
-    """Naive O(T^2) single-device attention, for correctness checks."""
+    """Naive O(T^2) single-device attention, for correctness checks.
+
+    Grouped-query attention: k/v may carry fewer heads than q (H a
+    multiple of H_kv); the group's heads are broadcast via repeat —
+    the semantics the fused kernels implement without materializing.
+    """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    _, group = _kv_heads(q.shape[2], k)   # validates divisibility
+    if group > 1:
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
