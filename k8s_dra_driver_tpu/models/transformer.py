@@ -52,6 +52,29 @@ class TransformerConfig:
     # whole step — the standard FLOPs-for-memory trade on TPU where
     # HBM, not compute, bounds batch x sequence.
     remat: bool = False
+    # Context-parallel strategy when the mesh has sp > 1: "ring"
+    # (K/V blocks stream over S ppermutes, O(T/S) memory) or
+    # "ulysses" (two all_to_alls reshard seq<->heads, one dense local
+    # flash call; needs local heads % sp == 0).
+    seq_parallel: str = "ring"
+    # Grouped-query attention: 0 = full MHA; otherwise the K/V head
+    # count (must divide n_heads). Flows straight into the kernels'
+    # native GQA path (ops/flash_attention.py) — no repeated K/V.
+    n_kv_heads: int = 0
+
+    def __post_init__(self):
+        if self.seq_parallel not in ("ring", "ulysses"):
+            raise ValueError(
+                f"unknown seq_parallel {self.seq_parallel!r}; "
+                "choose 'ring' or 'ulysses'")
+        if self.n_kv_heads and self.n_heads % self.n_kv_heads:
+            raise ValueError(
+                f"n_heads {self.n_heads} not a multiple of "
+                f"n_kv_heads {self.n_kv_heads}")
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
 
     @property
     def is_moe(self) -> bool:
@@ -66,7 +89,8 @@ def _layer_shapes(cfg: TransformerConfig) -> dict[str, tuple[int, ...]]:
     d, h, dh, f = cfg.d_model, cfg.n_heads, cfg.d_head, cfg.d_ff
     shapes = {
         "ln1": (d,), "ln2": (d,),
-        "wq": (d, h, dh), "wk": (d, h, dh), "wv": (d, h, dh),
+        "wq": (d, h, dh), "wk": (d, cfg.kv_heads, dh),
+        "wv": (d, cfg.kv_heads, dh),
         "wo": (h, dh, d),
     }
     if cfg.is_moe:
@@ -169,7 +193,11 @@ def _attention(x, layer, cfg: TransformerConfig, mesh: Mesh | None):
     k = rotary(jnp.einsum("btd,dhk->bthk", x, layer["wk"]), positions)
     v = jnp.einsum("btd,dhk->bthk", x, layer["wv"])
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        o = ring_attention(q, k, v, mesh, causal=True)
+        if cfg.seq_parallel == "ulysses":
+            from ..ops.ulysses_attention import ulysses_attention
+            o = ulysses_attention(q, k, v, mesh, causal=True)
+        else:
+            o = ring_attention(q, k, v, mesh, causal=True)
     elif mesh_platform(mesh) == "tpu":
         # fused pallas kernel on hardware (ops/flash_attention.py);
         # gated on the devices the computation actually runs on, not

@@ -1,0 +1,112 @@
+"""Ulysses-style all-to-all sequence parallelism for attention.
+
+The second context-parallel strategy next to ring attention
+(ops/ring_attention.py), trading collective pattern for layout: where
+the ring streams K/V blocks over S sequential ICI hops, Ulysses does
+TWO ``all_to_all``s — resharding [B, T/S, H, D] (sequence-sharded) to
+[B, T, H/S, D] (head-sharded), running plain LOCAL attention over the
+full sequence on each device's head subset, then resharding back.
+
+When to use which (the scaling-book framing):
+- Ulysses: 2 collectives per attention regardless of S, and the local
+  compute is a single dense flash call (best MXU shape) — wins while
+  heads are plentiful (S <= H) and the all-to-all payload (the whole
+  activation, 2x) fits comfortably in ICI bandwidth.
+- Ring: S ppermutes each fully overlapped with block compute, O(T/S)
+  peak memory for K/V — wins when S exceeds the head count, for very
+  long T (K/V never gathered), or when overlap hides the fabric
+  entirely.
+
+Differentiation needs no custom VJP: ``all_to_all`` is linear (its
+transpose is the reverse all_to_all) and the local attention is
+``flash_attention``'s custom-VJP pallas kernels, so ``jax.grad``
+composes — the backward is two transposed all_to_alls around the
+pallas flash backward.
+
+GQA: K/V heads reshard the same way, so H_kv must also be divisible
+by the sp size; the kernels then see the same grouped layout they
+already handle natively.
+
+No reference counterpart (the reference has no compute layer); the
+technique follows the public DeepSpeed-Ulysses design, built here on
+``jax.lax.all_to_all`` + shard_map.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.mesh import mesh_platform
+from .flash_attention import _kv_heads
+from .ring_attention import attention_reference
+
+
+def _ulysses_local(axis_name, causal, scale, use_flash, interpret,
+                   q, k, v):
+    """Per-shard body: all_to_all -> local attention -> all_to_all."""
+    s = jax.lax.psum(1, axis_name)
+
+    def seq_to_heads(x):
+        # [B, T/S, h, D] -> [B, T, h/S, D]: split heads S ways, gather
+        # the full sequence locally
+        return jax.lax.all_to_all(x, axis_name, split_axis=2,
+                                  concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    if s > 1:
+        q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if use_flash:
+        from .flash_attention import flash_attention
+        o = flash_attention(q, k, v, causal=causal, scale=scale,
+                            interpret=interpret)
+    else:
+        o = attention_reference(q, k, v, causal=causal, scale=scale)
+    return heads_to_seq(o) if s > 1 else o
+
+
+def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                      mesh: Mesh, *, axis_name: str = "sp",
+                      causal: bool = True, scale: float | None = None,
+                      batch_axes=("dp", "ep"),
+                      head_axis: str | None = "tp",
+                      use_flash: bool | None = None) -> jax.Array:
+    """Exact attention with sequence sharded over ``axis_name`` via
+    head/sequence all_to_all resharding (drop-in for ring_attention;
+    same global shapes and sharding contract).
+
+    q/k/v: [batch, seq, heads, head_dim] global. Requires the local
+    head count (after any ``head_axis`` sharding) — and the K/V head
+    count under GQA — to be divisible by the ``axis_name`` mesh size.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    platform = mesh_platform(mesh)
+    if use_flash is None:
+        use_flash = platform == "tpu"
+    interpret = platform != "tpu"
+
+    sp = mesh.shape[axis_name]
+    tp = mesh.shape[head_axis] if head_axis else 1
+    h = q.shape[2]
+    h_kv, _ = _kv_heads(h, k)
+    for name, heads in (("query", h), ("kv", h_kv)):
+        local = heads // tp if tp > 1 else heads
+        if local % sp:
+            raise ValueError(
+                f"ulysses needs local {name} head count {local} "
+                f"divisible by {axis_name}={sp}; use ring_attention "
+                f"for seq-parallel sizes beyond the head count")
+
+    spec = P(batch_axes, axis_name, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(_ulysses_local, axis_name, causal, scale,
+                          use_flash, interpret),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)

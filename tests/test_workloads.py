@@ -1,7 +1,9 @@
 """Workload-layer tests on the virtual 8-device CPU mesh: mesh building,
 ring attention vs reference, sharded MoE transformer train step."""
 
+import dataclasses
 import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -69,12 +71,21 @@ class TestTransformer:
         logits = forward(params, tokens, SMALL)
         assert logits.shape == (2, 16, 128)
 
-    def test_sharded_equals_unsharded(self):
-        mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
-        params = init_params(SMALL, jax.random.PRNGKey(0))
+    @pytest.mark.parametrize("seq_parallel,n_kv_heads,spec", [
+        ("ring", 0, MeshSpec(dp=2, sp=2, tp=2)),
+        ("ulysses", 0, MeshSpec(dp=2, sp=2, tp=2)),
+        ("ring", 2, MeshSpec(dp=2, sp=2, tp=2)),
+        # ulysses needs local kv heads % sp == 0, so GQA runs tp-less
+        ("ulysses", 2, MeshSpec(dp=4, sp=2, tp=1)),
+    ])
+    def test_sharded_equals_unsharded(self, seq_parallel, n_kv_heads, spec):
+        mesh = make_mesh(spec)
+        cfg = dataclasses.replace(SMALL, seq_parallel=seq_parallel,
+                                  n_kv_heads=n_kv_heads)
+        params = init_params(cfg, jax.random.PRNGKey(0))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
-        plain = forward(params, tokens, SMALL, mesh=None)
-        sharded = forward(shard_params(params, SMALL, mesh), tokens, SMALL,
+        plain = forward(params, tokens, cfg, mesh=None)
+        sharded = forward(shard_params(params, cfg, mesh), tokens, cfg,
                           mesh=mesh)
         np.testing.assert_allclose(np.asarray(plain), np.asarray(sharded),
                                    atol=2e-4, rtol=2e-4)
@@ -136,3 +147,13 @@ class TestCollectives:
         out = allreduce_bandwidth(size_mb=1, iters=2)
         assert out["devices"] == 8
         assert out["gbps"] > 0
+
+
+class TestConfigValidation:
+    def test_unknown_seq_parallel_rejected(self):
+        with pytest.raises(ValueError, match="seq_parallel"):
+            dataclasses.replace(SMALL, seq_parallel="ulysess")
+
+    def test_indivisible_kv_heads_rejected(self):
+        with pytest.raises(ValueError, match="n_kv_heads"):
+            dataclasses.replace(SMALL, n_kv_heads=3)
