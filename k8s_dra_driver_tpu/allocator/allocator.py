@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 from ..api import resource
 from ..cluster import Node, match_labels
@@ -67,10 +68,22 @@ class _BudgetExhausted(Exception):
 
 
 class Allocator:
+    """``engine`` selects the DFS implementation: "python" (default),
+    "native" (the C++ core, native/tpualloc.cc — errors if it cannot
+    build/load), or "auto" (native with silent Python fallback).
+    Both engines are pick-identical by contract
+    (tests/test_native_alloc.py); TPU_ALLOC_ENGINE overrides the
+    default for deployments."""
+
     def __init__(self, driver: str = DRIVER_NAME,
-                 search_budget: int = DEFAULT_SEARCH_BUDGET):
+                 search_budget: int = DEFAULT_SEARCH_BUDGET,
+                 engine: str | None = None):
         self.driver = driver
         self.search_budget = search_budget
+        self.engine = engine or os.environ.get("TPU_ALLOC_ENGINE",
+                                               "python")
+        if self.engine not in ("python", "native", "auto"):
+            raise ValueError(f"unknown allocator engine {self.engine!r}")
 
     # ------------------------------------------------------------------
 
@@ -188,11 +201,21 @@ class Allocator:
                     f"request {req.name!r}: no eligible devices")
             per_request.append((req, eligible, match_attrs))
 
-        budget = [self.search_budget]
-        try:
-            solution = self._search(per_request, 0, {}, set(), constraints,
-                                    budget)
-        except _BudgetExhausted:
+        status, solution = "nosolution", None
+        if self.engine in ("native", "auto"):
+            status, solution = self._solve_native(per_request, constraints)
+        if status == "unavailable" or self.engine == "python":
+            budget = [self.search_budget]
+            try:
+                solution = self._search(per_request, 0, {}, set(),
+                                        constraints, budget)
+                status = "ok" if solution is not None else "nosolution"
+            except _BudgetExhausted:
+                status = "budget"
+
+        # one raise site so the two engines can never report a shared
+        # outcome differently
+        if status == "budget":
             raise AllocationError(
                 f"search budget ({self.search_budget} expansions) "
                 "exhausted without a conflict-free combination; the "
@@ -203,6 +226,18 @@ class Allocator:
                 "no conflict-free device combination satisfies all "
                 "requests and constraints")
         return solution
+
+    def _solve_native(self, per_request, constraints):
+        """Run the C++ search core; status "unavailable" means fall
+        back to Python (only under engine="auto")."""
+        from . import native as native_alloc
+        try:
+            return native_alloc.solve(per_request, constraints,
+                                      self.search_budget)
+        except native_alloc.NativeAllocUnavailableError:
+            if self.engine == "auto":
+                return "unavailable", None
+            raise
 
     @staticmethod
     def _match_attrs_for(req_name, constraints) -> list[str]:

@@ -18,7 +18,6 @@ from __future__ import annotations
 import ctypes
 import json
 import os
-import subprocess
 from pathlib import Path
 
 from .topology import GENERATIONS, ICICoord, MeshShape
@@ -46,31 +45,11 @@ def generations_spec() -> str:
 def ensure_built(source: Path | None = None,
                  lib_path: Path | None = None) -> Path:
     """Return a usable shared library, compiling it if needed."""
-    explicit = os.environ.get("TPU_DISCOVERY_LIB")
-    if explicit:
-        return Path(explicit)
-    source = source or (NATIVE_DIR / "tpudiscovery.cc")
-    lib_path = lib_path or DEFAULT_LIB
-    if lib_path.exists() and (not source.exists() or
-                              lib_path.stat().st_mtime >=
-                              source.stat().st_mtime):
-        return lib_path
-    if not source.exists():
-        raise NativeUnavailableError(f"shim source missing: {source}")
-    cmd = ["g++", "-O2", "-Wall", "-std=c++17", "-fPIC", "-shared",
-           "-o", str(lib_path), str(source)]
-    try:
-        lib_path.parent.mkdir(parents=True, exist_ok=True)
-        out = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=120)
-    except (OSError, subprocess.SubprocessError) as e:
-        # read-only filesystems etc. must fall through to the sysfs
-        # backend under --discovery auto
-        raise NativeUnavailableError(f"cannot build shim: {e}") from e
-    if out.returncode != 0:
-        raise NativeUnavailableError(
-            f"shim compile failed:\n{out.stderr[-2000:]}")
-    return lib_path
+    from ..utils import nativebuild
+    return nativebuild.ensure_built(
+        source or (NATIVE_DIR / "tpudiscovery.cc"),
+        lib_path or DEFAULT_LIB,
+        "TPU_DISCOVERY_LIB", NativeUnavailableError)
 
 
 class NativeBackend(DiscoveryBackend):

@@ -1,0 +1,44 @@
+"""Shared build-or-load logic for the native shims.
+
+Both C++ shims (native/tpudiscovery.cc, native/tpualloc.cc) follow the
+same contract: use a prebuilt .so when the env var points at one,
+rebuild with g++ when the source is newer, degrade cleanly where no
+toolchain exists.  One parameterized implementation so the two cannot
+drift (the allocator copy had already diverged from the discovery
+original before this was extracted).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+from pathlib import Path
+
+NATIVE_DIR = Path(__file__).parent.parent.parent / "native"
+
+
+def ensure_built(source: Path, lib_path: Path, env_var: str,
+                 error_cls: type[Exception]) -> Path:
+    """Return a usable shared library, compiling it if needed."""
+    explicit = os.environ.get(env_var)
+    if explicit:
+        return Path(explicit)
+    if lib_path.exists() and (not source.exists() or
+                              lib_path.stat().st_mtime >=
+                              source.stat().st_mtime):
+        return lib_path
+    if not source.exists():
+        raise error_cls(f"shim source missing: {source}")
+    cmd = ["g++", "-O2", "-Wall", "-std=c++17", "-fPIC", "-shared",
+           "-o", str(lib_path), str(source)]
+    try:
+        lib_path.parent.mkdir(parents=True, exist_ok=True)
+        out = subprocess.run(cmd, capture_output=True, text=True,
+                             timeout=120)
+    except (OSError, subprocess.SubprocessError) as e:
+        # read-only filesystems / missing toolchain must degrade to the
+        # pure-Python implementation behind the caller's gate
+        raise error_cls(f"cannot build shim: {e}") from e
+    if out.returncode != 0:
+        raise error_cls(f"shim build failed: {out.stderr[-2000:]}")
+    return lib_path

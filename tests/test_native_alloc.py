@@ -1,0 +1,181 @@
+"""Native allocator core: pick-parity with the Python engine.
+
+The conformance contract from the discovery shim applied to search
+(tests/test_native_discovery.py analog): the C++ DFS
+(native/tpualloc.cc) must choose EXACTLY the devices the Python DFS
+chooses — same candidate order in, same picks out — across the
+allocator test corpus shapes and randomized pools.  Skips cleanly
+when no toolchain can build the shim.
+"""
+
+import random
+
+import pytest
+
+from k8s_dra_driver_tpu.allocator import AllocationError, Allocator
+from k8s_dra_driver_tpu.allocator.native import (
+    NativeAllocUnavailableError, ensure_built, version)
+from k8s_dra_driver_tpu.api import resource
+from k8s_dra_driver_tpu.api.classes import standard_device_classes
+from k8s_dra_driver_tpu.cluster import Node
+from k8s_dra_driver_tpu.devicemodel import enumerate_host_devices
+from k8s_dra_driver_tpu.discovery import FakeHost
+
+CLASSES = standard_device_classes()
+
+try:
+    ensure_built()
+    HAVE_SHIM = True
+except NativeAllocUnavailableError:
+    HAVE_SHIM = False
+
+pytestmark = pytest.mark.skipif(not HAVE_SHIM,
+                                reason="no toolchain for tpualloc shim")
+
+
+def claim_for(requests, constraints=(), name="c"):
+    return resource.ResourceClaim(
+        metadata=resource.ObjectMeta(name=name, namespace="default"),
+        spec=resource.ResourceClaimSpec(devices=resource.DeviceClaim(
+            requests=requests, constraints=list(constraints))))
+
+
+def req(name="r0", count=1, cls="tpu.google.com", selectors=(),
+        mode=""):
+    return resource.DeviceRequest(
+        name=name, device_class_name=cls, count=count,
+        allocation_mode=mode or resource.ALLOCATION_MODE_EXACT,
+        selectors=[resource.DeviceSelector(cel=s) for s in selectors])
+
+
+def host_slices(tmp_path, n_hosts=2, generation="v5p"):
+    topo = FakeHost(hostname="h", generation=generation).materialize(
+        tmp_path).enumerate()
+    devices = [d.to_device()
+               for _, d in sorted(enumerate_host_devices(topo).items())]
+    slices, nodes = [], []
+    for i in range(n_hosts):
+        name = f"host-{i:02d}"
+        slices.append(resource.ResourceSlice(
+            metadata=resource.ObjectMeta(name=f"s-{name}"),
+            driver="tpu.google.com",
+            pool=resource.ResourcePool(name=name), node_name=name,
+            devices=devices))
+        nodes.append(Node(metadata=resource.ObjectMeta(name=name)))
+    return slices, nodes
+
+
+def both_engines(claim, slices, nodes, allocated=()):
+    """Run both engines; return (python_result, native_result) where a
+    result is either the allocation or the AllocationError message."""
+    out = []
+    for engine in ("python", "native"):
+        alloc = Allocator(engine=engine)
+        try:
+            res = alloc.allocate(claim, slices, CLASSES, nodes=nodes,
+                                 allocated_claims=list(allocated))
+            out.append(sorted((r.request, r.pool, r.device)
+                              for r in res.results))
+        except AllocationError:
+            out.append("AllocationError")
+    return out[0], out[1]
+
+
+class TestParity:
+    def test_version(self):
+        assert version().startswith("tpualloc/")
+
+    def test_single_chip(self, tmp_path):
+        slices, nodes = host_slices(tmp_path)
+        py, nat = both_engines(claim_for([req()]), slices, nodes)
+        assert py == nat != "AllocationError"
+
+    def test_multi_request_with_constraint(self, tmp_path):
+        slices, nodes = host_slices(tmp_path)
+        c = claim_for(
+            [req("a", cls="tpu-core.google.com"),
+             req("b", cls="tpu-core.google.com")],
+            constraints=[resource.DeviceConstraint(
+                requests=["a", "b"], match_attribute="parentUUID")])
+        py, nat = both_engines(c, slices, nodes)
+        assert py == nat != "AllocationError"
+
+    def test_allocation_mode_all(self, tmp_path):
+        slices, nodes = host_slices(tmp_path)
+        c = claim_for([req("every", mode=resource.ALLOCATION_MODE_ALL,
+                           selectors=['device.attributes["type"] '
+                                      '== "chip"'])])
+        py, nat = both_engines(c, slices, nodes)
+        assert py == nat != "AllocationError"
+
+    def test_unsatisfiable(self, tmp_path):
+        slices, nodes = host_slices(tmp_path)
+        py, nat = both_engines(claim_for([req(count=99)]), slices, nodes)
+        assert py == nat == "AllocationError"
+
+    def test_token_conflicts_from_prior_claims(self, tmp_path):
+        slices, nodes = host_slices(tmp_path, n_hosts=1)
+        base = claim_for([req(count=4)], name="hog")
+        alloc = Allocator()
+        base.status = resource.ResourceClaimStatus(
+            allocation=alloc.allocate(base, slices, CLASSES, nodes=nodes))
+        py, nat = both_engines(claim_for([req()]), slices, nodes,
+                               allocated=[base])
+        assert py == nat == "AllocationError"
+
+    def test_randomized_pools(self, tmp_path):
+        """Fuzz: random claims over a 4-host pool must be
+        pick-identical (or identically infeasible) across engines."""
+        slices, nodes = host_slices(tmp_path, n_hosts=4)
+        rng = random.Random(7)
+        classes = ["tpu.google.com", "tpu-core.google.com",
+                   "tpu-slice.google.com"]
+        for i in range(40):
+            n_reqs = rng.randint(1, 3)
+            reqs, names = [], []
+            for r in range(n_reqs):
+                names.append(f"r{r}")
+                reqs.append(req(f"r{r}", count=rng.randint(1, 3),
+                                cls=rng.choice(classes)))
+            constraints = []
+            if rng.random() < 0.4:
+                constraints.append(resource.DeviceConstraint(
+                    requests=rng.sample(names, rng.randint(1, n_reqs)),
+                    match_attribute=rng.choice(
+                        ["parentUUID", "generation", "uuid"])))
+            c = claim_for(reqs, constraints, name=f"fuzz-{i}")
+            py, nat = both_engines(c, slices, nodes)
+            assert py == nat, f"fuzz case {i}: {py} != {nat}"
+
+
+class TestEngineFallback:
+    def test_auto_falls_back_when_shim_unavailable(self, tmp_path,
+                                                   monkeypatch):
+        from k8s_dra_driver_tpu.allocator import native as na
+        monkeypatch.setattr(na, "_lib", None)
+        monkeypatch.setattr(na, "_load_error", None)
+        monkeypatch.setenv("TPU_ALLOC_LIB", str(tmp_path / "missing.so"))
+        slices, nodes = host_slices(tmp_path)
+        res = Allocator(engine="auto").allocate(
+            claim_for([req()]), slices, CLASSES, nodes=nodes)
+        assert res.results          # python fallback served the claim
+        # unavailability is cached: second load fails fast
+        with pytest.raises(NativeAllocUnavailableError):
+            na.load()
+        with pytest.raises(NativeAllocUnavailableError):
+            na.load()
+
+    def test_native_engine_surfaces_unavailability(self, tmp_path,
+                                                   monkeypatch):
+        from k8s_dra_driver_tpu.allocator import native as na
+        monkeypatch.setattr(na, "_lib", None)
+        monkeypatch.setattr(na, "_load_error", None)
+        monkeypatch.setenv("TPU_ALLOC_LIB", str(tmp_path / "missing.so"))
+        slices, nodes = host_slices(tmp_path)
+        with pytest.raises(NativeAllocUnavailableError):
+            Allocator(engine="native").allocate(
+                claim_for([req()]), slices, CLASSES, nodes=nodes)
+
+    def test_unknown_engine_rejected(self):
+        with pytest.raises(ValueError, match="engine"):
+            Allocator(engine="rust")
