@@ -1,0 +1,34 @@
+# Developer entry points — the reference Makefile's lint/build/test
+# targets (reference Makefile:62,97) mapped to this stack.
+
+PYTHON ?= python
+
+.PHONY: all build lint test bench image native clean
+
+all: build
+
+native:
+	$(MAKE) -C native
+
+build: native
+	$(PYTHON) -m compileall -q k8s_dra_driver_tpu
+
+lint:
+	ruff check .
+
+# native build is best-effort here: the suite degrades gracefully
+# (shim-dependent tests skip) on hosts without a C++ toolchain
+test:
+	-$(MAKE) -C native
+	$(PYTHON) -m pytest tests/ -q
+
+bench:
+	$(PYTHON) bench.py
+
+# Mirrors .github/workflows/image.yaml / the reference's image-build
+image:
+	docker build -f deployments/container/Dockerfile -t tpu-dra-driver:dev .
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +

@@ -128,10 +128,14 @@ def solve(per_request, constraints, budget: int
     """
     text, by_id = _encode_problem(per_request, constraints, budget)
     lib = load()
+    encoded = text.encode()
+    rc, out = 4, ""
     cap = 1 << 20
-    buf = ctypes.create_string_buffer(cap)
-    rc = lib.tpu_allocate(text.encode(), buf, cap)
-    out = buf.value.decode()
+    while rc == 4 and cap <= (1 << 26):   # rc 4 = result didn't fit
+        buf = ctypes.create_string_buffer(cap)
+        rc = lib.tpu_allocate(encoded, buf, cap)
+        out = buf.value.decode()
+        cap *= 8
     if rc == 2:
         return "budget", None
     if rc == 1:

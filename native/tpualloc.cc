@@ -64,7 +64,13 @@ bool parse_int_list(const std::string &s, std::vector<int> *out) {
   std::string part;
   while (std::getline(ss, part, ',')) {
     if (part.empty()) return false;
-    out->push_back(std::stoi(part));
+    try {
+      out->push_back(std::stoi(part));
+    } catch (const std::exception &) {
+      // non-numeric / out-of-range must surface as rc=3 "fail parse",
+      // never as an exception escaping the C ABI
+      return false;
+    }
   }
   return true;
 }

@@ -29,7 +29,9 @@ try:
 except NativeAllocUnavailableError:
     HAVE_SHIM = False
 
-pytestmark = pytest.mark.skipif(not HAVE_SHIM,
+# applied to TestParity only — the fallback tests below exist exactly
+# for toolchain-less hosts and must run there
+needs_shim = pytest.mark.skipif(not HAVE_SHIM,
                                 reason="no toolchain for tpualloc shim")
 
 
@@ -81,6 +83,7 @@ def both_engines(claim, slices, nodes, allocated=()):
     return out[0], out[1]
 
 
+@needs_shim
 class TestParity:
     def test_version(self):
         assert version().startswith("tpualloc/")
