@@ -1,9 +1,12 @@
 """Workload models (proof-of-function for allocated TPUs)."""
 
+from .decode import (KVCache, decode_step, greedy_generate, init_cache,
+                     prefill)
 from .transformer import (TransformerConfig, forward, init_params, loss_fn,
                           make_optimizer, make_train_step, param_specs,
                           shard_params)
 
-__all__ = ["TransformerConfig", "forward", "init_params", "loss_fn",
-           "make_optimizer", "make_train_step", "param_specs",
+__all__ = ["KVCache", "TransformerConfig", "decode_step", "forward",
+           "greedy_generate", "init_cache", "init_params", "loss_fn",
+           "make_optimizer", "make_train_step", "param_specs", "prefill",
            "shard_params"]
