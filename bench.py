@@ -320,6 +320,31 @@ def bench_tpu_compute() -> dict:
         run_attention("attention_gqa",
                       [(4, 2048, 8, 16)],
                       probe=lambda **kw: attention_probe(kv_heads=2, **kw))
+
+    # Serving path: greedy generation through the static-shape KV
+    # cache, differential over scan lengths (prefill + dispatch RTT
+    # cancel). Decode is HBM-bound: tok/s ~ bandwidth / param bytes.
+    from k8s_dra_driver_tpu.ops import decode_probe
+    decode_shapes = ([("154m_b8", dict()),
+                      ("38m_b4", dict(batch=4, n_layers=4, d_model=512,
+                                      heads=8, kv_heads=2, d_ff=2048,
+                                      n_tokens=32))]
+                     if on_accel else
+                     [("tiny", dict(batch=2, n_layers=2, d_model=128,
+                                    heads=4, kv_heads=2, d_ff=256,
+                                    prompt_len=8, n_tokens=8, max_seq=64,
+                                    reps=1))])
+    label, res, errs = _retry_probe(
+        [(lbl, lambda kw=kw: decode_probe(**kw))
+         for lbl, kw in decode_shapes])
+    if res is not None:
+        out["decode"] = {"shape": label, **{
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in res.items()}}
+    else:
+        out["decode"] = {"error": errs[-1] if errs else "no attempts"}
+    if errs:
+        out.setdefault("retries", []).extend(errs)
     return out
 
 
