@@ -1,27 +1,32 @@
-"""Out-of-process plugin bed: the REAL binary behind every boundary.
+"""Out-of-process bed: the REAL binaries behind every boundary.
 
 The hermetic ``E2EBed`` runs drivers in-process (real gRPC over UDS,
 but one process).  This bed closes the remaining gap to a live kubelet
-path without docker/kind: the actual ``tpu-dra-plugin`` binary runs as
-a subprocess, discovers a fake topology, talks to a real HTTP API
-server (``MiniAPIServer``) through a kubeconfig — publishing its
-ResourceSlices over the wire — and serves NodePrepareResources on its
-UDS socket to this process, which plays kubelet (gRPC client) and
-container runtime (CDI interpreter).  Coordinator Deployments the
-plugin creates via REST are picked up by a deployment-controller
-thread that executes the rendered ``tpu-coordinatord`` command, so
-readiness is earned, not granted.
+path without docker/kind: the actual ``tpu-dra-plugin`` binaries run
+as subprocesses (one per fake node) — and, for gang scenarios, the
+actual ``tpu-dra-controller`` binary as another — all talking to a
+real HTTP API server (``MiniAPIServer``) through a kubeconfig.
+Plugins publish their ResourceSlices over the wire, self-label their
+Nodes with slice identity, the controller watches those labels and
+publishes the gang pool, and this process plays kubelet (gRPC client
+per node) and container runtime (CDI interpreter).  Coordinator
+Deployments the plugins create via REST are picked up by a
+deployment-controller thread that executes the rendered
+``tpu-coordinatord`` command, so readiness is earned, not granted.
 
-Boundaries that are real here: process (fork/exec), HTTP (API server),
-UDS gRPC (prepare path), filesystem (CDI specs, checkpoints,
-coordinator ctl dirs).  Only kube-scheduler (in-repo allocator) and
-kubelet/containerd themselves are played by the caller — the same
-substitutions the reference's kind tier makes for the control plane it
-doesn't run (reference demo/clusters/kind/create-cluster.sh).
+Boundaries that are real here: process (fork/exec, one per binary),
+HTTP (API server, including the label-watch path), UDS gRPC (prepare),
+filesystem (CDI specs, checkpoints, coordinator ctl dirs).  Only
+kube-scheduler (in-repo allocator) and kubelet/containerd themselves
+are played by the caller — the same substitutions the reference's kind
+tier makes for the control plane it doesn't run (reference
+demo/clusters/kind/create-cluster.sh).
 """
 
 from __future__ import annotations
 
+import dataclasses
+import json
 import os
 import subprocess
 import sys
@@ -101,112 +106,211 @@ def _start_deployment_controller(server: MiniAPIServer,
     return t
 
 
+@dataclasses.dataclass
+class _PluginProc:
+    node: str
+    proc: subprocess.Popen
+    plugin_root: Path
+    cdi_root: Path
+    log_path: Path
+    log_file: object
+    stub: DRAPluginStub | None = None
+
+    @property
+    def socket(self) -> Path:
+        return self.plugin_root / "plugin.sock"
+
+
 class OOPBed:
-    """One fake-topology node, one real plugin subprocess."""
+    """N fake-topology nodes, one real plugin subprocess each, plus an
+    optional real controller subprocess for gang scenarios."""
 
     def __init__(self, tmp_path: Path, topo: dict | None = None,
-                 node_name: str = "oop-node", verbosity: int = 1):
+                 node_name: str = "oop-node", verbosity: int = 1,
+                 topos: dict[str, dict] | None = None,
+                 with_controller: bool = False):
         self.tmp = Path(tmp_path)
-        self.node = node_name
+        if topos is None:
+            topos = {node_name: dict(topo or {"generation": "v5e",
+                                              "num_chips": 4})}
+        self.node = next(iter(topos))
         self.api = MiniAPIServer()
         self.api.start()
         self._stop = threading.Event()
         self._dc_thread = _start_deployment_controller(self.api, self._stop)
         self.client = RestClusterClient(self.api.url, auth={},
                                         qps=0, burst=1)
+        self.controller_proc: subprocess.Popen | None = None
+        self._ctl_log = None
+        self.plugins: dict[str, _PluginProc] = {}
 
-        self.client.create(Node(metadata=resource.ObjectMeta(
-            name=node_name)))
-        self.classes = standard_device_classes()
-        for cls in self.classes.values():
-            self.client.create(cls)
-
-        kubeconfig = self.tmp / "kubeconfig.yaml"
-        kubeconfig.write_text(
-            KUBECONFIG_TEMPLATE.format(server=self.api.url))
-        topo = dict(topo or {"generation": "v5e", "num_chips": 4})
-        topo.setdefault("hostname", node_name)
-        topo_file = self.tmp / "topology.json"
-        import json as _json
-        topo_file.write_text(_json.dumps(topo))
-
-        self.plugin_root = self.tmp / "plugin"
-        self.cdi_root = self.tmp / "cdi"
-        self.log_path = self.tmp / "plugin.log"
-        self._log_file = open(self.log_path, "w")
-        self.proc = subprocess.Popen(
-            [sys.executable, "-m", "k8s_dra_driver_tpu.cmd.plugin",
-             "--node-name", node_name,
-             "--plugin-root", str(self.plugin_root),
-             "--registrar-root", str(self.tmp / "registrar"),
-             "--cdi-root", str(self.cdi_root),
-             "--fake-topology", str(topo_file),
-             "--kubeconfig", str(kubeconfig),
-             "--kube-api-qps", "0", "--kube-api-burst", "1",
-             "--coordinator-namespace", "tpu-dra-driver",
-             "--coordinator-image", "registry.local/tpu-dra-driver:test",
-             "-v", str(verbosity)],
-            cwd=REPO, stdout=self._log_file, stderr=subprocess.STDOUT,
-            env={**os.environ, "JAX_PLATFORMS": ""})
-        self.socket = self.plugin_root / "plugin.sock"
-        self._stub: DRAPluginStub | None = None
         try:
+            for name in topos:
+                self.client.create(Node(metadata=resource.ObjectMeta(
+                    name=name)))
+            self.classes = standard_device_classes()
+            for cls in self.classes.values():
+                self.client.create(cls)
+
+            kubeconfig = self.tmp / "kubeconfig.yaml"
+            kubeconfig.write_text(
+                KUBECONFIG_TEMPLATE.format(server=self.api.url))
+
+            if with_controller:
+                self._ctl_log = open(self.tmp / "controller.log", "w")
+                self.controller_proc = subprocess.Popen(
+                    [sys.executable, "-m",
+                     "k8s_dra_driver_tpu.cmd.controller",
+                     "--kubeconfig", str(kubeconfig),
+                     "--kube-api-qps", "0", "--kube-api-burst", "1",
+                     "--device-classes", "podslice,rendezvous",
+                     "--retry-delay", "0.2",
+                     "-v", str(verbosity)],
+                    cwd=REPO, stdout=self._ctl_log,
+                    stderr=subprocess.STDOUT,
+                    env={**os.environ, "JAX_PLATFORMS": ""})
+
+            for name, node_topo in topos.items():
+                node_topo = dict(node_topo)
+                node_topo.setdefault("hostname", name)
+                node_dir = self.tmp / name
+                node_dir.mkdir(exist_ok=True)
+                topo_file = node_dir / "topology.json"
+                topo_file.write_text(json.dumps(node_topo))
+                log_path = node_dir / "plugin.log"
+                log_file = open(log_path, "w")
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "k8s_dra_driver_tpu.cmd.plugin",
+                     "--node-name", name,
+                     "--plugin-root", str(node_dir / "plugin"),
+                     "--registrar-root", str(node_dir / "registrar"),
+                     "--cdi-root", str(node_dir / "cdi"),
+                     "--fake-topology", str(topo_file),
+                     "--kubeconfig", str(kubeconfig),
+                     "--kube-api-qps", "0", "--kube-api-burst", "1",
+                     "--coordinator-namespace", "tpu-dra-driver",
+                     "--coordinator-image",
+                     "registry.local/tpu-dra-driver:test",
+                     "-v", str(verbosity)],
+                    cwd=REPO, stdout=log_file, stderr=subprocess.STDOUT,
+                    env={**os.environ, "JAX_PLATFORMS": "",
+                         "NODE_NAME": name})
+                self.plugins[name] = _PluginProc(
+                    node=name, proc=proc, plugin_root=node_dir / "plugin",
+                    cdi_root=node_dir / "cdi", log_path=log_path,
+                    log_file=log_file)
             self._await_ready()
         except Exception:
-            # no caller holds a handle yet: reap the subprocess and
+            # no caller holds a handle yet: reap subprocesses and the
             # server here or they outlive the bench/pytest process
             self.shutdown()
             raise
 
+    # -- compat accessors for the single-node tests/bench ---------------
+
+    @property
+    def cdi_root(self) -> Path:
+        return self.plugins[self.node].cdi_root
+
+    @property
+    def log_path(self) -> Path:
+        return self.plugins[self.node].log_path
+
     # -- lifecycle -------------------------------------------------------
 
-    def _await_ready(self, timeout_s: float = 30.0) -> None:
-        """Up when the UDS socket exists AND slices are published."""
+    def _await_ready(self, timeout_s: float = 60.0) -> None:
+        """Up when every plugin's UDS socket exists AND its node pool
+        is published over the wire."""
+        deadline = time.monotonic() + timeout_s
+        pending = set(self.plugins)
+        while time.monotonic() < deadline:
+            # liveness for EVERY process, every pass: a plugin can
+            # crash after its socket appears but before publishing
+            for name, p in self.plugins.items():
+                if p.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"plugin {name} exited rc={p.proc.returncode}:\n"
+                        + p.log_path.read_text()[-2000:])
+            self._check_controller_alive()
+            pending = {n for n in pending
+                       if not self.plugins[n].socket.exists()}
+            if not pending:
+                published = {s.node_name
+                             for s in self.client.list("ResourceSlice")}
+                if all(n in published for n in self.plugins):
+                    return
+            time.sleep(0.05)
+        unpublished = set(self.plugins) - {
+            s.node_name for s in self.client.list("ResourceSlice")}
+        worst = sorted(pending or unpublished or set(self.plugins))[0]
+        raise TimeoutError(
+            f"bed never became ready; no socket: {sorted(pending)}, "
+            f"unpublished: {sorted(unpublished)}; log of {worst}:\n"
+            + self.plugins[worst].log_path.read_text()[-2000:])
+
+    def _check_controller_alive(self) -> None:
+        if self.controller_proc is not None and \
+                self.controller_proc.poll() is not None:
+            raise RuntimeError(
+                f"controller exited rc={self.controller_proc.returncode}"
+                ":\n" + (self.tmp / "controller.log").read_text()[-2000:])
+
+    def await_gang_pool(self, timeout_s: float = 30.0):
+        """Wait for the controller subprocess to publish the
+        slice-scoped gang pool (podslice + rendezvous devices)."""
+        if self.controller_proc is None:
+            raise RuntimeError(
+                "bed was created without with_controller=True")
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
-            if self.proc.poll() is not None:
-                raise RuntimeError(
-                    f"plugin exited rc={self.proc.returncode}:\n"
-                    + self.log_path.read_text()[-2000:])
-            if self.socket.exists() and \
-                    self.client.list("ResourceSlice"):
-                return
-            time.sleep(0.05)
-        raise TimeoutError("plugin never became ready:\n"
-                           + self.log_path.read_text()[-2000:])
+            self._check_controller_alive()
+            gang = [s for s in self.client.list("ResourceSlice")
+                    if not s.node_name and s.node_selector]
+            if gang:
+                return gang
+            time.sleep(0.1)
+        raise TimeoutError(
+            "controller never published a gang pool:\n"
+            + (self.tmp / "controller.log").read_text()[-2000:])
 
     def shutdown(self) -> None:
         self._stop.set()
-        if self.proc.poll() is None:
-            self.proc.terminate()
+        procs = [p.proc for p in self.plugins.values()]
+        if self.controller_proc is not None:
+            procs.append(self.controller_proc)
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
             try:
-                self.proc.wait(10)
+                proc.wait(10)
             except subprocess.TimeoutExpired:
-                self.proc.kill()
-                self.proc.wait(5)
-        self._log_file.close()
+                proc.kill()
+                proc.wait(5)
+        for p in self.plugins.values():
+            p.log_file.close()
+        if self._ctl_log is not None:
+            self._ctl_log.close()
         self.client.close()
         self.api.stop()
 
     # -- the kubelet role ------------------------------------------------
 
-    def stub(self) -> DRAPluginStub:
-        if self._stub is None:
-            self._stub = DRAPluginStub(
-                grpc.insecure_channel(f"unix://{self.socket}"))
-        return self._stub
+    def stub(self, node: str | None = None) -> DRAPluginStub:
+        p = self.plugins[node or self.node]
+        if p.stub is None:
+            p.stub = DRAPluginStub(
+                grpc.insecure_channel(f"unix://{p.socket}"))
+        return p.stub
 
     def create_claim(self, claim: resource.ResourceClaim
                      ) -> resource.ResourceClaim:
         return self.client.create(claim)
 
-    def run_pod(self, claim: resource.ResourceClaim) -> PodView:
-        """Allocate (scheduler role, over REST) + prepare (kubelet
-        role, over the subprocess's UDS gRPC) + CDI apply (runtime
-        role)."""
-        if claim.status.allocation is None:
-            allocate_claim(self.client, claim)
-        resp = self.stub().NodePrepareResources(
+    def prepare_on(self, claim: resource.ResourceClaim,
+                   node: str) -> PodView:
+        """Kubelet role on one node: gRPC prepare + CDI apply."""
+        resp = self.stub(node).NodePrepareResources(
             dra_pb2.NodePrepareResourcesRequest(claims=[dra_pb2.Claim(
                 uid=claim.metadata.uid,
                 namespace=claim.metadata.namespace,
@@ -219,12 +323,33 @@ class OOPBed:
             for cid in dev.cdi_device_ids:
                 if cid not in cdi_ids:
                     cdi_ids.append(cid)
-        view = apply_cdi(self.cdi_root, cdi_ids)
-        view.node = self.node
+        view = apply_cdi(self.plugins[node].cdi_root, cdi_ids)
+        view.node = node
         return view
 
-    def delete_pod(self, claim: resource.ResourceClaim) -> None:
-        resp = self.stub().NodeUnprepareResources(
+    def run_pod(self, claim: resource.ResourceClaim,
+                node: str | None = None) -> PodView:
+        """Allocate (scheduler role, over REST) + prepare + CDI apply
+        on the node the allocation pins (or ``node``)."""
+        if claim.status.allocation is None:
+            allocate_claim(self.client, claim)
+        if node is None:
+            selector = claim.status.allocation.node_selector or {}
+            node = selector.get("kubernetes.io/hostname")
+            if node is None:
+                if len(self.plugins) > 1 and selector:
+                    # a gang-pool label selector matches several
+                    # nodes; silently preparing on the first would
+                    # hand every caller worker-0's view
+                    raise ValueError(
+                        f"allocation selects by label {selector}; pass "
+                        "node= or use prepare_on() per worker")
+                node = self.node
+        return self.prepare_on(claim, node)
+
+    def delete_pod(self, claim: resource.ResourceClaim,
+                   node: str | None = None) -> None:
+        resp = self.stub(node).NodeUnprepareResources(
             dra_pb2.NodeUnprepareResourcesRequest(claims=[dra_pb2.Claim(
                 uid=claim.metadata.uid,
                 namespace=claim.metadata.namespace,
