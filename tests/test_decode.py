@@ -129,3 +129,34 @@ class TestReviewRegressions:
         params, prompt = setup(CFG, t=3)
         out = greedy_generate(params, prompt, CFG, n_tokens=1)
         assert out.shape == (2, 4)
+
+
+def test_tp_sharded_decode_matches_unsharded():
+    """Serving on a mesh: with params sharded on tp, the jitted
+    cache forward runs SPMD (GSPMD propagates shardings through the
+    einsums) and must reproduce the unsharded logits."""
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from k8s_dra_driver_tpu.models import shard_params
+
+    cfg = dataclasses.replace(CFG, n_kv_heads=2)
+    params, tokens = setup(cfg, t=8)
+    want = forward(params, tokens, cfg)
+
+    devs = np.array(jax.devices()[:2]).reshape(1, 1, 1, 2)
+    mesh = Mesh(devs, ("dp", "ep", "sp", "tp"))
+    sharded = shard_params(params, cfg, mesh)
+    cache = init_cache(cfg, tokens.shape[0])
+    # cache stays replicated, params sharded; GSPMD resolves the mix
+    logits, cache = prefill(sharded, tokens[:, :4], cfg, cache)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(want[:, :4]),
+                               atol=1e-4, rtol=1e-4)
+    for i in range(4, 8):
+        step_logits, cache = decode_step(sharded, tokens[:, i:i + 1],
+                                         cfg, cache)
+        np.testing.assert_allclose(
+            np.asarray(step_logits),
+            np.asarray(forward(params, tokens[:, :i + 1], cfg)[:, -1]),
+            atol=1e-4, rtol=1e-4, err_msg=f"step {i}")
