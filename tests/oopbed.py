@@ -170,33 +170,19 @@ class OOPBed:
                     stderr=subprocess.STDOUT,
                     env={**os.environ, "JAX_PLATFORMS": ""})
 
+            self.verbosity = verbosity
             for name, node_topo in topos.items():
                 node_topo = dict(node_topo)
                 node_topo.setdefault("hostname", name)
                 node_dir = self.tmp / name
                 node_dir.mkdir(exist_ok=True)
-                topo_file = node_dir / "topology.json"
-                topo_file.write_text(json.dumps(node_topo))
+                (node_dir / "topology.json").write_text(
+                    json.dumps(node_topo))
                 log_path = node_dir / "plugin.log"
                 log_file = open(log_path, "w")
-                proc = subprocess.Popen(
-                    [sys.executable, "-m", "k8s_dra_driver_tpu.cmd.plugin",
-                     "--node-name", name,
-                     "--plugin-root", str(node_dir / "plugin"),
-                     "--registrar-root", str(node_dir / "registrar"),
-                     "--cdi-root", str(node_dir / "cdi"),
-                     "--fake-topology", str(topo_file),
-                     "--kubeconfig", str(kubeconfig),
-                     "--kube-api-qps", "0", "--kube-api-burst", "1",
-                     "--coordinator-namespace", "tpu-dra-driver",
-                     "--coordinator-image",
-                     "registry.local/tpu-dra-driver:test",
-                     "-v", str(verbosity)],
-                    cwd=REPO, stdout=log_file, stderr=subprocess.STDOUT,
-                    env={**os.environ, "JAX_PLATFORMS": "",
-                         "NODE_NAME": name})
                 self.plugins[name] = _PluginProc(
-                    node=name, proc=proc, plugin_root=node_dir / "plugin",
+                    node=name, proc=self._spawn_plugin(name, log_file),
+                    plugin_root=node_dir / "plugin",
                     cdi_root=node_dir / "cdi", log_path=log_path,
                     log_file=log_file)
             self._await_ready()
@@ -294,6 +280,61 @@ class OOPBed:
         self.client.close()
         self.api.stop()
 
+    def _spawn_plugin(self, name: str, log_file) -> subprocess.Popen:
+        """One argv for first start AND restart, so the two can never
+        drift into differently-configured binaries."""
+        node_dir = self.tmp / name
+        return subprocess.Popen(
+            [sys.executable, "-m", "k8s_dra_driver_tpu.cmd.plugin",
+             "--node-name", name,
+             "--plugin-root", str(node_dir / "plugin"),
+             "--registrar-root", str(node_dir / "registrar"),
+             "--cdi-root", str(node_dir / "cdi"),
+             "--fake-topology", str(node_dir / "topology.json"),
+             "--kubeconfig", str(self.tmp / "kubeconfig.yaml"),
+             "--kube-api-qps", "0", "--kube-api-burst", "1",
+             "--coordinator-namespace", "tpu-dra-driver",
+             "--coordinator-image",
+             "registry.local/tpu-dra-driver:test",
+             "-v", str(self.verbosity)],
+            cwd=REPO, stdout=log_file, stderr=subprocess.STDOUT,
+            env={**os.environ, "JAX_PLATFORMS": "", "NODE_NAME": name})
+
+    def restart_plugin(self, node: str | None = None,
+                       kill: bool = False) -> None:
+        """Stop one plugin subprocess (SIGKILL if ``kill`` — the crash
+        case) and start a fresh one over the same plugin/cdi roots, so
+        checkpoint recovery is exercised across a REAL process exit."""
+        name = node or self.node
+        p = self.plugins[name]
+        if p.proc.poll() is None:
+            (p.proc.kill if kill else p.proc.terminate)()
+            try:
+                p.proc.wait(10)
+            except subprocess.TimeoutExpired:
+                # stuck in its SIGTERM path (e.g. holding the prepare
+                # mutex): escalate rather than leak the process
+                p.proc.kill()
+                p.proc.wait(5)
+        p.log_file.close()
+        p.stub = None
+        if p.socket.exists():        # a SIGKILLed server leaves it
+            p.socket.unlink()
+        p.log_file = open(p.log_path, "a")
+        p.proc = self._spawn_plugin(name, p.log_file)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if p.proc.poll() is not None:
+                raise RuntimeError(
+                    f"restarted plugin {name} exited "
+                    f"rc={p.proc.returncode}:\n"
+                    + p.log_path.read_text()[-2000:])
+            if p.socket.exists():
+                return
+            time.sleep(0.05)
+        raise TimeoutError(f"restarted plugin {name} never came up:\n"
+                           + p.log_path.read_text()[-2000:])
+
     # -- the kubelet role ------------------------------------------------
 
     def stub(self, node: str | None = None) -> DRAPluginStub:
@@ -346,6 +387,15 @@ class OOPBed:
                         "node= or use prepare_on() per worker")
                 node = self.node
         return self.prepare_on(claim, node)
+
+    def teardown_claim(self, claim: resource.ResourceClaim,
+                       node: str | None = None) -> None:
+        """Unprepare AND delete the claim object — module-scoped beds
+        leak allocated claims (and starve later allocations) when a
+        test forgets the second half."""
+        self.delete_pod(claim, node)
+        self.client.delete("ResourceClaim", claim.metadata.namespace,
+                           claim.metadata.name)
 
     def delete_pod(self, claim: resource.ResourceClaim,
                    node: str | None = None) -> None:

@@ -241,3 +241,16 @@ def test_slice_test1_gang(tmp_path):
             assert len(v_chip.visible_chips) == 4
     finally:
         bed.shutdown()
+
+
+def test_tpu_test_serve_decodes_on_claimed_chip(single_host):
+    """Serving demo: the pod's whole-chip claim injects the env the
+    decode workload asserts; the in-pod script's degradation contract
+    (no jax -> env assert only) keeps it runnable everywhere."""
+    r = SpecRunner(single_host, load("tpu-test-serve.yaml"))
+    (pod,) = r.pods
+    v = r.run(pod)
+    assert len(v.visible_chips) == 1
+    args = pod["spec"]["containers"][0]["args"][0]
+    assert "decode_probe" in args          # runs the real serving path
+    assert "TPU_VISIBLE_CHIPS" in args

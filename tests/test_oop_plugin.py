@@ -53,14 +53,14 @@ class TestOutOfProcessPlugin:
         view = bed.run_pod(c)
         assert len(view.visible_chips) == 1
         assert any("/dev/accel" in d for d in view.device_nodes)
-        bed.delete_pod(c)
+        bed.teardown_claim(c)
 
     def test_prepare_is_idempotent_across_calls(self, bed):
         c = bed.create_claim(_claim("oop-idem"))
         v1 = bed.run_pod(c)
         v2 = bed.run_pod(c)      # second kubelet call: same devices
         assert v1.visible_chips == v2.visible_chips
-        bed.delete_pod(c)
+        bed.teardown_claim(c)
 
     def test_coordinated_claim_spawns_ready_coordinator(self, bed):
         c = bed.create_claim(_claim(
@@ -73,7 +73,7 @@ class TestOutOfProcessPlugin:
         deps = bed.client.list("Deployment", namespace="tpu-dra-driver")
         assert deps, "no coordinator Deployment was created over REST"
         assert all(d.ready_replicas >= 1 for d in deps)
-        bed.delete_pod(c)
+        bed.teardown_claim(c)
         # teardown deletes the Deployment through the API server
         assert not bed.client.list("Deployment",
                                    namespace="tpu-dra-driver")
@@ -88,4 +88,31 @@ class TestOutOfProcessPlugin:
                                     cls="tpu-core.google.com"))
         view = bed.run_pod(c)
         assert view.env.get("TPU_VISIBLE_CORES")
-        bed.delete_pod(c)
+        bed.teardown_claim(c)
+
+
+class TestRealProcessRestart:
+    def test_checkpoint_survives_sigkill(self, bed):
+        """Prepare -> SIGKILL the plugin binary -> fresh process over
+        the same roots: the checkpoint must make the second prepare
+        idempotent (same devices) and the unprepare clean — the
+        reference's restart-safety contract (device_state.go:134-158)
+        across a REAL process boundary."""
+        c = bed.create_claim(_claim("oop-crash"))
+        v1 = bed.run_pod(c)
+        bed.restart_plugin(kill=True)
+        v2 = bed.run_pod(c)         # re-prepare after crash: idempotent
+        assert v1.visible_chips == v2.visible_chips
+        bed.teardown_claim(c)
+        # fully unprepared: the chip is allocatable again
+        c2 = bed.create_claim(_claim("oop-after-crash"))
+        assert bed.run_pod(c2).visible_chips
+        bed.teardown_claim(c2)
+
+    def test_graceful_restart_preserves_unprepare(self, bed):
+        """Claim prepared by process #1 can be unprepared by process
+        #2 purely from its checkpoint."""
+        c = bed.create_claim(_claim("oop-handoff"))
+        bed.run_pod(c)
+        bed.restart_plugin()
+        bed.teardown_claim(c)       # process #2 never prepared this
