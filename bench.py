@@ -109,7 +109,55 @@ def bench_driver_path(rounds: int = 20) -> dict:
                                        c.metadata.name)
         finally:
             bed.shutdown()
-    return _summarize(latencies)
+    out = _summarize(latencies)
+    out["gang_4host"] = bench_gang_path(max(rounds // 2, 3))
+    return out
+
+
+def bench_gang_path(rounds: int = 10) -> dict:
+    """BASELINE config 5: 4-host v5e 4x4 pod-slice gang claim.
+
+    p50 from gang-claim creation to ALL FOUR workers prepared (each
+    over its host's real gRPC socket) — claim→Running for a gang pod
+    is gated on the slowest worker, so the whole fan-out is timed.
+    """
+    from k8s_dra_driver_tpu.api import resource
+    from k8s_dra_driver_tpu.discovery import fake_slice_hosts
+    from k8s_dra_driver_tpu.plugin import DeviceState
+
+    from testbed import E2EBed
+
+    DeviceState._sleep = staticmethod(lambda s: None)
+
+    def gang_claim(i):
+        return resource.ResourceClaim(
+            metadata=resource.ObjectMeta(name=f"g-{i}",
+                                         namespace="default"),
+            spec=resource.ResourceClaimSpec(devices=resource.DeviceClaim(
+                requests=[resource.DeviceRequest(
+                    name="slice",
+                    device_class_name="tpu-podslice.google.com",
+                    count=1)])))
+
+    lat: list[float] = []
+    with tempfile.TemporaryDirectory() as tmp:
+        bed = E2EBed(Path(tmp), fake_slice_hosts(4, topology="4x4"))
+        try:
+            workers = sorted(bed.drivers)
+            for i in range(rounds):
+                c = bed.create_claim(gang_claim(i))
+                t0 = time.perf_counter()
+                for node in workers:
+                    bed.run_pod(c, node=node)
+                lat.append((time.perf_counter() - t0) * 1000)
+                for node in workers:
+                    bed.delete_pod(c, node)
+                bed.cluster.delete("ResourceClaim", "default",
+                                   c.metadata.name)
+        finally:
+            bed.shutdown()
+    return {"p50_ms": round(statistics.median(lat), 3),
+            "workers": 4, "samples": len(lat)}
 
 
 def bench_driver_path_oop(rounds: int = 10) -> dict:
