@@ -1,7 +1,7 @@
 """Workload models (proof-of-function for allocated TPUs)."""
 
 from .decode import (KVCache, decode_step, greedy_generate, init_cache,
-                     prefill)
+                     prefill, sample_generate)
 from .transformer import (TransformerConfig, forward, init_params, loss_fn,
                           make_optimizer, make_train_step, param_specs,
                           shard_params)
@@ -9,4 +9,4 @@ from .transformer import (TransformerConfig, forward, init_params, loss_fn,
 __all__ = ["KVCache", "TransformerConfig", "decode_step", "forward",
            "greedy_generate", "init_cache", "init_params", "loss_fn",
            "make_optimizer", "make_train_step", "param_specs", "prefill",
-           "shard_params"]
+           "sample_generate", "shard_params"]

@@ -102,10 +102,19 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg):
 
 
 def forward_with_cache(params: Params, tokens: jax.Array,
-                       cfg: TransformerConfig, cache: KVCache
+                       cfg: TransformerConfig, cache: KVCache,
+                       first_chunk: bool = False
                        ) -> tuple[jax.Array, KVCache]:
     """tokens [B, T] appended at cache.pos -> (logits [B,T,vocab],
-    updated cache).  T=prompt length for prefill, T=1 for decode."""
+    updated cache).  T=prompt length for prefill, T=1 for decode.
+
+    ``first_chunk`` (static): caller guarantees cache.pos == 0, so
+    attention runs causally against just the chunk's own K/V — on TPU
+    through the pallas flash kernel instead of the [T,S] masked-score
+    path, which makes long-prompt prefill flash-fast.  Wrong results
+    if asserted on a non-empty cache (earlier keys would be ignored);
+    only ``prefill``/``greedy_generate`` set it, on fresh caches.
+    """
     b, t = tokens.shape
     if t > cache.k[0].shape[1]:
         raise ValueError(
@@ -124,7 +133,13 @@ def forward_with_cache(params: Params, tokens: jax.Array,
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
         new_k.append(k_cache)
         new_v.append(v_cache)
-        o = _cached_attention(q, k_cache, v_cache, pos, t, cfg)
+        if first_chunk and t > 1:
+            # flash_attention's own default handles interpret-mode
+            # gating (TPU backend -> compiled, else interpreter)
+            from ..ops.flash_attention import flash_attention
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            o = _cached_attention(q, k_cache, v_cache, pos, t, cfg)
         x = x + jnp.einsum("bthk,hkd->btd", o, layer["wo"])
         mlp_in = rms_norm(x, layer["ln2"])
         if cfg.is_moe:
@@ -136,10 +151,23 @@ def forward_with_cache(params: Params, tokens: jax.Array,
     return logits, KVCache(k=new_k, v=new_v, pos=pos + t)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
+@functools.partial(jax.jit, static_argnames=("cfg", "first_chunk"))
+def _prefill_jit(params, tokens, cfg, cache, first_chunk):
+    return forward_with_cache(params, tokens, cfg, cache,
+                              first_chunk=first_chunk)
+
+
 def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             cache: KVCache) -> tuple[jax.Array, KVCache]:
-    return forward_with_cache(params, tokens, cfg, cache)
+    """Append the prompt chunk to the cache.
+
+    On a fresh cache the attention runs through the pallas flash
+    kernel; on a non-empty cache (multi-turn / chunked prefill) it
+    falls back to the full-cache masked path, which is correct at any
+    position.  The choice concretizes ``cache.pos`` — call
+    ``forward_with_cache`` directly if you need this inside jit."""
+    first_chunk = int(jax.device_get(cache.pos)) == 0
+    return _prefill_jit(params, tokens, cfg, cache, first_chunk)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
@@ -151,13 +179,9 @@ def decode_step(params: Params, token: jax.Array, cfg: TransformerConfig,
     return logits[:, 0], cache
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("cfg", "n_tokens", "max_seq"))
-def greedy_generate(params: Params, prompt: jax.Array,
-                    cfg: TransformerConfig, n_tokens: int,
-                    max_seq: int | None = None) -> jax.Array:
-    """prompt [B, Tp] -> [B, Tp + n_tokens] greedy continuation, one
-    compiled scan over decode steps."""
+def _validated_prefill(params, prompt, cfg, n_tokens, max_seq):
+    """Shared generation front half: static bounds checks + flash
+    prefill of a fresh cache."""
     b, tp = prompt.shape
     max_seq = max_seq or cfg.max_seq
     if n_tokens < 1:
@@ -170,7 +194,19 @@ def greedy_generate(params: Params, prompt: jax.Array,
             f"prompt ({tp}) + n_tokens ({n_tokens}) exceeds the "
             f"{max_seq}-slot cache")
     cache = init_cache(cfg, b, max_seq)
-    logits, cache = forward_with_cache(params, prompt, cfg, cache)
+    return forward_with_cache(params, prompt, cfg, cache,
+                              first_chunk=True)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "n_tokens", "max_seq"))
+def greedy_generate(params: Params, prompt: jax.Array,
+                    cfg: TransformerConfig, n_tokens: int,
+                    max_seq: int | None = None) -> jax.Array:
+    """prompt [B, Tp] -> [B, Tp + n_tokens] greedy continuation, one
+    compiled scan over decode steps."""
+    logits, cache = _validated_prefill(params, prompt, cfg, n_tokens,
+                                       max_seq)
     first = jnp.argmax(logits[:, -1], axis=-1).astype(prompt.dtype)
 
     def step(carry, _):
@@ -182,5 +218,44 @@ def greedy_generate(params: Params, prompt: jax.Array,
 
     (_, _), rest = jax.lax.scan(step, (first, cache), None,
                                 length=n_tokens - 1)
+    generated = jnp.concatenate([first[:, None], rest.T], axis=1)
+    return jnp.concatenate([prompt, generated], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n_tokens", "max_seq",
+                                             "top_k"))
+def sample_generate(params: Params, prompt: jax.Array,
+                    cfg: TransformerConfig, n_tokens: int,
+                    key: jax.Array, temperature: float = 1.0,
+                    top_k: int = 0,
+                    max_seq: int | None = None) -> jax.Array:
+    """Temperature/top-k sampling; same one-scan structure as
+    greedy_generate.  ``top_k=0`` samples the full distribution;
+    ``temperature`` scales logits before softmax (smaller -> closer
+    to greedy)."""
+    logits, cache = _validated_prefill(params, prompt, cfg, n_tokens,
+                                       max_seq)
+
+    def pick(logits, key):
+        scaled = logits.astype(jnp.float32) / jnp.maximum(
+            jnp.float32(temperature), 1e-6)
+        if top_k:
+            kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+            scaled = jnp.where(scaled >= kth, scaled, -1e30)
+        return jax.random.categorical(key, scaled, axis=-1)
+
+    key, sub = jax.random.split(key)
+    first = pick(logits[:, -1], sub).astype(prompt.dtype)
+
+    def step(carry, _):
+        token, cache, key = carry
+        logits, cache = forward_with_cache(params, token[:, None], cfg,
+                                           cache)
+        key, sub = jax.random.split(key)
+        nxt = pick(logits[:, 0], sub).astype(token.dtype)
+        return (nxt, cache, key), nxt
+
+    (_, _, _), rest = jax.lax.scan(step, (first, cache, key), None,
+                                   length=n_tokens - 1)
     generated = jnp.concatenate([first[:, None], rest.T], axis=1)
     return jnp.concatenate([prompt, generated], axis=1)

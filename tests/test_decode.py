@@ -160,3 +160,51 @@ def test_tp_sharded_decode_matches_unsharded():
             np.asarray(step_logits),
             np.asarray(forward(params, tokens[:, :i + 1], cfg)[:, -1]),
             atol=1e-4, rtol=1e-4, err_msg=f"step {i}")
+
+
+class TestSampling:
+    def test_top_k_1_equals_greedy(self):
+        params, prompt = setup(CFG, t=4)
+        from k8s_dra_driver_tpu.models.decode import sample_generate
+        greedy = greedy_generate(params, prompt, CFG, n_tokens=5)
+        sampled = sample_generate(params, prompt, CFG, n_tokens=5,
+                                  key=jax.random.PRNGKey(7), top_k=1)
+        np.testing.assert_array_equal(np.asarray(sampled),
+                                      np.asarray(greedy))
+
+    def test_low_temperature_approaches_greedy(self):
+        params, prompt = setup(CFG, t=4)
+        from k8s_dra_driver_tpu.models.decode import sample_generate
+        greedy = greedy_generate(params, prompt, CFG, n_tokens=5)
+        cold = sample_generate(params, prompt, CFG, n_tokens=5,
+                               key=jax.random.PRNGKey(7),
+                               temperature=1e-4)
+        np.testing.assert_array_equal(np.asarray(cold),
+                                      np.asarray(greedy))
+
+    def test_deterministic_per_key_and_in_vocab(self):
+        params, prompt = setup(CFG, t=4)
+        from k8s_dra_driver_tpu.models.decode import sample_generate
+        a = sample_generate(params, prompt, CFG, n_tokens=6,
+                            key=jax.random.PRNGKey(3), top_k=8)
+        b = sample_generate(params, prompt, CFG, n_tokens=6,
+                            key=jax.random.PRNGKey(3), top_k=8)
+        c = sample_generate(params, prompt, CFG, n_tokens=6,
+                            key=jax.random.PRNGKey(4), top_k=8)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+        gen = np.asarray(a)[:, 4:]
+        assert ((gen >= 0) & (gen < CFG.vocab)).all()
+
+
+def test_multi_turn_prefill_is_correct():
+    """prefill on a NON-empty cache (second turn) must attend to the
+    first turn's cached keys — the silently-wrong case review caught
+    when first_chunk was unconditional."""
+    params, tokens = setup(CFG, t=12)
+    cache = init_cache(CFG, 2)
+    _, cache = prefill(params, tokens[:, :6], CFG, cache)
+    logits, cache = prefill(params, tokens[:, 6:], CFG, cache)
+    want = forward(params, tokens, CFG)[:, 6:]
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
+                               atol=1e-4, rtol=1e-4)
