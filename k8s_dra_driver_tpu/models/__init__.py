@@ -1,12 +1,13 @@
 """Workload models (proof-of-function for allocated TPUs)."""
 
+from .checkpoint import TrainCheckpointer
 from .decode import (KVCache, decode_step, greedy_generate, init_cache,
                      prefill, sample_generate)
 from .transformer import (TransformerConfig, forward, init_params, loss_fn,
                           make_optimizer, make_train_step, param_specs,
                           shard_params)
 
-__all__ = ["KVCache", "TransformerConfig", "decode_step", "forward",
+__all__ = ["KVCache", "TrainCheckpointer", "TransformerConfig", "decode_step", "forward",
            "greedy_generate", "init_cache", "init_params", "loss_fn",
            "make_optimizer", "make_train_step", "param_specs", "prefill",
            "sample_generate", "shard_params"]

@@ -282,6 +282,15 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
     def init_state(key):
         params = shard_params(init_params(cfg, key), cfg, mesh)
         opt_state = optimizer.init(params)
+        # Commit every leaf: optax scalars (step count) are born
+        # uncommitted on the default device, which works under jit but
+        # conflicts with mesh-committed params once a checkpoint
+        # restore pins placements — replicate them on the mesh instead.
+        replicated = NamedSharding(mesh, P())
+        opt_state = jax.tree.map(
+            lambda x: x if isinstance(getattr(x, "sharding", None),
+                                      NamedSharding)
+            else jax.device_put(x, replicated), opt_state)
         return params, opt_state
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))

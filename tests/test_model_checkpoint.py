@@ -1,0 +1,102 @@
+"""Workload checkpoint/resume: the preempted-pod story end to end.
+
+Train N steps → checkpoint → "preemption" (fresh state, possibly a
+DIFFERENT mesh layout) → restore → the loss trajectory continues
+exactly as if never interrupted.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import (TransformerConfig, init_params,
+                                       make_train_step, shard_params)
+from k8s_dra_driver_tpu.models.checkpoint import TrainCheckpointer
+from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
+
+CFG = TransformerConfig(vocab=96, d_model=48, n_layers=2, n_heads=4,
+                        d_head=12, d_ff=96, max_seq=32,
+                        dtype=jnp.float32)
+
+
+def tokens(seed=1, batch=4, t=16):
+    return jax.random.randint(jax.random.PRNGKey(seed), (batch, t), 0,
+                              CFG.vocab)
+
+
+def test_resume_continues_exact_trajectory(tmp_path):
+    mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+    step, init_state = make_train_step(CFG, mesh)
+    params, opt = init_state(jax.random.PRNGKey(0))
+    batch = tokens()
+
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    for i in range(3):
+        params, opt, _ = step(params, opt, batch)
+    ckpt.save(3, params, opt)
+    # the uninterrupted trajectory
+    p_ref, o_ref = params, opt
+    ref_losses = []
+    for i in range(2):
+        p_ref, o_ref, loss = step(p_ref, o_ref, batch)
+        ref_losses.append(float(loss))
+
+    # "preemption": fresh process state, restore onto fresh shardings
+    params2, opt2 = init_state(jax.random.PRNGKey(9))   # different init
+    params2, opt2, at = ckpt.restore(params2, opt2)
+    assert at == 3
+    losses = []
+    for i in range(2):
+        params2, opt2, loss = step(params2, opt2, batch)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-6)
+    ckpt.close()
+
+
+def test_restore_onto_different_mesh_layout(tmp_path):
+    """Elastic resume: written at dp=2/sp=2/tp=2, restored at
+    dp=1/sp=4/tp=2 — the allocator handed the job a different slice
+    shape; orbax reshards onto the new targets."""
+    mesh_a = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+    step_a, init_a = make_train_step(CFG, mesh_a)
+    params, opt = init_a(jax.random.PRNGKey(0))
+    params, opt, loss_a = step_a(params, opt, tokens())
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    ckpt.save(1, params, opt)
+
+    mesh_b = make_mesh(MeshSpec(dp=1, ep=1, sp=4, tp=2))
+    step_b, init_b = make_train_step(CFG, mesh_b)
+    params_b, opt_b = init_b(jax.random.PRNGKey(5))
+    params_b, opt_b, at = ckpt.restore(params_b, opt_b)
+    assert at == 1
+    # same math on the new layout: one more step must equal the old
+    # mesh's next step
+    p_ref, o_ref, loss_ref = step_a(params, opt, tokens())
+    p_new, o_new, loss_new = step_b(params_b, opt_b, tokens())
+    np.testing.assert_allclose(float(loss_new), float(loss_ref),
+                               rtol=1e-5)
+    ckpt.close()
+
+
+def test_latest_and_retention(tmp_path):
+    mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+    step, init_state = make_train_step(CFG, mesh)
+    params, opt = init_state(jax.random.PRNGKey(0))
+    ckpt = TrainCheckpointer(tmp_path / "ckpt", keep=2)
+    for s in (1, 2, 3):
+        ckpt.save(s, params, opt)
+    assert ckpt.latest_step() == 3
+    _, _, at = ckpt.restore(params, opt)
+    assert at == 3
+    ckpt.close()
+
+
+def test_missing_checkpoint_raises(tmp_path):
+    mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+    _, init_state = make_train_step(CFG, mesh)
+    params, opt = init_state(jax.random.PRNGKey(0))
+    ckpt = TrainCheckpointer(tmp_path / "empty")
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(params, opt)
+    ckpt.close()
