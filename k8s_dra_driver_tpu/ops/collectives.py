@@ -112,10 +112,12 @@ def allreduce_bandwidth(size_mb: float = 64.0, iters: int = 16,
     }
 
 
-#: generous physical ceiling used to reject too-good-to-be-true
+#: generous physical ceilings used to reject too-good-to-be-true
 #: differentials: no bf16 kernel beats 2x the v5e MXU peak (~197
-#: TFLOPs), so an elapsed time implying more is a measurement artifact.
+#: TFLOPs) and nothing streams HBM faster than ~2.5x its ~820 GB/s,
+#: so an elapsed time implying either is a measurement artifact.
 _PEAK_TFLOPS_CEILING = 400.0
+_PEAK_HBM_GBPS_CEILING = 2000.0
 
 
 def measure_chain(make, arg, iters: int, floor_s: float = 0.0,
@@ -144,7 +146,8 @@ def measure_chain(make, arg, iters: int, floor_s: float = 0.0,
 def _attention_differential(batch, seq, heads, head_dim, iters, dtype,
                             interpret, block_q, block_k,
                             matmuls, make_body,
-                            kv_heads: int | None = None) -> dict:
+                            kv_heads: int | None = None,
+                            window: int | None = None) -> dict:
     """Shared flash-vs-naive harness behind both attention probes.
 
     Identical q/k/v generation, physical-floor computation, chain
@@ -167,6 +170,16 @@ def _attention_differential(batch, seq, heads, head_dim, iters, dtype,
     flops = matmuls * 2 * batch * heads * seq * seq * head_dim * 0.5
     on_accel = jax.devices()[0].platform not in ("cpu",)
     floor_s = flops / (_PEAK_TFLOPS_CEILING * 1e12) if on_accel else 0.0
+    # The naive path additionally materializes the f32 score tensor in
+    # HBM (written + read back), so it has a BANDWIDTH floor far above
+    # its compute floor — without it, a transport glitch once recorded
+    # naive causal attention at 69 us where the score traffic alone
+    # needs >500 us (round-2 lesson, in the flattering-the-naive
+    # direction this time).
+    score_bytes = 2 * batch * heads * seq * seq * 4
+    naive_floor_s = (max(floor_s, score_bytes
+                         / (_PEAK_HBM_GBPS_CEILING * 1e9))
+                     if on_accel else 0.0)
 
     def make_chain(attn):
         body = make_body(attn, k, v)
@@ -181,15 +194,16 @@ def _attention_differential(batch, seq, heads, head_dim, iters, dtype,
 
     flash = functools.partial(flash_attention, causal=True,
                               interpret=interpret, block_q=block_q,
-                              block_k=block_k)
-    naive = functools.partial(attention_reference, causal=True)
+                              block_k=block_k, window=window)
+    naive = functools.partial(attention_reference, causal=True,
+                              window=window)
     t_flash, flash_valid = measure_chain(make_chain(flash), q, iters,
                                          floor_s)
     t_naive, naive_valid = measure_chain(make_chain(naive), q, iters,
-                                         floor_s)
+                                         naive_floor_s)
     return {
         "batch": batch, "seq": seq, "heads": heads, "head_dim": head_dim,
-        "kv_heads": kv_heads or heads,
+        "kv_heads": kv_heads or heads, "window": window,
         "flash_ms": t_flash * 1000, "naive_ms": t_naive * 1000,
         "flash_tflops": flops / t_flash / 1e12,
         "naive_tflops": flops / t_naive / 1e12,
@@ -203,7 +217,8 @@ def attention_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
                     dtype=jnp.bfloat16, interpret: bool | None = None,
                     block_q: int | None = None,
                     block_k: int | None = None,
-                    kv_heads: int | None = None) -> dict:
+                    kv_heads: int | None = None,
+                    window: int | None = None) -> dict:
     """Flash (pallas) vs naive (XLA) causal attention on the device.
 
     The fused-kernel half of the BASELINE workload story: same chained
@@ -222,7 +237,7 @@ def attention_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
     # forward only: 2 matmuls
     return _attention_differential(batch, seq, heads, head_dim, iters,
                                    dtype, interpret, block_q, block_k,
-                                   2, make_body, kv_heads)
+                                   2, make_body, kv_heads, window)
 
 
 def attention_grad_probe(batch: int = 4, seq: int = 2048, heads: int = 8,

@@ -235,15 +235,23 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     return fn(q, k, v)
 
 
-def attention_reference(q, k, v, *, causal=True, scale=None):
+def attention_reference(q, k, v, *, causal=True, scale=None,
+                        window=None):
     """Naive O(T^2) single-device attention, for correctness checks.
 
     Grouped-query attention: k/v may carry fewer heads than q (H a
     multiple of H_kv); the group's heads are broadcast via repeat —
     the semantics the fused kernels implement without materializing.
+    ``window``: sliding-window (local) attention — each query attends
+    to its ``window`` most recent positions (self included); requires
+    ``causal``.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if window is not None and (not causal or window < 1):
+        # same contract as the flash kernels (window=0 would silently
+        # produce a uniform average over all positions here)
+        raise ValueError("window requires causal attention and >= 1")
     _, group = _kv_heads(q.shape[2], k)   # validates divisibility
     if group > 1:
         k = jnp.repeat(k, group, axis=2)
@@ -253,6 +261,8 @@ def attention_reference(q, k, v, *, causal=True, scale=None):
     if causal:
         t = q.shape[1]
         mask = jnp.tril(jnp.ones((t, t), bool))
+        if window is not None:
+            mask &= jnp.triu(jnp.ones((t, t), bool), -(window - 1))
         scores = jnp.where(mask[None, None], scores, _NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p,

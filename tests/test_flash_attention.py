@@ -333,3 +333,71 @@ class TestGroupedQueryAttention:
             assert g.shape == gr.shape, name
             np.testing.assert_allclose(g, gr, atol=2e-4, rtol=2e-4,
                                        err_msg=name)
+
+
+class TestSlidingWindow:
+    """Local attention: each query sees its `window` most recent
+    positions; out-of-window K blocks are skipped entirely, so long
+    contexts cost O(T*W) computed blocks."""
+
+    @pytest.mark.parametrize("t,w", [(128, 16), (128, 64), (100, 32),
+                                     (256, 256)])
+    def test_forward_matches_reference(self, t, w):
+        B, H, D = 1, 2, 32
+        q, k, v = (rand((B, t, H, D), i) for i in range(3))
+        out = flash_attention(q, k, v, causal=True, window=w)
+        ref = attention_reference(q, k, v, causal=True, window=w)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_window_one_is_self_attention_only(self):
+        """W=1: each token attends only to itself -> output == v."""
+        B, T, H, D = 1, 64, 2, 32
+        q, k, v = (rand((B, T, H, D), i) for i in range(3))
+        out = flash_attention(q, k, v, causal=True, window=1,
+                              block_q=16, block_k=128)
+        np.testing.assert_allclose(out, v, atol=2e-5, rtol=2e-5)
+
+    def test_grads_match_reference(self):
+        B, T, H, D, W = 1, 128, 2, 32, 32
+        q, k, v = (rand((B, T, H, D), i) for i in range(3))
+        wgt = rand((B, T, H, D), 9)
+
+        def loss(attn):
+            return lambda q, k, v: jnp.sum(
+                attn(q, k, v, causal=True, window=W) * wgt)
+
+        val, grads = jax.value_and_grad(
+            loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+        val_ref, grads_ref = jax.value_and_grad(
+            loss(attention_reference), argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(val, val_ref, rtol=1e-4)
+        for g, gr, name in zip(grads, grads_ref, "dq dk dv".split()):
+            np.testing.assert_allclose(g, gr, atol=2e-4, rtol=2e-4,
+                                       err_msg=name)
+
+    def test_window_with_gqa(self):
+        B, T, H, h_kv, D = 1, 128, 4, 2, 32
+        q = rand((B, T, H, D), 0)
+        k, v = (rand((B, T, h_kv, D), i) for i in (1, 2))
+        out = flash_attention(q, k, v, causal=True, window=48)
+        ref = attention_reference(q, k, v, causal=True, window=48)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_non_causal_window_rejected(self):
+        q, k, v = (rand((1, 64, 2, 32), i) for i in range(3))
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=8)
+
+
+def test_reference_rejects_degenerate_window():
+    """Reference and kernel must share one window contract: window=0
+    silently produced a uniform average over ALL positions before."""
+    q, k, v = (rand((1, 64, 2, 32), i) for i in range(3))
+    with pytest.raises(ValueError, match="causal"):
+        attention_reference(q, k, v, causal=True, window=0)
+    with pytest.raises(ValueError, match="causal"):
+        attention_reference(q, k, v, causal=False, window=8)
+    with pytest.raises(ValueError, match="causal"):
+        flash_block_grads(q, k, v, q, jnp.zeros((1, 2, 64)),
+                          jnp.zeros((1, 2, 64)), 0, 0, causal=True,
+                          window=0, block_q=16, block_k=128)
