@@ -84,6 +84,9 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg):
     key_pos = jnp.arange(k_cache.shape[1])
     q_pos = pos + jnp.arange(t)
     mask = key_pos[None, :] <= q_pos[:, None]           # [T, S]
+    if cfg.attention_window:
+        mask &= (q_pos[:, None] - key_pos[None, :]) < \
+            cfg.attention_window
     if group == 1:
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
                             preferred_element_type=jnp.float32) * scale
@@ -137,7 +140,8 @@ def forward_with_cache(params: Params, tokens: jax.Array,
             # flash_attention's own default handles interpret-mode
             # gating (TPU backend -> compiled, else interpreter)
             from ..ops.flash_attention import flash_attention
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, k, v, causal=True,
+                                window=cfg.attention_window or None)
         else:
             o = _cached_attention(q, k_cache, v_cache, pos, t, cfg)
         x = x + jnp.einsum("bthk,hkd->btd", o, layer["wo"])

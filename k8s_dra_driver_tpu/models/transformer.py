@@ -61,6 +61,11 @@ class TransformerConfig:
     # count (must divide n_heads). Flows straight into the kernels'
     # native GQA path (ops/flash_attention.py) — no repeated K/V.
     n_kv_heads: int = 0
+    # Sliding-window (local) attention: 0 = full causal; otherwise each
+    # token attends to its `attention_window` most recent positions
+    # (kernels skip out-of-window blocks). Not composable with sp>1
+    # context parallelism yet — validated below.
+    attention_window: int = 0
 
     def __post_init__(self):
         if self.seq_parallel not in ("ring", "ulysses"):
@@ -71,6 +76,8 @@ class TransformerConfig:
             raise ValueError(
                 f"n_heads {self.n_heads} not a multiple of "
                 f"n_kv_heads {self.n_kv_heads}")
+        if self.attention_window < 0:
+            raise ValueError("attention_window must be >= 0")
 
     @property
     def kv_heads(self) -> int:
@@ -192,7 +199,13 @@ def _attention(x, layer, cfg: TransformerConfig, mesh: Mesh | None):
     q = rotary(jnp.einsum("btd,dhk->bthk", x, layer["wq"]), positions)
     k = rotary(jnp.einsum("btd,dhk->bthk", x, layer["wk"]), positions)
     v = jnp.einsum("btd,dhk->bthk", x, layer["wv"])
+    window = cfg.attention_window or None
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
+        if window is not None:
+            raise NotImplementedError(
+                "attention_window with sp>1 context parallelism is "
+                "not supported; shard long local-attention sequences "
+                "on dp/tp instead")
         if cfg.seq_parallel == "ulysses":
             from ..ops.ulysses_attention import ulysses_attention
             o = ulysses_attention(q, k, v, mesh, causal=True)
@@ -203,9 +216,11 @@ def _attention(x, layer, cfg: TransformerConfig, mesh: Mesh | None):
         # gated on the devices the computation actually runs on, not
         # the process-default backend (VERDICT weak #2)
         from ..ops.flash_attention import flash_attention
-        o = flash_attention(q, k, v, causal=True, interpret=False)
+        o = flash_attention(q, k, v, causal=True, interpret=False,
+                            window=window)
     else:
-        o = attention_reference(q, k, v, causal=True).astype(x.dtype)
+        o = attention_reference(q, k, v, causal=True,
+                                window=window).astype(x.dtype)
     return jnp.einsum("bthk,hkd->btd", o, layer["wo"])
 
 

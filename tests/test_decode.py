@@ -208,3 +208,22 @@ def test_multi_turn_prefill_is_correct():
     want = forward(params, tokens, CFG)[:, 6:]
     np.testing.assert_allclose(np.asarray(logits), np.asarray(want),
                                atol=1e-4, rtol=1e-4)
+
+
+def test_windowed_decode_matches_forward():
+    """Sliding-window model: prefill + stepwise decode equals the
+    training forward with the same window."""
+    cfg = dataclasses.replace(CFG, attention_window=6)
+    params, tokens = setup(cfg, t=12)
+    cache = init_cache(cfg, tokens.shape[0])
+    logits, cache = prefill(params, tokens[:, :6], cfg, cache)
+    np.testing.assert_allclose(
+        np.asarray(logits), np.asarray(forward(params, tokens[:, :6], cfg)),
+        atol=1e-4, rtol=1e-4)
+    for i in range(6, 12):
+        step_logits, cache = decode_step(params, tokens[:, i:i + 1],
+                                         cfg, cache)
+        want = forward(params, tokens[:, :i + 1], cfg)[:, -1]
+        np.testing.assert_allclose(np.asarray(step_logits),
+                                   np.asarray(want), atol=1e-4,
+                                   rtol=1e-4, err_msg=f"step {i}")

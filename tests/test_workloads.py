@@ -157,3 +157,28 @@ class TestConfigValidation:
     def test_indivisible_kv_heads_rejected(self):
         with pytest.raises(ValueError, match="n_kv_heads"):
             dataclasses.replace(SMALL, n_kv_heads=3)
+
+
+class TestSlidingWindowModel:
+    def test_forward_uses_window(self):
+        cfg = dataclasses.replace(SMALL, attention_window=8)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, 128)
+        windowed = forward(params, tokens, cfg)
+        full = forward(params, tokens, SMALL)
+        # same params, different masking: outputs must differ beyond
+        # the first `window` positions and agree inside them
+        assert not np.allclose(np.asarray(windowed)[:, -1],
+                               np.asarray(full)[:, -1])
+        np.testing.assert_allclose(np.asarray(windowed)[:, :8],
+                                   np.asarray(full)[:, :8],
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_window_with_sp_rejected(self):
+        cfg = dataclasses.replace(SMALL, attention_window=8)
+        mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
+        with pytest.raises(NotImplementedError, match="sp>1"):
+            forward(shard_params(params, cfg, mesh), tokens, cfg,
+                    mesh=mesh)
