@@ -382,17 +382,29 @@ def bench_tpu_compute() -> dict:
                                     heads=4, kv_heads=2, d_ff=256,
                                     prompt_len=8, n_tokens=8, max_seq=64,
                                     reps=1))])
-    label, res, errs = _retry_probe(
-        [(lbl, lambda kw=kw: decode_probe(**kw))
-         for lbl, kw in decode_shapes])
-    if res is not None:
-        out["decode"] = {"shape": label, **{
-            k: (round(v, 3) if isinstance(v, float) else v)
-            for k, v in res.items()}}
-    else:
-        out["decode"] = {"error": errs[-1] if errs else "no attempts"}
-    if errs:
-        out.setdefault("retries", []).extend(errs)
+    # bf16 baseline, then weight-only int8 (models/quant.py) through
+    # the pallas int8-matmul kernels — decode streams weights, so
+    # ms/token should track the byte halving (~2x); both recorded so
+    # the comparison is an artifact, not a claim.
+    results = {}
+    for int8, key in [(False, "decode"), (True, "decode_int8")]:
+        label, res, errs = _retry_probe(
+            [(lbl, lambda kw=kw, int8=int8: decode_probe(int8=int8, **kw))
+             for lbl, kw in decode_shapes])
+        if res is not None:
+            out[key] = {"shape": label, **{
+                k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in res.items()}}
+            results[key] = (label, res)
+        else:
+            out[key] = {"error": errs[-1] if errs else "no attempts"}
+        if errs:
+            out.setdefault("retries", []).extend(errs)
+    if "decode" in results and "decode_int8" in results:
+        (lbl, bf), (lbl8, i8) = results["decode"], results["decode_int8"]
+        if bf.get("valid") and i8.get("valid") and lbl == lbl8:
+            out["decode_int8"]["speedup_vs_bf16"] = round(
+                bf["ms_per_token"] / i8["ms_per_token"], 2)
     return out
 
 

@@ -3,11 +3,14 @@
 from .checkpoint import TrainCheckpointer
 from .decode import (KVCache, decode_step, greedy_generate, init_cache,
                      prefill, sample_generate)
+from .quant import QTensor, quantize_params, quantized_bytes
 from .transformer import (TransformerConfig, forward, init_params, loss_fn,
                           make_optimizer, make_train_step, param_specs,
                           shard_params)
 
-__all__ = ["KVCache", "TrainCheckpointer", "TransformerConfig", "decode_step", "forward",
+__all__ = ["KVCache", "QTensor", "TrainCheckpointer", "TransformerConfig",
+           "decode_step", "forward",
            "greedy_generate", "init_cache", "init_params", "loss_fn",
            "make_optimizer", "make_train_step", "param_specs", "prefill",
+           "quantize_params", "quantized_bytes",
            "sample_generate", "shard_params"]

@@ -33,6 +33,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from .quant import ein, take_rows
 from .transformer import (Params, TransformerConfig, _dense_mlp, _moe_mlp,
                           rms_norm, rotary)
 
@@ -124,14 +125,14 @@ def forward_with_cache(params: Params, tokens: jax.Array,
             f"{t} tokens cannot fit a {cache.k[0].shape[1]}-slot cache")
     pos = cache.pos
     positions = pos + jnp.arange(t)
-    x = params["embed"][tokens]
+    x = take_rows(params["embed"], tokens, cfg.dtype)
     new_k, new_v = [], []
     for layer, k_cache, v_cache in zip(params["layers"], cache.k,
                                        cache.v):
         h = rms_norm(x, layer["ln1"])
-        q = rotary(jnp.einsum("btd,dhk->bthk", h, layer["wq"]), positions)
-        k = rotary(jnp.einsum("btd,dhk->bthk", h, layer["wk"]), positions)
-        v = jnp.einsum("btd,dhk->bthk", h, layer["wv"])
+        q = rotary(ein("btd,dhk->bthk", h, layer["wq"]), positions)
+        k = rotary(ein("btd,dhk->bthk", h, layer["wk"]), positions)
+        v = ein("btd,dhk->bthk", h, layer["wv"])
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
         new_k.append(k_cache)
@@ -144,14 +145,14 @@ def forward_with_cache(params: Params, tokens: jax.Array,
                                 window=cfg.attention_window or None)
         else:
             o = _cached_attention(q, k_cache, v_cache, pos, t, cfg)
-        x = x + jnp.einsum("bthk,hkd->btd", o, layer["wo"])
+        x = x + ein("bthk,hkd->btd", o, layer["wo"])
         mlp_in = rms_norm(x, layer["ln2"])
         if cfg.is_moe:
             x = x + _moe_mlp(mlp_in, layer, cfg)
         else:
             x = x + _dense_mlp(mlp_in, layer)
     x = rms_norm(x, params["ln_f"])
-    logits = jnp.einsum("btd,dv->btv", x, params["unembed"])
+    logits = ein("btd,dv->btv", x, params["unembed"])
     return logits, KVCache(k=new_k, v=new_v, pos=pos + t)
 
 

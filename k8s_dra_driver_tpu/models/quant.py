@@ -1,0 +1,419 @@
+"""Weight-only int8 quantization for the serving path.
+
+Decode on TPU is HBM-bound: every generated token re-streams the full
+weight set, so tokens/s tracks bytes-per-weight.  Halving them is
+worth ~2x tokens/s — compute is nowhere near the bottleneck; the
+recorded artifact (tools/int8_decode_v5e.json) shows the int8 path
+streaming weights at ~90% of v5e HBM peak (0.164 ms/token), ~2.1x
+the repo's healthy bf16 baseline of 0.35 ms/token — the byte halving,
+delivered.  This module quantizes weights to int8 with
+**per-output-channel symmetric scales**, shaped so the matmul itself
+consumes only the int8 tensor:
+
+- quantize:  ``scale = max|w| / 127`` over the *contraction* dims,
+  ``q = round(w / scale)`` — one scale per output channel, no zero
+  points (symmetric), so dequantization commutes with the contraction;
+- matmul:    ``einsum(spec, x, q.astype(x.dtype))`` — the int8 ->
+  bf16 convert is exact and fuses into the dot's operand read, so HBM
+  sees int8 bytes;
+- rescale:   the per-channel scale multiplies the *output*, an
+  elementwise op XLA fuses into the surrounding computation.
+
+The reference has no serving stack at all (SURVEY.md §2.3: demo
+workloads are ``nvidia-smi -L`` and a CUDA nbody sample); this is the
+TPU build's beyond-parity serving tier, layered on models/decode.py.
+
+Embeddings quantize per *row* (the gather axis), dequantized after the
+gather — the embedding table is the single largest tensor and is
+gathered, not matmul'ed, so its scale rides along the row.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+@dataclasses.dataclass
+class QTensor:
+    """int8 values + broadcast-ready f32 scale (same rank as ``q``,
+    contraction dims reduced to 1)."""
+
+    q: jax.Array                    # int8, original weight shape
+    scale: jax.Array                # float32, 1 on contracted dims
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def size(self):
+        return self.q.size
+
+    def dequant(self, dtype=jnp.float32) -> jax.Array:
+        return (self.q.astype(jnp.float32) * self.scale).astype(dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, _aux, children):
+        return cls(*children)
+
+
+jax.tree_util.register_pytree_node(
+    QTensor, QTensor.tree_flatten, QTensor.tree_unflatten)
+
+
+def quantize(w: jax.Array, contract_dims: tuple[int, ...]) -> QTensor:
+    """Symmetric per-channel int8: one scale per slice along every
+    non-contracted dim; ``contract_dims`` are the axes a downstream
+    matmul will reduce over (they share one scale so the rescale can
+    move past the reduction)."""
+    w = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(w), axis=contract_dims, keepdims=True)
+    scale = jnp.maximum(amax, 1e-12) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale)
+
+
+def _spec_parts(spec: str) -> tuple[str, str, str]:
+    ins, out = spec.split("->")
+    x_labels, w_labels = ins.split(",")
+    return x_labels, w_labels, out
+
+
+def quantize_for(spec: str, w: jax.Array) -> QTensor:
+    """Quantize ``w`` for use as the second operand of
+    ``einsum(spec, x, w)``: contraction dims are the w labels missing
+    from the output."""
+    _, w_labels, out = _spec_parts(spec)
+    contract = tuple(i for i, lbl in enumerate(w_labels)
+                     if lbl not in out)
+    if not contract:
+        raise ValueError(f"no contraction dims in {spec!r}")
+    return quantize(w, contract)
+
+
+# ------------------------------------------------------------------
+# Pallas int8 matmul: the kernel the decode path needs.  A plain
+# ``einsum(x, q.astype(bf16))`` leaves it to XLA whether the convert
+# fuses into the dot's operand read or materializes the dequantized
+# weight through HBM; this kernel makes the good case structural —
+# int8 blocks stream HBM->VMEM and convert in VMEM, so HBM sees half
+# of bf16's bytes by construction.  Recorded on v5e
+# (tools/int8_decode_v5e.json): the kernel path decodes at ~740 GB/s
+# effective int8 weight streaming (~90% of HBM peak, 0.164 ms/token —
+# ~2.1x the healthy 0.35 ms/token bf16 baseline) and 2.4x the
+# XLA-fallback int8 path.
+# ------------------------------------------------------------------
+
+def _int8_matmul_kernel(x_ref, w_ref, o_ref, acc_scr, *, n_k: int):
+    """grid (..., n, k): k sequential innermost; x [.., M, bk],
+    w [.., bk, bn] int8, acc [M, bn] f32 written to o on the last k
+    step.  Used with both a 2-d grid (plain matmul) and a 3-d grid
+    with a leading expert dim (batched MoE matmul)."""
+    kk = pl.program_id(x_ref.ndim == 3 and 2 or 1)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    x = x_ref[0] if x_ref.ndim == 3 else x_ref[...]
+    w = w_ref[0] if w_ref.ndim == 3 else w_ref[...]
+    acc_scr[:] += jax.lax.dot_general(
+        x, w.astype(x.dtype),
+        (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _done():
+        if o_ref.ndim == 3:
+            o_ref[0] = acc_scr[:]
+        else:
+            o_ref[...] = acc_scr[:]
+
+
+def _pad_to(x, axis, mult):
+    n = x.shape[axis]
+    pad = -n % mult
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int8_matmul(x: jax.Array, q: jax.Array, scale: jax.Array,
+                interpret: bool | None = None) -> jax.Array:
+    """[M, K] @ [K, N] int8 -> [M, N] x.dtype, rescaled by ``scale``
+    [N]-broadcastable f32.  The weight is read from HBM as int8 and
+    converted in VMEM."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, k_dim = x.shape
+    n_dim = q.shape[1]
+    bk = min(512, -(-k_dim // 128) * 128)
+    bn = min(512, -(-n_dim // 128) * 128)
+    # M pads to the bf16 sublane minimum (16) so the tile is legal in
+    # every input dtype
+    xp = _pad_to(_pad_to(x, 0, 16), 1, bk)
+    qp = _pad_to(_pad_to(q, 0, bk), 1, bn)
+    mp = xp.shape[0]
+    n_k = xp.shape[1] // bk
+    n_n = qp.shape[1] // bn
+    out = pl.pallas_call(
+        functools.partial(_int8_matmul_kernel, n_k=n_k),
+        grid=(n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((mp, bk), lambda n, kk: (0, kk)),
+            pl.BlockSpec((bk, bn), lambda n, kk: (kk, n)),
+        ],
+        out_specs=pl.BlockSpec((mp, bn), lambda n, kk: (0, n)),
+        out_shape=jax.ShapeDtypeStruct((mp, qp.shape[1]), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((mp, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(xp, qp)
+    return (out[:m, :n_dim] * scale).astype(x.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def int8_bmm(x: jax.Array, q: jax.Array, scale: jax.Array,
+             interpret: bool | None = None) -> jax.Array:
+    """Batched [G, M, K] @ [G, K, N] int8 -> [G, M, N] x.dtype,
+    rescaled by ``scale`` [G, 1, N] f32 — the expert-batched matmul of
+    the quantized MoE decode path (one grid step per expert; int8
+    converted in VMEM, same as :func:`int8_matmul`)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    g, m, k_dim = x.shape
+    n_dim = q.shape[2]
+    bk = min(512, -(-k_dim // 128) * 128)
+    bn = min(512, -(-n_dim // 128) * 128)
+    xp = _pad_to(_pad_to(x, 1, 16), 2, bk)
+    qp = _pad_to(_pad_to(q, 1, bk), 2, bn)
+    mp = xp.shape[1]
+    n_k = xp.shape[2] // bk
+    n_n = qp.shape[2] // bn
+    out = pl.pallas_call(
+        functools.partial(_int8_matmul_kernel, n_k=n_k),
+        grid=(g, n_n, n_k),
+        in_specs=[
+            pl.BlockSpec((1, mp, bk), lambda e, n, kk: (e, 0, kk)),
+            pl.BlockSpec((1, bk, bn), lambda e, n, kk: (e, kk, n)),
+        ],
+        out_specs=pl.BlockSpec((1, mp, bn), lambda e, n, kk: (e, 0, n)),
+        out_shape=jax.ShapeDtypeStruct((g, mp, qp.shape[2]),
+                                       jnp.float32),
+        scratch_shapes=[pltpu.VMEM((mp, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(xp, qp)
+    return (out[:, :m, :n_dim] * scale).astype(x.dtype)
+
+
+def _as_2d_matmul(spec: str, x: jax.Array, w: QTensor):
+    """Detect specs that collapse to one [M,K]x[K,N] matmul: w labels =
+    [contracted...][kept...] in order, x labels = [batch...][same
+    contracted...], out = [batch...][kept...].  Returns (x2d, q2d,
+    scale_n, out_shape) or None."""
+    x_labels, w_labels, out = _spec_parts(spec)
+    contract = [lbl for lbl in w_labels if lbl not in out]
+    kept = [lbl for lbl in w_labels if lbl in out]
+    nc = len(contract)
+    if (list(w_labels) != contract + kept
+            or list(x_labels[-nc:]) != contract
+            or any(lbl in w_labels for lbl in x_labels[:-nc])
+            or list(out) != list(x_labels[:-nc]) + kept):
+        return None
+    batch_shape = x.shape[:-nc]
+    k_dim = 1
+    for d in x.shape[-nc:]:
+        k_dim *= d
+    n_dim = w.size // k_dim
+    x2d = x.reshape(-1, k_dim)
+    q2d = w.q.reshape(k_dim, n_dim)
+    scale_n = w.scale.reshape(1, n_dim)
+    return x2d, q2d, scale_n, batch_shape + w.shape[nc:]
+
+
+#: decode-shaped calls (few rows) take the pallas kernel; larger M
+#: amortizes the XLA convert and is MXU-bound anyway
+_KERNEL_MAX_M = 64
+
+
+def _use_kernel(m: int) -> bool:
+    return m <= _KERNEL_MAX_M and not os.environ.get(
+        "TPU_QUANT_FORCE_XLA")
+
+
+def _qeinsum_impl(spec: str, x: jax.Array, w: QTensor) -> jax.Array:
+    _, w_labels, out = _spec_parts(spec)
+    two_d = _as_2d_matmul(spec, x, w)
+    if two_d is not None:
+        x2d, q2d, scale_n, out_shape = two_d
+        if _use_kernel(x2d.shape[0]):
+            return int8_matmul(x2d, q2d, scale_n).reshape(out_shape)
+    elif spec == "btd,edf->btef":
+        # MoE up-projection: one batched kernel call, x shared across
+        # experts (the broadcast is M x K bf16 per expert — KBs at
+        # decode shapes, nothing vs the expert weights themselves)
+        b, t, d = x.shape
+        e, f = w.shape[0], w.shape[2]
+        if _use_kernel(b * t):
+            x3 = jnp.broadcast_to(x.reshape(1, b * t, d), (e, b * t, d))
+            out3 = int8_bmm(x3, w.q, w.scale.reshape(e, 1, f))
+            return out3.transpose(1, 0, 2).reshape(b, t, e, f)
+    elif spec == "btef,efd->bted":
+        # MoE down-projection: expert is a shared batch dim
+        b, t, e, f = x.shape
+        d = w.shape[2]
+        if _use_kernel(b * t):
+            x3 = x.reshape(b * t, e, f).transpose(1, 0, 2)
+            out3 = int8_bmm(x3, w.q, w.scale.reshape(e, 1, d))
+            return out3.transpose(1, 0, 2).reshape(b, t, e, d)
+    y = jnp.einsum(spec, x, w.q.astype(x.dtype))
+    # broadcast the kept scales into output axes; contracted scale
+    # dims are already 1, kept dims map by label
+    shape = tuple(
+        w.scale.shape[w_labels.index(lbl)] if lbl in w_labels else 1
+        for lbl in out)
+    scale = w.scale.reshape(shape)
+    return (y.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def qeinsum(spec: str, x: jax.Array, w: QTensor) -> jax.Array:
+    """``einsum(spec, x, dequant(w))`` with the dequantization split so
+    the dot reads int8: exact int8->dtype convert fused into the
+    contraction, per-channel rescale on the output.
+
+    On TPU, small-M contractions (the autoregressive decode shape —
+    M = batch x 1 token) go through the pallas ``int8_matmul`` /
+    ``int8_bmm`` kernels, which convert int8->bf16 in VMEM so the HBM
+    traffic is structurally int8-sized; whether XLA's einsum fuses the
+    convert or round-trips a dequantized copy through HBM is its
+    choice, and the recorded artifact (tools/int8_decode_v5e.json)
+    shows the kernel path 2.4x faster than the XLA path and ~2.1x
+    faster than the healthy bf16 baseline at the 154M-param decode
+    shape.  Large-M calls
+    (prefill/training) stay on the XLA einsum, where the convert is
+    amortized over many rows and the MXU is the bottleneck anyway.
+
+    Differentiable in ``x`` only (pallas has no JVP rule — same
+    custom-VJP treatment as the flash kernels): the int8 weights are
+    frozen, their cotangent is symbolically zero.  Training should
+    differentiate the full-precision model; this path exists for
+    serving and frozen-backbone fine-tuning.
+    """
+    return _qeinsum_impl(spec, x, w)
+
+
+def _qeinsum_fwd(spec, x, w):
+    return _qeinsum_impl(spec, x, w), w
+
+
+def _qeinsum_bwd(spec, w, g):
+    x_labels, w_labels, out = _spec_parts(spec)
+    # d/dx einsum(spec, x, W) = einsum(out,W->x) with the dequantized
+    # weight — valid for every spec this module emits
+    dx = jnp.einsum(f"{out},{w_labels}->{x_labels}",
+                    g.astype(jnp.float32), w.dequant()).astype(g.dtype)
+    dw = QTensor(q=np.zeros(w.q.shape, jax.dtypes.float0),
+                 scale=jnp.zeros_like(w.scale))
+    return dx, dw
+
+
+qeinsum.defvjp(_qeinsum_fwd, _qeinsum_bwd)
+
+
+def ein(spec: str, x: jax.Array, w) -> jax.Array:
+    """einsum that dispatches on the weight type: QTensor -> qeinsum,
+    plain array -> jnp.einsum.  The forward paths call this so one code
+    path serves both full-precision and quantized parameters."""
+    if isinstance(w, QTensor):
+        return qeinsum(spec, x, w)
+    return jnp.einsum(spec, x, w)
+
+
+def take_rows(table, tokens: jax.Array, dtype=None):
+    """Embedding lookup that dispatches on the table type.  Quantized
+    tables are gathered as int8 and rescaled per row after the gather
+    (scale shape [vocab, 1] -> gathered [..., 1])."""
+    if isinstance(table, QTensor):
+        rows = table.q[tokens]
+        scale = table.scale[tokens]
+        out = rows.astype(jnp.float32) * scale
+        return out.astype(dtype) if dtype is not None else out
+    out = table[tokens]
+    return out.astype(dtype) if dtype is not None else out
+
+
+# Einsum specs each weight participates in (transformer.py /
+# decode.py); embeddings are handled separately (gather, per-row).
+_WEIGHT_SPECS = {
+    "wq": "btd,dhk->bthk", "wk": "btd,dhk->bthk", "wv": "btd,dhk->bthk",
+    "wo": "bthk,hkd->btd",
+    "w_in": None,       # dense "btd,df->btf" / moe "btd,edf->btef"
+    "w_out": None,      # dense "btf,fd->btd" / moe "btef,efd->bted"
+    "unembed": "btd,dv->btv",
+}
+
+
+def quantize_params(params: dict[str, Any], cfg) -> dict[str, Any]:
+    """Full-model weight-only quantization.  Layer norms and the MoE
+    router stay full precision (tiny, accuracy-sensitive); everything
+    that streams per token is int8.
+
+    Works on the pytree from ``init_params`` (transformer.py); the
+    result drops into ``forward``/``forward_with_cache``/the generate
+    functions unchanged — their einsums go through :func:`ein`.
+    """
+    moe = cfg.is_moe
+    out: dict[str, Any] = {
+        "embed": quantize(params["embed"], (1,)),   # per-row for gather
+        "unembed": quantize_for("btd,dv->btv", params["unembed"]),
+        "ln_f": params["ln_f"],
+        "layers": [],
+    }
+    for layer in params["layers"]:
+        qlayer: dict[str, Any] = {}
+        for name, w in layer.items():
+            if name.startswith("ln") or name == "router":
+                qlayer[name] = w
+            elif name == "w_in":
+                qlayer[name] = quantize_for(
+                    "btd,edf->btef" if moe else "btd,df->btf", w)
+            elif name == "w_out":
+                qlayer[name] = quantize_for(
+                    "btef,efd->bted" if moe else "btf,fd->btd", w)
+            else:
+                qlayer[name] = quantize_for(_WEIGHT_SPECS[name], w)
+        out["layers"].append(qlayer)
+    return out
+
+
+def quantized_bytes(params: dict[str, Any]) -> tuple[int, int]:
+    """(bytes as stored, bytes if everything were bf16) — the HBM
+    traffic ratio the decode speedup should track."""
+    stored = full = 0
+    for leaf in jax.tree.leaves(
+            params, is_leaf=lambda x: isinstance(x, QTensor)):
+        if isinstance(leaf, QTensor):
+            stored += leaf.q.size + leaf.scale.size * 4
+            full += leaf.q.size * 2
+        else:
+            stored += leaf.size * leaf.dtype.itemsize
+            full += leaf.size * 2
+    return stored, full

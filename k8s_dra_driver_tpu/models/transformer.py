@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.ring_attention import attention_reference, ring_attention
 from ..parallel.mesh import BATCH_AXES, mesh_platform
+from .quant import ein, take_rows
 
 Params = dict[str, Any]
 
@@ -193,12 +194,13 @@ def rotary(x, positions):
     return out.reshape(x.shape)
 
 
-def _attention(x, layer, cfg: TransformerConfig, mesh: Mesh | None):
+def _attention(x, layer, cfg: TransformerConfig, mesh: Mesh | None,
+               segment_ids=None):
     b, t, d = x.shape
     positions = jnp.arange(t)
-    q = rotary(jnp.einsum("btd,dhk->bthk", x, layer["wq"]), positions)
-    k = rotary(jnp.einsum("btd,dhk->bthk", x, layer["wk"]), positions)
-    v = jnp.einsum("btd,dhk->bthk", x, layer["wv"])
+    q = rotary(ein("btd,dhk->bthk", x, layer["wq"]), positions)
+    k = rotary(ein("btd,dhk->bthk", x, layer["wk"]), positions)
+    v = ein("btd,dhk->bthk", x, layer["wv"])
     window = cfg.attention_window or None
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
         if window is not None:
@@ -206,6 +208,10 @@ def _attention(x, layer, cfg: TransformerConfig, mesh: Mesh | None):
                 "attention_window with sp>1 context parallelism is "
                 "not supported; shard long local-attention sequences "
                 "on dp/tp instead")
+        if segment_ids is not None:
+            raise NotImplementedError(
+                "segment_ids with sp>1 context parallelism is not "
+                "supported; pack on dp-sharded batches instead")
         if cfg.seq_parallel == "ulysses":
             from ..ops.ulysses_attention import ulysses_attention
             o = ulysses_attention(q, k, v, mesh, causal=True)
@@ -217,16 +223,16 @@ def _attention(x, layer, cfg: TransformerConfig, mesh: Mesh | None):
         # the process-default backend (VERDICT weak #2)
         from ..ops.flash_attention import flash_attention
         o = flash_attention(q, k, v, causal=True, interpret=False,
-                            window=window)
+                            window=window, segment_ids=segment_ids)
     else:
-        o = attention_reference(q, k, v, causal=True,
-                                window=window).astype(x.dtype)
-    return jnp.einsum("bthk,hkd->btd", o, layer["wo"])
+        o = attention_reference(q, k, v, causal=True, window=window,
+                                segment_ids=segment_ids).astype(x.dtype)
+    return ein("bthk,hkd->btd", o, layer["wo"])
 
 
 def _dense_mlp(x, layer):
-    h = jax.nn.gelu(jnp.einsum("btd,df->btf", x, layer["w_in"]))
-    return jnp.einsum("btf,fd->btd", h, layer["w_out"])
+    h = jax.nn.gelu(ein("btd,df->btf", x, layer["w_in"]))
+    return ein("btf,fd->btd", h, layer["w_out"])
 
 
 def _moe_mlp(x, layer, cfg: TransformerConfig):
@@ -239,13 +245,15 @@ def _moe_mlp(x, layer, cfg: TransformerConfig):
         gates = jnp.where(gates >= top, gates, 0.0)
         gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
     gates = gates.astype(x.dtype)
-    h = jax.nn.gelu(jnp.einsum("btd,edf->btef", x, layer["w_in"]))
-    y = jnp.einsum("btef,efd->bted", h, layer["w_out"])
+    h = jax.nn.gelu(ein("btd,edf->btef", x, layer["w_in"]))
+    y = ein("btef,efd->bted", h, layer["w_out"])
     return jnp.einsum("bted,bte->btd", y, gates)
 
 
-def _layer_forward(x, layer, cfg: TransformerConfig, mesh: Mesh | None):
-    x = x + _attention(rms_norm(x, layer["ln1"]), layer, cfg, mesh)
+def _layer_forward(x, layer, cfg: TransformerConfig, mesh: Mesh | None,
+                   segment_ids=None):
+    x = x + _attention(rms_norm(x, layer["ln1"]), layer, cfg, mesh,
+                       segment_ids)
     mlp_in = rms_norm(x, layer["ln2"])
     if cfg.is_moe:
         return x + _moe_mlp(mlp_in, layer, cfg)
@@ -253,30 +261,45 @@ def _layer_forward(x, layer, cfg: TransformerConfig, mesh: Mesh | None):
 
 
 def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
-            mesh: Mesh | None = None) -> jax.Array:
-    """tokens [B, T] int32 -> logits [B, T, vocab]."""
-    x = params["embed"][tokens]
-    layer_fn = functools.partial(_layer_forward, cfg=cfg, mesh=mesh)
+            mesh: Mesh | None = None, segment_ids=None) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab].
+
+    ``segment_ids`` [B, T] int32 packs several documents into one row:
+    attention is masked within segments (ops/flash_attention.py) so
+    short sequences train at full MXU utilization without cross-
+    document contamination.
+    """
+    x = take_rows(params["embed"], tokens, cfg.dtype)
+    layer_fn = functools.partial(_layer_forward, cfg=cfg, mesh=mesh,
+                                 segment_ids=segment_ids)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
     for layer in params["layers"]:
         x = layer_fn(x, layer)
     x = rms_norm(x, params["ln_f"])
-    return jnp.einsum("btd,dv->btv", x, params["unembed"])
+    return ein("btd,dv->btv", x, params["unembed"])
 
 
 def loss_fn(params: Params, tokens: jax.Array,
-            cfg: TransformerConfig, mesh: Mesh | None = None) -> jax.Array:
+            cfg: TransformerConfig, mesh: Mesh | None = None,
+            segment_ids=None) -> jax.Array:
     """Next-token cross-entropy.
 
     The forward pass runs on the full (sp-divisible) sequence; the shift
     happens on logits afterwards so sequence sharding stays uniform.
+    With ``segment_ids``, positions whose next token belongs to a
+    different segment are excluded from the loss (no document predicts
+    its neighbor's first token).
     """
-    logits = forward(params, tokens, cfg, mesh).astype(jnp.float32)
+    logits = forward(params, tokens, cfg, mesh,
+                     segment_ids).astype(jnp.float32)
     logp = jax.nn.log_softmax(logits[:, :-1])
     targets = tokens[:, 1:]
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return -ll.mean()
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    if segment_ids is None:
+        return -ll.mean()
+    keep = (segment_ids[:, 1:] == segment_ids[:, :-1]).astype(ll.dtype)
+    return -(ll * keep).sum() / jnp.maximum(keep.sum(), 1.0)
 
 
 # --------------------------------------------------------------------------
@@ -309,9 +332,13 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
         return params, opt_state
 
     @functools.partial(jax.jit, donate_argnums=(0, 1))
-    def train_step(params, opt_state, tokens):
+    def train_step(params, opt_state, tokens, segment_ids=None):
         tokens = jax.lax.with_sharding_constraint(tokens, batch_spec)
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        if segment_ids is not None:
+            segment_ids = jax.lax.with_sharding_constraint(
+                segment_ids, batch_spec)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg,
+                                                  mesh, segment_ids)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss

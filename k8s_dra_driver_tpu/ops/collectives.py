@@ -304,20 +304,28 @@ def matmul_tflops(dim: int = 4096, iters: int = 400,
 def decode_probe(batch: int = 8, n_layers: int = 8, d_model: int = 1024,
                  heads: int = 16, kv_heads: int = 4, d_ff: int = 4096,
                  prompt_len: int = 128, n_tokens: int = 64,
-                 max_seq: int = 2048, reps: int = 3) -> dict:
+                 max_seq: int = 2048, reps: int = 3,
+                 int8: bool = False) -> dict:
     """Serving-path probe: greedy generation through the static-shape
     KV cache (models/decode.py), timed as ONE compiled lax.scan so
     per-dispatch overhead cannot pollute the per-token number.
     Reports tokens/s and ms/token for a GQA config (kv_heads < heads,
-    the cache layout the decode path exists to exploit).
+    the cache layout the decode path exists to exploit).  ``int8``
+    runs the same generation on weight-only-quantized params
+    (models/quant.py) — decode is HBM-bound, so the per-token time
+    should track the weight-byte halving.
     """
-    from ..models import (TransformerConfig, greedy_generate, init_params)
+    from ..models import (TransformerConfig, greedy_generate, init_params,
+                          quantize_params)
 
     cfg = TransformerConfig(
         vocab=32000, d_model=d_model, n_layers=n_layers, n_heads=heads,
         d_head=d_model // heads, n_kv_heads=kv_heads, d_ff=d_ff,
         max_seq=max_seq, dtype=jnp.bfloat16)
     params = init_params(cfg, jax.random.PRNGKey(0))
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+    if int8:
+        params = quantize_params(params, cfg)
 
     # The standard differential harness (_differential_median): two
     # scan lengths, so the prefill and the fixed per-dispatch cost
@@ -334,12 +342,28 @@ def decode_probe(batch: int = 8, n_layers: int = 8, d_model: int = 1024,
             return greedy_generate(params, p, cfg, n)[-1, -1]
         return run
 
-    per_tok, valid, _ = _differential_median(
-        make(n_tokens), make(short), 0, n_tokens, short, trials=reps)
-    n_params = sum(x.size for x in jax.tree.leaves(params))
+    # Physical floor: every decode step re-streams all non-embedding
+    # weights (the embedding is gathered, not read in full), so a
+    # per-token time implying more than the generous HBM ceiling is a
+    # transport artifact — reject and retry, the same discipline as
+    # measure_chain (a tunnel glitch once recorded the int8 path at
+    # 2.6 TB/s effective).
+    itemsize = 1 if int8 else jnp.dtype(cfg.dtype).itemsize
+    streamed = (n_params - cfg.vocab * d_model) * itemsize
+    on_accel = jax.devices()[0].platform not in ("cpu",)
+    floor_s = (streamed / (_PEAK_HBM_GBPS_CEILING * 1e9)
+               if on_accel else 0.0)
+    per_tok, valid = None, False
+    for _ in range(3):
+        per_tok, valid, _ = _differential_median(
+            make(n_tokens), make(short), 0, n_tokens, short, trials=reps)
+        if valid and per_tok < floor_s:
+            valid = False
+        if valid:
+            break
     return {
         "batch": batch, "layers": n_layers, "d_model": d_model,
-        "heads": heads, "kv_heads": kv_heads,
+        "heads": heads, "kv_heads": kv_heads, "int8": int8,
         "params_m": round(n_params / 1e6, 1),
         "prompt_len": prompt_len, "n_tokens": n_tokens,
         "ms_per_token": per_tok * 1000,

@@ -236,7 +236,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
 
 
 def attention_reference(q, k, v, *, causal=True, scale=None,
-                        window=None):
+                        window=None, segment_ids=None):
     """Naive O(T^2) single-device attention, for correctness checks.
 
     Grouped-query attention: k/v may carry fewer heads than q (H a
@@ -244,7 +244,8 @@ def attention_reference(q, k, v, *, causal=True, scale=None,
     the semantics the fused kernels implement without materializing.
     ``window``: sliding-window (local) attention — each query attends
     to its ``window`` most recent positions (self included); requires
-    ``causal``.
+    ``causal``.  ``segment_ids`` [B, T]: packed-sequence masking,
+    queries attend only within their own segment.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -258,12 +259,16 @@ def attention_reference(q, k, v, *, causal=True, scale=None,
         v = jnp.repeat(v, group, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    t = q.shape[1]
+    mask = jnp.ones((1, t, t), bool)
     if causal:
-        t = q.shape[1]
-        mask = jnp.tril(jnp.ones((t, t), bool))
+        mask &= jnp.tril(jnp.ones((t, t), bool))[None]
         if window is not None:
-            mask &= jnp.triu(jnp.ones((t, t), bool), -(window - 1))
-        scores = jnp.where(mask[None, None], scores, _NEG_INF)
+            mask &= jnp.triu(jnp.ones((t, t), bool), -(window - 1))[None]
+    if segment_ids is not None:
+        mask = mask & (segment_ids[:, :, None] == segment_ids[:, None, :])
+    if causal or segment_ids is not None:
+        scores = jnp.where(mask[:, None], scores, _NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p,
                       v.astype(p.dtype)).astype(q.dtype)

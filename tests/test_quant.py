@@ -1,0 +1,203 @@
+"""Weight-only int8 serving path (models/quant.py).
+
+What must hold for the quantized path to be trustworthy:
+
+- the quantizer's error is bounded by its per-channel step size;
+- ``qeinsum`` equals an einsum against the dequantized weight (the
+  rescale commutes with the contraction — the property the whole
+  scheme rests on);
+- the quantized model is *internally* consistent: prefill + stepwise
+  decode reproduce the quantized training forward exactly, same
+  contract the bf16 path pins in test_decode.py;
+- quantized logits track full-precision logits closely enough that
+  greedy generations rarely diverge (quality, not bit-exactness);
+- the stored bytes actually halve (the HBM win the path exists for).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import (TransformerConfig, forward,
+                                       init_params, quantize_params,
+                                       quantized_bytes)
+from k8s_dra_driver_tpu.models.decode import (decode_step, greedy_generate,
+                                              init_cache, prefill)
+from k8s_dra_driver_tpu.models.quant import (QTensor, qeinsum, quantize,
+                                             quantize_for, take_rows)
+
+CFG = TransformerConfig(vocab=96, d_model=48, n_layers=2, n_heads=4,
+                        d_head=12, d_ff=96, max_seq=32,
+                        dtype=jnp.float32)
+
+
+def test_quantize_error_bounded_by_step():
+    w = jax.random.normal(jax.random.PRNGKey(0), (64, 32), jnp.float32)
+    qt = quantize(w, (0,))
+    err = jnp.abs(qt.dequant() - w)
+    # round-to-nearest: |err| <= scale/2 per element, scale per column
+    assert bool(jnp.all(err <= qt.scale[0] / 2 + 1e-7))
+    assert qt.q.dtype == jnp.int8
+    assert qt.scale.shape == (1, 32)
+
+
+def test_qeinsum_matches_dequantized_einsum():
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 5, 48), jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(2), (48, 4, 12), jnp.float32)
+    qt = quantize_for("btd,dhk->bthk", w)
+    got = qeinsum("btd,dhk->bthk", x, qt)
+    want = jnp.einsum("btd,dhk->bthk", x, qt.dequant())
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_qeinsum_multi_axis_contraction():
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 5, 4, 12),
+                          jnp.float32)
+    w = jax.random.normal(jax.random.PRNGKey(4), (4, 12, 48), jnp.float32)
+    qt = quantize_for("bthk,hkd->btd", w)
+    assert qt.scale.shape == (1, 1, 48)
+    got = qeinsum("bthk,hkd->btd", x, qt)
+    want = jnp.einsum("bthk,hkd->btd", x, qt.dequant())
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_take_rows_per_row_scale():
+    table = jax.random.normal(jax.random.PRNGKey(5), (96, 48), jnp.float32)
+    qt = quantize(table, (1,))
+    tokens = jnp.array([[0, 3, 95], [7, 7, 1]])
+    got = take_rows(qt, tokens, jnp.float32)
+    want = qt.dequant()[tokens]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
+    assert got.shape == (2, 3, 48)
+
+
+@pytest.mark.parametrize("cfg", [
+    CFG,
+    dataclasses.replace(CFG, n_kv_heads=2),
+    dataclasses.replace(CFG, n_experts=4, top_k=2),
+], ids=["dense", "gqa", "moe"])
+def test_quantized_decode_matches_quantized_forward(cfg):
+    """Same prefill/decode-vs-forward parity contract as the bf16
+    path, run entirely on quantized weights — proves the cache path
+    and the training forward consume QTensors identically."""
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quantize_params(params, cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                                cfg.vocab)
+    want = forward(qparams, tokens, cfg)
+
+    cache = init_cache(cfg, 2, cfg.max_seq)
+    logits, cache = prefill(qparams, tokens[:, :8], cfg, cache)
+    np.testing.assert_allclose(np.asarray(logits),
+                               np.asarray(want[:, :8]),
+                               rtol=2e-4, atol=2e-4)
+    for i in range(8, 12):
+        step_logits, cache = decode_step(qparams, tokens[:, i:i + 1],
+                                         cfg, cache)
+        np.testing.assert_allclose(np.asarray(step_logits),
+                                   np.asarray(want[:, i]),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_quantized_logits_track_full_precision():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qparams = quantize_params(params, CFG)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                CFG.vocab)
+    full = forward(params, tokens, CFG)
+    quant = forward(qparams, tokens, CFG)
+    # int8 per-channel keeps relative logit error small; greedy picks
+    # should almost always agree on a random init
+    denom = jnp.maximum(jnp.std(full), 1e-6)
+    rel = jnp.abs(quant - full) / denom
+    assert float(jnp.mean(rel)) < 0.05, float(jnp.mean(rel))
+    agree = jnp.mean((jnp.argmax(quant, -1) ==
+                      jnp.argmax(full, -1)).astype(jnp.float32))
+    assert float(agree) > 0.9, float(agree)
+
+
+def test_quantized_generate_runs_jitted():
+    params = quantize_params(init_params(CFG, jax.random.PRNGKey(0)), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 6), 0,
+                                CFG.vocab)
+    out = greedy_generate(params, prompt, CFG, 5)
+    assert out.shape == (2, 11)
+    assert bool(jnp.all(out[:, :6] == prompt))
+    assert bool(jnp.all((out >= 0) & (out < CFG.vocab)))
+
+
+def test_quantized_bytes_halve():
+    params = init_params(CFG, jax.random.PRNGKey(0))
+    qparams = quantize_params(params, CFG)
+    stored, full = quantized_bytes(qparams)
+    # ln params stay f32, scales add a little; still well under 60%
+    assert stored < 0.6 * full, (stored, full)
+
+
+def test_moe_qeinsum_kernel_matches_xla(monkeypatch):
+    """The MoE specs must hit the batched kernel and agree with the
+    XLA fallback (TPU_QUANT_FORCE_XLA) bit-for-bit-ish.  monkeypatch
+    pins each path explicitly so an inherited env var can't turn this
+    into an XLA-vs-XLA comparison."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 48), jnp.float32)
+    w_in = jax.random.normal(jax.random.PRNGKey(1), (4, 48, 96),
+                             jnp.float32)
+    qt = quantize_for("btd,edf->btef", w_in)
+    h = jax.random.normal(jax.random.PRNGKey(2), (2, 3, 4, 96),
+                          jnp.float32)
+    w_out = jax.random.normal(jax.random.PRNGKey(3), (4, 96, 48),
+                              jnp.float32)
+    qt2 = quantize_for("btef,efd->bted", w_out)
+
+    monkeypatch.delenv("TPU_QUANT_FORCE_XLA", raising=False)
+    got = qeinsum("btd,edf->btef", x, qt)
+    got2 = qeinsum("btef,efd->bted", h, qt2)
+    assert got.shape == (2, 3, 4, 96)
+
+    monkeypatch.setenv("TPU_QUANT_FORCE_XLA", "1")
+    want = qeinsum("btd,edf->btef", x, qt)
+    want2 = qeinsum("btef,efd->bted", h, qt2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(got2), np.asarray(want2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_forward_is_differentiable_in_x():
+    """jax.grad through a quantized forward must work (qeinsum carries
+    a custom VJP: activations get gradients, int8 weights are frozen)
+    — without it the pallas kernel raises the no-JVP-rule error."""
+    from k8s_dra_driver_tpu.models import loss_fn
+    cfg = dataclasses.replace(CFG, n_experts=4, top_k=2)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    qparams = quantize_params(params, cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                cfg.vocab)
+
+    # grad w.r.t. an activation-side input: a soft prompt added to the
+    # embedding is the natural differentiable surface of a frozen
+    # quantized model
+    def loss(delta):
+        x = jax.random.normal(jax.random.PRNGKey(2),
+                              (2, 8, cfg.d_model)) * 0 + delta
+        # run the blocks directly on x + embedding
+        from k8s_dra_driver_tpu.models.quant import take_rows
+        from k8s_dra_driver_tpu.models.transformer import (_layer_forward,
+                                                           rms_norm, ein)
+        h = take_rows(qparams["embed"], tokens, jnp.float32) + x
+        for layer in qparams["layers"]:
+            h = _layer_forward(h, layer, cfg, None)
+        h = rms_norm(h, qparams["ln_f"])
+        logits = ein("btd,dv->btv", h, qparams["unembed"])
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss)(jnp.zeros((2, 8, cfg.d_model)))
+    assert g.shape == (2, 8, cfg.d_model)
+    assert bool(jnp.all(jnp.isfinite(g)))
+    assert float(jnp.max(jnp.abs(g))) > 0
