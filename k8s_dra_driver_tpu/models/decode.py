@@ -38,6 +38,19 @@ from .transformer import (Params, TransformerConfig, _dense_mlp, _moe_mlp,
                           rms_norm, rotary)
 
 
+@functools.lru_cache(maxsize=None)
+def _serving_cfg(cfg: TransformerConfig) -> TransformerConfig:
+    """Serving always runs the exact dense MoE dispatch: the capacity
+    strategy's token-drop bookkeeping is a *training* compute trade
+    whose cumsum restarts every chunk — chunked prefill + stepwise
+    decode would drop different tokens than the training forward.
+    Dense dispatch is drop-free and chunk-invariant (standard eval
+    practice for capacity-trained MoEs)."""
+    if cfg.is_moe and cfg.moe_dispatch != "dense":
+        return dataclasses.replace(cfg, moe_dispatch="dense")
+    return cfg
+
+
 @dataclasses.dataclass
 class KVCache:
     """Per-layer K/V tensors [B, max_seq, H_kv, D] + current length."""
@@ -148,7 +161,7 @@ def forward_with_cache(params: Params, tokens: jax.Array,
         x = x + ein("bthk,hkd->btd", o, layer["wo"])
         mlp_in = rms_norm(x, layer["ln2"])
         if cfg.is_moe:
-            x = x + _moe_mlp(mlp_in, layer, cfg)
+            x = x + _moe_mlp(mlp_in, layer, _serving_cfg(cfg))
         else:
             x = x + _dense_mlp(mlp_in, layer)
     x = rms_norm(x, params["ln_f"])

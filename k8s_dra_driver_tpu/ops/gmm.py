@@ -1,0 +1,270 @@
+"""Grouped matmul (pallas): the sparse-MoE expert compute kernel.
+
+``gmm(x, w, group_sizes)`` multiplies row-group ``e`` of ``x`` by
+expert matrix ``w[e]`` — the megablocks-style "dropless MoE" primitive
+(tokens sorted by expert, each group padded to a block multiple), so
+expert FLOPs scale with the *routed* token count (top_k), not with
+``n_experts`` the way dense dispatch does, and with no ``[B,T,E,C]``
+one-hot dispatch tensors and no dropped tokens.  Recorded v5e
+train-step medians (tools/moe_dispatch_v5e.json, differential-median
+harness): 2.5x dense dispatch at E16/dff4096.  Capacity routing
+measures faster still (4.25x) at that shape but drops over-budget
+tokens; gmm is the fastest *exact* path.
+
+TPU mapping: the row-block -> expert assignment rides in as a
+scalar-prefetch argument (``pltpu.PrefetchScalarGridSpec``), so the
+kernel's weight BlockSpec can DMA the right expert's block before the
+body runs — the pallas_guide.md "Scalar Prefetch" pattern.  Static
+shapes throughout: group sizes are data, but every array shape is a
+function of the static row-capacity bound.
+
+Autodiff via ``jax.custom_vjp`` (pallas has no JVP rule):
+``dx = gmm(dy, w^T)`` reuses the forward kernel with transposed
+experts; ``dw[e] = x_e^T dy_e`` is a second kernel accumulating over
+each expert's (contiguous, sorted) row blocks in VMEM scratch.
+
+The reference has no MoE stack at all (SURVEY.md §2.3); this kernel
+is part of the beyond-parity workload tier, consumed by
+``models/transformer.py``'s ``moe_dispatch="gmm"`` path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _round_up(n: int, k: int) -> int:
+    return -(-n // k) * k
+
+
+def _block_experts(group_sizes: jax.Array, n_blocks: int,
+                   block_m: int) -> jax.Array:
+    """Expert id of each row block ([n_blocks] int32).  Requires every
+    group size to be a multiple of ``block_m`` (the routing layer pads
+    groups), so no block straddles two experts; blocks beyond the last
+    group clamp to the final expert and compute on zero rows."""
+    ends = jnp.cumsum(group_sizes)
+    starts = jnp.arange(n_blocks, dtype=jnp.int32) * block_m
+    eb = jnp.searchsorted(ends, starts, side="right")
+    return jnp.minimum(eb, group_sizes.shape[0] - 1).astype(jnp.int32)
+
+
+def _gmm_whole_kernel(eb_ref, x_ref, w_ref, o_ref):
+    """Weight-stationary mode, grid (m,): the whole expert matrix is
+    one block, so consecutive row blocks of the same (sorted) expert
+    elide the weight DMA — w streams HBM once per expert instead of
+    once per row block (the difference between ~64 MB and ~576 MB of
+    weight traffic at E16/dff4096)."""
+    x = x_ref[...]
+    o_ref[...] = jax.lax.dot_general(
+        x, w_ref[0].astype(x.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _gmm_kernel(eb_ref, x_ref, w_ref, o_ref, acc, *, n_k: int):
+    """Blocked fallback for experts too big for VMEM residency: grid
+    (n, m, k), k sequential innermost (accumulation), m middle so that
+    when n_k == 1 consecutive same-expert row blocks still elide the
+    weight fetch."""
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    x = x_ref[...]
+    acc[:] += jax.lax.dot_general(
+        x, w_ref[0].astype(x.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _done():
+        o_ref[...] = acc[:].astype(o_ref.dtype)
+
+
+def _gmm_dw_kernel(eb_ref, x_ref, dy_ref, o_ref, acc, *, n_m: int):
+    """grid (k, n, m), m sequential innermost.  Rows are sorted by
+    expert, so an expert's m-blocks are consecutive: the accumulator
+    resets on each expert boundary and the (expert, k, n) output block
+    is written on the expert's last m-block — the output block stays
+    VMEM-resident across the consecutive same-index iterations."""
+    i = pl.program_id(2)
+    prev = eb_ref[jnp.maximum(i - 1, 0)]
+    nxt = eb_ref[jnp.minimum(i + 1, n_m - 1)]
+    cur = eb_ref[i]
+
+    @pl.when((i == 0) | (prev != cur))
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+
+    x = x_ref[...]
+    acc[:] += jax.lax.dot_general(
+        x, dy_ref[...].astype(x.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when((i == n_m - 1) | (nxt != cur))
+    def _done():
+        o_ref[0] = acc[:]
+
+
+def _pad_dim(x, axis, mult):
+    pad = -x.shape[axis] % mult
+    if not pad:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_k",
+                                             "block_n", "interpret"))
+def _gmm_impl(x, w, group_sizes, block_m=128, block_k=512, block_n=512,
+              interpret=None):
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, k_dim = x.shape
+    e, _, n_dim = w.shape
+    if m % block_m:
+        raise ValueError(f"rows {m} not a multiple of block_m {block_m}")
+    kp = _round_up(k_dim, 128)
+    np_ = _round_up(n_dim, 128)
+    n_m = m // block_m
+    eb = _block_experts(group_sizes, n_m, block_m)
+    # Weight-stationary when a whole (padded) expert matrix fits a
+    # ~4 MB VMEM block (double-buffered well under the ~16 MB/core
+    # budget); interpret mode has no VMEM, gate on elements so the
+    # hermetic f32 CPU suite exercises the same mode bf16 takes on TPU
+    whole = (kp * np_ * jnp.dtype(w.dtype).itemsize <= 4 * 2 ** 20
+             or (interpret and kp * np_ <= 2 ** 21))
+    if whole:
+        xp = _pad_dim(x, 1, kp)
+        wp = _pad_dim(_pad_dim(w, 1, kp), 2, np_)
+        out = pl.pallas_call(
+            _gmm_whole_kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(n_m,),
+                in_specs=[
+                    pl.BlockSpec((block_m, kp), lambda i, eb: (i, 0)),
+                    pl.BlockSpec((1, kp, np_),
+                                 lambda i, eb: (eb[i], 0, 0)),
+                ],
+                out_specs=pl.BlockSpec((block_m, np_),
+                                       lambda i, eb: (i, 0)),
+            ),
+            out_shape=jax.ShapeDtypeStruct((m, np_), x.dtype),
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)),
+            interpret=interpret,
+        )(eb, xp, wp)
+        return out[:, :n_dim]
+    bk = min(block_k, kp)
+    bn = min(block_n, np_)
+    xp = _pad_dim(x, 1, bk)
+    wp = _pad_dim(_pad_dim(w, 1, bk), 2, bn)
+    n_k, n_n = xp.shape[1] // bk, wp.shape[2] // bn
+    out = pl.pallas_call(
+        functools.partial(_gmm_kernel, n_k=n_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_n, n_m, n_k),
+            in_specs=[
+                pl.BlockSpec((block_m, bk),
+                             lambda j, i, kk, eb: (i, kk)),
+                pl.BlockSpec((1, bk, bn),
+                             lambda j, i, kk, eb: (eb[i], kk, j)),
+            ],
+            out_specs=pl.BlockSpec((block_m, bn),
+                                   lambda j, i, kk, eb: (i, j)),
+            scratch_shapes=[pltpu.VMEM((block_m, bn), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((m, wp.shape[2]), x.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(eb, xp, wp)
+    return out[:, :n_dim]
+
+
+@functools.partial(jax.jit, static_argnames=("block_m", "block_k",
+                                             "block_n", "interpret"))
+def _gmm_dw(x, dy, group_sizes, block_m=128, block_k=1024, block_n=1024,
+            interpret=None):
+    """dw[e] = x_e^T @ dy_e, [E, K, N] f32.  Bigger K/N blocks than
+    the forward: x is re-read once per N block and dy once per K
+    block, so fewer, larger blocks cut the re-read traffic (the 4 MB
+    f32 accumulator still fits VMEM comfortably)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    m, k_dim = x.shape
+    n_dim = dy.shape[1]
+    e = group_sizes.shape[0]
+    if m % block_m:
+        raise ValueError(f"rows {m} not a multiple of block_m {block_m}")
+    bk = min(block_k, _round_up(k_dim, 128))
+    bn = min(block_n, _round_up(n_dim, 128))
+    xp = _pad_dim(x, 1, bk)
+    dyp = _pad_dim(dy, 1, bn)
+    n_m, n_k, n_n = m // block_m, xp.shape[1] // bk, dyp.shape[1] // bn
+    eb = _block_experts(group_sizes, n_m, block_m)
+    dw = pl.pallas_call(
+        functools.partial(_gmm_dw_kernel, n_m=n_m),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(n_k, n_n, n_m),
+            in_specs=[
+                pl.BlockSpec((block_m, bk),
+                             lambda kq, j, i, eb: (i, kq)),
+                pl.BlockSpec((block_m, bn),
+                             lambda kq, j, i, eb: (i, j)),
+            ],
+            out_specs=pl.BlockSpec((1, bk, bn),
+                                   lambda kq, j, i, eb: (eb[i], kq, j)),
+            scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((e, xp.shape[1], dyp.shape[1]),
+                                       jnp.float32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
+        interpret=interpret,
+    )(eb, xp, dyp)
+    # empty experts own no row block: their output block is never
+    # written (uninitialized memory, NaN under the interpreter) —
+    # select, don't multiply: 0 * NaN is still NaN
+    dw = jnp.where((group_sizes > 0)[:, None, None], dw, 0.0)
+    return dw[:, :k_dim, :n_dim]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def gmm(x, w, group_sizes, block_m: int = 128):
+    """Grouped matmul: rows of ``x`` [M, K] are grouped by expert
+    (group ``e`` spans ``group_sizes[:e].sum()`` onward, every group a
+    multiple of ``block_m`` rows — the routing layer's padding
+    invariant), each multiplied by ``w[e]`` [E, K, N] -> [M, N].
+
+    Differentiable in x and w (custom VJP; ``group_sizes`` is data).
+    """
+    return _gmm_impl(x, w, group_sizes, block_m=block_m)
+
+
+def _gmm_fwd(x, w, group_sizes, block_m):
+    return _gmm_impl(x, w, group_sizes, block_m=block_m), \
+        (x, w, group_sizes)
+
+
+def _gmm_bwd(block_m, res, dy):
+    x, w, group_sizes = res
+    dx = _gmm_impl(dy, jnp.swapaxes(w, 1, 2), group_sizes,
+                   block_m=block_m).astype(x.dtype)
+    dw = _gmm_dw(x, dy, group_sizes, block_m=block_m).astype(w.dtype)
+    dgs = np.zeros(group_sizes.shape, jax.dtypes.float0)
+    return dx, dw, dgs
+
+
+gmm.defvjp(_gmm_fwd, _gmm_bwd)

@@ -236,3 +236,98 @@ class TestPackedSequences:
         with pytest.raises(NotImplementedError, match="segment"):
             forward(shard_params(params, cfg, mesh), tokens, cfg,
                     mesh=mesh, segment_ids=seg)
+
+
+class TestCapacityMoE:
+    """GShard-style capacity dispatch (moe_dispatch='capacity'):
+    expert FLOPs scale with top_k, and the math equals dense dispatch
+    exactly whenever no token overflows an expert's budget."""
+
+    def test_ample_capacity_equals_dense(self):
+        cfg_d = dataclasses.replace(SMALL_MOE, dtype=jnp.float32)
+        # capacity_factor = E guarantees cap = T: nothing can drop
+        cfg_c = dataclasses.replace(cfg_d, moe_dispatch="capacity",
+                                    capacity_factor=float(
+                                        cfg_d.n_experts))
+        params = init_params(cfg_d, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg_d.vocab)
+        dense = forward(params, tokens, cfg_d)
+        cap = forward(params, tokens, cfg_c)
+        np.testing.assert_allclose(np.asarray(cap), np.asarray(dense),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_tight_capacity_drops_but_finite(self):
+        cfg = dataclasses.replace(SMALL_MOE, dtype=jnp.float32,
+                                  moe_dispatch="capacity",
+                                  capacity_factor=0.25)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg.vocab)
+        out = forward(params, tokens, cfg)
+        assert bool(jnp.all(jnp.isfinite(out)))
+        dense = forward(params, tokens,
+                        dataclasses.replace(cfg, moe_dispatch="dense"))
+        assert float(jnp.max(jnp.abs(out - dense))) > 0
+
+    def test_sharded_equals_unsharded(self):
+        from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
+        mesh = make_mesh(MeshSpec(dp=1, ep=2, sp=2, tp=2))
+        cfg = dataclasses.replace(SMALL_MOE, dtype=jnp.float32,
+                                  moe_dispatch="capacity")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab)
+        plain = forward(params, tokens, cfg, mesh=None)
+        sharded = forward(shard_params(params, cfg, mesh), tokens, cfg,
+                          mesh=mesh)
+        np.testing.assert_allclose(np.asarray(plain),
+                                   np.asarray(sharded),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_capacity_train_step_reduces_loss(self):
+        from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
+        mesh = make_mesh(MeshSpec(dp=1, ep=2, sp=2, tp=2))
+        cfg = dataclasses.replace(SMALL_MOE, dtype=jnp.float32,
+                                  moe_dispatch="capacity")
+        step, init_state = make_train_step(cfg, mesh)
+        params, opt_state = init_state(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab)
+        losses = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_decode_serves_dense_even_when_capacity_trained(self):
+        """Serving parity: a capacity-trained config decodes through
+        the drop-free dense dispatch, so prefill + stepwise decode
+        stay chunk-invariant (models/decode.py:_serving_cfg)."""
+        from k8s_dra_driver_tpu.models.decode import (decode_step,
+                                                      init_cache,
+                                                      prefill)
+        cfg = dataclasses.replace(SMALL_MOE, dtype=jnp.float32,
+                                  max_seq=32, moe_dispatch="capacity")
+        dense_cfg = dataclasses.replace(cfg, moe_dispatch="dense")
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                                    cfg.vocab)
+        want = forward(params, tokens, dense_cfg)
+        cache = init_cache(cfg, 2, cfg.max_seq)
+        logits, cache = prefill(params, tokens[:, :8], cfg, cache)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(want[:, :8]),
+                                   rtol=2e-4, atol=2e-4)
+        for i in range(8, 12):
+            step_logits, cache = decode_step(params, tokens[:, i:i + 1],
+                                             cfg, cache)
+            np.testing.assert_allclose(np.asarray(step_logits),
+                                       np.asarray(want[:, i]),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_bad_dispatch_rejected(self):
+        with pytest.raises(ValueError, match="moe_dispatch"):
+            dataclasses.replace(SMALL_MOE, moe_dispatch="sorted")
+        with pytest.raises(ValueError, match="capacity_factor"):
+            dataclasses.replace(SMALL_MOE, capacity_factor=0.0)
