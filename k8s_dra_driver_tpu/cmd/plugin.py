@@ -88,6 +88,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default=env_default("HTTP_ENDPOINT", ""),
                    help="host:port for /metrics + /healthz; empty disables "
                         "[env HTTP_ENDPOINT]")
+    p.add_argument("--health-interval", type=float,
+                   default=env_default("HEALTH_INTERVAL", "30"),
+                   help="seconds between chip-health probes (device-node "
+                        "presence + sysfs health attrs); failed chips are "
+                        "unpublished from ResourceSlices; 0 disables "
+                        "[env HEALTH_INTERVAL]")
     p.add_argument("--fake-topology",
                    default=env_default("FAKE_TOPOLOGY", ""),
                    help="path to a fake-host JSON spec; uses the hermetic "
@@ -186,6 +192,15 @@ def run(args: argparse.Namespace, client=None, backend=None,
             pass  # not on the main thread (tests)
 
     driver.start()
+    monitor = None
+    if args.health_interval > 0:
+        from ..plugin.health import HealthMonitor
+        monitor = HealthMonitor(driver, backend,
+                                interval=args.health_interval)
+        monitor.check_once()       # surface boot-time failures at once
+        monitor.start()
+        log.info("health monitor polling every %.0fs",
+                 args.health_interval)
     log.info("driver started: %d allocatable devices, sockets at %s",
              len(state.allocatable), driver.plugin_socket)
     if ready_event is not None:
@@ -194,6 +209,8 @@ def run(args: argparse.Namespace, client=None, backend=None,
         stop.wait()
     finally:
         log.info("shutting down")
+        if monitor:
+            monitor.stop()
         driver.shutdown()
         if endpoint:
             endpoint.stop()

@@ -114,6 +114,11 @@ def fake_slice_hosts(num_hosts: int, topology: str = "4x4",
 class StaticBackend(DiscoveryBackend):
     def __init__(self, topo: HostTopology):
         self._topo = topo
+        # tests flip entries here to simulate chip failures
+        self.unhealthy: dict[int, str] = {}
 
     def enumerate(self) -> HostTopology:
         return self._topo
+
+    def health(self, expected=None) -> dict[int, str]:
+        return dict(self.unhealthy)

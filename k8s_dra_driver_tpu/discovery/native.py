@@ -91,6 +91,14 @@ class NativeBackend(DiscoveryBackend):
             size = rc           # buffer too small: retry at needed size
         raise RuntimeError("tpu_discover: buffer negotiation failed")
 
+    def health(self, expected=None) -> dict[int, str]:
+        """Health is a per-poll sysfs observation regardless of which
+        backend enumerated the chips — reuse the shared probe so
+        ``--discovery native`` nodes get real monitoring instead of the
+        interface's always-healthy default."""
+        from .sysfs import sysfs_health
+        return sysfs_health(self.root, expected)
+
     def enumerate(self) -> HostTopology:
         data = self._call()
         slice_info = None

@@ -75,6 +75,48 @@ def load_env_overlay(root: Path | str,
     return {str(k): str(v) for k, v in overlay.items()}
 
 
+#: sysfs health attribute values treated as serviceable
+_HEALTHY_VALUES = ("", "ok", "alive", "healthy", "good")
+
+
+def sysfs_health(root: Path | str, expected=None) -> dict[int, str]:
+    """Unhealthy chips from observable node state under ``root``.
+
+    A chip is failed when its ``/dev/accel<i>`` node has vanished
+    (driver unbind, PCIe drop), its sysfs ``device/health`` attribute
+    reports a non-ok value (the accel-class convention; absent
+    attribute = no health reporting = healthy), or — given
+    ``expected``, the boot-time enumerated chip indices — its whole
+    ``/sys/class/accel/accel<i>`` entry is gone (surprise removal
+    deletes the class device along with the node, so a live-dir scan
+    alone would report the dead chip healthy).
+
+    Shared by the sysfs and native discovery backends: the native shim
+    enumerates through C, but health is a per-poll sysfs observation
+    either way.
+    """
+    root = Path(root)
+    out: dict[int, str] = {}
+    base = root / "sys/class/accel"
+    present: set[int] = set()
+    if base.is_dir():
+        for d in sorted(base.iterdir()):
+            if not d.name.startswith("accel"):
+                continue
+            idx = int(d.name.removeprefix("accel") or 0)
+            present.add(idx)
+            if not (root / "dev" / d.name).exists():
+                out[idx] = f"device node /dev/{d.name} missing"
+                continue
+            raw = _read(d / "device" / "health")
+            if raw is not None and \
+                    raw.strip().lower() not in _HEALTHY_VALUES:
+                out[idx] = f"sysfs health: {raw.strip()}"
+    for idx in set(expected or ()) - present:
+        out[idx] = f"sysfs entry /sys/class/accel/accel{idx} vanished"
+    return out
+
+
 def parse_bounds(s: str) -> MeshShape:
     """Parse "2,2,1"-style bounds env values."""
     parts = [int(p) for p in s.split(",")]
@@ -165,6 +207,11 @@ class SysfsBackend(DiscoveryBackend):
             if (self.root / rel).is_file():
                 return "/" + rel
         return ""
+
+    # -- health ------------------------------------------------------------
+
+    def health(self, expected=None) -> dict[int, str]:
+        return sysfs_health(self.root, expected)
 
     # -- main entry point --------------------------------------------------
 

@@ -93,3 +93,19 @@ class DiscoveryBackend:
 
     def enumerate(self) -> HostTopology:
         raise NotImplementedError
+
+    def health(self, expected=None) -> dict[int, str]:
+        """Chip index -> failure reason, for UNHEALTHY chips only.
+
+        ``expected`` is the boot-time enumerated index set: chips in
+        it that the backend can no longer observe at all must be
+        reported failed (surprise removal erases the sysfs entry, not
+        just the attributes).  {} means every expected chip is
+        serviceable.  Backends that cannot observe health (static
+        fixtures) inherit this default.  The reference has no health
+        surface at all — an unhealthy GPU stays published until an
+        operator intervenes; here the plugin polls this and
+        republishes ResourceSlices without failed chips
+        (plugin/health.py).
+        """
+        return {}

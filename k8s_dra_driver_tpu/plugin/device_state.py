@@ -80,6 +80,9 @@ class DeviceState:
         self.topology = backend.enumerate()
         self.allocatable = enumerate_host_devices(
             self.topology, kinds=config.device_kinds)
+        # full enumeration, untouched by health filtering
+        self.all_allocatable = dict(self.allocatable)
+        self.unhealthy: dict[int, str] = {}
         self.cdi = CDIHandler(config.cdi_root, config.driver_root)
         self.cdi.create_standard_spec(self.allocatable,
                                       self.topology.libtpu_path)
@@ -160,9 +163,37 @@ class DeviceState:
         # edits into the next prepare (VERDICT weak #8).
         return prepared, extra_edits
 
+    def apply_health(self, unhealthy: dict[int, str]) -> bool:
+        """Filter the allocatable set to chips not in ``unhealthy``
+        (chip index -> reason).  Every device touching a failed chip
+        disappears — the chip itself, its core partitions, and every
+        pre-enumerated slice containing it — so the scheduler cannot
+        place new claims on broken hardware.  Already-prepared claims
+        are untouched (kubelet tears them down on pod deletion as
+        usual).  Returns True when the set changed (caller republishes
+        ResourceSlices).  No reference analog: the reference keeps
+        publishing a failed GPU until an operator intervenes.
+        """
+        with self._lock:
+            if unhealthy == self.unhealthy:
+                return False
+            self.unhealthy = dict(unhealthy)
+            self.allocatable = {
+                name: dev for name, dev in self.all_allocatable.items()
+                if not any(c.index in unhealthy for c in dev.chips)}
+            return True
+
     def _lookup(self, res) -> AllocatableDevice:
         dev = self.allocatable.get(res.device)
         if dev is None:
+            sick = self.all_allocatable.get(res.device)
+            if sick is not None:       # known device, filtered by health
+                reasons = "; ".join(
+                    self.unhealthy[c.index] for c in sick.chips
+                    if c.index in self.unhealthy)
+                raise PrepareError(
+                    f"allocated device {res.device!r} is unhealthy on "
+                    f"node {self.config.node_name}: {reasons}")
             dev = self._synthesize_cluster_device(res.device)
         if dev is None:
             raise PrepareError(

@@ -33,6 +33,10 @@ class DriverMetrics:
             "tpu_dra_published_devices",
             "Number of devices currently published in ResourceSlices",
             registry=self.registry)
+        self.unhealthy_chips = Gauge(
+            "tpu_dra_unhealthy_chips",
+            "Chips currently excluded from publication by the health "
+            "monitor", registry=self.registry)
         self.slice_reconciles = Counter(
             "tpu_dra_resourceslice_reconciles_total",
             "ResourceSlice reconcile operations", ["op"],

@@ -1,0 +1,197 @@
+"""Chip health monitoring (plugin/health.py + discovery health()).
+
+The property under test: a failed chip disappears from everything the
+scheduler can allocate — the chip device, its core partitions, every
+ICI slice containing it — the ResourceSlices are republished without
+them, prepare of an already-allocated device on it fails with the
+reason, and recovery restores the full set.  The reference has no
+analog (a dead GPU stays published until an operator acts); SURVEY.md
+§5 lists failure detection among the aux subsystems to build.
+
+Health is driven through the real sysfs path: the fake host tree is
+mutated the way hardware failures manifest (device node removed,
+``device/health`` attribute written), and the SysfsBackend observes
+it — no test-only backend shims.
+"""
+
+import sys
+import types
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from helpers import make_allocated_claim  # noqa: E402
+
+from k8s_dra_driver_tpu.cluster import FakeCluster, Node  # noqa: E402
+from k8s_dra_driver_tpu.api import resource  # noqa: E402
+from k8s_dra_driver_tpu.discovery import FakeHost  # noqa: E402
+from k8s_dra_driver_tpu.plugin import (DeviceState, DeviceStateConfig,
+                                       Driver)  # noqa: E402
+from k8s_dra_driver_tpu.plugin.device_state import PrepareError  # noqa: E402
+from k8s_dra_driver_tpu.plugin.health import HealthMonitor  # noqa: E402
+
+
+class TestSysfsHealth:
+    def test_all_healthy_by_default(self, tmp_path):
+        backend = FakeHost(num_chips=4).materialize(tmp_path)
+        assert backend.health() == {}
+
+    def test_sysfs_health_attr(self, tmp_path):
+        backend = FakeHost(num_chips=4).materialize(tmp_path)
+        # accel<i>/device symlinks into the PCI dir; writing through
+        # it is exactly where the kernel driver exposes the attribute
+        (tmp_path / "sys/class/accel/accel2/device/health").write_text(
+            "hbm uncorrectable ecc\n")
+        (tmp_path / "sys/class/accel/accel1/device/health").write_text(
+            "ok\n")
+        h = backend.health()
+        assert set(h) == {2}
+        assert "ecc" in h[2]
+
+    def test_missing_device_node(self, tmp_path):
+        backend = FakeHost(num_chips=4).materialize(tmp_path)
+        (tmp_path / "dev/accel3").unlink()
+        h = backend.health()
+        assert set(h) == {3}
+        assert "missing" in h[3]
+
+
+@pytest.fixture()
+def bed(tmp_path):
+    cluster = FakeCluster()
+    cluster.create(Node(metadata=resource.ObjectMeta(name="n1")))
+    root = tmp_path / "host"
+    backend = FakeHost(num_chips=4, hostname="n1").materialize(root)
+    state = DeviceState(backend, cluster, DeviceStateConfig(
+        plugin_root=str(tmp_path / "plugin"),
+        cdi_root=str(tmp_path / "cdi"), node_name="n1"))
+    driver = Driver(state, cluster, plugin_dir=str(tmp_path / "plugin"))
+    driver.start()
+    b = types.SimpleNamespace(cluster=cluster, driver=driver,
+                              state=state, backend=backend, root=root,
+                              monitor=HealthMonitor(driver, backend,
+                                                    interval=0))
+    try:
+        yield b
+    finally:
+        driver.shutdown()
+
+
+def _published_device_names(cluster) -> set[str]:
+    names = set()
+    for sl in cluster.list("ResourceSlice"):
+        for d in sl.devices:
+            names.add(d.name)
+    return names
+
+
+def _fail_chip(root: Path, idx: int, reason: str = "ecc") -> None:
+    (root / f"sys/class/accel/accel{idx}/device/health").write_text(
+        reason + "\n")
+
+
+def _heal_chip(root: Path, idx: int) -> None:
+    (root / f"sys/class/accel/accel{idx}/device/health").unlink()
+
+
+class TestHealthMonitor:
+    def test_failure_unpublishes_chip_cores_and_slices(self, bed):
+        assert bed.monitor.check_once() is False          # steady state
+        before = _published_device_names(bed.cluster)
+        assert "chip-1" in before
+
+        _fail_chip(bed.root, 1, "hbm uncorrectable ecc")
+        assert bed.monitor.check_once() is True
+        after = _published_device_names(bed.cluster)
+        gone = before - after
+        assert "chip-1" in gone
+        assert any(n.startswith("chip-1-core-") for n in gone)
+        # every 2x2 slice on a 4-chip host contains chip 1
+        assert all(not n.startswith("slice-2x2") for n in after)
+        assert "chip-0" in after
+        assert bed.driver.metrics.unhealthy_chips._value.get() == 1.0
+
+    def test_recovery_republishes_everything(self, bed):
+        before = _published_device_names(bed.cluster)
+        _fail_chip(bed.root, 0, "gone")
+        assert bed.monitor.check_once() is True
+        _heal_chip(bed.root, 0)
+        assert bed.monitor.check_once() is True
+        assert _published_device_names(bed.cluster) == before
+        assert bed.driver.metrics.unhealthy_chips._value.get() == 0.0
+
+    def test_prepare_on_unhealthy_device_fails_with_reason(self, bed):
+        _fail_chip(bed.root, 1, "pcie link down")
+        bed.monitor.check_once()
+        claim = make_allocated_claim("c1", [("r0", "chip-1")], pool="n1")
+        with pytest.raises(PrepareError) as err:
+            bed.state.prepare(claim)
+        assert "unhealthy" in str(err.value)
+        assert "pcie link down" in str(err.value)
+
+    def test_healthy_chip_still_prepares_during_failure(self, bed):
+        _fail_chip(bed.root, 1)
+        bed.monitor.check_once()
+        claim = make_allocated_claim("c2", [("r0", "chip-0")], pool="n1")
+        prepared = bed.state.prepare(claim)
+        assert prepared.devices
+
+    def test_unchanged_health_does_not_republish(self, bed):
+        _fail_chip(bed.root, 2)
+        assert bed.monitor.check_once() is True
+        assert bed.monitor.check_once() is False
+
+
+class TestHealthHardening:
+    def test_vanished_sysfs_entry_reported(self, tmp_path):
+        """Surprise removal deletes the whole accel class entry; the
+        boot-time expected set is what catches it."""
+        import shutil
+        backend = FakeHost(num_chips=4).materialize(tmp_path)
+        shutil.rmtree(tmp_path / "sys/class/accel/accel3")
+        (tmp_path / "dev/accel3").unlink()
+        assert backend.health() == {}            # live scan alone: blind
+        h = backend.health(expected={0, 1, 2, 3})
+        assert set(h) == {3}
+        assert "vanished" in h[3]
+
+    def test_monitor_catches_vanished_entry(self, bed):
+        import shutil
+        shutil.rmtree(bed.root / "sys/class/accel/accel2")
+        (bed.root / "dev/accel2").unlink()
+        assert bed.monitor.check_once() is True
+        assert "chip-2" not in _published_device_names(bed.cluster)
+
+    def test_failed_republish_retries_next_tick(self, bed):
+        _fail_chip(bed.root, 1)
+        real = bed.driver.publish_resources
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            raise RuntimeError("api server down")
+
+        bed.driver.publish_resources = flaky
+        assert bed.monitor.check_once() is False    # publish failed
+        assert calls["n"] == 1
+        # local view already narrowed, publish still owed
+        assert "chip-1" not in bed.state.allocatable
+        bed.driver.publish_resources = real
+        # no health change since, but the republish retries and lands
+        assert bed.monitor.check_once() is True
+        assert "chip-1" not in _published_device_names(bed.cluster)
+
+    def test_native_backend_shares_sysfs_health(self, tmp_path):
+        pytest.importorskip("ctypes")
+        from k8s_dra_driver_tpu.discovery.native import (
+            NativeBackend, NativeUnavailableError)
+        FakeHost(num_chips=2).materialize(tmp_path)
+        try:
+            backend = NativeBackend(host_root=str(tmp_path))
+        except NativeUnavailableError:
+            pytest.skip("native shim not buildable here")
+        _fail_chip(tmp_path, 1, "ecc")
+        h = backend.health(expected={0, 1})
+        assert set(h) == {1}
