@@ -382,14 +382,19 @@ def bench_tpu_compute() -> dict:
                                     heads=4, kv_heads=2, d_ff=256,
                                     prompt_len=8, n_tokens=8, max_seq=64,
                                     reps=1))])
-    # bf16 baseline, then weight-only int8 (models/quant.py) through
-    # the pallas int8-matmul kernels — decode streams weights, so
-    # ms/token should track the byte halving (~2x); both recorded so
-    # the comparison is an artifact, not a claim.
+    # bf16 baseline, then weight-only int8 (models/quant.py), then
+    # int8 weights + int8 KV cache (kv_cache_dtype) — decode streams
+    # weights + the full static cache each token, so ms/token should
+    # track the respective byte halvings; all recorded so the
+    # comparison is an artifact, not a claim.
     results = {}
-    for int8, key in [(False, "decode"), (True, "decode_int8")]:
+    for key, kwargs in [("decode", {}),
+                        ("decode_int8", dict(int8=True)),
+                        ("decode_int8_kv8",
+                         dict(int8=True, kv_int8=True))]:
         label, res, errs = _retry_probe(
-            [(lbl, lambda kw=kw, int8=int8: decode_probe(int8=int8, **kw))
+            [(lbl, lambda kw=kw, kwargs=kwargs:
+              decode_probe(**kwargs, **kw))
              for lbl, kw in decode_shapes])
         if res is not None:
             out[key] = {"shape": label, **{
@@ -400,11 +405,13 @@ def bench_tpu_compute() -> dict:
             out[key] = {"error": errs[-1] if errs else "no attempts"}
         if errs:
             out.setdefault("retries", []).extend(errs)
-    if "decode" in results and "decode_int8" in results:
-        (lbl, bf), (lbl8, i8) = results["decode"], results["decode_int8"]
-        if bf.get("valid") and i8.get("valid") and lbl == lbl8:
-            out["decode_int8"]["speedup_vs_bf16"] = round(
-                bf["ms_per_token"] / i8["ms_per_token"], 2)
+    base = results.get("decode")
+    for key in ("decode_int8", "decode_int8_kv8"):
+        if base and key in results:
+            (lbl, bf), (lbl8, i8) = base, results[key]
+            if bf.get("valid") and i8.get("valid") and lbl == lbl8:
+                out[key]["speedup_vs_bf16"] = round(
+                    bf["ms_per_token"] / i8["ms_per_token"], 2)
     return out
 
 

@@ -133,8 +133,12 @@ def build_backend(args: argparse.Namespace):
         spec = json.loads(Path(args.fake_topology).read_text())
         if "worker_hostnames" in spec:
             spec["worker_hostnames"] = tuple(spec["worker_hostnames"])
+        # optional "root": materialize at a caller-known path so tests
+        # can mutate the tree (health files) while the plugin runs
+        root = spec.pop("root", None) or tempfile.mkdtemp(
+            prefix="tpu-fake-")
         host = FakeHost(**spec)
-        return host.materialize(Path(tempfile.mkdtemp(prefix="tpu-fake-")))
+        return host.materialize(Path(root))
     if args.discovery in ("native", "auto"):
         from ..discovery.native import NativeBackend, NativeUnavailableError
         try:

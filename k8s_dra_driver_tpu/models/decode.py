@@ -53,14 +53,23 @@ def _serving_cfg(cfg: TransformerConfig) -> TransformerConfig:
 
 @dataclasses.dataclass
 class KVCache:
-    """Per-layer K/V tensors [B, max_seq, H_kv, D] + current length."""
+    """Per-layer K/V tensors [B, max_seq, H_kv, D] + current length.
+
+    With ``kv_cache_dtype="int8"`` the k/v tensors are int8 and
+    ``k_scale``/``v_scale`` hold one symmetric f32 scale per
+    (batch, position, kv-head) — [B, max_seq, H_kv, 1]; otherwise the
+    scale lists are None and k/v are in the model dtype.
+    """
 
     k: list[jax.Array]
     v: list[jax.Array]
     pos: jax.Array                  # int32 scalar: tokens cached so far
+    k_scale: list[jax.Array] | None = None
+    v_scale: list[jax.Array] | None = None
 
     def tree_flatten(self):
-        return (self.k, self.v, self.pos), None
+        return (self.k, self.v, self.pos, self.k_scale,
+                self.v_scale), None
 
     @classmethod
     def tree_unflatten(cls, _aux, children):
@@ -77,20 +86,51 @@ def init_cache(cfg: TransformerConfig, batch: int,
     shape = (batch, max_seq, cfg.kv_heads, cfg.d_head)
     # distinct arrays for k and v: decode_step donates the cache, and
     # aliased buffers trip "donate the same buffer twice"
+    if cfg.kv_cache_dtype == "int8":
+        sshape = (batch, max_seq, cfg.kv_heads, 1)
+        return KVCache(
+            k=[jnp.zeros(shape, jnp.int8) for _ in range(cfg.n_layers)],
+            v=[jnp.zeros(shape, jnp.int8) for _ in range(cfg.n_layers)],
+            k_scale=[jnp.zeros(sshape, jnp.float32)
+                     for _ in range(cfg.n_layers)],
+            v_scale=[jnp.zeros(sshape, jnp.float32)
+                     for _ in range(cfg.n_layers)],
+            pos=jnp.int32(0))
     return KVCache(
         k=[jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
         v=[jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
         pos=jnp.int32(0))
 
 
-def _cached_attention(q, k_cache, v_cache, pos, t, cfg):
+def _quantize_rows(x):
+    """[B, T, H, D] -> (int8 values, f32 scale [B, T, H, 1]):
+    symmetric per-(token, head) quantization over the head dim."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True),
+                        1e-12) / 127.0
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
+                      k_scale=None, v_scale=None):
     """q [B,T,H,D] at absolute positions pos..pos+T-1 against the full
     static cache [B,S,H_kv,D]; causal trim via position mask.
 
     GQA stays grouped: the query side is reshaped to
     [B,T,H_kv,G,D] and the einsums carry the group axis, so the
     un-repeated cache is read once — the per-step K/V traffic saving
-    is real, not undone by a materialized repeat."""
+    is real, not undone by a materialized repeat.
+
+    With scales (int8 cache), entries are dequantized at read:
+    ``k = k_q * k_scale`` per (batch, position, head) — HBM sees int8
+    bytes, the arithmetic runs dequantized.
+    """
+    if k_scale is not None:
+        k_cache = (k_cache.astype(jnp.float32)
+                   * k_scale).astype(q.dtype)
+        v_cache = (v_cache.astype(jnp.float32)
+                   * v_scale).astype(q.dtype)
     b, _, h, dh = q.shape
     h_kv = k_cache.shape[2]
     group = h // h_kv
@@ -138,26 +178,48 @@ def forward_with_cache(params: Params, tokens: jax.Array,
             f"{t} tokens cannot fit a {cache.k[0].shape[1]}-slot cache")
     pos = cache.pos
     positions = pos + jnp.arange(t)
+    quantized = cache.k_scale is not None
     x = take_rows(params["embed"], tokens, cfg.dtype)
     new_k, new_v = [], []
-    for layer, k_cache, v_cache in zip(params["layers"], cache.k,
-                                       cache.v):
+    new_ks, new_vs = [], []
+    for i, (layer, k_cache, v_cache) in enumerate(
+            zip(params["layers"], cache.k, cache.v)):
         h = rms_norm(x, layer["ln1"])
         q = rotary(ein("btd,dhk->bthk", h, layer["wq"]), positions)
         k = rotary(ein("btd,dhk->bthk", h, layer["wk"]), positions)
         v = ein("btd,dhk->bthk", h, layer["wv"])
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+        ks_cache = vs_cache = None
+        if quantized:
+            kq, ks = _quantize_rows(k)
+            vq, vs = _quantize_rows(v)
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, kq, (0, pos, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, vq, (0, pos, 0, 0))
+            ks_cache = jax.lax.dynamic_update_slice(
+                cache.k_scale[i], ks, (0, pos, 0, 0))
+            vs_cache = jax.lax.dynamic_update_slice(
+                cache.v_scale[i], vs, (0, pos, 0, 0))
+            new_ks.append(ks_cache)
+            new_vs.append(vs_cache)
+        else:
+            k_cache = jax.lax.dynamic_update_slice(
+                k_cache, k, (0, pos, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                v_cache, v, (0, pos, 0, 0))
         new_k.append(k_cache)
         new_v.append(v_cache)
         if first_chunk and t > 1:
             # flash_attention's own default handles interpret-mode
-            # gating (TPU backend -> compiled, else interpreter)
+            # gating (TPU backend -> compiled, else interpreter).
+            # The chunk's own K/V are used unquantized — only *cached*
+            # entries round-trip through int8.
             from ..ops.flash_attention import flash_attention
             o = flash_attention(q, k, v, causal=True,
                                 window=cfg.attention_window or None)
         else:
-            o = _cached_attention(q, k_cache, v_cache, pos, t, cfg)
+            o = _cached_attention(q, k_cache, v_cache, pos, t, cfg,
+                                  ks_cache, vs_cache)
         x = x + ein("bthk,hkd->btd", o, layer["wo"])
         mlp_in = rms_norm(x, layer["ln2"])
         if cfg.is_moe:
@@ -166,7 +228,9 @@ def forward_with_cache(params: Params, tokens: jax.Array,
             x = x + _dense_mlp(mlp_in, layer)
     x = rms_norm(x, params["ln_f"])
     logits = ein("btd,dv->btv", x, params["unembed"])
-    return logits, KVCache(k=new_k, v=new_v, pos=pos + t)
+    return logits, KVCache(k=new_k, v=new_v, pos=pos + t,
+                           k_scale=new_ks if quantized else None,
+                           v_scale=new_vs if quantized else None)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "first_chunk"))

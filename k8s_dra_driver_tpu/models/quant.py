@@ -1,14 +1,15 @@
 """Weight-only int8 quantization for the serving path.
 
 Decode on TPU is HBM-bound: every generated token re-streams the full
-weight set, so tokens/s tracks bytes-per-weight.  Halving them is
-worth ~2x tokens/s — compute is nowhere near the bottleneck; the
-recorded artifact (tools/int8_decode_v5e.json) shows the int8 path
-streaming weights at ~90% of v5e HBM peak (0.164 ms/token), ~2.1x
-the repo's healthy bf16 baseline of 0.35 ms/token — the byte halving,
-delivered.  This module quantizes weights to int8 with
-**per-output-channel symmetric scales**, shaped so the matmul itself
-consumes only the int8 tensor:
+weight set (plus the static KV cache), so tokens/s tracks the byte
+count — compute is nowhere near the bottleneck.  Recorded on v5e
+(tools/int8_decode_v5e.json, best-valid over interleaved rounds,
+physical-floor-checked over weights+cache bytes): int8 decode (the
+default XLA path) is **1.3x** bf16 at 154M params and **3.7x** at
+660M (0.84 vs 3.13 ms/token, ~950 GB/s implied on the int8 working
+set); int8 weights + int8 KV cache reached **2.0x** at 154M.  This
+module quantizes weights to int8 with **per-output-channel symmetric
+scales**, shaped so the matmul itself consumes only the int8 tensor:
 
 - quantize:  ``scale = max|w| / 127`` over the *contraction* dims,
   ``q = round(w / scale)`` — one scale per output channel, no zero
@@ -104,16 +105,16 @@ def quantize_for(spec: str, w: jax.Array) -> QTensor:
 
 
 # ------------------------------------------------------------------
-# Pallas int8 matmul: the kernel the decode path needs.  A plain
+# Pallas int8 matmul: the structural-guarantee path.  A plain
 # ``einsum(x, q.astype(bf16))`` leaves it to XLA whether the convert
 # fuses into the dot's operand read or materializes the dequantized
-# weight through HBM; this kernel makes the good case structural —
+# weight through HBM; these kernels make the good case structural —
 # int8 blocks stream HBM->VMEM and convert in VMEM, so HBM sees half
-# of bf16's bytes by construction.  Recorded on v5e
-# (tools/int8_decode_v5e.json): the kernel path decodes at ~740 GB/s
-# effective int8 weight streaming (~90% of HBM peak, 0.164 ms/token —
-# ~2.1x the healthy 0.35 ms/token bf16 baseline) and 2.4x the
-# XLA-fallback int8 path.
+# of bf16's bytes by construction.  As recorded, XLA *does* fuse and
+# its einsum outruns the kernels at every decode shape
+# (tools/int8_decode_v5e.json), so they are opt-in
+# (``TPU_QUANT_KERNEL=1``) — kept tested and conformance-diffed
+# against the XLA path as insurance against fusion regressions.
 # ------------------------------------------------------------------
 
 def _int8_matmul_kernel(x_ref, w_ref, o_ref, acc_scr, *, n_k: int):
@@ -248,14 +249,21 @@ def _as_2d_matmul(spec: str, x: jax.Array, w: QTensor):
     return x2d, q2d, scale_n, batch_shape + w.shape[nc:]
 
 
-#: decode-shaped calls (few rows) take the pallas kernel; larger M
-#: amortizes the XLA convert and is MXU-bound anyway
+#: decode-shaped calls (few rows) may take the pallas kernel; larger
+#: M amortizes the XLA convert and is MXU-bound anyway
 _KERNEL_MAX_M = 64
 
 
 def _use_kernel(m: int) -> bool:
-    return m <= _KERNEL_MAX_M and not os.environ.get(
-        "TPU_QUANT_FORCE_XLA")
+    """The pallas path is OPT-IN (``TPU_QUANT_KERNEL=1``): interleaved
+    head-to-head measurement (tools/int8_decode_v5e.json) shows XLA's
+    own einsum fuses the int8 convert into the dot and beats the
+    kernel at every recorded decode shape (e.g. 0.84 vs 1.34 ms/token
+    at 660M params).  The kernels stay as the structural guarantee —
+    int8-sized HBM traffic by construction — should a future XLA stop
+    fusing."""
+    return m <= _KERNEL_MAX_M and bool(os.environ.get(
+        "TPU_QUANT_KERNEL"))
 
 
 def _qeinsum_impl(spec: str, x: jax.Array, w: QTensor) -> jax.Array:
@@ -299,17 +307,14 @@ def qeinsum(spec: str, x: jax.Array, w: QTensor) -> jax.Array:
     the dot reads int8: exact int8->dtype convert fused into the
     contraction, per-channel rescale on the output.
 
-    On TPU, small-M contractions (the autoregressive decode shape —
-    M = batch x 1 token) go through the pallas ``int8_matmul`` /
-    ``int8_bmm`` kernels, which convert int8->bf16 in VMEM so the HBM
-    traffic is structurally int8-sized; whether XLA's einsum fuses the
-    convert or round-trips a dequantized copy through HBM is its
-    choice, and the recorded artifact (tools/int8_decode_v5e.json)
-    shows the kernel path 2.4x faster than the XLA path and ~2.1x
-    faster than the healthy bf16 baseline at the 154M-param decode
-    shape.  Large-M calls
-    (prefill/training) stay on the XLA einsum, where the convert is
-    amortized over many rows and the MXU is the bottleneck anyway.
+    The default is the XLA einsum: measured on v5e it fuses the int8
+    convert into the dot and is the fastest int8 path at every
+    recorded decode shape (tools/int8_decode_v5e.json — 1.3x bf16 at
+    154M, 3.7x at 660M params).  ``TPU_QUANT_KERNEL=1`` routes
+    small-M contractions (the autoregressive decode shape) through
+    the pallas ``int8_matmul``/``int8_bmm`` kernels instead, which
+    convert int8->bf16 in VMEM so the traffic is int8-sized by
+    construction rather than by XLA's fusion choice.
 
     Differentiable in ``x`` only (pallas has no JVP rule — same
     custom-VJP treatment as the flash kernels): the int8 weights are

@@ -84,6 +84,23 @@ class TransformerConfig:
     # gmm the fastest *exact* (drop-free) option.
     moe_dispatch: str = "dense"
     capacity_factor: float = 1.25
+    # Router auxiliary losses (training-quality guards; 0 disables):
+    # - aux_loss_weight: Switch-Transformer load-balancing loss
+    #   E * sum_e(token_fraction_e * mean_gate_e) — pushes the router
+    #   toward uniform expert usage so capacity/gmm dispatch neither
+    #   drops nor starves;
+    # - router_z_weight: z-loss mean(logsumexp(router_logits)^2) —
+    #   keeps router logits bounded (bf16-stable softmax).
+    aux_loss_weight: float = 0.0
+    router_z_weight: float = 0.0
+    # Serving KV-cache storage: "model" keeps cache entries in the
+    # model dtype; "int8" stores them quantized with one symmetric
+    # scale per (batch, position, kv-head) — at long contexts the
+    # cache read, not the weights, dominates per-token HBM traffic
+    # (B8/S8192/Hkv4/D64 reads 268 MB of bf16 cache per token vs
+    # 242 MB of weights), so halving it is the same lever int8
+    # weights pull (models/quant.py).
+    kv_cache_dtype: str = "model"
 
     def __post_init__(self):
         if self.seq_parallel not in ("ring", "ulysses"):
@@ -102,6 +119,12 @@ class TransformerConfig:
                 "choose 'dense', 'capacity' or 'gmm'")
         if self.capacity_factor <= 0:
             raise ValueError("capacity_factor must be > 0")
+        if self.aux_loss_weight < 0 or self.router_z_weight < 0:
+            raise ValueError("router aux-loss weights must be >= 0")
+        if self.kv_cache_dtype not in ("model", "int8"):
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r}; "
+                "choose 'model' or 'int8'")
 
     @property
     def kv_heads(self) -> int:
@@ -259,15 +282,37 @@ def _dense_mlp(x, layer):
 
 
 def _router_gates(x, layer, cfg: TransformerConfig):
-    """Softmax router with top-k zeroing + renormalization; f32
-    [B, T, E] gates, zero on unselected experts."""
-    gates = jax.nn.softmax(
-        jnp.einsum("btd,de->bte", x, layer["router"]).astype(jnp.float32))
+    """Softmax router with top-k zeroing + renormalization.
+
+    Returns ``(gates, probs, logits)``, all f32 [B, T, E]: gates are
+    zero on unselected experts; probs are the full pre-top-k softmax
+    (the quantity the load-balance loss needs); logits feed the
+    z-loss."""
+    logits = jnp.einsum("btd,de->bte", x,
+                        layer["router"]).astype(jnp.float32)
+    probs = jax.nn.softmax(logits)
+    gates = probs
     if cfg.top_k < cfg.n_experts:
         top = jax.lax.top_k(gates, cfg.top_k)[0][..., -1:]
         gates = jnp.where(gates >= top, gates, 0.0)
         gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
-    return gates
+    return gates, probs, logits
+
+
+def _moe_aux(gates, probs, logits, cfg: TransformerConfig):
+    """Router auxiliary objectives for one layer, f32 scalars.
+
+    Load balance (Switch Transformer eq. 4, generalized to top-k):
+    ``E * sum_e assignment_fraction_e * mean_prob_e`` — minimized at
+    uniform routing (value 1).  Z-loss: ``mean(logsumexp(logits)^2)``
+    keeps router logits from drifting to magnitudes where bf16
+    softmax saturates."""
+    sel = (gates > 0.0).astype(jnp.float32)
+    frac = sel.mean(axis=(0, 1)) / max(cfg.top_k, 1)      # [E]
+    mean_prob = probs.mean(axis=(0, 1))                   # [E]
+    load = cfg.n_experts * jnp.sum(frac * mean_prob)
+    z = jnp.mean(jax.nn.logsumexp(logits, axis=-1) ** 2)
+    return load, z
 
 
 def _moe_capacity(cfg: TransformerConfig, t: int) -> int:
@@ -275,7 +320,7 @@ def _moe_capacity(cfg: TransformerConfig, t: int) -> int:
     return max(min(cap, t), 1)
 
 
-def _moe_mlp_capacity(x, layer, cfg: TransformerConfig):
+def _moe_mlp_capacity(x, gates, layer, cfg: TransformerConfig):
     """GShard-style capacity dispatch (SPMD-native sparse MoE).
 
     One-hot dispatch/combine tensors route each token to a position
@@ -289,7 +334,6 @@ def _moe_mlp_capacity(x, layer, cfg: TransformerConfig):
     """
     b, t, d = x.shape
     cap = _moe_capacity(cfg, t)
-    gates = _router_gates(x, layer, cfg)                 # [b,t,e] f32
     sel = gates > 0.0
     # position of each token within its expert's budget, in sequence
     # order (deterministic, jit-static shapes)
@@ -307,7 +351,7 @@ def _moe_mlp_capacity(x, layer, cfg: TransformerConfig):
 _GMM_BLOCK_M = 128
 
 
-def _moe_mlp_gmm(x, layer, cfg: TransformerConfig):
+def _moe_mlp_gmm(x, gates, layer, cfg: TransformerConfig):
     """Dropless sparse MoE via the pallas grouped matmul (ops/gmm.py).
 
     Tokens are sorted by routed expert, each expert's rows padded to a
@@ -324,7 +368,6 @@ def _moe_mlp_gmm(x, layer, cfg: TransformerConfig):
     e, k = cfg.n_experts, cfg.top_k
     n = b * t
     bm = _GMM_BLOCK_M
-    gates = _router_gates(x, layer, cfg)                  # [b,t,e] f32
     gate_vals, expert_ids = jax.lax.top_k(gates.reshape(n, e), k)
     flat_e = expert_ids.reshape(-1)                       # [n*k]
     flat_tok = jnp.repeat(jnp.arange(n), k)
@@ -350,15 +393,18 @@ def _moe_mlp_gmm(x, layer, cfg: TransformerConfig):
     return out.reshape(b, t, d).astype(x.dtype)
 
 
-def _moe_mlp(x, layer, cfg: TransformerConfig, mesh: Mesh | None = None):
+def _moe_mlp(x, layer, cfg: TransformerConfig, mesh: Mesh | None = None,
+             with_aux: bool = False):
     """Dense-dispatch MoE: top-k router weights, expert einsum over the
     ep-sharded expert dimension (XLA inserts the ep reduction).  The
     "capacity" strategy routes through the SPMD-friendly one-hot
     dispatch above; "gmm" through the single-device pallas grouped
-    matmul."""
+    matmul.  ``with_aux`` additionally returns the router auxiliary
+    objectives ``(load_balance, z)`` for this layer."""
+    gates, probs, logits = _router_gates(x, layer, cfg)
     if cfg.moe_dispatch == "capacity":
-        return _moe_mlp_capacity(x, layer, cfg)
-    if cfg.moe_dispatch == "gmm":
+        out = _moe_mlp_capacity(x, gates, layer, cfg)
+    elif cfg.moe_dispatch == "gmm":
         if mesh is not None:
             raise NotImplementedError(
                 "moe_dispatch='gmm' is a single-device kernel path; "
@@ -370,41 +416,66 @@ def _moe_mlp(x, layer, cfg: TransformerConfig, mesh: Mesh | None = None):
                 "moe_dispatch='gmm' expects full-precision expert "
                 "weights; quantized serving runs the dense dispatch "
                 "(models/decode.py:_serving_cfg)")
-        return _moe_mlp_gmm(x, layer, cfg)
-    gates = _router_gates(x, layer, cfg).astype(x.dtype)
-    h = jax.nn.gelu(ein("btd,edf->btef", x, layer["w_in"]))
-    y = ein("btef,efd->bted", h, layer["w_out"])
-    return jnp.einsum("bted,bte->btd", y, gates)
+        out = _moe_mlp_gmm(x, gates, layer, cfg)
+    else:
+        g = gates.astype(x.dtype)
+        h = jax.nn.gelu(ein("btd,edf->btef", x, layer["w_in"]))
+        y = ein("btef,efd->bted", h, layer["w_out"])
+        out = jnp.einsum("bted,bte->btd", y, g)
+    if with_aux:
+        return out, _moe_aux(gates, probs, logits, cfg)
+    return out
 
 
 def _layer_forward(x, layer, cfg: TransformerConfig, mesh: Mesh | None,
-                   segment_ids=None):
+                   segment_ids=None, with_aux: bool = False):
     x = x + _attention(rms_norm(x, layer["ln1"]), layer, cfg, mesh,
                        segment_ids)
     mlp_in = rms_norm(x, layer["ln2"])
     if cfg.is_moe:
+        if with_aux:
+            out, aux = _moe_mlp(mlp_in, layer, cfg, mesh, with_aux=True)
+            return x + out, aux
         return x + _moe_mlp(mlp_in, layer, cfg, mesh)
-    return x + _dense_mlp(mlp_in, layer)
+    out = x + _dense_mlp(mlp_in, layer)
+    return (out, (jnp.float32(0.0), jnp.float32(0.0))) if with_aux \
+        else out
 
 
 def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
-            mesh: Mesh | None = None, segment_ids=None) -> jax.Array:
+            mesh: Mesh | None = None, segment_ids=None,
+            return_aux: bool = False):
     """tokens [B, T] int32 -> logits [B, T, vocab].
 
     ``segment_ids`` [B, T] int32 packs several documents into one row:
     attention is masked within segments (ops/flash_attention.py) so
     short sequences train at full MXU utilization without cross-
-    document contamination.
+    document contamination.  ``return_aux`` additionally returns
+    ``{"load_balance": mean-over-layers, "router_z": ...}`` (zeros for
+    dense-MLP configs) — consumed by ``loss_fn`` when the router aux
+    weights are set.
     """
     x = take_rows(params["embed"], tokens, cfg.dtype)
     layer_fn = functools.partial(_layer_forward, cfg=cfg, mesh=mesh,
-                                 segment_ids=segment_ids)
+                                 segment_ids=segment_ids,
+                                 with_aux=return_aux)
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn)
+    load_total = z_total = jnp.float32(0.0)
     for layer in params["layers"]:
-        x = layer_fn(x, layer)
+        if return_aux:
+            x, (load, z) = layer_fn(x, layer)
+            load_total = load_total + load
+            z_total = z_total + z
+        else:
+            x = layer_fn(x, layer)
     x = rms_norm(x, params["ln_f"])
-    return ein("btd,dv->btv", x, params["unembed"])
+    logits = ein("btd,dv->btv", x, params["unembed"])
+    if not return_aux:
+        return logits
+    n = max(len(params["layers"]), 1)
+    return logits, {"load_balance": load_total / n,
+                    "router_z": z_total / n}
 
 
 def loss_fn(params: Params, tokens: jax.Array,
@@ -416,17 +487,31 @@ def loss_fn(params: Params, tokens: jax.Array,
     happens on logits afterwards so sequence sharding stays uniform.
     With ``segment_ids``, positions whose next token belongs to a
     different segment are excluded from the loss (no document predicts
-    its neighbor's first token).
+    its neighbor's first token).  When the config sets
+    ``aux_loss_weight``/``router_z_weight`` on an MoE model, the router
+    auxiliary objectives are added with those weights.
     """
-    logits = forward(params, tokens, cfg, mesh,
-                     segment_ids).astype(jnp.float32)
+    want_aux = cfg.is_moe and (cfg.aux_loss_weight > 0
+                               or cfg.router_z_weight > 0)
+    if want_aux:
+        logits, aux = forward(params, tokens, cfg, mesh, segment_ids,
+                              return_aux=True)
+    else:
+        logits = forward(params, tokens, cfg, mesh, segment_ids)
+    logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits[:, :-1])
     targets = tokens[:, 1:]
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if segment_ids is None:
-        return -ll.mean()
-    keep = (segment_ids[:, 1:] == segment_ids[:, :-1]).astype(ll.dtype)
-    return -(ll * keep).sum() / jnp.maximum(keep.sum(), 1.0)
+        loss = -ll.mean()
+    else:
+        keep = (segment_ids[:, 1:] ==
+                segment_ids[:, :-1]).astype(ll.dtype)
+        loss = -(ll * keep).sum() / jnp.maximum(keep.sum(), 1.0)
+    if want_aux:
+        loss = (loss + cfg.aux_loss_weight * aux["load_balance"]
+                + cfg.router_z_weight * aux["router_z"])
+    return loss
 
 
 # --------------------------------------------------------------------------

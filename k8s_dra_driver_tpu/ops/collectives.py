@@ -301,19 +301,30 @@ def matmul_tflops(dim: int = 4096, iters: int = 400,
             "tflops": 2 * dim ** 3 / elapsed / 1e12}
 
 
+#: decode floor ceiling: unlike the loose attention ceiling, decode's
+#: minimum HBM traffic is known exactly (weights + full static cache
+#: per token), so a measurement implying more than ~1.2x the v5e HBM
+#: peak (~820 GB/s) is an artifact, full stop.  Round-3 lesson: with
+#: a weights-only floor this probe recorded 0.164 ms/token — 1.55
+#: TB/s implied — and the number survived review until the cache
+#: bytes were counted.
+_DECODE_HBM_GBPS_CEILING = 1000.0
+
+
 def decode_probe(batch: int = 8, n_layers: int = 8, d_model: int = 1024,
                  heads: int = 16, kv_heads: int = 4, d_ff: int = 4096,
                  prompt_len: int = 128, n_tokens: int = 64,
                  max_seq: int = 2048, reps: int = 3,
-                 int8: bool = False) -> dict:
+                 int8: bool = False, kv_int8: bool = False) -> dict:
     """Serving-path probe: greedy generation through the static-shape
     KV cache (models/decode.py), timed as ONE compiled lax.scan so
     per-dispatch overhead cannot pollute the per-token number.
     Reports tokens/s and ms/token for a GQA config (kv_heads < heads,
     the cache layout the decode path exists to exploit).  ``int8``
     runs the same generation on weight-only-quantized params
-    (models/quant.py) — decode is HBM-bound, so the per-token time
-    should track the weight-byte halving.
+    (models/quant.py); ``kv_int8`` stores the KV cache int8
+    (kv_cache_dtype) — decode is HBM-bound, so the per-token time
+    should track the respective byte halvings.
     """
     from ..models import (TransformerConfig, greedy_generate, init_params,
                           quantize_params)
@@ -321,7 +332,8 @@ def decode_probe(batch: int = 8, n_layers: int = 8, d_model: int = 1024,
     cfg = TransformerConfig(
         vocab=32000, d_model=d_model, n_layers=n_layers, n_heads=heads,
         d_head=d_model // heads, n_kv_heads=kv_heads, d_ff=d_ff,
-        max_seq=max_seq, dtype=jnp.bfloat16)
+        max_seq=max_seq, dtype=jnp.bfloat16,
+        kv_cache_dtype="int8" if kv_int8 else "model")
     params = init_params(cfg, jax.random.PRNGKey(0))
     n_params = sum(x.size for x in jax.tree.leaves(params))
     if int8:
@@ -343,15 +355,19 @@ def decode_probe(batch: int = 8, n_layers: int = 8, d_model: int = 1024,
         return run
 
     # Physical floor: every decode step re-streams all non-embedding
-    # weights (the embedding is gathered, not read in full), so a
-    # per-token time implying more than the generous HBM ceiling is a
-    # transport artifact — reject and retry, the same discipline as
-    # measure_chain (a tunnel glitch once recorded the int8 path at
-    # 2.6 TB/s effective).
-    itemsize = 1 if int8 else jnp.dtype(cfg.dtype).itemsize
-    streamed = (n_params - cfg.vocab * d_model) * itemsize
+    # weights (the embedding is gathered, not read in full) AND the
+    # full static KV cache (the masked einsum reads every slot), so a
+    # per-token time implying more than ~1.2x HBM peak over those
+    # bytes is a transport artifact — reject and retry.  Counting
+    # ONLY the weights once let a 1.55 TB/s-implied reading through.
+    w_itemsize = 1 if int8 else jnp.dtype(cfg.dtype).itemsize
+    weight_bytes = (n_params - cfg.vocab * d_model) * w_itemsize
+    c_itemsize = 1 if kv_int8 else jnp.dtype(cfg.dtype).itemsize
+    cache_bytes = (2 * batch * max_seq * kv_heads
+                   * (d_model // heads) * c_itemsize * n_layers)
+    streamed = weight_bytes + cache_bytes
     on_accel = jax.devices()[0].platform not in ("cpu",)
-    floor_s = (streamed / (_PEAK_HBM_GBPS_CEILING * 1e9)
+    floor_s = (streamed / (_DECODE_HBM_GBPS_CEILING * 1e9)
                if on_accel else 0.0)
     per_tok, valid = None, False
     for _ in range(3):
@@ -364,9 +380,12 @@ def decode_probe(batch: int = 8, n_layers: int = 8, d_model: int = 1024,
     return {
         "batch": batch, "layers": n_layers, "d_model": d_model,
         "heads": heads, "kv_heads": kv_heads, "int8": int8,
+        "kv_int8": kv_int8,
         "params_m": round(n_params / 1e6, 1),
         "prompt_len": prompt_len, "n_tokens": n_tokens,
         "ms_per_token": per_tok * 1000,
         "tokens_per_s": batch / per_tok,
+        "streamed_mb_per_token": round(streamed / 1e6, 1),
+        "implied_gbps": round(streamed / per_tok / 1e9, 1),
         "valid": valid,
     }

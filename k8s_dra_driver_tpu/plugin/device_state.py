@@ -220,6 +220,17 @@ class DeviceState:
                 KIND_RENDEZVOUS, (), channel_id=channel_id,
                 slice_id=sl.slice_id if sl else "")
         if name == "podslice" and sl is not None:
+            if self.unhealthy:
+                # a gang member with a dead chip would join the slice
+                # with a partial mesh — fail the prepare in-band
+                # instead (the health filter covers pre-enumerated
+                # devices; synthesized gang devices must check too)
+                reasons = "; ".join(
+                    f"chip {i}: {r}"
+                    for i, r in sorted(self.unhealthy.items()))
+                raise PrepareError(
+                    f"podslice gang prepare refused on node "
+                    f"{self.config.node_name}: {reasons}")
             return AllocatableDevice(
                 KIND_PODSLICE, tuple(self.topology.chips),
                 slice_id=sl.slice_id)

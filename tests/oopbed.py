@@ -128,8 +128,10 @@ class OOPBed:
     def __init__(self, tmp_path: Path, topo: dict | None = None,
                  node_name: str = "oop-node", verbosity: int = 1,
                  topos: dict[str, dict] | None = None,
-                 with_controller: bool = False):
+                 with_controller: bool = False,
+                 plugin_env: dict[str, str] | None = None):
         self.tmp = Path(tmp_path)
+        self.plugin_env = dict(plugin_env or {})
         if topos is None:
             topos = {node_name: dict(topo or {"generation": "v5e",
                                               "num_chips": 4})}
@@ -298,7 +300,8 @@ class OOPBed:
              "registry.local/tpu-dra-driver:test",
              "-v", str(self.verbosity)],
             cwd=REPO, stdout=log_file, stderr=subprocess.STDOUT,
-            env={**os.environ, "JAX_PLATFORMS": "", "NODE_NAME": name})
+            env={**os.environ, "JAX_PLATFORMS": "", "NODE_NAME": name,
+                 **self.plugin_env})
 
     def restart_plugin(self, node: str | None = None,
                        kill: bool = False) -> None:

@@ -195,3 +195,39 @@ class TestHealthHardening:
         _fail_chip(tmp_path, 1, "ecc")
         h = backend.health(expected={0, 1})
         assert set(h) == {1}
+
+
+def test_gang_podslice_prepare_refused_on_unhealthy_chip(tmp_path):
+    """A gang member with a dead chip must fail its podslice prepare
+    in-band — a worker joining the slice with a partial local mesh
+    would break the whole gang's SPMD program (the synthesized-device
+    path bypasses the allocatable filter, so it checks explicitly)."""
+    cluster = FakeCluster()
+    cluster.create(Node(metadata=resource.ObjectMeta(name="w0")))
+    root = tmp_path / "host"
+    backend = FakeHost(
+        num_chips=4, hostname="w0", slice_id="slice-a", topology="4x4",
+        worker_id=0,
+        worker_hostnames=("w0", "w1", "w2", "w3")).materialize(root)
+    state = DeviceState(backend, cluster, DeviceStateConfig(
+        plugin_root=str(tmp_path / "plugin"),
+        cdi_root=str(tmp_path / "cdi"), node_name="w0"))
+    driver = Driver(state, cluster, plugin_dir=str(tmp_path / "plugin"))
+    driver.start()
+    try:
+        monitor = HealthMonitor(driver, backend, interval=0)
+        _fail_chip(root, 3, "hbm ecc")
+        monitor.check_once()
+        claim = make_allocated_claim(
+            "gang", [("r0", "podslice")], pool="slice-a")
+        with pytest.raises(PrepareError) as err:
+            state.prepare(claim)
+        assert "podslice" in str(err.value)
+        assert "chip 3" in str(err.value)
+        # recovery clears the refusal
+        _heal_chip(root, 3)
+        monitor.check_once()
+        prepared = state.prepare(claim)
+        assert prepared.devices
+    finally:
+        driver.shutdown()

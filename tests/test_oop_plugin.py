@@ -116,3 +116,48 @@ class TestRealProcessRestart:
         bed.run_pod(c)
         bed.restart_plugin()
         bed.teardown_claim(c)       # process #2 never prepared this
+
+
+class TestLiveHealthLoop:
+    """The full health loop across real process boundaries: the
+    binary's own HealthMonitor observes a sysfs health-file flip in
+    its fake tree and republishes the ResourceSlices over the live
+    REST API server — no in-process shortcuts anywhere."""
+
+    def test_failed_chip_unpublished_live(self, tmp_path):
+        import time
+        root = tmp_path / "tree"
+        bed = OOPBed(
+            tmp_path, topo={"generation": "v5e", "num_chips": 4,
+                            "root": str(root)},
+            plugin_env={"HEALTH_INTERVAL": "0.2"})
+        try:
+            def published():
+                names = set()
+                for sl in bed.client.list("ResourceSlice"):
+                    for d in sl.devices:
+                        names.add(d.name)
+                return names
+
+            assert "chip-2" in published()
+            (root / "sys/class/accel/accel2/device/health").write_text(
+                "hbm uncorrectable ecc\n")
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if "chip-2" not in published():
+                    break
+                time.sleep(0.2)
+            names = published()
+            assert "chip-2" not in names, names
+            assert "chip-0" in names
+
+            # recovery: the chip comes back
+            (root / "sys/class/accel/accel2/device/health").unlink()
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                if "chip-2" in published():
+                    break
+                time.sleep(0.2)
+            assert "chip-2" in published()
+        finally:
+            bed.shutdown()

@@ -141,10 +141,10 @@ def test_quantized_bytes_halve():
 
 
 def test_moe_qeinsum_kernel_matches_xla(monkeypatch):
-    """The MoE specs must hit the batched kernel and agree with the
-    XLA fallback (TPU_QUANT_FORCE_XLA) bit-for-bit-ish.  monkeypatch
-    pins each path explicitly so an inherited env var can't turn this
-    into an XLA-vs-XLA comparison."""
+    """The MoE specs must hit the batched kernel (TPU_QUANT_KERNEL=1,
+    the opt-in) and agree with the default XLA path bit-for-bit-ish.
+    monkeypatch pins each path explicitly so an inherited env var
+    can't turn this into an XLA-vs-XLA comparison."""
     x = jax.random.normal(jax.random.PRNGKey(0), (2, 3, 48), jnp.float32)
     w_in = jax.random.normal(jax.random.PRNGKey(1), (4, 48, 96),
                              jnp.float32)
@@ -155,12 +155,12 @@ def test_moe_qeinsum_kernel_matches_xla(monkeypatch):
                               jnp.float32)
     qt2 = quantize_for("btef,efd->bted", w_out)
 
-    monkeypatch.delenv("TPU_QUANT_FORCE_XLA", raising=False)
+    monkeypatch.setenv("TPU_QUANT_KERNEL", "1")
     got = qeinsum("btd,edf->btef", x, qt)
     got2 = qeinsum("btef,efd->bted", h, qt2)
     assert got.shape == (2, 3, 4, 96)
 
-    monkeypatch.setenv("TPU_QUANT_FORCE_XLA", "1")
+    monkeypatch.delenv("TPU_QUANT_KERNEL")
     want = qeinsum("btd,edf->btef", x, qt)
     want2 = qeinsum("btef,efd->bted", h, qt2)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),

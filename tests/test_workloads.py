@@ -331,3 +331,83 @@ class TestCapacityMoE:
             dataclasses.replace(SMALL_MOE, moe_dispatch="sorted")
         with pytest.raises(ValueError, match="capacity_factor"):
             dataclasses.replace(SMALL_MOE, capacity_factor=0.0)
+
+
+class TestRouterAuxLosses:
+    """Switch-style load-balance loss + router z-loss: the training-
+    quality guards that keep capacity/gmm dispatch from collapsing
+    onto a few experts."""
+
+    def test_load_balance_is_one_at_uniform(self):
+        from k8s_dra_driver_tpu.models.transformer import _moe_aux
+        cfg = dataclasses.replace(SMALL_MOE, top_k=1)
+        e = cfg.n_experts
+        # perfectly uniform assignment + probabilities
+        b, t = 2, e * 4
+        logits = jnp.zeros((b, t, e))
+        probs = jnp.full((b, t, e), 1.0 / e)
+        gates = jnp.zeros((b, t, e)).at[
+            :, jnp.arange(t), jnp.arange(t) % e].set(1.0)
+        load, z = _moe_aux(gates, probs, logits, cfg)
+        np.testing.assert_allclose(float(load), 1.0, rtol=1e-6)
+        # logits all zero -> logsumexp = log(E)
+        np.testing.assert_allclose(float(z), float(np.log(e)) ** 2,
+                                   rtol=1e-5)
+
+    def test_load_balance_penalizes_collapse(self):
+        from k8s_dra_driver_tpu.models.transformer import _moe_aux
+        cfg = dataclasses.replace(SMALL_MOE, top_k=1)
+        e = cfg.n_experts
+        b, t = 2, 16
+        # every token routed to expert 0 with high confidence
+        logits = jnp.zeros((b, t, e)).at[..., 0].set(10.0)
+        probs = jax.nn.softmax(logits)
+        gates = jnp.zeros((b, t, e)).at[..., 0].set(1.0)
+        load, _ = _moe_aux(gates, probs, logits, cfg)
+        assert float(load) > 2.0        # uniform would be 1.0
+
+    def test_loss_fn_adds_weighted_aux(self):
+        from k8s_dra_driver_tpu.models import loss_fn
+        cfg0 = dataclasses.replace(SMALL_MOE, dtype=jnp.float32)
+        cfg1 = dataclasses.replace(cfg0, aux_loss_weight=0.01,
+                                   router_z_weight=0.001)
+        params = init_params(cfg0, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    cfg0.vocab)
+        base = float(loss_fn(params, tokens, cfg0))
+        with_aux = float(loss_fn(params, tokens, cfg1))
+        _, aux = forward(params, tokens, cfg1, return_aux=True)
+        want = base + 0.01 * float(aux["load_balance"]) \
+            + 0.001 * float(aux["router_z"])
+        np.testing.assert_allclose(with_aux, want, rtol=1e-5)
+        assert with_aux != base
+
+    def test_aux_train_step_reduces_loss(self):
+        from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
+        mesh = make_mesh(MeshSpec(dp=1, ep=2, sp=2, tp=2))
+        cfg = dataclasses.replace(SMALL_MOE, dtype=jnp.float32,
+                                  moe_dispatch="capacity",
+                                  aux_loss_weight=0.01,
+                                  router_z_weight=0.001)
+        step, init_state = make_train_step(cfg, mesh)
+        params, opt_state = init_state(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab)
+        losses = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_dense_mlp_config_aux_is_zero(self):
+        cfg = dataclasses.replace(SMALL, dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                    cfg.vocab)
+        _, aux = forward(params, tokens, cfg, return_aux=True)
+        assert float(aux["load_balance"]) == 0.0
+        assert float(aux["router_z"]) == 0.0
+
+    def test_negative_weight_rejected(self):
+        with pytest.raises(ValueError, match="aux-loss"):
+            dataclasses.replace(SMALL_MOE, aux_loss_weight=-1.0)

@@ -4,11 +4,11 @@ Three measurements of the same greedy generation (154M-param GQA
 config, ops/collectives.py:decode_probe, differential-median harness):
 
 - ``bf16``        — full-precision baseline;
-- ``int8_kernel`` — weight-only int8 through the pallas
-  ``int8_matmul`` kernel (models/quant.py), int8 converted in VMEM;
-- ``int8_xla``    — the same quantized params with the kernel disabled
-  (``TPU_QUANT_FORCE_XLA=1``): XLA materializes the dequantized weight
-  through HBM each step, the trap the kernel exists to avoid.
+- ``int8_kernel`` — weight-only int8 through the opt-in pallas
+  ``int8_matmul`` kernel (``TPU_QUANT_KERNEL=1``), int8 converted in
+  VMEM — the structural-guarantee path;
+- ``int8_xla``    — the default path: XLA's einsum fuses the int8
+  convert into the dot (and, as recorded, outruns the kernel).
 
 Run on a idle v5e chip from the repo root:
     python tools/bench_int8.py
@@ -26,20 +26,32 @@ import sys
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
 
 
-def measure(int8: bool, force_xla: bool = False, reps: int = 3) -> dict:
+#: the two recorded shapes: "small" (the bench default, 154M params)
+#: where the bf16 baseline already streams near HBM peak, and
+#: "large" (660M params) where the int8 byte halving pays in full
+SHAPES = {
+    "154m": dict(n_tokens=48),
+    "660m": dict(n_layers=12, d_model=2048, heads=16, kv_heads=4,
+                 d_ff=8192, max_seq=1024, n_tokens=24),
+}
+
+
+def measure(shape: dict, int8: bool, kernel: bool = False,
+            reps: int = 2, kv_int8: bool = False) -> dict:
     """Each measurement runs in a fresh subprocess: jit caches key on
-    shapes, not on TPU_QUANT_FORCE_XLA, so an in-process 'XLA path'
-    measurement would silently reuse the kernel-path executable."""
+    shapes, not on TPU_QUANT_KERNEL, so an in-process comparison
+    would silently reuse one path's executable for both."""
     code = (
         "import json, sys\n"
         "from k8s_dra_driver_tpu.ops.collectives import decode_probe\n"
-        f"res = decode_probe(n_tokens=48, reps={reps}, int8={int8})\n"
+        f"res = decode_probe(reps={reps}, int8={int8}, "
+        f"kv_int8={kv_int8}, **{shape!r})\n"
         "print('RESULT ' + json.dumps(res))\n")
     env = dict(os.environ)
-    if force_xla:
-        env["TPU_QUANT_FORCE_XLA"] = "1"
+    if kernel:
+        env["TPU_QUANT_KERNEL"] = "1"
     else:
-        env.pop("TPU_QUANT_FORCE_XLA", None)
+        env.pop("TPU_QUANT_KERNEL", None)
     proc = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
         env=env, cwd=str(pathlib.Path(__file__).resolve().parent.parent))
@@ -65,24 +77,40 @@ def main() -> None:
         "harness": "ops/collectives.py:decode_probe "
                    "(_differential_median over scan lengths)",
     }
-    out["bf16"] = measure(int8=False)
-    out["int8_kernel"] = measure(int8=True)
-    out["int8_xla"] = measure(int8=True, force_xla=True)
-    if out["bf16"]["valid"] and out["int8_kernel"]["valid"]:
-        out["kernel_speedup_vs_bf16"] = round(
-            out["bf16"]["ms_per_token"]
-            / out["int8_kernel"]["ms_per_token"], 3)
-    if out["int8_xla"].get("valid") and out["int8_kernel"]["valid"]:
-        out["kernel_speedup_vs_xla_path"] = round(
-            out["int8_xla"]["ms_per_token"]
-            / out["int8_kernel"]["ms_per_token"], 3)
-    if out["bf16"]["valid"] and out["int8_xla"].get("valid"):
-        # plain ratio, named for what it is (the XLA path has measured
-        # both faster and slower than bf16 across sessions — XLA's
-        # fusion choice, not a stable property)
-        out["xla_vs_bf16_ratio"] = round(
-            out["int8_xla"]["ms_per_token"]
-            / out["bf16"]["ms_per_token"], 3)
+    # The tunneled chip's observed throughput drifts by 3-5x across
+    # minutes; each variant keeps its best *valid* (physical-floor-
+    # checked) reading over several interleaved rounds — the floor
+    # (weights + full cache bytes at a 1000 GB/s ceiling,
+    # ops/collectives.py) bounds how flattering "best" can get, the
+    # rounds bound how unlucky a variant can be.
+    variants = {
+        "bf16": dict(int8=False),
+        "int8_kernel": dict(int8=True, kernel=True),
+        "int8_kv8": dict(int8=True, kv_int8=True),
+        "int8_xla": dict(int8=True),      # the default path
+    }
+    rounds = 2
+    for shape_name, shape in SHAPES.items():
+        sec: dict = {}
+        for name in variants:
+            sec[name] = {"valid": False, "ms_per_token": float("inf")}
+        for _ in range(rounds):
+            for name, kw in variants.items():
+                res = measure(shape, **kw)
+                best = sec[name]
+                better = res["ms_per_token"] < best["ms_per_token"]
+                if (res["valid"] and (not best["valid"] or better)) or \
+                        (not best["valid"] and not res["valid"]
+                         and better):
+                    sec[name] = res
+        if sec["bf16"]["valid"]:
+            for name in ("int8_kernel", "int8_kv8", "int8_xla"):
+                if sec[name]["valid"]:
+                    sec[f"{name}_speedup_vs_bf16"] = round(
+                        sec["bf16"]["ms_per_token"]
+                        / sec[name]["ms_per_token"], 3)
+        out[shape_name] = sec
+    out["rounds"] = rounds
     path = pathlib.Path(__file__).parent / "int8_decode_v5e.json"
     path.write_text(json.dumps(out, indent=1) + "\n")
     print(json.dumps(out, indent=1))
