@@ -4,6 +4,7 @@ from .checkpoint import TrainCheckpointer
 from .decode import (KVCache, decode_step, greedy_generate, init_cache,
                      prefill, sample_generate)
 from .quant import QTensor, quantize_params, quantized_bytes
+from .speculative import speculative_generate
 from .transformer import (TransformerConfig, forward, init_params, loss_fn,
                           make_optimizer, make_train_step, param_specs,
                           shard_params)
@@ -13,4 +14,4 @@ __all__ = ["KVCache", "QTensor", "TrainCheckpointer", "TransformerConfig",
            "greedy_generate", "init_cache", "init_params", "loss_fn",
            "make_optimizer", "make_train_step", "param_specs", "prefill",
            "quantize_params", "quantized_bytes",
-           "sample_generate", "shard_params"]
+           "sample_generate", "shard_params", "speculative_generate"]

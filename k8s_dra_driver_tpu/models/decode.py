@@ -123,8 +123,12 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
     is real, not undone by a materialized repeat.
 
     With scales (int8 cache), entries are dequantized at read:
-    ``k = k_q * k_scale`` per (batch, position, head) — HBM sees int8
-    bytes, the arithmetic runs dequantized.
+    ``k = k_q * k_scale`` per (batch, position, head).  Whether HBM
+    sees int8 or a materialized dequantized copy is XLA's fusion
+    choice (recorded both ways — tools/int8_decode_v5e.json: 2.0x at
+    154M with int8 weights, a regression at 660M); the structural
+    guarantee of the int8 cache is *storage* — twice the
+    batch x context per chip.
     """
     if k_scale is not None:
         k_cache = (k_cache.astype(jnp.float32)

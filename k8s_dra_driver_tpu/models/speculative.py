@@ -1,0 +1,160 @@
+"""Speculative decoding: draft-model speculation, target-exact output.
+
+The serving-latency lever for memory-bound decode: a small draft
+model proposes ``draft_len`` greedy tokens autoregressively (cheap —
+its weights are small), then the target model scores all of them in
+ONE forward_with_cache call (one stream of the big weights instead of
+``draft_len``).  Accepted prefix + one correction token advance the
+output per iteration, so the big model's HBM traffic per emitted
+token drops by up to ``(accepted+1)x``.
+
+Greedy speculation is **algorithmically exact**: a draft token is
+accepted only when it equals the target's own greedy choice at that
+position, and the first divergence is replaced by the target's
+choice — under deterministic numerics the emitted sequence is
+bit-identical to ``greedy_generate`` on the target model (pinned on
+the f32 CPU suite, tests/test_speculative.py).  In bf16 on TPU the
+chunked scoring pass and stepwise decode accumulate in different
+orders, so a near-tie argmax can occasionally pick a different —
+equally greedy — continuation; every emitted token is still the
+target's greedy choice for its actual prefix.  Batched rows advance
+in lockstep by the *minimum* acceptance across the batch: rows that
+accepted more re-emit the same target-greedy tokens next iteration,
+so the guarantee holds per row while shapes stay static.
+
+TPU-first mechanics:
+
+- one compiled ``lax.while_loop``; every iteration's shapes are
+  static (``draft_len`` proposals, ``draft_len+1`` target logits);
+- cache "rollback" is free: the static-shape KV cache masks keys by
+  position (``key_pos <= q_pos``), so rejecting speculative entries
+  is just not advancing ``pos`` — stale slots are invisible and are
+  overwritten by the next write at the same offset;
+- the output rides in a fixed buffer written with
+  ``dynamic_update_slice``; over-written speculative tails are
+  corrected by the next iteration's write.
+
+The reference has no serving stack at all (SURVEY.md §2.3); this sits
+on models/decode.py beside the int8 serving path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .decode import KVCache, forward_with_cache, init_cache
+from .transformer import Params, TransformerConfig
+
+
+def _greedy_draft(draft_params, draft_cfg, cache: KVCache, last,
+                  draft_len: int):
+    """Propose ``draft_len`` greedy tokens from the draft model;
+    ``last`` [B] is the most recent emitted token (fed as the first
+    input).  Runs ``draft_len + 1`` steps so the cache also holds the
+    LAST proposal's K/V — on a full accept the position advances past
+    it, and a missing entry there would silently degrade every later
+    draft (it cost a 2x iteration count before this was caught).
+    Returns (proposals [B, draft_len], updated draft cache)."""
+
+    def step(carry, _):
+        token, cache = carry
+        logits, cache = forward_with_cache(
+            draft_params, token[:, None], draft_cfg, cache)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(token.dtype)
+        return (nxt, cache), nxt
+
+    (_, cache), drafts = jax.lax.scan(
+        step, (last, cache), None, length=draft_len + 1)
+    return drafts.T[:, :draft_len], cache        # [B, draft_len]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "draft_cfg", "n_tokens", "draft_len", "max_seq"))
+def speculative_generate(params: Params, draft_params: Params,
+                         prompt: jax.Array, cfg: TransformerConfig,
+                         draft_cfg: TransformerConfig, n_tokens: int,
+                         draft_len: int = 4,
+                         max_seq: int | None = None):
+    """prompt [B, Tp] -> ([B, Tp + n_tokens] greedy continuation of
+    the TARGET model, iterations used).
+
+    ``params``/``cfg`` is the target model, ``draft_params``/
+    ``draft_cfg`` the proposer (same vocab; anything from a distilled
+    sibling to the target itself).  ``iterations`` counts target
+    forwards — with a perfect draft it approaches
+    ``n_tokens / (draft_len + 1)``.
+    """
+    b, tp = prompt.shape
+    max_seq = max_seq or cfg.max_seq
+    if n_tokens < 1:
+        raise ValueError(f"n_tokens must be >= 1, got {n_tokens}")
+    if draft_len < 1:
+        raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    if cfg.vocab != draft_cfg.vocab:
+        raise ValueError("target and draft must share a vocab "
+                         f"({cfg.vocab} != {draft_cfg.vocab})")
+    # target writes up to draft_len+1 speculative entries past the
+    # emitted prefix; both caches must hold the worst case
+    need = tp + n_tokens + draft_len + 1
+    if need > max_seq:
+        raise ValueError(
+            f"prompt ({tp}) + n_tokens ({n_tokens}) + draft_len "
+            f"({draft_len}) + 1 exceeds the {max_seq}-slot cache")
+
+    t_cache = init_cache(cfg, b, max_seq)
+    d_cache = init_cache(draft_cfg, b, max_seq)
+    t_logits, t_cache = forward_with_cache(params, prompt, cfg, t_cache,
+                                           first_chunk=True)
+    _, d_cache = forward_with_cache(draft_params, prompt, draft_cfg,
+                                    d_cache, first_chunk=True)
+    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(prompt.dtype)
+
+    # out buffer: generated tokens only; slot 0 = `first`
+    out0 = jnp.zeros((b, n_tokens + draft_len + 1), prompt.dtype)
+    out0 = out0.at[:, 0].set(first)
+
+    def cond(carry):
+        _, _, _, count, _, _ = carry
+        return count < n_tokens
+
+    def body(carry):
+        t_cache, d_cache, out, count, last, iters = carry
+        drafts, d_cache_spec = _greedy_draft(
+            draft_params, draft_cfg, d_cache, last, draft_len)
+        # target scores [last, d_0 .. d_{L-1}] in one call: logits at
+        # input i give the target's greedy choice for position i+1
+        t_in = jnp.concatenate([last[:, None], drafts], axis=1)
+        t_logits, t_cache_spec = forward_with_cache(
+            params, t_in, cfg, t_cache)
+        greedy = jnp.argmax(t_logits, axis=-1).astype(last.dtype)
+        # accepted prefix per row, then lockstep min across the batch
+        match = (drafts == greedy[:, :-1])
+        acc = jnp.min(jnp.cumprod(match.astype(jnp.int32),
+                                  axis=1).sum(axis=1))
+        emit_n = acc + 1                      # accepted + correction
+        # write the full candidate block at the next free slot; the
+        # tail beyond emit_n is speculative and gets overwritten by
+        # the next iteration's write
+        out = jax.lax.dynamic_update_slice(out, greedy, (0, count))
+        last = jax.lax.dynamic_index_in_dim(greedy, acc, axis=1,
+                                            keepdims=False)
+        # keep the speculative caches' arrays, roll the position back
+        # to the accepted prefix (stale entries are position-masked)
+        t_cache = KVCache(k=t_cache_spec.k, v=t_cache_spec.v,
+                          pos=t_cache.pos + emit_n,
+                          k_scale=t_cache_spec.k_scale,
+                          v_scale=t_cache_spec.v_scale)
+        d_cache = KVCache(k=d_cache_spec.k, v=d_cache_spec.v,
+                          pos=d_cache.pos + emit_n,
+                          k_scale=d_cache_spec.k_scale,
+                          v_scale=d_cache_spec.v_scale)
+        return (t_cache, d_cache, out, count + emit_n, last, iters + 1)
+
+    _, _, out, _, _, iters = jax.lax.while_loop(
+        cond, body, (t_cache, d_cache, out0, jnp.int32(1), first,
+                     jnp.int32(0)))
+    return (jnp.concatenate([prompt, out[:, :n_tokens]], axis=1),
+            iters)

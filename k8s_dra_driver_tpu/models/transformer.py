@@ -95,11 +95,11 @@ class TransformerConfig:
     router_z_weight: float = 0.0
     # Serving KV-cache storage: "model" keeps cache entries in the
     # model dtype; "int8" stores them quantized with one symmetric
-    # scale per (batch, position, kv-head) — at long contexts the
-    # cache read, not the weights, dominates per-token HBM traffic
-    # (B8/S8192/Hkv4/D64 reads 268 MB of bf16 cache per token vs
-    # 242 MB of weights), so halving it is the same lever int8
-    # weights pull (models/quant.py).
+    # scale per (batch, position, kv-head) — halves cache *storage*
+    # (2x the batch x context per chip). Speed depends on XLA fusing
+    # the read-side dequant: recorded 2.0x tokens/s at one shape and
+    # a regression at another (tools/int8_decode_v5e.json) — treat it
+    # as a capacity lever and measure before claiming speed.
     kv_cache_dtype: str = "model"
 
     def __post_init__(self):

@@ -1,0 +1,97 @@
+"""Speculative decoding (models/speculative.py).
+
+THE property: greedy speculation is exact — the emitted sequence is
+bit-identical to the target model's own greedy_generate, whatever the
+draft proposes.  Plus the efficiency contract: a perfect draft (the
+target itself) finishes in ~n/(draft_len+1) target iterations.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                       greedy_generate, init_params)
+from k8s_dra_driver_tpu.models.speculative import speculative_generate
+
+CFG = TransformerConfig(vocab=96, d_model=48, n_layers=2, n_heads=4,
+                        d_head=12, d_ff=96, max_seq=64,
+                        dtype=jnp.float32)
+DRAFT = TransformerConfig(vocab=96, d_model=24, n_layers=1, n_heads=2,
+                          d_head=12, d_ff=48, max_seq=64,
+                          dtype=jnp.float32)
+
+
+def setup(seed=0, batch=2, t=8):
+    target = init_params(CFG, jax.random.PRNGKey(seed))
+    draft = init_params(DRAFT, jax.random.PRNGKey(seed + 1))
+    prompt = jax.random.randint(jax.random.PRNGKey(seed + 2),
+                                (batch, t), 0, CFG.vocab)
+    return target, draft, prompt
+
+
+@pytest.mark.parametrize("draft_len", [1, 3, 4])
+def test_exactly_matches_target_greedy(draft_len):
+    """An unrelated random draft model must still yield the target's
+    exact greedy sequence (only speed may differ)."""
+    target, draft, prompt = setup()
+    want = greedy_generate(target, prompt, CFG, 16)
+    got, iters = speculative_generate(target, draft, prompt, CFG,
+                                      DRAFT, 16, draft_len=draft_len)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(iters) >= 1
+
+
+@pytest.mark.parametrize("cfg_kw", [
+    dict(n_kv_heads=2),
+    dict(n_experts=4, top_k=2),
+    dict(kv_cache_dtype="int8"),
+], ids=["gqa", "moe", "kv8"])
+def test_exact_across_model_variants(cfg_kw):
+    cfg = dataclasses.replace(CFG, **cfg_kw)
+    target = init_params(cfg, jax.random.PRNGKey(0))
+    draft = init_params(DRAFT, jax.random.PRNGKey(1))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0,
+                                cfg.vocab)
+    want = greedy_generate(target, prompt, cfg, 12)
+    got, _ = speculative_generate(target, draft, prompt, cfg, DRAFT,
+                                  12, draft_len=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_perfect_draft_amortizes_iterations():
+    """Draft == target: every proposal is accepted, so n_tokens come
+    out in ceil(n / (draft_len+1)) target forwards."""
+    target, _, prompt = setup(batch=1)
+    n, dl = 20, 4
+    got, iters = speculative_generate(target, target, prompt, CFG, CFG,
+                                      n, draft_len=dl)
+    want = greedy_generate(target, prompt, CFG, n)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    assert int(iters) <= -(-n // (dl + 1)) + 1, int(iters)
+
+
+def test_batch_lockstep_is_exact_per_row():
+    """Rows accept different prefixes; lockstep min-acceptance must
+    still reproduce each row's exact target greedy continuation."""
+    target, draft, _ = setup()
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (4, 8), 0,
+                                CFG.vocab)
+    want = greedy_generate(target, prompt, CFG, 14)
+    got, _ = speculative_generate(target, draft, prompt, CFG, DRAFT,
+                                  14, draft_len=4)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_cache_bound_validated():
+    target, draft, prompt = setup(t=8)
+    with pytest.raises(ValueError, match="exceeds"):
+        speculative_generate(target, draft, prompt, CFG, DRAFT,
+                             n_tokens=60, draft_len=4)
+    with pytest.raises(ValueError, match="vocab"):
+        bad = dataclasses.replace(DRAFT, vocab=128)
+        speculative_generate(target, init_params(
+            bad, jax.random.PRNGKey(1)), prompt, CFG, bad, 4)
