@@ -249,20 +249,22 @@ def _attention(x, layer, cfg: TransformerConfig, mesh: Mesh | None,
     v = ein("btd,dhk->bthk", x, layer["wv"])
     window = cfg.attention_window or None
     if mesh is not None and mesh.shape.get("sp", 1) > 1:
-        if window is not None:
-            raise NotImplementedError(
-                "attention_window with sp>1 context parallelism is "
-                "not supported; shard long local-attention sequences "
-                "on dp/tp instead")
-        if segment_ids is not None:
-            raise NotImplementedError(
-                "segment_ids with sp>1 context parallelism is not "
-                "supported; pack on dp-sharded batches instead")
         if cfg.seq_parallel == "ulysses":
+            # ulysses' local attention sees the full sequence, so
+            # window and segment masking apply as-is
             from ..ops.ulysses_attention import ulysses_attention
-            o = ulysses_attention(q, k, v, mesh, causal=True)
+            o = ulysses_attention(q, k, v, mesh, causal=True,
+                                  window=window,
+                                  segment_ids=segment_ids)
         else:
-            o = ring_attention(q, k, v, mesh, causal=True)
+            if window is not None:
+                raise NotImplementedError(
+                    "attention_window with ring context parallelism "
+                    "is not supported; use seq_parallel='ulysses' "
+                    "(its local attention windows exactly) or shard "
+                    "long local-attention sequences on dp/tp")
+            o = ring_attention(q, k, v, mesh, causal=True,
+                               segment_ids=segment_ids)
     elif mesh_platform(mesh) == "tpu":
         # fused pallas kernel on hardware (ops/flash_attention.py);
         # gated on the devices the computation actually runs on, not

@@ -370,7 +370,7 @@ def decode_probe(batch: int = 8, n_layers: int = 8, d_model: int = 1024,
     floor_s = (streamed / (_DECODE_HBM_GBPS_CEILING * 1e9)
                if on_accel else 0.0)
     per_tok, valid = None, False
-    for _ in range(3):
+    for _ in range(5):
         per_tok, valid, _ = _differential_median(
             make(n_tokens), make(short), 0, n_tokens, short, trials=reps)
         if valid and per_tok < floor_s:

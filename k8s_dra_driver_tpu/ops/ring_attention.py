@@ -34,6 +34,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import mesh_platform
@@ -45,12 +46,15 @@ from .flash_attention import (_kv_heads, attention_block_grads,
 _NEG_INF = -1e30
 
 
-def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale):
+def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale,
+                  q_seg=None, k_seg=None):
     """One online-softmax accumulation step against a K/V block.
 
     Shapes: q [B,Tq,H,D], k/v [B,Tk,H_kv,D] (GQA via broadcast —
     this is the pure-XLA fallback, so the repeat materializes here);
-    o [B,Tq,H,D] f32; m,l [B,H,Tq] f32.  Returns updated (o, m, l).
+    o [B,Tq,H,D] f32; m,l [B,H,Tq] f32.  ``q_seg``/``k_seg``
+    ([B,Tq]/[B,Tk]) add packed-sequence masking.  Returns updated
+    (o, m, l).
     """
     _, group = _kv_heads(q.shape[2], k)
     if group > 1:
@@ -58,13 +62,18 @@ def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale):
         v = jnp.repeat(v, group, axis=2)
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                         preferred_element_type=jnp.float32) * scale
+    tq, tk = q.shape[1], k.shape[1]
+    mask = None                                          # [B?,Tq,Tk]
     if causal:
-        tq, tk = q.shape[1], k.shape[1]
         q_pos = q_offset + jnp.arange(tq)
         k_pos = k_offset + jnp.arange(tk)
-        mask = q_pos[:, None] >= k_pos[None, :]          # [Tq,Tk]
-        scores = jnp.where(mask[None, None], scores, _NEG_INF)
-        maskf = mask[None, None].astype(scores.dtype)
+        mask = (q_pos[:, None] >= k_pos[None, :])[None]
+    if q_seg is not None:
+        seg = q_seg[:, :, None] == k_seg[:, None, :]
+        mask = seg if mask is None else (mask & seg)
+    if mask is not None:
+        scores = jnp.where(mask[:, None], scores, _NEG_INF)
+        maskf = mask[:, None].astype(scores.dtype)
     else:
         maskf = jnp.ones((1, 1, 1, 1), scores.dtype)
 
@@ -83,12 +92,20 @@ def _ring_perm(ring_size: int) -> list[tuple[int, int]]:
     return [(j, (j - 1) % ring_size) for j in range(ring_size)]
 
 
-def _ring_forward(q, k, v, axis_name, causal, scale, use_flash, interpret):
-    """Forward ring pass. Returns (o [B,Tq,H,D] q.dtype, lse [B,H,Tq])."""
+def _ring_forward(q, k, v, seg, axis_name, causal, scale, use_flash,
+                  interpret):
+    """Forward ring pass. Returns (o [B,Tq,H,D] q.dtype, lse [B,H,Tq]).
+
+    ``seg`` is this shard's [B, T/S] segment-id block or None; the
+    full [B, T] id vector is all_gathered once (int32 — noise next to
+    the rotating K/V) and the visiting block's ids sliced per hop, so
+    the rotating quartet stays unchanged."""
     ring_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
     q_offset = my_idx * t_local
+    seg_all = (None if seg is None else
+               jax.lax.all_gather(seg, axis_name, axis=1, tiled=True))
 
     o = jnp.zeros(q.shape, jnp.float32)
     m = jnp.full((q.shape[0], q.shape[2], q.shape[1]), _NEG_INF, jnp.float32)
@@ -98,6 +115,9 @@ def _ring_forward(q, k, v, axis_name, causal, scale, use_flash, interpret):
     def body(step, carry):
         o, m, l, k_blk, v_blk = carry
         k_idx = (my_idx + step) % ring_size
+        k_seg = (None if seg_all is None else
+                 jax.lax.dynamic_slice_in_dim(seg_all, k_idx * t_local,
+                                              t_local, axis=1))
         if use_flash:
             # fused pallas kernel for the block compute: scores stay in
             # VMEM, matmuls on the MXU (ops/flash_attention.py)
@@ -105,11 +125,13 @@ def _ring_forward(q, k, v, axis_name, causal, scale, use_flash, interpret):
             o_blk, m_blk, l_blk = flash_block_attention(
                 q, k_blk, v_blk, q_offset, k_idx * t_local,
                 causal=causal, scale=scale, interpret=interpret,
-                block_q=bq, block_k=bk)
+                block_q=bq, block_k=bk,
+                q_segments=seg, k_segments=k_seg)
             o, m, l = merge_flash_stats(o, m, l, o_blk, m_blk, l_blk)
         else:
             o, m, l = _block_update(q, k_blk, v_blk, o, m, l, q_offset,
-                                    k_idx * t_local, causal, scale)
+                                    k_idx * t_local, causal, scale,
+                                    seg, k_seg)
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return (o, m, l, k_blk, v_blk)
@@ -121,28 +143,30 @@ def _ring_forward(q, k, v, axis_name, causal, scale, use_flash, interpret):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
 def _ring_attention_local(axis_name, causal, scale, use_flash, interpret,
-                          q, k, v):
+                          q, k, v, seg):
     """Per-shard body; call inside shard_map with sequence sharded on
     ``axis_name``."""
-    return _ring_forward(q, k, v, axis_name, causal, scale, use_flash,
-                         interpret)[0]
+    return _ring_forward(q, k, v, seg, axis_name, causal, scale,
+                         use_flash, interpret)[0]
 
 
 def _ring_attention_local_fwd(axis_name, causal, scale, use_flash,
-                              interpret, q, k, v):
-    out, lse = _ring_forward(q, k, v, axis_name, causal, scale, use_flash,
-                             interpret)
-    return out, (q, k, v, out, lse)
+                              interpret, q, k, v, seg):
+    out, lse = _ring_forward(q, k, v, seg, axis_name, causal, scale,
+                             use_flash, interpret)
+    return out, (q, k, v, seg, out, lse)
 
 
 def _ring_attention_local_bwd(axis_name, causal, scale, use_flash,
                               interpret, res, do):
-    q, k, v, out, lse = res
+    q, k, v, seg, out, lse = res
     ring_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
     t_local = q.shape[1]
     q_offset = my_idx * t_local
     perm = _ring_perm(ring_size)
+    seg_all = (None if seg is None else
+               jax.lax.all_gather(seg, axis_name, axis=1, tiled=True))
 
     delta = attention_delta(do, out)
 
@@ -150,6 +174,9 @@ def _ring_attention_local_bwd(axis_name, causal, scale, use_flash,
         dq, k_blk, v_blk, dk_blk, dv_blk = carry
         k_idx = (my_idx + step) % ring_size
         k_offset = k_idx * t_local
+        k_seg = (None if seg_all is None else
+                 jax.lax.dynamic_slice_in_dim(seg_all, k_offset,
+                                              t_local, axis=1))
 
         def block(args):
             k_blk, v_blk = args
@@ -161,9 +188,12 @@ def _ring_attention_local_bwd(axis_name, causal, scale, use_flash,
                 return flash_block_grads(
                     q, k_blk, v_blk, do, delta, lse, q_offset, k_offset,
                     causal=causal, scale=scale, block_q=bq, block_k=bk,
-                    interpret=interpret)
+                    interpret=interpret,
+                    q_segments=seg, k_segments=k_seg)
             return attention_block_grads(q, k_blk, v_blk, do, delta, lse,
-                                         q_offset, k_offset, causal, scale)
+                                         q_offset, k_offset, causal,
+                                         scale, q_segments=seg,
+                                         k_segments=k_seg)
 
         def skip(args):
             return (jnp.zeros(q.shape, jnp.float32),
@@ -194,7 +224,10 @@ def _ring_attention_local_bwd(axis_name, causal, scale, use_flash,
     dq, _, _, dk, dv = jax.lax.fori_loop(
         0, ring_size, body,
         (jnp.zeros(q.shape, jnp.float32), k, v, zeros, zeros))
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    dseg = (None if seg is None else
+            np.zeros(seg.shape, jax.dtypes.float0))
+    return (dq.astype(q.dtype), dk.astype(k.dtype),
+            dv.astype(v.dtype), dseg)
 
 
 _ring_attention_local.defvjp(_ring_attention_local_fwd,
@@ -206,7 +239,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    scale: float | None = None,
                    batch_axes=("dp", "ep"),
                    head_axis: str | None = "tp",
-                   use_flash: bool | None = None) -> jax.Array:
+                   use_flash: bool | None = None,
+                   segment_ids: jax.Array | None = None) -> jax.Array:
     """Exact attention with sequence sharded over ``axis_name``.
 
     q/k/v: [batch, seq, heads, head_dim] global shapes.  Batch is
@@ -218,7 +252,9 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     not the process default backend; the pure-XLA path elsewhere.
     Pallas interpret mode is exercised by tests but too slow for real
     CPU workloads).  Fully differentiable either way via the ring
-    custom VJP.
+    custom VJP.  ``segment_ids`` [B, T] adds packed-sequence masking
+    (the ids are all_gathered per shard; the rotating K/V quartet is
+    unchanged).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -226,13 +262,31 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     if use_flash is None:
         use_flash = platform == "tpu"
     interpret = platform != "tpu"
+    return sharded_attention_call(
+        functools.partial(_ring_attention_local, axis_name, causal,
+                          scale, use_flash, interpret),
+        mesh, batch_axes, axis_name, head_axis, q, k, v, segment_ids)
+
+
+def sharded_attention_call(local, mesh, batch_axes, axis_name, head_axis,
+                           q, k, v, segment_ids):
+    """Shared shard_map dispatch for the context-parallel strategies:
+    ``local(q, k, v, seg_or_None)`` per shard, q/k/v on the full
+    (batch, seq, head) layout, segment ids (when given) sequence-
+    sharded like the tensors they mask.  One definition so ring and
+    ulysses cannot drift."""
     spec = P(batch_axes, axis_name, head_axis, None)
+    if segment_ids is None:
+        fn = jax.shard_map(
+            lambda q, k, v: local(q, k, v, None),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        return fn(q, k, v)
+    seg_spec = P(batch_axes, axis_name)
     fn = jax.shard_map(
-        functools.partial(_ring_attention_local, axis_name, causal, scale,
-                          use_flash, interpret),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return fn(q, k, v)
+        local, mesh=mesh, in_specs=(spec, spec, spec, seg_spec),
+        out_specs=spec, check_vma=False)
+    return fn(q, k, v, segment_ids)
 
 
 def attention_reference(q, k, v, *, causal=True, scale=None,

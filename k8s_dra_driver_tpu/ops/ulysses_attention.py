@@ -37,16 +37,22 @@ from __future__ import annotations
 import functools
 
 import jax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh
 
 from ..parallel.mesh import mesh_platform
 from .flash_attention import _kv_heads
-from .ring_attention import attention_reference
+from .ring_attention import attention_reference, sharded_attention_call
 
 
 def _ulysses_local(axis_name, causal, scale, use_flash, interpret,
-                   q, k, v):
-    """Per-shard body: all_to_all -> local attention -> all_to_all."""
+                   window, q, k, v, seg):
+    """Per-shard body: all_to_all -> local attention -> all_to_all.
+
+    The local attention covers the FULL sequence (that is the point
+    of the reshard), so sliding-window and segment masking apply
+    as-is; segment ids are sequence-sharded on entry and all_gathered
+    (an int32 [B, T] — noise next to the activation all_to_alls).
+    """
     s = jax.lax.psum(1, axis_name)
 
     def seq_to_heads(x):
@@ -61,12 +67,17 @@ def _ulysses_local(axis_name, causal, scale, use_flash, interpret,
 
     if s > 1:
         q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+        if seg is not None:
+            seg = jax.lax.all_gather(seg, axis_name, axis=1,
+                                     tiled=True)
     if use_flash:
         from .flash_attention import flash_attention
         o = flash_attention(q, k, v, causal=causal, scale=scale,
-                            interpret=interpret)
+                            interpret=interpret, window=window,
+                            segment_ids=seg)
     else:
-        o = attention_reference(q, k, v, causal=causal, scale=scale)
+        o = attention_reference(q, k, v, causal=causal, scale=scale,
+                                window=window, segment_ids=seg)
     return heads_to_seq(o) if s > 1 else o
 
 
@@ -75,7 +86,9 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                       causal: bool = True, scale: float | None = None,
                       batch_axes=("dp", "ep"),
                       head_axis: str | None = "tp",
-                      use_flash: bool | None = None) -> jax.Array:
+                      use_flash: bool | None = None,
+                      window: int | None = None,
+                      segment_ids: jax.Array | None = None) -> jax.Array:
     """Exact attention with sequence sharded over ``axis_name`` via
     head/sequence all_to_all resharding (drop-in for ring_attention;
     same global shapes and sharding contract).
@@ -83,6 +96,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     q/k/v: [batch, seq, heads, head_dim] global. Requires the local
     head count (after any ``head_axis`` sharding) — and the K/V head
     count under GQA — to be divisible by the ``axis_name`` mesh size.
+    ``window``/``segment_ids`` ([B, T]) mask the local attention the
+    same way the single-device kernels do.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -103,10 +118,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                 f"divisible by {axis_name}={sp}; use ring_attention "
                 f"for seq-parallel sizes beyond the head count")
 
-    spec = P(batch_axes, axis_name, head_axis, None)
-    fn = jax.shard_map(
+    return sharded_attention_call(
         functools.partial(_ulysses_local, axis_name, causal, scale,
-                          use_flash, interpret),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
-    return fn(q, k, v)
+                          use_flash, interpret, window),
+        mesh, batch_axes, axis_name, head_axis, q, k, v, segment_ids)

@@ -179,7 +179,7 @@ class TestSlidingWindowModel:
         mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
         params = init_params(cfg, jax.random.PRNGKey(0))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
-        with pytest.raises(NotImplementedError, match="sp>1"):
+        with pytest.raises(NotImplementedError, match="ring"):
             forward(shard_params(params, cfg, mesh), tokens, cfg,
                     mesh=mesh)
 
@@ -226,16 +226,75 @@ class TestPackedSequences:
             losses.append(float(loss))
         assert losses[-1] < losses[0], losses
 
-    def test_segments_with_sp_rejected(self):
+    @pytest.mark.parametrize("seq_parallel", ["ring", "ulysses"])
+    def test_packed_sharded_equals_unsharded(self, seq_parallel):
+        """Segment masking composes with sp>1 context parallelism:
+        the sharded packed forward equals the single-device packed
+        forward for both strategies (ring all_gathers the ids and
+        slices per hop; ulysses masks its full-sequence local
+        attention)."""
         from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
         mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
-        cfg = dataclasses.replace(SMALL, max_seq=32)
+        cfg = dataclasses.replace(SMALL, max_seq=32,
+                                  seq_parallel=seq_parallel,
+                                  dtype=jnp.float32)
         params = init_params(cfg, jax.random.PRNGKey(0))
-        tokens = jnp.zeros((2, 32), jnp.int32)
-        seg = jnp.zeros((2, 32), jnp.int32)
-        with pytest.raises(NotImplementedError, match="segment"):
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab)
+        seg = jnp.concatenate([jnp.zeros((4, 16), jnp.int32),
+                               jnp.ones((4, 16), jnp.int32)], axis=1)
+        plain = forward(params, tokens, cfg, mesh=None,
+                        segment_ids=seg)
+        sharded = forward(shard_params(params, cfg, mesh), tokens, cfg,
+                          mesh=mesh, segment_ids=seg)
+        np.testing.assert_allclose(np.asarray(plain),
+                                   np.asarray(sharded),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_packed_sharded_train_step_reduces_loss(self):
+        from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
+        mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        cfg = dataclasses.replace(SMALL, max_seq=32, dtype=jnp.float32)
+        step, init_state = make_train_step(cfg, mesh)
+        params, opt_state = init_state(jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab)
+        seg = jnp.concatenate([jnp.zeros((4, 16), jnp.int32),
+                               jnp.ones((4, 16), jnp.int32)], axis=1)
+        losses = []
+        for _ in range(3):
+            params, opt_state, loss = step(params, opt_state, tokens,
+                                           seg)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+
+    def test_window_with_ulysses_sharded_equals_unsharded(self):
+        from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
+        mesh = make_mesh(MeshSpec(dp=4, sp=2, tp=1))
+        cfg = dataclasses.replace(SMALL, max_seq=32,
+                                  seq_parallel="ulysses",
+                                  attention_window=8,
+                                  dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab)
+        plain = forward(params, tokens, cfg, mesh=None)
+        sharded = forward(shard_params(params, cfg, mesh), tokens, cfg,
+                          mesh=mesh)
+        np.testing.assert_allclose(np.asarray(plain),
+                                   np.asarray(sharded),
+                                   atol=2e-4, rtol=2e-4)
+
+    def test_window_with_ring_still_rejected(self):
+        from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
+        mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        cfg = dataclasses.replace(SMALL, max_seq=32,
+                                  attention_window=8)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((4, 32), jnp.int32)
+        with pytest.raises(NotImplementedError, match="ulysses"):
             forward(shard_params(params, cfg, mesh), tokens, cfg,
-                    mesh=mesh, segment_ids=seg)
+                    mesh=mesh)
 
 
 class TestCapacityMoE:
