@@ -64,8 +64,9 @@ class TransformerConfig:
     n_kv_heads: int = 0
     # Sliding-window (local) attention: 0 = full causal; otherwise each
     # token attends to its `attention_window` most recent positions
-    # (kernels skip out-of-window blocks). Not composable with sp>1
-    # context parallelism yet — validated below.
+    # (kernels skip out-of-window blocks). Composes with sp>1 context
+    # parallelism: ring masks per hop via absolute offsets, ulysses
+    # windows its full-sequence local attention.
     attention_window: int = 0
     # MoE dispatch strategy: "dense" computes every expert on every
     # token and mixes by the (top-k-zeroed) gates — simple, exact, but
@@ -257,14 +258,8 @@ def _attention(x, layer, cfg: TransformerConfig, mesh: Mesh | None,
                                   window=window,
                                   segment_ids=segment_ids)
         else:
-            if window is not None:
-                raise NotImplementedError(
-                    "attention_window with ring context parallelism "
-                    "is not supported; use seq_parallel='ulysses' "
-                    "(its local attention windows exactly) or shard "
-                    "long local-attention sequences on dp/tp")
             o = ring_attention(q, k, v, mesh, causal=True,
-                               segment_ids=segment_ids)
+                               segment_ids=segment_ids, window=window)
     elif mesh_platform(mesh) == "tpu":
         # fused pallas kernel on hardware (ops/flash_attention.py);
         # gated on the devices the computation actually runs on, not

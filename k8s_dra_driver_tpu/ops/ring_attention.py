@@ -47,7 +47,7 @@ _NEG_INF = -1e30
 
 
 def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale,
-                  q_seg=None, k_seg=None):
+                  q_seg=None, k_seg=None, window=None):
     """One online-softmax accumulation step against a K/V block.
 
     Shapes: q [B,Tq,H,D], k/v [B,Tk,H_kv,D] (GQA via broadcast —
@@ -68,6 +68,9 @@ def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale,
         q_pos = q_offset + jnp.arange(tq)
         k_pos = k_offset + jnp.arange(tk)
         mask = (q_pos[:, None] >= k_pos[None, :])[None]
+        if window is not None:
+            mask = mask & ((q_pos[:, None] - k_pos[None, :])
+                           < window)[None]
     if q_seg is not None:
         seg = q_seg[:, :, None] == k_seg[:, None, :]
         mask = seg if mask is None else (mask & seg)
@@ -86,6 +89,21 @@ def _block_update(q, k, v, o, m, l, q_offset, k_offset, causal, scale,
     return o_new, m_new, l_new
 
 
+def _hop_contributes(q_offset, k_offset, t_local: int, causal: bool,
+                     window: int | None):
+    """Whether a visiting K/V block can contribute anything to this
+    shard's queries: hops entirely above the causal diagonal or
+    entirely behind the sliding window are all-masked — skipping them
+    makes windowed ring attention O(T*W) in computed hops, the same
+    economics the single-device kernel gets from its block skip."""
+    run = (q_offset + t_local - 1 >= k_offset) if causal else True
+    if window is not None:
+        # newest visiting key vs oldest in-window position of the
+        # oldest local query
+        run &= q_offset - (k_offset + t_local - 1) < window
+    return run
+
+
 def _ring_perm(ring_size: int) -> list[tuple[int, int]]:
     # device i receives the block of device (i+1) each step, so after
     # `step` hops it holds block (i + step) % S.
@@ -93,7 +111,7 @@ def _ring_perm(ring_size: int) -> list[tuple[int, int]]:
 
 
 def _ring_forward(q, k, v, seg, axis_name, causal, scale, use_flash,
-                  interpret):
+                  interpret, window=None):
     """Forward ring pass. Returns (o [B,Tq,H,D] q.dtype, lse [B,H,Tq]).
 
     ``seg`` is this shard's [B, T/S] segment-id block or None; the
@@ -115,23 +133,33 @@ def _ring_forward(q, k, v, seg, axis_name, causal, scale, use_flash,
     def body(step, carry):
         o, m, l, k_blk, v_blk = carry
         k_idx = (my_idx + step) % ring_size
+        k_offset = k_idx * t_local
         k_seg = (None if seg_all is None else
-                 jax.lax.dynamic_slice_in_dim(seg_all, k_idx * t_local,
+                 jax.lax.dynamic_slice_in_dim(seg_all, k_offset,
                                               t_local, axis=1))
-        if use_flash:
-            # fused pallas kernel for the block compute: scores stay in
-            # VMEM, matmuls on the MXU (ops/flash_attention.py)
-            bq, bk = pick_blocks(q.shape[1], k_blk.shape[1], q.shape[-1])
-            o_blk, m_blk, l_blk = flash_block_attention(
-                q, k_blk, v_blk, q_offset, k_idx * t_local,
-                causal=causal, scale=scale, interpret=interpret,
-                block_q=bq, block_k=bk,
-                q_segments=seg, k_segments=k_seg)
-            o, m, l = merge_flash_stats(o, m, l, o_blk, m_blk, l_blk)
-        else:
-            o, m, l = _block_update(q, k_blk, v_blk, o, m, l, q_offset,
-                                    k_idx * t_local, causal, scale,
-                                    seg, k_seg)
+
+        def compute(carry):
+            o, m, l = carry
+            if use_flash:
+                # fused pallas kernel for the block compute: scores
+                # stay in VMEM, matmuls on the MXU
+                # (ops/flash_attention.py)
+                bq, bk = pick_blocks(q.shape[1], k_blk.shape[1],
+                                     q.shape[-1])
+                o_blk, m_blk, l_blk = flash_block_attention(
+                    q, k_blk, v_blk, q_offset, k_offset,
+                    causal=causal, scale=scale, interpret=interpret,
+                    block_q=bq, block_k=bk, window=window,
+                    q_segments=seg, k_segments=k_seg)
+                return merge_flash_stats(o, m, l, o_blk, m_blk, l_blk)
+            return _block_update(q, k_blk, v_blk, o, m, l, q_offset,
+                                 k_offset, causal, scale,
+                                 seg, k_seg, window)
+
+        o, m, l = jax.lax.cond(
+            _hop_contributes(q_offset, k_offset, t_local, causal,
+                             window),
+            compute, lambda c: c, (o, m, l))
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return (o, m, l, k_blk, v_blk)
@@ -141,24 +169,24 @@ def _ring_forward(q, k, v, seg, axis_name, causal, scale, use_flash,
     return out.astype(q.dtype), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5))
 def _ring_attention_local(axis_name, causal, scale, use_flash, interpret,
-                          q, k, v, seg):
+                          window, q, k, v, seg):
     """Per-shard body; call inside shard_map with sequence sharded on
     ``axis_name``."""
     return _ring_forward(q, k, v, seg, axis_name, causal, scale,
-                         use_flash, interpret)[0]
+                         use_flash, interpret, window)[0]
 
 
 def _ring_attention_local_fwd(axis_name, causal, scale, use_flash,
-                              interpret, q, k, v, seg):
+                              interpret, window, q, k, v, seg):
     out, lse = _ring_forward(q, k, v, seg, axis_name, causal, scale,
-                             use_flash, interpret)
+                             use_flash, interpret, window)
     return out, (q, k, v, seg, out, lse)
 
 
 def _ring_attention_local_bwd(axis_name, causal, scale, use_flash,
-                              interpret, res, do):
+                              interpret, window, res, do):
     q, k, v, seg, out, lse = res
     ring_size = jax.lax.psum(1, axis_name)
     my_idx = jax.lax.axis_index(axis_name)
@@ -188,11 +216,12 @@ def _ring_attention_local_bwd(axis_name, causal, scale, use_flash,
                 return flash_block_grads(
                     q, k_blk, v_blk, do, delta, lse, q_offset, k_offset,
                     causal=causal, scale=scale, block_q=bq, block_k=bk,
-                    interpret=interpret,
+                    interpret=interpret, window=window,
                     q_segments=seg, k_segments=k_seg)
             return attention_block_grads(q, k_blk, v_blk, do, delta, lse,
                                          q_offset, k_offset, causal,
-                                         scale, q_segments=seg,
+                                         scale, window=window,
+                                         q_segments=seg,
                                          k_segments=k_seg)
 
         def skip(args):
@@ -201,11 +230,13 @@ def _ring_attention_local_bwd(axis_name, causal, scale, use_flash,
                     jnp.zeros(v_blk.shape, jnp.float32))
 
         if causal:
-            # visiting blocks entirely above the diagonal contribute
-            # all-zero grads — skip their five matmuls (the backward
-            # mirror of the forward kernel's `run` fast path)
+            # visiting blocks entirely above the diagonal — or fully
+            # behind the sliding window — contribute all-zero grads:
+            # skip their five matmuls (the backward mirror of the
+            # forward hop skip)
             dq_c, dk_c, dv_c = jax.lax.cond(
-                q_offset + t_local - 1 >= k_offset, block, skip,
+                _hop_contributes(q_offset, k_offset, t_local, causal,
+                                 window), block, skip,
                 (k_blk, v_blk))
         else:
             dq_c, dk_c, dv_c = block((k_blk, v_blk))
@@ -240,7 +271,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
                    batch_axes=("dp", "ep"),
                    head_axis: str | None = "tp",
                    use_flash: bool | None = None,
-                   segment_ids: jax.Array | None = None) -> jax.Array:
+                   segment_ids: jax.Array | None = None,
+                   window: int | None = None) -> jax.Array:
     """Exact attention with sequence sharded over ``axis_name``.
 
     q/k/v: [batch, seq, heads, head_dim] global shapes.  Batch is
@@ -254,8 +286,12 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     CPU workloads).  Fully differentiable either way via the ring
     custom VJP.  ``segment_ids`` [B, T] adds packed-sequence masking
     (the ids are all_gathered per shard; the rotating K/V quartet is
-    unchanged).
+    unchanged); ``window`` adds sliding-window masking (absolute ring
+    offsets make the per-hop mask exact — hops fully behind the
+    window still rotate, they just contribute nothing).
     """
+    if window is not None and (not causal or window < 1):
+        raise ValueError("window requires causal attention and >= 1")
     if scale is None:
         scale = q.shape[-1] ** -0.5
     platform = mesh_platform(mesh)
@@ -264,7 +300,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
     interpret = platform != "tpu"
     return sharded_attention_call(
         functools.partial(_ring_attention_local, axis_name, causal,
-                          scale, use_flash, interpret),
+                          scale, use_flash, interpret, window),
         mesh, batch_axes, axis_name, head_axis, q, k, v, segment_ids)
 
 

@@ -174,14 +174,53 @@ class TestSlidingWindowModel:
                                    np.asarray(full)[:, :8],
                                    atol=2e-5, rtol=2e-5)
 
-    def test_window_with_sp_rejected(self):
-        cfg = dataclasses.replace(SMALL, attention_window=8)
-        mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+    @pytest.mark.parametrize("seq_parallel", ["ring", "ulysses"])
+    def test_window_sharded_equals_unsharded(self, seq_parallel):
+        """Sliding windows compose with both context-parallel
+        strategies (ring masks per hop with absolute offsets; ulysses
+        windows its full-sequence local attention)."""
+        cfg = dataclasses.replace(SMALL, attention_window=8,
+                                  seq_parallel=seq_parallel,
+                                  dtype=jnp.float32)
+        spec = (MeshSpec(dp=2, sp=2, tp=2) if seq_parallel == "ring"
+                else MeshSpec(dp=4, sp=2, tp=1))
+        mesh = make_mesh(spec)
         params = init_params(cfg, jax.random.PRNGKey(0))
-        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, 128)
-        with pytest.raises(NotImplementedError, match="ring"):
-            forward(shard_params(params, cfg, mesh), tokens, cfg,
-                    mesh=mesh)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    128)
+        plain = forward(params, tokens, cfg, mesh=None)
+        sharded = forward(shard_params(params, cfg, mesh), tokens, cfg,
+                          mesh=mesh)
+        np.testing.assert_allclose(np.asarray(plain),
+                                   np.asarray(sharded),
+                                   atol=2e-4, rtol=2e-4)
+
+
+    def test_ring_window_grads_match_reference(self):
+        """Windowed ring gradients equal single-device autodiff (the
+        backward recompute carries the same per-hop window mask)."""
+        from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
+        from k8s_dra_driver_tpu.models import loss_fn
+        mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        cfg = dataclasses.replace(SMALL, max_seq=32,
+                                  attention_window=8,
+                                  dtype=jnp.float32)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        sharded = shard_params(params, cfg, mesh)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    cfg.vocab)
+        g_plain = jax.grad(loss_fn)(params, tokens, cfg, None)
+        g_shard = jax.grad(loss_fn)(sharded, tokens, cfg, mesh)
+        for a, b in zip(jax.tree.leaves(g_plain),
+                        jax.tree.leaves(g_shard)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-4, rtol=3e-4)
+
+
+class TestCapacityMoE:
+    """GShard-style capacity dispatch (moe_dispatch='capacity'):
+    expert FLOPs scale with top_k, and the math equals dense dispatch
+    exactly whenever no token overflows an expert's budget."""
 
 
 class TestPackedSequences:
@@ -268,39 +307,7 @@ class TestPackedSequences:
             losses.append(float(loss))
         assert losses[-1] < losses[0], losses
 
-    def test_window_with_ulysses_sharded_equals_unsharded(self):
-        from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
-        mesh = make_mesh(MeshSpec(dp=4, sp=2, tp=1))
-        cfg = dataclasses.replace(SMALL, max_seq=32,
-                                  seq_parallel="ulysses",
-                                  attention_window=8,
-                                  dtype=jnp.float32)
-        params = init_params(cfg, jax.random.PRNGKey(0))
-        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
-                                    cfg.vocab)
-        plain = forward(params, tokens, cfg, mesh=None)
-        sharded = forward(shard_params(params, cfg, mesh), tokens, cfg,
-                          mesh=mesh)
-        np.testing.assert_allclose(np.asarray(plain),
-                                   np.asarray(sharded),
-                                   atol=2e-4, rtol=2e-4)
 
-    def test_window_with_ring_still_rejected(self):
-        from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
-        mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
-        cfg = dataclasses.replace(SMALL, max_seq=32,
-                                  attention_window=8)
-        params = init_params(cfg, jax.random.PRNGKey(0))
-        tokens = jnp.zeros((4, 32), jnp.int32)
-        with pytest.raises(NotImplementedError, match="ulysses"):
-            forward(shard_params(params, cfg, mesh), tokens, cfg,
-                    mesh=mesh)
-
-
-class TestCapacityMoE:
-    """GShard-style capacity dispatch (moe_dispatch='capacity'):
-    expert FLOPs scale with top_k, and the math equals dense dispatch
-    exactly whenever no token overflows an expert's budget."""
 
     def test_ample_capacity_equals_dense(self):
         cfg_d = dataclasses.replace(SMALL_MOE, dtype=jnp.float32)
