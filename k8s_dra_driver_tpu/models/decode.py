@@ -189,8 +189,10 @@ def forward_with_cache(params: Params, tokens: jax.Array,
     for i, (layer, k_cache, v_cache) in enumerate(
             zip(params["layers"], cache.k, cache.v)):
         h = rms_norm(x, layer["ln1"])
-        q = rotary(ein("btd,dhk->bthk", h, layer["wq"]), positions)
-        k = rotary(ein("btd,dhk->bthk", h, layer["wk"]), positions)
+        q = rotary(ein("btd,dhk->bthk", h, layer["wq"]), positions,
+                   cfg.rope_theta)
+        k = rotary(ein("btd,dhk->bthk", h, layer["wk"]), positions,
+                   cfg.rope_theta)
         v = ein("btd,dhk->bthk", h, layer["wv"])
         ks_cache = vs_cache = None
         if quantized:
@@ -309,16 +311,20 @@ def greedy_generate(params: Params, prompt: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_tokens", "max_seq",
-                                             "top_k"))
+                                             "top_k", "top_p"))
 def sample_generate(params: Params, prompt: jax.Array,
                     cfg: TransformerConfig, n_tokens: int,
                     key: jax.Array, temperature: float = 1.0,
-                    top_k: int = 0,
+                    top_k: int = 0, top_p: float = 0.0,
                     max_seq: int | None = None) -> jax.Array:
-    """Temperature/top-k sampling; same one-scan structure as
+    """Temperature/top-k/top-p sampling; same one-scan structure as
     greedy_generate.  ``top_k=0`` samples the full distribution;
-    ``temperature`` scales logits before softmax (smaller -> closer
-    to greedy)."""
+    ``top_p`` in (0, 1) keeps the smallest prefix of the
+    probability-sorted vocab whose mass reaches p (nucleus sampling;
+    composable with top_k — both filters apply); ``temperature``
+    scales logits before softmax (smaller -> closer to greedy)."""
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
     logits, cache = _validated_prefill(params, prompt, cfg, n_tokens,
                                        max_seq)
 
@@ -328,6 +334,17 @@ def sample_generate(params: Params, prompt: jax.Array,
         if top_k:
             kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
             scaled = jnp.where(scaled >= kth, scaled, -1e30)
+        if top_p and top_p < 1.0:
+            # nucleus: drop tokens outside the smallest prefix of the
+            # sorted distribution with cumulative mass >= p (the top
+            # token always survives: its cumsum term includes itself)
+            srt = jnp.sort(scaled, axis=-1)[..., ::-1]
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = cum - probs < top_p              # [B, V] sorted
+            cutoff = jnp.max(jnp.where(keep, srt, -jnp.inf), axis=-1,
+                             keepdims=True)
+            scaled = jnp.where(scaled >= cutoff, scaled, -1e30)
         return jax.random.categorical(key, scaled, axis=-1)
 
     key, sub = jax.random.split(key)

@@ -102,6 +102,9 @@ class TransformerConfig:
     # a regression at another (tools/int8_decode_v5e.json) — treat it
     # as a capacity lever and measure before claiming speed.
     kv_cache_dtype: str = "model"
+    # RoPE base; raise (e.g. 500000) to stretch rotation wavelengths
+    # for long-context serving beyond the training length.
+    rope_theta: float = 10000.0
 
     def __post_init__(self):
         if self.seq_parallel not in ("ring", "ulysses"):
@@ -229,10 +232,14 @@ def rms_norm(x, weight, eps=1e-6):
     return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * weight
 
 
-def rotary(x, positions):
-    """Rotary position embedding; x [B,T,H,D], positions [T]."""
+def rotary(x, positions, theta: float = 10000.0):
+    """Rotary position embedding; x [B,T,H,D], positions [T].
+
+    ``theta`` is the RoPE base: larger values stretch the rotation
+    wavelengths, the standard knob for extending context beyond the
+    training length (e.g. 500000 for 64k-token serving)."""
     d = x.shape[-1]
-    freqs = 10000.0 ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
     angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
     cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
@@ -245,8 +252,10 @@ def _attention(x, layer, cfg: TransformerConfig, mesh: Mesh | None,
                segment_ids=None):
     b, t, d = x.shape
     positions = jnp.arange(t)
-    q = rotary(ein("btd,dhk->bthk", x, layer["wq"]), positions)
-    k = rotary(ein("btd,dhk->bthk", x, layer["wk"]), positions)
+    q = rotary(ein("btd,dhk->bthk", x, layer["wq"]), positions,
+               cfg.rope_theta)
+    k = rotary(ein("btd,dhk->bthk", x, layer["wk"]), positions,
+               cfg.rope_theta)
     v = ein("btd,dhk->bthk", x, layer["wv"])
     window = cfg.attention_window or None
     if mesh is not None and mesh.shape.get("sp", 1) > 1:

@@ -217,12 +217,6 @@ class TestSlidingWindowModel:
                                        atol=3e-4, rtol=3e-4)
 
 
-class TestCapacityMoE:
-    """GShard-style capacity dispatch (moe_dispatch='capacity'):
-    expert FLOPs scale with top_k, and the math equals dense dispatch
-    exactly whenever no token overflows an expert's budget."""
-
-
 class TestPackedSequences:
     """Segment-id packing at the model level: attention and loss are
     both segment-masked, so a packed row trains exactly like its
@@ -308,6 +302,11 @@ class TestPackedSequences:
         assert losses[-1] < losses[0], losses
 
 
+
+class TestCapacityMoE:
+    """GShard-style capacity dispatch (moe_dispatch='capacity'):
+    expert FLOPs scale with top_k, and the math equals dense dispatch
+    exactly whenever no token overflows an expert's budget."""
 
     def test_ample_capacity_equals_dense(self):
         cfg_d = dataclasses.replace(SMALL_MOE, dtype=jnp.float32)
