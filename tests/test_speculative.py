@@ -95,3 +95,17 @@ def test_cache_bound_validated():
         bad = dataclasses.replace(DRAFT, vocab=128)
         speculative_generate(target, init_params(
             bad, jax.random.PRNGKey(1)), prompt, CFG, bad, 4)
+
+
+def test_quantized_target_still_exact():
+    """Speculation composes with weight-only int8: the quantized
+    target's speculative output equals the quantized target's own
+    greedy output (quantization changes the model, not the
+    speculation guarantee)."""
+    from k8s_dra_driver_tpu.models import quantize_params
+    target, draft, prompt = setup()
+    qtarget = quantize_params(target, CFG)
+    want = greedy_generate(qtarget, prompt, CFG, 12)
+    got, _ = speculative_generate(qtarget, draft, prompt, CFG, DRAFT,
+                                  12, draft_len=3)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
