@@ -1,0 +1,32 @@
+"""bench.py smoke coverage.
+
+The driver runs ``python bench.py`` once per round on real hardware;
+until now nothing in CI executed any of it, so an import error or a
+bed-API drift would only surface in that one end-of-round run.  These
+tests run the HERMETIC tiers (in-process driver bed, gang bed) at a
+reduced round count — the TPU probes stay out (no hardware in CI).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))  # repo root
+sys.path.insert(0, str(Path(__file__).parent))
+
+import bench  # noqa: E402
+
+
+def test_driver_path_hermetic_tier():
+    out = bench.bench_driver_path(rounds=3)
+    assert out["samples"] == 3 * 5            # five BASELINE configs
+    assert out["p50_ms"] > 0
+    assert set(out["per_config_p50_ms"]) == {
+        "exclusive_chip", "timeslice_shared", "coordinated_shared",
+        "core_partition", "slice_2x2"}
+
+
+def test_gang_path_hermetic_tier():
+    out = bench.bench_gang_path(rounds=2)
+    assert out["workers"] == 4
+    assert out["p50_ms"] > 0
+    assert out["samples"] == 2
