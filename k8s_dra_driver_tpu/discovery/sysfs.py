@@ -46,6 +46,20 @@ def _read(path: Path) -> str | None:
         return None
 
 
+def _accel_index(name: str) -> int | None:
+    """Chip index from an accel-class entry name, or None if the entry
+    is not a chip.  Non-numeric suffixes (vendor entries like
+    "accel0_vfio") must be skipped, not raise: a ValueError here would
+    abort whole-tree enumeration and freeze the health probe at its
+    last known state (enumeration and health share this filter)."""
+    if not name.startswith("accel"):
+        return None
+    suffix = name.removeprefix("accel")
+    if not suffix.isdigit() and suffix != "":
+        return None
+    return int(suffix or 0)
+
+
 # Opt-in for reading a tree-carried env contract. Deliberately NOT
 # inferred from the driver root: production runs with --driver-root
 # /host, and a stray host /tpu-env.json must never be able to override
@@ -101,9 +115,9 @@ def sysfs_health(root: Path | str, expected=None) -> dict[int, str]:
     present: set[int] = set()
     if base.is_dir():
         for d in sorted(base.iterdir()):
-            if not d.name.startswith("accel"):
+            idx = _accel_index(d.name)
+            if idx is None:
                 continue
-            idx = int(d.name.removeprefix("accel") or 0)
             present.add(idx)
             if not (root / "dev" / d.name).exists():
                 out[idx] = f"device node /dev/{d.name} missing"
@@ -160,8 +174,9 @@ class SysfsBackend(DiscoveryBackend):
         base = self.root / "sys/class/accel"
         if not base.is_dir():
             return []
-        return sorted((d for d in base.iterdir() if d.name.startswith("accel")),
-                      key=lambda d: int(d.name.removeprefix("accel") or 0))
+        return sorted((d for d in base.iterdir()
+                       if _accel_index(d.name) is not None),
+                      key=lambda d: _accel_index(d.name))
 
     def _generation_for(self, device_dir: Path) -> GenerationSpec | None:
         vendor = _read(device_dir / "vendor")

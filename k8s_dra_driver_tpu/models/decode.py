@@ -337,7 +337,10 @@ def sample_generate(params: Params, prompt: jax.Array,
         if top_p and top_p < 1.0:
             # nucleus: drop tokens outside the smallest prefix of the
             # sorted distribution with cumulative mass >= p (the top
-            # token always survives: its cumsum term includes itself)
+            # token always survives: its cumsum term includes itself).
+            # Ties with the smallest kept logit also survive (standard
+            # implementations share this >= -on-raw-logits behavior);
+            # only exact float ties at the boundary over-keep.
             srt = jnp.sort(scaled, axis=-1)[..., ::-1]
             probs = jax.nn.softmax(srt, axis=-1)
             cum = jnp.cumsum(probs, axis=-1)

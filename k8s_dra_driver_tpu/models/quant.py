@@ -261,7 +261,13 @@ def _use_kernel(m: int) -> bool:
     kernel at every recorded decode shape (e.g. 0.84 vs 1.34 ms/token
     at 660M params).  The kernels stay as the structural guarantee —
     int8-sized HBM traffic by construction — should a future XLA stop
-    fusing."""
+    fusing.
+
+    The env var is read at TRACE time: a jitted caller keeps the
+    executable it was traced with even if ``TPU_QUANT_KERNEL`` changes
+    afterwards (XLA caches the traced program).  Measurements that
+    flip the flag must use a fresh process per setting, as
+    tools/bench_int8.py does."""
     return m <= _KERNEL_MAX_M and bool(os.environ.get(
         "TPU_QUANT_KERNEL"))
 

@@ -1,0 +1,174 @@
+"""Workload-side consumer of the gang rendezvous contract.
+
+The reference's IMEX channel is only *proven* when a workload actually
+opens the channel device node the driver mknod'ed (reference
+cmd/nvidia-dra-plugin/nvlib.go:490-519); until then the injection is
+just env/devfs decoration.  Our analog of "opening the device" is
+standing up the multi-process JAX runtime from the env a gang prepare
+injected (plugin/device_state.py ``_apply_rendezvous``, the
+device_state.go:430-444 analog):
+
+- ``TPU_COORDINATOR_ADDRESS``  host:port of the gang coordinator
+- ``TPU_WORKER_ID``            this process's rank in the gang
+- ``TPU_NUM_WORKERS``          gang size (explicit; hostnames may be
+                               empty when an external coordinator is
+                               configured)
+- ``TPU_WORKER_HOSTNAMES``     comma list, informational
+- ``TPU_RENDEZVOUS_BARRIER_TIMEOUT_S``  init deadline
+- ``TPU_RENDEZVOUS_CHANNEL``   allocated channel id, informational
+
+``initialize()`` parses that contract and calls
+``jax.distributed.initialize`` with it; afterwards ``jax.devices()``
+spans the whole gang and XLA collectives ride the mesh.  ``gang_psum``
+is the canonical liveness check: every worker contributes a value and
+all of them must observe the same global sum, which only happens if
+the cross-process collective actually ran.
+
+Used by tests/test_oop_gang.py (real worker subprocesses consuming a
+real gang prepare's env) and as ``python -m
+k8s_dra_driver_tpu.parallel.rendezvous`` inside workload containers
+(demo/specs/quickstart/slice-test1.yaml does the same dance inline).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+
+
+class ContractError(ValueError):
+    """The injected rendezvous env is missing or inconsistent."""
+
+
+@dataclasses.dataclass(frozen=True)
+class RendezvousSpec:
+    coordinator_address: str          # host:port
+    worker_id: int
+    num_workers: int
+    barrier_timeout_s: int = 600
+    channel: int | None = None
+    topology: str = ""
+
+
+def spec_from_env(env: dict | None = None) -> RendezvousSpec:
+    """Parse the driver-injected contract; fail fast on gaps."""
+    env = dict(os.environ) if env is None else env
+    addr = env.get("TPU_COORDINATOR_ADDRESS", "")
+    if ":" not in addr:
+        raise ContractError(
+            f"TPU_COORDINATOR_ADDRESS missing or not host:port: {addr!r}")
+    try:
+        worker_id = int(env["TPU_WORKER_ID"])
+    except (KeyError, ValueError) as e:
+        raise ContractError(f"TPU_WORKER_ID invalid: {e}") from e
+    n_raw = env.get("TPU_NUM_WORKERS", "")
+    if n_raw:
+        try:
+            num_workers = int(n_raw)
+        except ValueError as e:
+            raise ContractError(f"TPU_NUM_WORKERS invalid: {e}") from e
+    else:
+        hosts = [h for h in
+                 env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h]
+        if not hosts:
+            raise ContractError(
+                "neither TPU_NUM_WORKERS nor TPU_WORKER_HOSTNAMES set")
+        num_workers = len(hosts)
+    if not 0 <= worker_id < num_workers:
+        raise ContractError(
+            f"worker_id {worker_id} out of range for {num_workers}")
+    try:
+        channel = env.get("TPU_RENDEZVOUS_CHANNEL")
+        return RendezvousSpec(
+            coordinator_address=addr,
+            worker_id=worker_id,
+            num_workers=num_workers,
+            barrier_timeout_s=int(
+                env.get("TPU_RENDEZVOUS_BARRIER_TIMEOUT_S", "600")
+                or 600),
+            channel=int(channel) if channel else None,
+            topology=env.get("TPU_TOPOLOGY", ""))
+    except ValueError as e:
+        raise ContractError(f"rendezvous env invalid: {e}") from e
+
+
+def initialize(spec: RendezvousSpec | None = None, *,
+               host_override: str | None = None) -> RendezvousSpec:
+    """``jax.distributed.initialize`` from the injected contract.
+
+    ``host_override`` replaces the host part of the coordinator
+    address — for test beds where gang worker hostnames exist only as
+    Node objects, not resolvable DNS (every process is local).
+    """
+    spec = spec or spec_from_env()
+    addr = spec.coordinator_address
+    if host_override:
+        _, _, port = addr.rpartition(":")
+        addr = f"{host_override}:{port}"
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=addr,
+        num_processes=spec.num_workers,
+        process_id=spec.worker_id,
+        initialization_timeout=spec.barrier_timeout_s)
+    return spec
+
+
+def gang_psum(value: float) -> float:
+    """Cross-process psum over the global mesh; every worker returns
+    the same total = sum of all workers' values."""
+    import jax
+    import jax.numpy as jnp  # noqa: F401  (keeps jit dtype promotion)
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = np.array(jax.devices())
+    mesh = Mesh(devs, ("gang",))
+    n_local = jax.local_device_count()
+    local = np.full((n_local,), np.float32(value))
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("gang")), local)
+    fn = jax.jit(
+        jax.shard_map(lambda a: jax.lax.psum(a, "gang"), mesh=mesh,
+                      in_specs=P("gang"), out_specs=P()),
+        out_shardings=NamedSharding(mesh, P()))
+    out = fn(garr)
+    return float(np.asarray(out.addressable_data(0))[0])
+
+
+def main(argv: list[str] | None = None) -> None:
+    """Consume the contract, run the liveness psum, print one JSON
+    line — the runnable proof a prepared gang pod would execute."""
+    import argparse
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--host-override", default=None)
+    parser.add_argument("--contribute", type=float, default=None,
+                        help="value this worker adds (default: rank+1)")
+    args = parser.parse_args(argv)
+    # Make a JAX_PLATFORMS env request actually stick: a site PJRT
+    # plugin (e.g. a tunneled TPU) can pin jax_platforms at
+    # interpreter start and then *hang* backend init — the config
+    # force is the only reliable override (utils/cpuproc.py story).
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+    spec = spec_from_env()
+    initialize(spec, host_override=args.host_override)
+    import jax
+    value = (args.contribute if args.contribute is not None
+             else float(spec.worker_id + 1))
+    total = gang_psum(value)
+    print(json.dumps({
+        "worker_id": spec.worker_id,
+        "num_workers": spec.num_workers,
+        "global_devices": jax.device_count(),
+        "local_devices": jax.local_device_count(),
+        "contributed": value,
+        "psum": total,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

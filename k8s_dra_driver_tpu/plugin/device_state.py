@@ -339,6 +339,10 @@ class DeviceState:
             coord = sl.coordinator_address or self.topology.hostname
             edits.env["TPU_TOPOLOGY"] = str(sl.topology)
             edits.env["TPU_WORKER_ID"] = str(sl.worker_id)
+            # explicit gang size: hostnames are empty when an external
+            # coordinator address is configured, so consumers
+            # (parallel/rendezvous.py) must not have to infer N
+            edits.env["TPU_NUM_WORKERS"] = str(sl.num_workers)
             edits.env["TPU_WORKER_HOSTNAMES"] = ",".join(
                 f"{sl.slice_id}-w{i}" for i in range(sl.num_workers)) \
                 if not sl.coordinator_address else ""

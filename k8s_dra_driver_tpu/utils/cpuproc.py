@@ -1,0 +1,40 @@
+"""Spawn recipe for CPU-pinned JAX subprocesses.
+
+A wedged tunneled-TPU PJRT plugin *hangs* JAX backend init rather than
+erroring, and a site plugin can pin ``jax_platforms`` at interpreter
+start, so env vars alone cannot keep a child process on the CPU
+backend.  Every harness child that must never touch the tunnel
+(bench.py's CPU-mesh probe, __graft_entry__'s multichip dryrun) shares
+this recipe: env pinned to CPU with an N-device virtual host platform,
+plus a code prelude that forces ``jax_platforms`` through jax.config
+before any backend init.  Fail-fast discipline mirrored from the
+reference's NVML init path, which cannot hang (reference
+cmd/nvidia-dra-plugin/nvlib.go:59-72, root.go:29-45).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+#: Run before anything else in the child: jax.config wins over both
+#: the env and any site plugin's interpreter-start pinning.
+CPU_FORCE_PRELUDE = ("import jax\n"
+                     "jax.config.update('jax_platforms', 'cpu')\n")
+
+
+def cpu_jax_env(n_devices: int, base: dict | None = None) -> dict:
+    """Child env forcing JAX onto ``n_devices`` virtual CPU devices.
+
+    Replaces (never duplicates) any pre-existing
+    ``--xla_force_host_platform_device_count`` so the caller's count
+    wins regardless of the parent's XLA_FLAGS.
+    """
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   env.get("XLA_FLAGS", ""))
+    env["XLA_FLAGS"] = (
+        flags
+        + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    return env

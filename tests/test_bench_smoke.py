@@ -30,3 +30,11 @@ def test_gang_path_hermetic_tier():
     assert out["workers"] == 4
     assert out["p50_ms"] > 0
     assert out["samples"] == 2
+
+
+def test_rendezvous_gang_probe():
+    """The contract→collective probe at reduced width: two real
+    processes consume a real prepare's env and psum across processes."""
+    out = bench.bench_rendezvous_gang(n_workers=2)
+    assert out.get("psum_ok") is True, out
+    assert out["wall_ms"] > 0

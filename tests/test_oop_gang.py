@@ -12,14 +12,22 @@ assertions stay comparable.
 """
 
 import dataclasses
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
 
 import pytest
 
 from k8s_dra_driver_tpu.allocator import allocate_claim
 from k8s_dra_driver_tpu.api import resource
 from k8s_dra_driver_tpu.api.config.v1alpha1 import API_VERSION
+from k8s_dra_driver_tpu.utils.cpuproc import cpu_jax_env
 
 from oopbed import OOPBed
+
+REPO = Path(__file__).parent.parent
 
 N_HOSTS = 4
 
@@ -111,3 +119,58 @@ class TestOutOfProcessGang:
 
         for w in range(N_HOSTS):
             bed.delete_pod(shared, f"slice-a-w{w}")
+
+    def test_rendezvous_env_drives_real_cross_process_collective(
+            self, bed):
+        """The contract CONSUMED, not just asserted (round-3 missing
+        #2): four real worker processes read the env a real gang
+        prepare injected and stand up jax.distributed + a psum across
+        processes — the analog of a workload actually opening the
+        IMEX channel device the driver mknod'ed (reference
+        nvlib.go:490-519).  Each worker contributes rank+1; all four
+        must observe the same global sum, which only a live
+        cross-process collective produces."""
+        bed.await_gang_pool()
+        free = socket.socket()
+        free.bind(("127.0.0.1", 0))
+        port = free.getsockname()[1]
+        free.close()
+        shared = bed.create_claim(claim(
+            "oop-rdv-consume",
+            [req("chan", cls="tpu-rendezvous.google.com")],
+            configs=[{"apiVersion": API_VERSION,
+                      "kind": "RendezvousConfig", "port": port}]))
+        allocate_claim(bed.client, shared)
+
+        workers = []
+        for w in range(N_HOSTS):
+            node = f"slice-a-w{w}"
+            rdv_view = bed.prepare_on(shared, node)
+            env = cpu_jax_env(1)          # 1 CPU device per process
+            env.update(rdv_view.env)
+            assert env["TPU_COORDINATOR_ADDRESS"].endswith(f":{port}")
+            assert env["TPU_NUM_WORKERS"] == str(N_HOSTS)
+            workers.append(subprocess.Popen(
+                [sys.executable, "-m",
+                 "k8s_dra_driver_tpu.parallel.rendezvous",
+                 "--host-override", "127.0.0.1"],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, text=True))
+        try:
+            reports = []
+            for p in workers:
+                out, err = p.communicate(timeout=180)
+                assert p.returncode == 0, err[-2000:]
+                reports.append(json.loads(out.strip().splitlines()[-1]))
+        finally:
+            for p in workers:
+                if p.poll() is None:
+                    p.kill()
+            for w in range(N_HOSTS):
+                bed.delete_pod(shared, f"slice-a-w{w}")
+
+        expected = float(sum(range(1, N_HOSTS + 1)))        # 1+2+3+4
+        assert {r["worker_id"] for r in reports} == set(range(N_HOSTS))
+        assert all(r["psum"] == expected for r in reports), reports
+        assert all(r["global_devices"] == N_HOSTS for r in reports), \
+            reports

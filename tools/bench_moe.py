@@ -68,10 +68,12 @@ def bench_shape(base, batch, seq):
         t, valid = step_time(cfg, tokens, params)
         out[name + "_ms"] = round(t * 1e3, 2)
         out[name + "_valid"] = valid
-    out["capacity_speedup_vs_dense"] = round(
-        out["dense_ms"] / out["capacity_ms"], 2)
-    out["gmm_speedup_vs_dense"] = round(
-        out["dense_ms"] / out["gmm_ms"], 2)
+    # Speedups only when both operands are valid (mirrors bench.py):
+    # a ratio over an invalid timing must not enter the evidence JSON.
+    for name in ("capacity", "gmm"):
+        if out["dense_valid"] and out[name + "_valid"]:
+            out[name + "_speedup_vs_dense"] = round(
+                out["dense_ms"] / out[name + "_ms"], 2)
     return out
 
 
