@@ -231,9 +231,11 @@ def bench_rendezvous_gang(n_workers: int = 4) -> dict:
                 env = cpu_jax_env(1)
                 env.update(view.env)
                 envs.append(env)
-            # bounded by the wall budget so section 2b can't overrun
-            # the contract its own gate enforces
-            wait_s = min(180.0, max(30.0, _remaining() - 20.0))
+            # one absolute deadline across ALL workers (not per-worker:
+            # staggered hangs would multiply it) so section 2b can't
+            # overrun the wall budget its own gate enforces
+            wait_deadline = time.monotonic() + min(
+                180.0, max(30.0, _remaining() - 20.0))
             t0 = time.perf_counter()
             workers = [subprocess.Popen(
                 [sys.executable, "-m",
@@ -242,19 +244,31 @@ def bench_rendezvous_gang(n_workers: int = 4) -> dict:
                 cwd=REPO, env=env, stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE, text=True) for env in envs]
             _CHILDREN.extend(workers)
-            reports = []
-            try:
-                for p in workers:
-                    out, err = p.communicate(timeout=wait_s)
-                    if p.returncode != 0:
-                        return {"error": err.strip()[-300:]}
-                    reports.append(
-                        json.loads(out.strip().splitlines()[-1]))
-            finally:
-                for p in workers:
-                    if p.poll() is None:
-                        p.kill()
+            # Collect every worker before judging: gangs fail
+            # collectively (one crash blocks the rest in the barrier),
+            # and an early return on the first timeout would record a
+            # bystander's error while killing the culprit unread.
+            outcomes = []
+            for p in workers:
+                try:
+                    so, se = p.communicate(timeout=max(
+                        1.0, wait_deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    so, se = p.communicate()
+                    outcomes.append(("timeout", se))
+                    continue
+                outcomes.append((p.returncode, so if p.returncode == 0
+                                 else se))
             wall_ms = (time.perf_counter() - t0) * 1000
+            failed = [(i, rc, txt) for i, (rc, txt) in
+                      enumerate(outcomes) if rc != 0]
+            if failed:
+                i, rc, txt = failed[0]
+                return {"error": f"worker {i} {rc}: "
+                                 f"{txt.strip()[-300:]}"}
+            reports = [json.loads(so.strip().splitlines()[-1])
+                       for _, so in outcomes]
         finally:
             bed.shutdown()
     expected = float(sum(range(1, n_workers + 1)))
@@ -622,16 +636,19 @@ def bench_tpu_compute(timeout_s: float | None = None) -> dict:
             pass
     if proc.poll() is None:
         proc.kill()
-        # keep anything that streamed out while we were between reads
-        while True:
-            try:
-                if not _consume(q.get_nowait()):
-                    break
-            except queue_mod.Empty:
+    # Always drain: finished-probe lines can sit in the queue whether
+    # the child was killed, crashed, or exited right at the deadline —
+    # the contract is that whatever streamed out is kept.
+    while True:
+        try:
+            if not _consume(q.get_nowait()):
                 break
+        except queue_mod.Empty:
+            break
+    if timed_out:
         out["truncated"] = (
-            f"tpu probe child killed at the {timeout_s:.0f}s deadline; "
-            "probes that finished before the kill are kept")
+            f"tpu probe child cut off at the {timeout_s:.0f}s deadline; "
+            "probes that finished before the cutoff are kept")
     elif proc.returncode != 0:
         # A crash (e.g. the PJRT plugin SIGSEGVing in backend init) is
         # not a hang: record it loudly instead of returning an empty

@@ -22,6 +22,50 @@ import pytest  # noqa: E402
 
 from k8s_dra_driver_tpu.discovery import FakeHost  # noqa: E402
 
+# -- slow-test tiering ----------------------------------------------------
+#
+# The full suite takes ~12 min (compile-heavy jax workload tests +
+# real-subprocess tiers); the pre-commit loop runs `-m "not slow"`
+# (<4 min) and CI runs both (round-3 VERDICT weak #8).  Curated from
+# `pytest --durations=60` — regenerate the same way after adding
+# compile-heavy tests.  Whole modules are listed when essentially every
+# test in them is compile- or process-bound; prefixes pick out the
+# heavy tests of otherwise-fast modules.
+
+SLOW_MODULES = {
+    "test_ulysses_attention",    # sharded-grad references, 90s worst
+    "test_workloads",            # sharded-vs-unsharded train steps
+    "test_speculative",          # decode scans per variant
+    "test_model_checkpoint",     # train/restore trajectories
+    "test_oop_plugin",           # real plugin subprocesses
+    "test_oop_gang",             # 4 plugin binaries + controller + jax
+    "test_bench_smoke",          # drives the bench beds end-to-end
+}
+
+SLOW_PREFIXES = (
+    "tests/test_decode.py::test_stepwise_decode_matches_forward",
+    "tests/test_decode.py::test_prefill_matches_forward",
+    "tests/test_decode.py::TestSamplingAndRope::test_top_p_limits_support",
+    "tests/test_quant.py::test_quantized_forward_is_differentiable_in_x",
+    "tests/test_quant.py::test_quantized_logits_track_full_precision",
+    "tests/test_flash_attention.py::TestGroupedQueryAttention",
+    "tests/test_flash_attention.py::test_non_tile_aligned_lengths",
+    "tests/test_flash_attention.py::test_ring_attention_segments",
+    "tests/test_flash_attention.py::test_ring_attention_grads",
+    "tests/test_flash_attention.py::TestSegmentIds::test_grads",
+    "tests/test_gmm.py::TestGmmDispatch::test_equals_dense_dispatch",
+    "tests/test_gmm.py::TestGmmDispatch::test_train_reduces_loss",
+    "tests/test_gmm.py::TestGmmDispatch::test_sharded_mesh_rejected",
+    "tests/test_coordclient.py::TestAlternation",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if (item.module.__name__ in SLOW_MODULES
+                or item.nodeid.startswith(SLOW_PREFIXES)):
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture
 def v5e_host(tmp_path):
