@@ -103,6 +103,9 @@ class Coordinator:
                  enforce: bool = False,
                  hbm_action: str = HBM_ACTION_REPORT,
                  stale_after_s: float = DEFAULT_STALE_AFTER_S,
+                 device_paths: list[str] | None = None,
+                 proc_root: str = "/proc",
+                 holder_scan_every: int = 1,
                  now_ms=lambda: time.time() * 1000.0):
         self.dir = Path(coordination_dir)
         self.duty_cycle_percent = duty_cycle_percent
@@ -123,6 +126,33 @@ class Coordinator:
         # worker name -> pid we SIGTERMed; a re-registration with a NEW
         # pid is a fresh process and gets fresh enforcement.
         self._terminated: dict[str, int] = {}
+        # Device nodes whose holders must be registered workers.
+        # OPT-IN at the library level (None disables the scan) so
+        # in-process Coordinator uses stay hermetic — a default-on
+        # /proc scan would let a unit test on a real TPU host observe
+        # (or under terminate, kill) unrelated holders of the real
+        # /dev/accel*.  The BINARY defaults it on (main() derives
+        # /dev/accel<i> from the visible chips).  proc_root is
+        # overridable for tests.
+        self.device_paths = device_paths or []
+        self.proc_root = proc_root
+        # intruder pid -> /proc starttime when we SIGTERMed it; the
+        # starttime disambiguates kernel pid reuse (a recycled pid is
+        # a fresh process and gets fresh enforcement, like the HBM
+        # path's name->pid map at _terminated)
+        self._intruders_terminated: dict[int, int] = {}
+        # Readlinking every fd on a hostPID node is not free: scan on
+        # every Nth step only (the binary defaults N=5 at 1s polls; the
+        # violation SLO is one *scan* tick).  Sticky between scans so
+        # status.json keeps showing a live violation.
+        self.holder_scan_every = max(1, holder_scan_every)
+        self._steps = 0
+        self._holder_violations: list[dict] = []
+        # pid -> monotonic eviction time: a stale-evicted worker gets a
+        # grace window before its still-open device fd counts as an
+        # intrusion, so eviction stays recoverable (re-register) rather
+        # than escalating straight to SIGTERM
+        self._evicted_at: dict[int, float] = {}
         self.violations: list[dict] = []
         # step()-refreshed caches so enforce_tick (which runs at
         # sub-quantum frequency) does no disk IO of its own.
@@ -195,12 +225,14 @@ class Coordinator:
         """Never leave an evicted worker's pid frozen, and let a future
         re-registration get fresh HBM enforcement."""
         pid = reg.get("pid")
-        if isinstance(pid, int) and pid in self._stopped_pids:
-            try:
-                self._signal_worker(reg, signal.SIGCONT)
-            except (ProcessLookupError, PermissionError):
-                pass
-            self._stopped_pids.discard(pid)
+        if isinstance(pid, int):
+            if pid in self._stopped_pids:
+                try:
+                    self._signal_worker(reg, signal.SIGCONT)
+                except (ProcessLookupError, PermissionError):
+                    pass
+                self._stopped_pids.discard(pid)
+            self._evicted_at[pid] = time.monotonic()
         self._terminated.pop(reg["name"], None)
 
     def step(self) -> bool:
@@ -236,7 +268,11 @@ class Coordinator:
             self.seq += 1
             self._last_schedule = text
             atomic_write(self.dir / SCHEDULE_FILE, text)
-        self.violations = self._check_hbm(workers)
+        if self._steps % self.holder_scan_every == 0:
+            self._holder_violations = self._check_device_holders(workers)
+        self._steps += 1
+        self.violations = self._check_hbm(workers) + \
+            self._holder_violations
         atomic_write(self.dir / STATUS_FILE, json.dumps({
             "pid": os.getpid(),
             "seq": self.seq,
@@ -290,6 +326,127 @@ class Coordinator:
                 except (ProcessLookupError, PermissionError) as e:
                     log.warning("cannot terminate pid %d: %s", pid, e)
         return out
+
+    # -- unregistered device-holder supervision ------------------------
+
+    def _check_device_holders(self, workers: list[dict]) -> list[dict]:
+        """Detect processes holding the claim's device nodes without a
+        registration — the enforcement escape the gate alone leaves
+        open (a pod that skips ``tpu-coordclient exec`` touches the
+        chip invisibly; round-3 weak #3).  The reference cannot be
+        bypassed at this level because compute mode is set in the
+        driver itself (reference cmd/nvidia-dra-plugin/nvlib.go:541-558);
+        our floor is node-level detection: scan ``/proc/*/fd`` for the
+        claim's ``/dev/accel*`` nodes and flag any holder that is
+        neither a registered worker pid nor inside a registered gate's
+        process group.  ``terminate`` + ``--enforce`` SIGTERMs the
+        intruder (once per pid); otherwise it is reported in
+        status.json.  Needs the workload PID namespace (hostPID
+        DaemonSet or in-pod sidecar), like enforce_tick."""
+        # a node without the device nodes has nothing to hold (and the
+        # scan is skipped entirely, keeping chip-less hosts cheap)
+        targets = {str(Path(p).resolve()) for p in self.device_paths
+                   if os.path.exists(p)}
+        if not targets:
+            return []
+        now = time.monotonic()
+        # eviction grace: long enough for the client's next heartbeat
+        # to re-register (HEARTBEAT_INTERVAL_S < stale_after_s)
+        grace_s = max(self.stale_after_s, 1.0)
+        self._evicted_at = {p: t for p, t in self._evicted_at.items()
+                            if now - t < grace_s}
+        # Exempt registered pids AND their process groups: forked
+        # children inherit the device fd (dataloaders, runtime helper
+        # procs) and share the parent's pgid, whether or not the
+        # registration is a gate group leader.
+        pids: set[int] = set(self._evicted_at)
+        pgids: set[int] = set()
+        for reg in workers:
+            pid = reg.get("pid")
+            if isinstance(pid, int) and pid > 1:
+                pids.add(pid)
+                if reg.get("pidIsGroup") is True:
+                    pgids.add(pid)
+                else:
+                    try:
+                        pgids.add(os.getpgid(pid))
+                    except (OSError, ProcessLookupError):
+                        pass
+        out = []
+        try:
+            entries = os.listdir(self.proc_root)
+        except OSError:
+            return []
+        for entry in entries:
+            if not entry.isdigit():
+                continue
+            pid = int(entry)
+            if pid == os.getpid() or pid in pids:
+                continue
+            fd_dir = os.path.join(self.proc_root, entry, "fd")
+            try:
+                fds = os.listdir(fd_dir)
+            except OSError:
+                continue          # exited, or not ours to inspect
+            held: set[str] = set()
+            for fd in fds:
+                try:
+                    tgt = os.readlink(os.path.join(fd_dir, fd))
+                except OSError:
+                    continue
+                if tgt in targets:
+                    held.add(tgt)
+                    if len(held) == len(targets):
+                        break     # nothing more to learn from this pid
+            if not held:
+                continue
+            try:
+                if os.getpgid(pid) in pgids:
+                    continue      # a registered workload's child
+            except (OSError, ProcessLookupError):
+                continue          # raced with exit
+            try:
+                comm = Path(self.proc_root, entry, "comm").read_text(
+                ).strip()
+            except OSError:
+                comm = ""
+            record = {"type": "unregisteredDeviceHolder", "pid": pid,
+                      "comm": comm, "devices": sorted(held),
+                      "action": self.hbm_action}
+            out.append(record)
+            log.warning("unregistered process %d (%s) holds %s",
+                        pid, comm, ",".join(sorted(held)))
+            if self.hbm_action == HBM_ACTION_TERMINATE and self.enforce:
+                start = self._proc_starttime(pid)
+                # terminate once per PROCESS: starttime distinguishes a
+                # recycled pid (fresh process) from one already signaled
+                if self._intruders_terminated.get(pid) != start:
+                    try:
+                        os.kill(pid, signal.SIGTERM)
+                        self._intruders_terminated[pid] = start
+                        log.warning("terminated intruder pid %d", pid)
+                    except (ProcessLookupError, PermissionError) as e:
+                        log.warning("cannot terminate pid %d: %s",
+                                    pid, e)
+        # prune terminate-dedup entries for processes that are gone (or
+        # whose pid was recycled — the starttime check above handles
+        # the race where the recycled pid is also an intruder)
+        self._intruders_terminated = {
+            p: s for p, s in self._intruders_terminated.items()
+            if self._proc_starttime(p) == s}
+        return out
+
+    def _proc_starttime(self, pid: int) -> int | None:
+        """Kernel start time (clock ticks) from /proc/<pid>/stat field
+        22 — the stable identity of a pid across kernel pid reuse.
+        None when the process is gone."""
+        try:
+            stat = Path(self.proc_root, str(pid), "stat").read_text()
+            # comm (field 2) may contain spaces/parens; fields after it
+            # start beyond the LAST ')'
+            return int(stat.rpartition(")")[2].split()[19])
+        except (OSError, ValueError, IndexError):
+            return None
 
     # -- duty-cycle enforcement ---------------------------------------
 
@@ -420,6 +577,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="SIGSTOP/SIGCONT registered worker pids to the "
                         "schedule (requires a shared PID namespace: "
                         "in-pod sidecar or hostPID) [env ENFORCE=true]")
+    p.add_argument("--device-paths",
+                   default=env_default("DEVICE_PATHS", "auto"),
+                   help="csv of device nodes whose holders must be "
+                        "registered workers; 'auto' = /dev/accel<i> "
+                        "for each visible chip, '' disables the scan. "
+                        "Unregistered holders are reported as "
+                        "violations, or SIGTERMed under --enforce "
+                        "with terminate action [env DEVICE_PATHS]")
+    p.add_argument("--holder-scan-every", type=int,
+                   default=env_default("HOLDER_SCAN_EVERY", 5, int),
+                   help="run the /proc device-holder scan on every "
+                        "Nth poll (it readlinks every fd on the node) "
+                        "[env HOLDER_SCAN_EVERY] (default 5)")
     p.add_argument("--hbm-action",
                    choices=[HBM_ACTION_REPORT, HBM_ACTION_TERMINATE],
                    default=env_default("HBM_ACTION", HBM_ACTION_REPORT),
@@ -450,7 +620,12 @@ def main(argv: list[str] | None = None) -> int:
         policy_dir=policy_dir,
         enforce=args.enforce,
         hbm_action=args.hbm_action,
-        stale_after_s=args.stale_after)
+        stale_after_s=args.stale_after,
+        device_paths=(
+            [f"/dev/accel{i}" for i in _parse_chips(args.visible_chips)]
+            if args.device_paths == "auto"
+            else [s for s in args.device_paths.split(",") if s]),
+        holder_scan_every=args.holder_scan_every)
 
     stop = threading.Event()
 

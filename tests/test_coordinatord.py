@@ -222,3 +222,186 @@ class TestRealProcess:
             if proc.poll() is None:
                 proc.kill()
                 proc.wait()
+
+
+class TestUnregisteredDeviceHolders:
+    """The enforcement escape closed at node level (round-3 weak #3):
+    a process holding the claim's device node without a registration is
+    detected within one step — the floor under the opt-in gate, vs the
+    reference's driver-set compute mode that cannot be bypassed
+    (nvlib.go:541-558).  Real processes holding real fds; /proc is the
+    real /proc."""
+
+    @staticmethod
+    def _holder(device, extra=""):
+        """A real process that opens the device node and sleeps."""
+        return subprocess.Popen(
+            [sys.executable, "-c",
+             f"import os, time, sys\n{extra}\n"
+             f"f = open({str(device)!r})\n"
+             "print('open', flush=True)\n"
+             "time.sleep(60)"],
+            stdout=subprocess.PIPE, text=True)
+
+    @staticmethod
+    def _wait_open(proc):
+        assert proc.stdout.readline().strip() == "open"
+
+    def test_intruder_detected_within_one_step(self, tmp_path):
+        device = tmp_path / "dev-accel0"
+        device.write_text("")
+        c = make_coord(tmp_path, device_paths=[str(device)])
+        c.start()
+        intruder = self._holder(device)
+        try:
+            self._wait_open(intruder)
+            c.step()
+            [v] = [v for v in c.violations
+                   if v.get("type") == "unregisteredDeviceHolder"]
+            assert v["pid"] == intruder.pid
+            assert v["devices"] == [str(device)]
+            assert v["action"] == "report"
+            # surfaced through the status file workloads/tests read
+            status = json.loads(
+                (tmp_path / "coord/status.json").read_text())
+            assert v in status["violations"]
+        finally:
+            intruder.kill()
+            intruder.wait()
+
+    def test_registered_worker_is_not_an_intruder(self, tmp_path):
+        device = tmp_path / "dev-accel0"
+        device.write_text("")
+        c = make_coord(tmp_path, device_paths=[str(device)])
+        c.start()
+        holder = self._holder(device)
+        try:
+            self._wait_open(holder)
+            (tmp_path / "coord/ctl/w1.json").write_text(json.dumps(
+                {"pid": holder.pid, "updatedAt": time.time()}))
+            c.step()
+            assert not [v for v in c.violations
+                        if v.get("type") == "unregisteredDeviceHolder"]
+        finally:
+            holder.kill()
+            holder.wait()
+
+    def test_gate_child_in_registered_group_is_not_an_intruder(
+            self, tmp_path):
+        """A registered gate leader's forked child holds the device:
+        covered by the pidIsGroup vouching, same as signal routing."""
+        device = tmp_path / "dev-accel0"
+        device.write_text("")
+        c = make_coord(tmp_path, device_paths=[str(device)])
+        c.start()
+        # leader becomes a session leader (what the gate does), forks a
+        # child; the CHILD opens the device
+        leader = subprocess.Popen(
+            [sys.executable, "-c",
+             "import os, time, sys\n"
+             "os.setsid()\n"
+             "pid = os.fork()\n"
+             "if pid == 0:\n"
+             f"    f = open({str(device)!r})\n"
+             "    print('open', flush=True)\n"
+             "    time.sleep(60)\n"
+             "else:\n"
+             "    time.sleep(60)\n"],
+            stdout=subprocess.PIPE, text=True)
+        try:
+            assert leader.stdout.readline().strip() == "open"
+            (tmp_path / "coord/ctl/gated.json").write_text(json.dumps(
+                {"pid": leader.pid, "pidIsGroup": True,
+                 "updatedAt": time.time()}))
+            c.step()
+            assert not [v for v in c.violations
+                        if v.get("type") == "unregisteredDeviceHolder"]
+        finally:
+            import os as _os
+            try:
+                _os.killpg(leader.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            leader.wait()
+
+    def test_enforce_terminate_kills_the_intruder(self, tmp_path):
+        device = tmp_path / "dev-accel0"
+        device.write_text("")
+        c = make_coord(tmp_path, device_paths=[str(device)],
+                       enforce=True, hbm_action="terminate")
+        c.start()
+        intruder = self._holder(device)
+        try:
+            self._wait_open(intruder)
+            c.step()
+            [v] = [v for v in c.violations
+                   if v.get("type") == "unregisteredDeviceHolder"]
+            assert v["action"] == "terminate"
+            assert intruder.wait(timeout=10) == -signal.SIGTERM
+        finally:
+            if intruder.poll() is None:
+                intruder.kill()
+                intruder.wait()
+
+    def test_forked_child_of_registered_worker_is_not_an_intruder(
+            self, tmp_path):
+        """fd inheritance: a plain (non-gate) registered worker forks;
+        the child holds the inherited device fd and shares the
+        parent's pgid — it must not be flagged, let alone killed."""
+        device = tmp_path / "dev-accel0"
+        device.write_text("")
+        c = make_coord(tmp_path, device_paths=[str(device)])
+        c.start()
+        parent = subprocess.Popen(
+            [sys.executable, "-c",
+             "import os, time\n"
+             f"f = open({str(device)!r})\n"
+             "pid = os.fork()\n"
+             "if pid == 0:\n"
+             "    print('forked', flush=True)\n"
+             "    time.sleep(60)\n"
+             "else:\n"
+             "    time.sleep(60)\n"],
+            stdout=subprocess.PIPE, text=True,
+            start_new_session=True)   # own pgid, like a container init
+        try:
+            assert parent.stdout.readline().strip() == "forked"
+            (tmp_path / "coord/ctl/plain.json").write_text(json.dumps(
+                {"pid": parent.pid, "updatedAt": time.time()}))
+            c.step()
+            assert not [v for v in c.violations
+                        if v.get("type") == "unregisteredDeviceHolder"]
+        finally:
+            import os as _os
+            try:
+                _os.killpg(parent.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            parent.wait()
+
+    def test_stale_evicted_worker_gets_grace_before_intrusion(
+            self, tmp_path):
+        """Eviction must stay recoverable: a worker whose registration
+        went stale (frozen heartbeat thread under enforcement) is not
+        instantly reclassified as an intruder — it has stale_after_s
+        to re-register."""
+        device = tmp_path / "dev-accel0"
+        device.write_text("")
+        c = make_coord(tmp_path, device_paths=[str(device)],
+                       stale_after_s=5.0)
+        c.start()
+        holder = self._holder(device)
+        try:
+            self._wait_open(holder)
+            # registered, but with a heartbeat already 6s old -> the
+            # same step() evicts it; the holder scan must NOT flag it
+            (tmp_path / "coord/ctl/w1.json").write_text(json.dumps(
+                {"pid": holder.pid,
+                 "heartbeatAtMs": c.now_ms() - 6000}))
+            c.step()
+            assert not [v for v in c.violations
+                        if v.get("type") == "unregisteredDeviceHolder"]
+            assert holder.pid in c._evicted_at
+        finally:
+            holder.kill()
+            holder.wait()
