@@ -268,6 +268,13 @@ class Coordinator:
             self.seq += 1
             self._last_schedule = text
             atomic_write(self.dir / SCHEDULE_FILE, text)
+        # prune here, not in the holder scan: with the scan disabled
+        # (or no device nodes present) the grace dict would otherwise
+        # grow by one entry per eviction for the daemon's lifetime
+        now_mono = time.monotonic()
+        grace_s = max(self.stale_after_s, 1.0)
+        self._evicted_at = {p: t for p, t in self._evicted_at.items()
+                            if now_mono - t < grace_s}
         if self._steps % self.holder_scan_every == 0:
             self._holder_violations = self._check_device_holders(workers)
         self._steps += 1
@@ -349,12 +356,6 @@ class Coordinator:
                    if os.path.exists(p)}
         if not targets:
             return []
-        now = time.monotonic()
-        # eviction grace: long enough for the client's next heartbeat
-        # to re-register (HEARTBEAT_INTERVAL_S < stale_after_s)
-        grace_s = max(self.stale_after_s, 1.0)
-        self._evicted_at = {p: t for p, t in self._evicted_at.items()
-                            if now - t < grace_s}
         # Exempt registered pids AND their process groups: forked
         # children inherit the device fd (dataloaders, runtime helper
         # procs) and share the parent's pgid, whether or not the

@@ -49,14 +49,18 @@ H_kv=8 (0.25 ms vs 0.47 ms, 10.5x naive XLA) because the kernel is
 K/V-bandwidth-bound at that shape.
 
 Sliding-window (local) attention: ``window=W`` masks each query to its
-W most recent positions AND skips out-of-window K blocks — compute via
-the ``run`` predicate (forward and backward alike), DMA via clamped
-K/V index maps (skipped steps revisit the boundary block, which the
-pipeline does not re-fetch), so long contexts cost O(T·W) computed
-blocks instead of O(T²/2).  Recorded v5e medians
-(tools/attention_window_v5e.json): 1.15 ms windowed vs 1.40 ms full
-causal at T=8192/W=1024 (~1.2x; tunnel-timing variance on individual
-runs is large — the artifact lists every run).
+W most recent positions and — in the single-device (zero-offset) path
+— runs fwd AND bwd on NARROW grids whose innermost dimension spans
+only the ≤ceil((block+W)/block)+1 blocks the window can touch, with
+index maps translating window-relative to absolute blocks.  Skipped
+blocks get no grid step at all (structurally: T=8192/W=1024 at
+512-blocks runs a 4-step inner grid instead of 16) — replacing the
+predicate-only design whose skipped steps still paid their iteration
+overhead and which measured just 1.2x vs full causal at
+T=8192/W=1024 (tools/attention_window_v5e.json; that artifact
+predates this redesign — the narrow grid's own measured numbers
+replace it when recorded).  Ring-sharded windows keep the hop-level
+skip instead (ops/ring_attention.py).
 
 On non-TPU backends the kernel runs in interpreter mode, so the
 hermetic CPU test suite exercises the exact same code path.
@@ -83,7 +87,8 @@ _K_TILE = 128
 
 def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
                   n_k: int, scale: float, causal: bool, k_valid: int,
-                  window: int | None = None, has_seg: bool = False):
+                  window: int | None = None, has_seg: bool = False,
+                  n_kw: int | None = None):
     """One (batch*head, q-block, k-block) program.
 
     K is a grid dimension so pallas double-buffers the K/V block DMAs
@@ -100,6 +105,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
     ``has_seg``, ``rest`` additionally starts with segment-id refs
     qseg [1, bq, 1] / kseg [1, 1, bk] (int32): queries attend only to
     keys of the same segment (packed-sequence masking).
+
+    ``n_kw`` set means the NARROW window grid: the innermost grid
+    dimension spans only the ≤n_kw K blocks a q-block's sliding window
+    can touch, and grid index j is window-relative — the absolute
+    block index is ``min(lo(i) + j, n_k - 1)`` mirroring the K/V
+    BlockSpec index map, with the (rare) clamped duplicate step masked
+    off.  This is what makes long-context local attention pay O(T·W)
+    *grid steps*, not just O(T·W) computed blocks inside an O(T²)
+    grid (the previous predicate-only design kept the full grid and
+    its per-step pipeline overhead).
     """
     if has_seg:
         qseg_ref, kseg_ref, o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr \
@@ -118,9 +133,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
         m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
 
+    if n_kw is not None:
+        # window-relative -> absolute K block (shared span math keeps
+        # this and the K/V BlockSpec index map identical)
+        i = pl.program_id(1)
+        lo, hi = _window_kv_span(i, bq, block_k, window, n_k)
+        j_abs = jnp.minimum(lo + j, hi)
+        # clamped duplicate steps (lo+j past hi) must not recompute
+        # the boundary block — that would double-count it
+        in_range = lo + j <= hi
+        last = j == n_kw - 1
+    else:
+        j_abs = j
+        in_range = True
+        last = j == n_k - 1
+
     # absolute positions: shard offset + block start + row/col
     q_start = qoff_ref[0, 0] + pl.program_id(1) * bq
-    k_start = koff_ref[0, 0] + j * block_k
+    k_start = koff_ref[0, 0] + j_abs * block_k
 
     # Causal fast path: skip blocks entirely above the diagonal; a
     # sliding window also skips blocks entirely BEHIND it, making
@@ -128,6 +158,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
     run = (q_start + bq - 1 >= k_start) if causal else True
     if window is not None:
         run &= q_start <= k_start + block_k - 1 + (window - 1)
+    run &= in_range
 
     @pl.when(run)
     def _update():
@@ -146,7 +177,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
             if window is not None:
                 mask &= q_pos - k_pos < window
         if padded:
-            k_local = j * block_k + jax.lax.broadcasted_iota(
+            k_local = j_abs * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (bq, block_k), 1)
             valid = k_local < k_valid
             mask = valid if mask is None else (mask & valid)
@@ -169,11 +200,32 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
-    @pl.when(j == n_k - 1)
+    @pl.when(last)
     def _done():
         o_ref[0] = acc_scr[:]
         m_ref[0] = m_scr[:]
         l_ref[0] = l_scr[:]
+
+
+def _window_kv_span(i, bq: int, bk: int, window: int, n_k: int):
+    """[lo, hi] K-block range q-block ``i``'s sliding window touches.
+
+    THE single source of the span math: the kernels' absolute-block
+    recovery and the BlockSpec index maps both call this, so they
+    cannot drift apart (a divergence would silently attend to the
+    wrong K/V block).  Works on ints and traced values alike.
+    """
+    lo = jnp.maximum((i * bq - (window - 1)) // bk, 0)
+    hi = jnp.minimum((i * bq + bq - 1) // bk, n_k - 1)
+    return lo, hi
+
+
+def _window_q_span(j, bq: int, bk: int, window: int, n_q: int):
+    """Transpose of _window_kv_span: q-block range whose window
+    reaches k-block ``j`` (ceil div via the floor-div identity)."""
+    lo = jnp.maximum(-((bq - 1 - j * bk) // bq), 0)
+    hi = jnp.minimum((j * bk + bk + window - 2) // bq, n_q - 1)
+    return lo, hi
 
 
 def _round_up(n: int, k: int) -> int:
@@ -291,26 +343,33 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
     koff = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
 
     n_k = tk_pad // bk
-    grid = (b_ * h, tq_pad // bq, n_k)
+    # Sliding window + static offsets: NARROW the innermost grid to
+    # the ≤n_kw K blocks a q-block's window can touch, with the K/V
+    # index maps translating window-relative j to absolute blocks.
+    # Predicating a full O(T²) grid (`pl.when` / clamped revisits)
+    # skips compute and DMA but still pays every skipped step's grid
+    # iteration + pipeline bookkeeping, which capped the measured win
+    # at ~1.2x; the narrow grid makes skipped blocks cost NOTHING, so
+    # T=8192/W=1024 runs an 8x-smaller inner grid.
+    narrow = (window is not None and isinstance(q_offset, int)
+              and isinstance(k_offset, int)
+              and q_offset == 0 and k_offset == 0)
+    if narrow:
+        # widest span of any q-block's [lo, hi] range (+1 boundary)
+        n_kw = min(n_k, (bq + window - 2) // bk + 2)
+        grid = (b_ * h, tq_pad // bq, n_kw)
+    else:
+        n_kw = None
+        grid = (b_ * h, tq_pad // bq, n_k)
     kernel = functools.partial(_flash_kernel, n_k=n_k, scale=scale,
                                causal=causal, k_valid=tk, window=window,
-                               has_seg=has_seg)
-    # Sliding window + static offsets: clamp the K/V block index to the
-    # q-block's live range, so skipped grid steps revisit the boundary
-    # block and the pipeline elides their DMAs — `pl.when` alone skips
-    # only COMPUTE, and this kernel is K/V-bandwidth-bound.  (The
-    # clamped steps' compute is masked off by `run`, so which block
-    # they fetch is irrelevant to correctness.)
-    clamp = (window is not None and isinstance(q_offset, int)
-             and isinstance(k_offset, int)
-             and q_offset == 0 and k_offset == 0)
+                               has_seg=has_seg, n_kw=n_kw)
 
     def kv_j(i, j):
-        if not clamp:
+        if not narrow:
             return j
-        lo = jnp.maximum((i * bq - (window - 1)) // bk, 0)
-        hi = jnp.minimum((i * bq + bq - 1) // bk, n_k - 1)
-        return jnp.clip(j, lo, hi)
+        lo, hi = _window_kv_span(i, bq, bk, window, n_k)
+        return jnp.minimum(lo + j, hi)
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
@@ -495,9 +554,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          n_k: int, scale: float, causal: bool,
                          k_valid: int | None, block_k: int,
                          window: int | None = None,
-                         has_seg: bool = False):
+                         has_seg: bool = False,
+                         n_kw: int | None = None):
     """grid (bh, i_q, j_k): j_k sequential innermost, dq accumulated in
-    VMEM scratch and written once on the last k step."""
+    VMEM scratch and written once on the last k step.  ``n_kw`` = the
+    narrow window grid (see _flash_kernel): j is window-relative."""
     if has_seg:
         qseg_ref, kseg_ref, dq_ref, dq_scr = rest
     else:
@@ -510,19 +571,31 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
+    if n_kw is not None:
+        i = pl.program_id(1)
+        lo, hi = _window_kv_span(i, bq, bk, window, n_k)
+        j_abs = jnp.minimum(lo + j, hi)
+        in_range = lo + j <= hi
+        last = j == n_kw - 1
+    else:
+        j_abs = j
+        in_range = True
+        last = j == n_k - 1
+
     q_start = qoff_ref[0, 0] + pl.program_id(1) * bq
-    k_start = koff_ref[0, 0] + j * bk
+    k_start = koff_ref[0, 0] + j_abs * bk
     run = (q_start + bq - 1 >= k_start) if causal else True
     if window is not None:
         run &= q_start <= k_start + bk - 1 + (window - 1)
+    run &= in_range
 
     @pl.when(run)
     def _update():
         qf = q_ref[0]
         kf = k_ref[0]
         p = _bwd_common(qf, kf, lse_ref[0][:, :1], scale, causal,
-                        q_start, k_start, bq, bk, k_valid, j, block_k,
-                        window,
+                        q_start, k_start, bq, bk, k_valid, j_abs,
+                        block_k, window,
                         qseg_ref[0] if has_seg else None,
                         kseg_ref[0] if has_seg else None)
         # dp = do v^T;  ds = p * (dp - delta) * scale;  dq += ds k
@@ -534,7 +607,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(kf.dtype), kf, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(j == n_k - 1)
+    @pl.when(last)
     def _done():
         dq_ref[0] = dq_scr[:]
 
@@ -544,9 +617,12 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           n_q: int, scale: float, causal: bool,
                           k_valid: int | None, block_k: int,
                           window: int | None = None,
-                          has_seg: bool = False):
+                          has_seg: bool = False,
+                          n_qw: int | None = None):
     """grid (bh, j_k, i_q): i_q sequential innermost, dk/dv accumulated
-    in VMEM scratch per k-block and written on the last q step."""
+    in VMEM scratch per k-block and written on the last q step.
+    ``n_qw`` = the narrow window grid transposed: i is window-relative
+    over the ≤n_qw q-blocks whose sliding window reaches k-block j."""
     if has_seg:
         qseg_ref, kseg_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
     else:
@@ -561,11 +637,22 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    q_start = qoff_ref[0, 0] + i * bq
+    if n_qw is not None:
+        lo, hi = _window_q_span(j, bq, bk, window, n_q)
+        i_abs = jnp.minimum(lo + i, hi)
+        in_range = lo + i <= hi
+        last = i == n_qw - 1
+    else:
+        i_abs = i
+        in_range = True
+        last = i == n_q - 1
+
+    q_start = qoff_ref[0, 0] + i_abs * bq
     k_start = koff_ref[0, 0] + j * bk
     run = (q_start + bq - 1 >= k_start) if causal else True
     if window is not None:
         run &= q_start <= k_start + bk - 1 + (window - 1)
+    run &= in_range
 
     @pl.when(run)
     def _update():
@@ -589,7 +676,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds.astype(qf.dtype), qf, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    @pl.when(i == n_q - 1)
+    @pl.when(last)
     def _done():
         dk_ref[0] = dk_scr[:]
         dv_ref[0] = dv_scr[:]
@@ -597,13 +684,14 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret",
-                                             "window"))
+                                             "window", "narrow_window"))
 def flash_block_grads(q, k, v, do, delta, lse, q_offset, k_offset, *,
                       causal: bool = True, scale: float | None = None,
                       block_q: int | None = None,
                       block_k: int | None = None,
                       interpret: bool | None = None,
                       window: int | None = None,
+                      narrow_window: bool = False,
                       q_segments=None, k_segments=None):
     """Pallas flash backward against one K/V block.
 
@@ -616,6 +704,11 @@ def flash_block_grads(q, k, v, do, delta, lse, q_offset, k_offset, *,
     contributions which are group-summed outside (an [B,H,Tk,D] f32
     intermediate — same size as dq — rather than serializing grid
     programs onto shared output blocks).
+
+    ``narrow_window=True`` (static; caller-asserted q_offset ==
+    k_offset == 0, i.e. the single-device non-ring path) runs both
+    kernels on the narrow window grids — O(T·W) grid steps like the
+    forward — instead of predicating the full O(T²) grids.
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -664,10 +757,23 @@ def flash_block_grads(q, k, v, do, delta, lse, q_offset, k_offset, *,
     koff = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
     k_valid = tk if tk_pad != tk else None
     n_q, n_k = tq_pad // bq, tk_pad // bk
+    narrow = narrow_window and window is not None
+    if narrow:
+        n_kw = min(n_k, (bq + window - 2) // bk + 2)
+        n_qw = min(n_q, (bk + window - 2) // bq + 2)
+    else:
+        n_kw = n_qw = None
+
+    def kv_j(i, j):
+        """window-relative j -> absolute K block (shared span math)."""
+        if not narrow:
+            return j
+        lo, hi = _window_kv_span(i, bq, bk, window, n_k)
+        return jnp.minimum(lo + j, hi)
 
     q_spec_i = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
     k_spec_j = pl.BlockSpec((1, bk, d),
-                            lambda bh, i, j: (kv_of(bh), j, 0))
+                            lambda bh, i, j: (kv_of(bh), kv_j(i, j), 0))
     stat_spec_i = pl.BlockSpec((1, bq, 128), lambda bh, i, j: (bh, i, 0))
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
 
@@ -681,15 +787,16 @@ def flash_block_grads(q, k, v, do, delta, lse, q_offset, k_offset, *,
                              tk_pad)[:, None, :]
         dq_in_specs += [
             pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh // h, i, 0)),
-            pl.BlockSpec((1, 1, bk), lambda bh, i, j: (bh // h, 0, j)),
+            pl.BlockSpec((1, 1, bk),
+                         lambda bh, i, j: (bh // h, 0, kv_j(i, j))),
         ]
         dq_inputs += [qseg, kseg]
 
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, n_k=n_k, scale=scale,
                           causal=causal, k_valid=k_valid, block_k=bk,
-                          window=window, has_seg=has_seg),
-        grid=(b_ * h, n_q, n_k),
+                          window=window, has_seg=has_seg, n_kw=n_kw),
+        grid=(b_ * h, n_q, n_kw if narrow else n_k),
         in_specs=dq_in_specs,
         out_specs=[pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((b_ * h, tq_pad, d), jnp.float32)],
@@ -701,24 +808,34 @@ def flash_block_grads(q, k, v, do, delta, lse, q_offset, k_offset, *,
 
     # dkv grid: (bh, j_k, i_q) — q-dim sequential innermost; under GQA
     # the grid stays per-QUERY-head (outputs too), group-summed after
-    q_spec_kv = pl.BlockSpec((1, bq, d), lambda bh, j, i: (bh, i, 0))
+    def q_i(j, i):
+        """window-relative i -> absolute q block (shared span math)."""
+        if not narrow:
+            return i
+        lo, hi = _window_q_span(j, bq, bk, window, n_q)
+        return jnp.minimum(lo + i, hi)
+
+    q_spec_kv = pl.BlockSpec((1, bq, d),
+                             lambda bh, j, i: (bh, q_i(j, i), 0))
     k_spec_kv = pl.BlockSpec((1, bk, d),
                              lambda bh, j, i: (kv_of(bh), j, 0))
-    stat_spec_kv = pl.BlockSpec((1, bq, 128), lambda bh, j, i: (bh, i, 0))
+    stat_spec_kv = pl.BlockSpec((1, bq, 128),
+                                lambda bh, j, i: (bh, q_i(j, i), 0))
     dkv_inputs = [qf, kf, vf, dof, lse_b, delta_b, qoff, koff]
     dkv_in_specs = [q_spec_kv, k_spec_kv, k_spec_kv, q_spec_kv,
                     stat_spec_kv, stat_spec_kv, smem, smem]
     if has_seg:
         dkv_in_specs += [
-            pl.BlockSpec((1, bq, 1), lambda bh, j, i: (bh // h, i, 0)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda bh, j, i: (bh // h, q_i(j, i), 0)),
             pl.BlockSpec((1, 1, bk), lambda bh, j, i: (bh // h, 0, j)),
         ]
         dkv_inputs += [qseg, kseg]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkv_kernel, n_q=n_q, scale=scale,
                           causal=causal, k_valid=k_valid, block_k=bk,
-                          window=window, has_seg=has_seg),
-        grid=(b_ * h, n_k, n_q),
+                          window=window, has_seg=has_seg, n_qw=n_qw),
+        grid=(b_ * h, n_k, n_qw if narrow else n_q),
         in_specs=dkv_in_specs,
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda bh, j, i: (bh, j, 0)),
@@ -830,7 +947,8 @@ def _flash_attention_bwd(causal, scale, interpret, block_q, block_k,
     dq, dk, dv = flash_block_grads(
         q, k, v, do, delta, lse, 0, 0, causal=causal, scale=scale,
         block_q=block_q, block_k=block_k, interpret=interpret,
-        window=window, q_segments=segment_ids, k_segments=segment_ids)
+        window=window, narrow_window=window is not None,
+        q_segments=segment_ids, k_segments=segment_ids)
     # integer primal -> symbolically-zero (float0) cotangent
     dseg = (None if segment_ids is None else
             np.zeros(segment_ids.shape, jax.dtypes.float0))

@@ -388,6 +388,69 @@ class TestSlidingWindow:
         with pytest.raises(ValueError, match="causal"):
             flash_attention(q, k, v, causal=False, window=8)
 
+    def test_narrow_grid_engages_fwd_and_bwd(self):
+        """T/blocks chosen so the narrow window grid is REALLY smaller
+        than the full grid (n_kw=3 < n_k=8, and the transposed dkv
+        narrowing likewise) — the small default shapes above leave the
+        narrow path degenerate, so without this case the j->j_abs
+        remap (and its double-count masking at clamped boundary steps)
+        would only be exercised where it cannot fail."""
+        B, T, H, D, W = 1, 1024, 2, 32, 128
+        bq = bk = 128                   # n_k = 8, n_kw = (128+126)//128+2 = 3
+        q, k, v = (rand((B, T, H, D), i) for i in range(3))
+        wgt = rand((B, T, H, D), 9)
+        out = flash_attention(q, k, v, causal=True, window=W,
+                              block_q=bq, block_k=bk)
+        ref = attention_reference(q, k, v, causal=True, window=W)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+        def loss(attn, **kw):
+            return lambda q, k, v: jnp.sum(
+                attn(q, k, v, causal=True, window=W, **kw) * wgt)
+
+        val, grads = jax.value_and_grad(
+            loss(flash_attention, block_q=bq, block_k=bk),
+            argnums=(0, 1, 2))(q, k, v)
+        val_ref, grads_ref = jax.value_and_grad(
+            loss(attention_reference), argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(val, val_ref, rtol=1e-4)
+        for g, gr, name in zip(grads, grads_ref, "dq dk dv".split()):
+            np.testing.assert_allclose(g, gr, atol=2e-4, rtol=2e-4,
+                                       err_msg=name)
+
+    def test_narrow_grid_with_segments_and_padding(self):
+        """Narrow grid composes with packed-segment masking and a
+        non-tile-aligned length (padded K columns must be masked via
+        the REMAPPED block index) — forward AND backward, since
+        jax.grad through window+segments always takes the narrow bwd
+        with its remapped qseg/kseg BlockSpecs."""
+        B, T, H, D, W = 1, 700, 2, 32, 96
+        q, k, v = (rand((B, T, H, D), i) for i in range(3))
+        wgt = rand((B, T, H, D), 9)
+        seg = jnp.concatenate([jnp.zeros((B, 300), jnp.int32),
+                               jnp.ones((B, T - 300), jnp.int32)], axis=1)
+        out = flash_attention(q, k, v, causal=True, window=W,
+                              block_q=128, block_k=128,
+                              segment_ids=seg)
+        ref = attention_reference(q, k, v, causal=True, window=W,
+                                  segment_ids=seg)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+        def loss(attn, **kw):
+            return lambda q, k, v: jnp.sum(
+                attn(q, k, v, causal=True, window=W,
+                     segment_ids=seg, **kw) * wgt)
+
+        val, grads = jax.value_and_grad(
+            loss(flash_attention, block_q=128, block_k=128),
+            argnums=(0, 1, 2))(q, k, v)
+        val_ref, grads_ref = jax.value_and_grad(
+            loss(attention_reference), argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(val, val_ref, rtol=1e-4)
+        for g, gr, name in zip(grads, grads_ref, "dq dk dv".split()):
+            np.testing.assert_allclose(g, gr, atol=2e-4, rtol=2e-4,
+                                       err_msg=name)
+
 
 def test_reference_rejects_degenerate_window():
     """Reference and kernel must share one window contract: window=0
