@@ -105,6 +105,18 @@ class TransformerConfig:
     # RoPE base; raise (e.g. 500000) to stretch rotation wavelengths
     # for long-context serving beyond the training length.
     rope_theta: float = 10000.0
+    # Pipeline parallelism: split the layer stack into this many stage
+    # groups pipelined over the mesh's "pp" axis with the GPipe
+    # microbatch schedule (parallel/pipeline.py — neighbor-only
+    # ppermute traffic, so stages may span DCN).  1 = off.  Stages run
+    # their layers with the single-device compute path; dp/ep stay
+    # automatic inside the pipeline, so pp composes with data/expert
+    # parallelism but not with sp sequence sharding or the router aux
+    # losses (validated below).
+    pp_stages: int = 1
+    # Microbatches per step under pp (0 = 2*pp_stages, amortizing the
+    # (S-1)/(M+S-1) fill/drain bubble); the global batch must divide.
+    pp_microbatches: int = 0
 
     def __post_init__(self):
         if self.seq_parallel not in ("ring", "ulysses"):
@@ -129,6 +141,18 @@ class TransformerConfig:
             raise ValueError(
                 f"unknown kv_cache_dtype {self.kv_cache_dtype!r}; "
                 "choose 'model' or 'int8'")
+        if self.pp_stages < 1 or self.pp_microbatches < 0:
+            raise ValueError("pp_stages must be >= 1 and "
+                             "pp_microbatches >= 0")
+        if self.pp_stages > 1:
+            if self.n_layers % self.pp_stages:
+                raise ValueError(
+                    f"n_layers {self.n_layers} does not split into "
+                    f"{self.pp_stages} pipeline stages")
+            if self.aux_loss_weight or self.router_z_weight:
+                raise ValueError(
+                    "pp_stages > 1 does not support the router aux "
+                    "losses (stage outputs carry activations only)")
 
     @property
     def kv_heads(self) -> int:
@@ -448,6 +472,48 @@ def _layer_forward(x, layer, cfg: TransformerConfig, mesh: Mesh | None,
         else out
 
 
+def _pipelined_layers(x, layers, cfg: TransformerConfig, mesh: Mesh):
+    """The layer stack as ``pp_stages`` pipelined stage groups.
+
+    Layer params are stacked [S, L/S, ...] at trace time and
+    constrained onto the pp axis; each stage applies its L/S layers
+    with the single-device compute path (dp/ep stay automatic inside
+    the pipeline — jax.shard_map(axis_names={'pp'})).
+
+    Honest limitation: ``param_specs`` stores layers as a list, so
+    params and optimizer state stay replicated across pp and the
+    stack+reshard here re-runs every step — this integration buys the
+    pipelined *compute* schedule (and its DCN-friendly neighbor
+    traffic), not per-stage parameter residency; that needs
+    stage-stacked parameter storage end to end (init/checkpoint),
+    tracked as future work.
+
+    ``cfg.remat`` maps to the pipeline's stage-level checkpoint (the
+    natural granularity: stage inputs are saved, in-stage activations
+    recomputed) — never combined with the per-layer wrap, which would
+    recompute every layer twice.
+    """
+    from ..parallel.pipeline import (pipeline_apply, split_layers,
+                                     stack_stages)
+    lps = split_layers(cfg.n_layers, cfg.pp_stages)
+    stages = [stack_stages(layers[s * lps:(s + 1) * lps])
+              for s in range(cfg.pp_stages)]
+    stacked = jax.lax.with_sharding_constraint(
+        stack_stages(stages), NamedSharding(mesh, P("pp")))
+
+    def stage_fn(stage, x):
+        for i in range(lps):
+            x = _layer_forward(x, jax.tree.map(lambda a, i=i: a[i],
+                                               stage),
+                               cfg=cfg, mesh=None)
+        return x
+
+    return pipeline_apply(
+        stage_fn, stacked, x, mesh=mesh,
+        n_microbatches=cfg.pp_microbatches or 2 * cfg.pp_stages,
+        checkpoint_stages=cfg.remat)
+
+
 def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
             mesh: Mesh | None = None, segment_ids=None,
             return_aux: bool = False):
@@ -462,6 +528,25 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     weights are set.
     """
     x = take_rows(params["embed"], tokens, cfg.dtype)
+    pipelined = cfg.pp_stages > 1 and mesh is not None
+    if pipelined:
+        # (mesh=None stays the sequential reference path for tests)
+        if mesh.shape.get("pp", 1) != cfg.pp_stages:
+            raise ValueError(
+                f"mesh pp axis {mesh.shape.get('pp', 'absent')} != "
+                f"pp_stages {cfg.pp_stages}")
+        if mesh.shape.get("sp", 1) > 1:
+            raise ValueError(
+                "pp_stages > 1 does not compose with sp sequence "
+                "sharding (stages run their layers with the "
+                "single-device path); use sp or pp, not both")
+        if segment_ids is not None or return_aux:
+            raise ValueError(
+                "pp_stages > 1 supports neither segment_ids nor "
+                "return_aux (stage traffic carries activations only)")
+        x = _pipelined_layers(x, params["layers"], cfg, mesh)
+        x = rms_norm(x, params["ln_f"])
+        return ein("btd,dv->btv", x, params["unembed"])
     layer_fn = functools.partial(_layer_forward, cfg=cfg, mesh=mesh,
                                  segment_ids=segment_ids,
                                  with_aux=return_aux)

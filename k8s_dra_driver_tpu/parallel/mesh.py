@@ -15,6 +15,9 @@ Axes convention (logical -> meaning):
   axis for non-MoE tensors, the standard ep-submesh-of-dp layout)
 - ``sp``  — sequence/context parallelism (ring attention over ICI)
 - ``tp``  — tensor parallelism (attention heads / MLP hidden)
+- ``pp``  — pipeline parallelism (layer stages, GPipe microbatch
+  schedule via ``parallel/pipeline.py``; neighbor-only ppermute
+  traffic, so stages may span DCN where the other axes want ICI)
 
 Collectives ride ICI when the mesh axes are laid out so neighbouring
 coordinates are ICI neighbours; `make_mesh` uses jax's device order
@@ -31,7 +34,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-MESH_AXES = ("dp", "ep", "sp", "tp")
+MESH_AXES = ("dp", "ep", "sp", "tp", "pp")
 
 # Batch dimension is sharded over every data-like axis.
 BATCH_AXES = ("dp", "ep")
@@ -43,13 +46,15 @@ class MeshSpec:
     ep: int = 1
     sp: int = 1
     tp: int = 1
+    pp: int = 1
 
     @property
     def num_devices(self) -> int:
-        return self.dp * self.ep * self.sp * self.tp
+        return self.dp * self.ep * self.sp * self.tp * self.pp
 
     def axis_sizes(self) -> dict[str, int]:
-        return {"dp": self.dp, "ep": self.ep, "sp": self.sp, "tp": self.tp}
+        return {"dp": self.dp, "ep": self.ep, "sp": self.sp,
+                "tp": self.tp, "pp": self.pp}
 
     @classmethod
     def infer(cls, n_devices: int) -> "MeshSpec":
@@ -89,7 +94,8 @@ def make_mesh(spec: MeshSpec | None = None,
         raise ValueError(
             f"mesh {spec} wants {spec.num_devices} devices, "
             f"have {len(devices)}")
-    arr = np.asarray(devices).reshape(spec.dp, spec.ep, spec.sp, spec.tp)
+    arr = np.asarray(devices).reshape(spec.dp, spec.ep, spec.sp,
+                                      spec.tp, spec.pp)
     return Mesh(arr, MESH_AXES)
 
 

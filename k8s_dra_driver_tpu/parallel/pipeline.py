@@ -1,0 +1,121 @@
+"""GPipe-style pipeline parallelism over a ``pp`` mesh axis.
+
+The reference has no parallelism stack at all (SURVEY.md §2.3); this
+is part of the beyond-parity workload tier that proves DRA-allocated
+meshes drive real multi-axis training.  TPU-first design notes:
+
+- The schedule is ONE ``lax.scan`` of ``n_microbatches + S - 1``
+  ticks: every tick, each stage applies its layers to its current
+  input and ``ppermute``s the result to its neighbor.  Static shapes,
+  no data-dependent Python control flow — XLA sees a single compiled
+  loop (jit-friendly; the fill/drain bubble is the standard GPipe
+  cost, ``(S-1)/(M+S-1)`` of the ticks).
+- Communication is neighbor-only (stage i -> i+1), so the ``pp`` axis
+  tolerates the slowest links: stages can span hosts over DCN while
+  dp/tp/sp/ep ride ICI inside each stage.
+- Implemented with ``jax.shard_map(..., axis_names={"pp"})``: only the
+  pipeline axis is manual; every other mesh axis stays automatic, so
+  the batch keeps its dp sharding *inside* the pipeline body and the
+  compiler still fuses/shards the per-stage compute.
+- Differentiable by construction: ``ppermute`` transposes to the
+  reverse permute and the scan transposes to the reverse-order
+  backward scan, which IS the backward pipeline schedule — no custom
+  VJP needed.  ``jax.checkpoint`` around the stage body keeps live
+  activation memory at one microbatch per in-flight tick.
+
+Used by ``models/transformer.py`` (``pp_stages`` config) and the
+harness dryrun (``__graft_entry__.dryrun_multichip``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def stack_stages(per_stage_params: list) -> object:
+    """[S] list of identically-structured pytrees -> one pytree whose
+    leaves lead with the stage axis (the layout ``pipeline_apply``
+    shards over ``pp``)."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_apply(stage_fn, stage_params, x, *, mesh: Mesh,
+                   n_microbatches: int, axis: str = "pp",
+                   checkpoint_stages: bool = True):
+    """Run ``x`` through ``S = mesh.shape[axis]`` pipelined stages.
+
+    ``stage_fn(params_slice, x_mb) -> y_mb`` must preserve the
+    microbatch's shape and dtype (a transformer block stack does);
+    ``stage_params`` leaves lead with the stage axis S; ``x`` is
+    batch-leading and its batch must divide into ``n_microbatches``.
+    Returns the final stage's output for the whole batch, in order.
+    """
+    n_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    if n_microbatches < 1:
+        raise ValueError("need at least one microbatch")
+    if batch % n_microbatches:
+        raise ValueError(f"batch {batch} not divisible into "
+                         f"{n_microbatches} microbatches")
+    sizes = {leaf.shape[0] for leaf in jax.tree.leaves(stage_params)}
+    if sizes != {n_stages}:
+        raise ValueError(
+            f"stage_params leaves must lead with the stage axis "
+            f"{n_stages}, got leading sizes {sorted(sizes)}")
+    fn = jax.checkpoint(stage_fn) if checkpoint_stages else stage_fn
+
+    def shard_body(params, x):
+        params = jax.tree.map(lambda a: a[0], params)   # this stage's
+        idx = jax.lax.axis_index(axis)
+        mb = x.reshape(n_microbatches, batch // n_microbatches,
+                       *x.shape[1:])
+        shift = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        def tick(carry, t):
+            recv, outs = carry
+            # stage 0 injects microbatch t (clamped during drain);
+            # later stages consume their neighbor's last send.  The
+            # fill/drain ticks compute on zeros/garbage and are masked
+            # off at emit — the standard bubble, traded for static
+            # shapes and a single fused loop.
+            inject = mb[jnp.minimum(t, n_microbatches - 1)]
+            y = fn(params, jnp.where(idx == 0, inject, recv))
+            send = jax.lax.ppermute(y, axis, shift)
+            emit = jnp.maximum(t - (n_stages - 1), 0)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outs, y.astype(outs.dtype), emit, 0)
+            outs = jnp.where(t >= n_stages - 1, upd, outs)
+            return (send, outs), None
+
+        # initial carry must be typed pp-varying (the tick outputs
+        # are: they depend on axis_index), hence the pcast
+        init = tuple(jax.lax.pcast(z, (axis,), to="varying")
+                     for z in (jnp.zeros_like(mb[0]),
+                               jnp.zeros_like(mb)))
+        (recv, outs), _ = jax.lax.scan(
+            tick, init, jnp.arange(n_microbatches + n_stages - 1))
+        # only the LAST stage's outs are the model output; psum after
+        # zeroing the others replicates it across the pp axis (the
+        # loss/optimizer run outside the pipeline on every shard)
+        outs = jax.lax.psum(
+            jnp.where(idx == n_stages - 1, outs,
+                      jnp.zeros_like(outs)), axis)
+        return outs.reshape(batch, *x.shape[1:])
+
+    return jax.shard_map(
+        shard_body, mesh=mesh,
+        in_specs=(P(axis), P()), out_specs=P(),
+        axis_names={axis})(stage_params, x)
+
+
+def split_layers(n_layers: int, n_stages: int) -> int:
+    """Layers per stage; n_layers must divide evenly."""
+    if n_layers % n_stages:
+        raise ValueError(
+            f"{n_layers} layers do not split into {n_stages} stages")
+    return n_layers // n_stages
+
+
+__all__ = ["pipeline_apply", "stack_stages", "split_layers"]
