@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -112,6 +113,24 @@ def _quantize_rows(x):
     return q, scale
 
 
+def _kernel_cached_attention(q, k_cache, v_cache, pos, t, cfg,
+                             k_scale, v_scale):
+    """int8-cache attention through the pallas flash kernel: the
+    causal trim is the kernel's absolute-position mask (q_offset=pos,
+    k_offset=0 — cache slots beyond the fill line are in the query's
+    future and mask out), GQA rides the kernel's native head routing,
+    and the dequant happens in VMEM (see _cached_attention)."""
+    from ..ops.flash_attention import (flash_block_attention,
+                                       normalize_flash_stats)
+    o, m, l = flash_block_attention(
+        q, k_cache, v_cache, pos, 0,
+        causal=True, scale=cfg.d_head ** -0.5,
+        window=cfg.attention_window or None,
+        k_scale=k_scale[..., 0], v_scale=v_scale[..., 0])
+    out, _ = normalize_flash_stats(o, m, l)
+    return out.astype(q.dtype)
+
+
 def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
                       k_scale=None, v_scale=None):
     """q [B,T,H,D] at absolute positions pos..pos+T-1 against the full
@@ -129,7 +148,20 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
     154M with int8 weights, a regression at 660M); the structural
     guarantee of the int8 cache is *storage* — twice the
     batch x context per chip.
+
+    ``TPU_KV_KERNEL=1`` (opt-in, read at TRACE time like
+    TPU_QUANT_KERNEL — flipping it later does not retrace cached
+    executables) routes the read through the pallas flash kernel
+    with in-VMEM dequantization (ops/flash_attention.py k_scale/
+    v_scale): HBM then streams int8 bytes by construction, the
+    structural fix for the 660M fusion regression.  Stays opt-in
+    until a recorded artifact shows where it wins — the
+    weight-quant lesson (models/quant.py _use_kernel) was that XLA
+    sometimes beats the hand kernel.
     """
+    if k_scale is not None and os.environ.get("TPU_KV_KERNEL"):
+        return _kernel_cached_attention(q, k_cache, v_cache, pos, t,
+                                        cfg, k_scale, v_scale)
     if k_scale is not None:
         k_cache = (k_cache.astype(jnp.float32)
                    * k_scale).astype(q.dtype)

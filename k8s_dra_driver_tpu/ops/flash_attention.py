@@ -88,7 +88,7 @@ _K_TILE = 128
 def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
                   n_k: int, scale: float, causal: bool, k_valid: int,
                   window: int | None = None, has_seg: bool = False,
-                  n_kw: int | None = None):
+                  n_kw: int | None = None, has_scales: bool = False):
     """One (batch*head, q-block, k-block) program.
 
     K is a grid dimension so pallas double-buffers the K/V block DMAs
@@ -116,12 +116,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
     grid (the previous predicate-only design kept the full grid and
     its per-step pipeline overhead).
     """
+    qseg_ref = kseg_ref = kscale_ref = vscale_ref = None
     if has_seg:
-        qseg_ref, kseg_ref, o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr \
-            = rest
-    else:
-        qseg_ref = kseg_ref = None
-        o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr = rest
+        qseg_ref, kseg_ref, *rest = rest
+    if has_scales:
+        kscale_ref, vscale_ref, *rest = rest
+    o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr = rest
     j = pl.program_id(2)
     bq = q_ref.shape[1]
     block_k = k_ref.shape[1]
@@ -164,8 +164,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
     def _update():
         # MXU inputs stay in the source dtype (bf16 runs at full MXU
         # rate); accumulation is f32 via preferred_element_type.
+        # With per-position scales (int8 KV cache) the dequant happens
+        # HERE, in VMEM — HBM only ever streams the int8 bytes, the
+        # structural guarantee XLA's fusion choice can't undo.
+        k_blk = k_ref[0]
+        if has_scales:
+            k_blk = (k_blk.astype(jnp.float32)
+                     * kscale_ref[0]).astype(q_ref.dtype)
         s = jax.lax.dot_general(
-            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            q_ref[0], k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [bq, bk]
         mask = None
         if causal:
@@ -194,8 +201,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=1, keepdims=True)
+        v_blk = v_ref[0].astype(jnp.float32)
+        if has_scales:
+            v_blk = v_blk * vscale_ref[0]
         acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
@@ -292,7 +302,8 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
                           block_q: int = 512, block_k: int = 512,
                           interpret: bool | None = None,
                           window: int | None = None,
-                          q_segments=None, k_segments=None):
+                          q_segments=None, k_segments=None,
+                          k_scale=None, v_scale=None):
     """Unnormalized flash attention of q against one K/V block.
 
     q: [B, Tq, H, D]; k/v: [B, Tk, H_kv, D] where H is a multiple of
@@ -308,6 +319,14 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
     sequence masking — a query attends only to keys with its segment
     id (composable with causal/window; both must be given together).
 
+    ``k_scale``/``v_scale`` ([B, Tk, H_kv] f32, given together):
+    per-(batch, position, kv-head) symmetric dequant scales for an
+    int8 K/V — the serving int8-KV-cache read path
+    (models/decode.py).  Dequantization happens inside the kernel in
+    VMEM, so HBM streams int8 bytes by construction instead of
+    depending on XLA fusing the read-side dequant (the 660M
+    regression in tools/int8_decode_v5e.json).
+
     Forward-only (no autodiff rule): differentiate through
     ``flash_attention`` / ``ring_attention`` which carry custom VJPs.
     """
@@ -320,7 +339,10 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
     if (q_segments is None) != (k_segments is None):
         raise ValueError("q_segments and k_segments must be given "
                          "together")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("k_scale and v_scale must be given together")
     has_seg = q_segments is not None
+    has_scales = k_scale is not None
 
     b_, tq, h, d = q.shape
     tk = k.shape[1]
@@ -363,7 +385,8 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
         grid = (b_ * h, tq_pad // bq, n_k)
     kernel = functools.partial(_flash_kernel, n_k=n_k, scale=scale,
                                causal=causal, k_valid=tk, window=window,
-                               has_seg=has_seg, n_kw=n_kw)
+                               has_seg=has_seg, n_kw=n_kw,
+                               has_scales=has_scales)
 
     def kv_j(i, j):
         if not narrow:
@@ -394,6 +417,21 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
                          lambda bh, i, j: (bh // h, 0, kv_j(i, j))),
         ]
         inputs += [qseg, kseg]
+    if has_scales:
+        # [B, Tk, H_kv] -> [B*H_kv, Tk_pad, 1], same head routing as
+        # the K/V blocks (padded positions get scale 0 -> zero keys,
+        # already masked by k_valid/causal anyway)
+        def flat_scale(s):
+            s = jnp.asarray(s, jnp.float32)
+            s = s.transpose(0, 2, 1).reshape(b_ * h_kv, s.shape[1], 1)
+            if s.shape[1] != tk_pad:
+                s = jnp.pad(s, ((0, 0), (0, tk_pad - s.shape[1]),
+                                (0, 0)))
+            return s
+        scale_spec = pl.BlockSpec(
+            (1, bk, 1), lambda bh, i, j: (kv_of(bh), kv_j(i, j), 0))
+        in_specs += [scale_spec, scale_spec]
+        inputs += [flat_scale(k_scale), flat_scale(v_scale)]
 
     o, m, l = pl.pallas_call(
         kernel,

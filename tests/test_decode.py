@@ -290,6 +290,38 @@ class TestInt8KVCache:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-6, atol=1e-6)
 
+    @pytest.mark.parametrize("gqa,window", [(False, 0), (True, 0),
+                                            (False, 6), (True, 6)])
+    def test_kv_kernel_path_matches_xla_path(self, monkeypatch, gqa,
+                                             window):
+        """TPU_KV_KERNEL=1 routes the int8-cache read through the
+        pallas flash kernel (in-VMEM dequant); its output must match
+        the XLA dequant path bit-for-bit in masking semantics —
+        mid-fill cache (stale garbage beyond pos must mask out), GQA
+        head routing, sliding window."""
+        from k8s_dra_driver_tpu.models.decode import (_cached_attention,
+                                                      _quantize_rows)
+        b, s_len, h, d = 2, 24, 4, 16
+        h_kv = 2 if gqa else h
+        cfg = dataclasses.replace(CFG, n_kv_heads=h_kv if gqa else 0,
+                                  attention_window=window, d_head=d,
+                                  n_heads=h)
+        q = jax.random.normal(jax.random.PRNGKey(0), (b, 1, h, d))
+        k = jax.random.normal(jax.random.PRNGKey(1), (b, s_len, h_kv, d))
+        v = jax.random.normal(jax.random.PRNGKey(2), (b, s_len, h_kv, d))
+        kq, ks = _quantize_rows(k)
+        vq, vs = _quantize_rows(v)
+        # garbage beyond the fill line: must be masked, not attended
+        fill = 13
+        kq = kq.at[:, fill:].set(107)
+        vq = vq.at[:, fill:].set(-93)
+        pos = jnp.int32(fill - 1)
+        want = _cached_attention(q, kq, vq, pos, 1, cfg, ks, vs)
+        monkeypatch.setenv("TPU_KV_KERNEL", "1")
+        got = _cached_attention(q, kq, vq, pos, 1, cfg, ks, vs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_quantize_rows_error_bounded(self):
         from k8s_dra_driver_tpu.models.decode import _quantize_rows
         x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 4, 12))

@@ -546,6 +546,17 @@ class TestPipelineParallelModel:
         with pytest.raises(ValueError, match="pp axis"):
             forward(params, jnp.zeros((4, 32), jnp.int32), cfg, mesh)
 
+    def test_gmm_with_pp_rejected(self):
+        """The real mesh flows into the pp stage body, so the gmm
+        single-device guard fires instead of the kernel silently
+        running inside a sharded program."""
+        cfg = dataclasses.replace(SMALL_MOE, pp_stages=2,
+                                  moe_dispatch="gmm")
+        mesh = make_mesh(MeshSpec(dp=4, pp=2))
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        with pytest.raises(NotImplementedError, match="gmm"):
+            forward(params, jnp.zeros((4, 32), jnp.int32), cfg, mesh)
+
     def test_sp_with_pp_rejected(self):
         """pp stages run the single-device layer path; an sp>1 mesh
         would silently lose its sequence sharding — reject it."""

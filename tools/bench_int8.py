@@ -37,10 +37,11 @@ SHAPES = {
 
 
 def measure(shape: dict, int8: bool, kernel: bool = False,
-            reps: int = 2, kv_int8: bool = False) -> dict:
+            reps: int = 2, kv_int8: bool = False,
+            kv_kernel: bool = False) -> dict:
     """Each measurement runs in a fresh subprocess: jit caches key on
-    shapes, not on TPU_QUANT_KERNEL, so an in-process comparison
-    would silently reuse one path's executable for both."""
+    shapes, not on TPU_QUANT_KERNEL/TPU_KV_KERNEL, so an in-process
+    comparison would silently reuse one path's executable for both."""
     code = (
         "import json, sys\n"
         "from k8s_dra_driver_tpu.ops.collectives import decode_probe\n"
@@ -52,6 +53,10 @@ def measure(shape: dict, int8: bool, kernel: bool = False,
         env["TPU_QUANT_KERNEL"] = "1"
     else:
         env.pop("TPU_QUANT_KERNEL", None)
+    if kv_kernel:
+        env["TPU_KV_KERNEL"] = "1"
+    else:
+        env.pop("TPU_KV_KERNEL", None)
     proc = subprocess.run(
         [sys.executable, "-c", code], capture_output=True, text=True,
         env=env, cwd=str(pathlib.Path(__file__).resolve().parent.parent))
@@ -90,6 +95,11 @@ def main() -> None:
         "bf16": dict(int8=False),
         "int8_kernel": dict(int8=True, kernel=True),
         "int8_kv8": dict(int8=True, kv_int8=True),
+        # int8 KV read through the pallas flash kernel (in-VMEM
+        # dequant, TPU_KV_KERNEL=1): the structural fix candidate for
+        # the 660M read-side fusion regression
+        "int8_kv8_kernel": dict(int8=True, kv_int8=True,
+                                kv_kernel=True),
         "int8_xla": dict(int8=True),      # the default path
     }
     rounds = 2
@@ -107,7 +117,8 @@ def main() -> None:
                          and better):
                     sec[name] = res
         if sec["bf16"]["valid"]:
-            for name in ("int8_kernel", "int8_kv8", "int8_xla"):
+            for name in ("int8_kernel", "int8_kv8",
+                         "int8_kv8_kernel", "int8_xla"):
                 if sec[name]["valid"]:
                     sec[f"{name}_speedup_vs_bf16"] = round(
                         sec["bf16"]["ms_per_token"]
