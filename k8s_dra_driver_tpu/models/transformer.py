@@ -82,7 +82,10 @@ class TransformerConfig:
     # drops.  Recorded v5e train-step medians
     # (tools/moe_dispatch_v5e.json): capacity 4.25x dense and gmm
     # 2.5x dense at E16/dff4096 — capacity is the fastest measured,
-    # gmm the fastest *exact* (drop-free) option.
+    # gmm the fastest *exact* (drop-free) option.  (That artifact
+    # predates the index-only dispatch rewrite in _moe_mlp_gmm —
+    # float-row scatters replaced by int32-index scatters + row
+    # gathers — and is refreshed at the next hardware window.)
     moe_dispatch: str = "dense"
     capacity_factor: float = 1.25
     # Router auxiliary losses (training-quality guards; 0 disables):
@@ -396,6 +399,17 @@ def _moe_mlp_gmm(x, gates, layer, cfg: TransformerConfig):
     dropped tokens.  Routing (top-k, argsort, scatter/gather, gate
     combine) is plain XLA and differentiates normally; the grouped
     matmuls carry a custom VJP.
+
+    Dispatch traffic note (round-3 weak #6: gmm barely beat dense at
+    E8): in the FORWARD pass the sort/unsort permutations move only
+    int32 ROW INDICES through scatters — ``[m_pad, d]`` activations
+    move through row *gathers* (and the unsort-combine is a
+    [n, k, d] weighted sum) because TPU scatters of wide float rows
+    serialize where gathers pipeline.  Under ``jax.grad`` the
+    gathers' transposes are still scatter-adds (autodiff), so the
+    training-step benefit is bounded by the forward half;
+    tools/moe_dispatch_v5e.json predates this rewrite and is the
+    artifact to refresh before claiming any ratio.
     """
     from ..ops.gmm import gmm
 
@@ -406,7 +420,6 @@ def _moe_mlp_gmm(x, gates, layer, cfg: TransformerConfig):
     gate_vals, expert_ids = jax.lax.top_k(gates.reshape(n, e), k)
     flat_e = expert_ids.reshape(-1)                       # [n*k]
     flat_tok = jnp.repeat(jnp.arange(n), k)
-    flat_gate = gate_vals.reshape(-1).astype(x.dtype)
 
     counts = jnp.zeros((e,), jnp.int32).at[flat_e].add(1)
     padded = ((counts + bm - 1) // bm) * bm               # group sizes
@@ -420,11 +433,21 @@ def _moe_mlp_gmm(x, gates, layer, cfg: TransformerConfig):
 
     m_pad = -(-(n * k) // bm) * bm + e * bm               # static bound
     xf = x.reshape(n, d)
-    x_sorted = jnp.zeros((m_pad, d), x.dtype).at[dest].set(xf[src_tok])
+    # int32 scatters build the row maps; the activations themselves
+    # only ever flow through gathers.  Padding rows point at token 0
+    # and are zero-masked (their compute lands in no token's output
+    # anyway — nothing reads them back).
+    tok_of_row = jnp.zeros((m_pad,), jnp.int32).at[dest].set(src_tok)
+    row_live = jnp.zeros((m_pad, 1), x.dtype).at[dest].set(1)
+    x_sorted = xf[tok_of_row] * row_live
     h = jax.nn.gelu(gmm(x_sorted, layer["w_in"], padded, bm))
     y = gmm(h, layer["w_out"], padded, bm)                # [m_pad, d]
-    contrib = flat_gate[order][:, None] * y[dest]
-    out = jnp.zeros((n, d), y.dtype).at[src_tok].add(contrib)
+    # unsort-combine: token-major view of each token's k expert rows,
+    # weighted by its gates — a gather + small reduction, not a
+    # [n*k, d] scatter-add
+    row_of_slot = jnp.zeros((n * k,), jnp.int32).at[order].set(dest)
+    y_tok = y[row_of_slot].reshape(n, k, d)
+    out = jnp.einsum("nk,nkd->nd", gate_vals.astype(y.dtype), y_tok)
     return out.reshape(b, t, d).astype(x.dtype)
 
 

@@ -296,12 +296,13 @@ def _pad_segments(seg, t_pad: int):
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret",
-                                             "window"))
+                                             "window", "narrow_window"))
 def flash_block_attention(q, k, v, q_offset, k_offset, *,
                           causal: bool = True, scale: float | None = None,
                           block_q: int = 512, block_k: int = 512,
                           interpret: bool | None = None,
                           window: int | None = None,
+                          narrow_window: bool = False,
                           q_segments=None, k_segments=None,
                           k_scale=None, v_scale=None):
     """Unnormalized flash attention of q against one K/V block.
@@ -365,17 +366,23 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
     koff = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
 
     n_k = tk_pad // bk
-    # Sliding window + static offsets: NARROW the innermost grid to
+    # Sliding window + zero offsets: NARROW the innermost grid to
     # the ≤n_kw K blocks a q-block's window can touch, with the K/V
     # index maps translating window-relative j to absolute blocks.
     # Predicating a full O(T²) grid (`pl.when` / clamped revisits)
     # skips compute and DMA but still pays every skipped step's grid
     # iteration + pipeline bookkeeping, which capped the measured win
     # at ~1.2x; the narrow grid makes skipped blocks cost NOTHING, so
-    # T=8192/W=1024 runs an 8x-smaller inner grid.
-    narrow = (window is not None and isinstance(q_offset, int)
-              and isinstance(k_offset, int)
-              and q_offset == 0 and k_offset == 0)
+    # T=8192/W=1024 runs a 4x-smaller inner grid.  The STATIC
+    # ``narrow_window`` flag is how jitted callers opt in (the jit
+    # wrapper makes q_offset a tracer, so the isinstance fallback
+    # below only catches direct eager zero-offset calls — the trap a
+    # round-4 review caught: the narrow grid was unreachable from
+    # flash_attention); setting it asserts zero offsets.
+    narrow = window is not None and (
+        narrow_window
+        or (isinstance(q_offset, int) and isinstance(k_offset, int)
+            and q_offset == 0 and k_offset == 0))
     if narrow:
         # widest span of any q-block's [lo, hi] range (+1 boundary)
         n_kw = min(n_k, (bq + window - 2) // bk + 2)
@@ -955,6 +962,7 @@ def _flash_forward(q, k, v, segment_ids, causal, scale, interpret,
                                     scale=scale, interpret=interpret,
                                     block_q=block_q, block_k=block_k,
                                     window=window,
+                                    narrow_window=window is not None,
                                     q_segments=segment_ids,
                                     k_segments=segment_ids)
     out, lse = normalize_flash_stats(o, m, l)

@@ -316,6 +316,9 @@ class TestInt8KVCache:
         kq = kq.at[:, fill:].set(107)
         vq = vq.at[:, fill:].set(-93)
         pos = jnp.int32(fill - 1)
+        # the reference MUST be the XLA path even if the shell exports
+        # the kernel flag (e.g. after a manual bench_int8 run)
+        monkeypatch.delenv("TPU_KV_KERNEL", raising=False)
         want = _cached_attention(q, kq, vq, pos, 1, cfg, ks, vs)
         monkeypatch.setenv("TPU_KV_KERNEL", "1")
         got = _cached_attention(q, kq, vq, pos, 1, cfg, ks, vs)
