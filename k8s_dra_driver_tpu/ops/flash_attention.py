@@ -294,17 +294,38 @@ def _pad_segments(seg, t_pad: int):
     return jnp.pad(seg, ((0, 0), (0, t_pad - t)), constant_values=-1)
 
 
+def flash_block_attention(q, k, v, q_offset, k_offset, *,
+                          narrow_window: bool = False, **kwargs):
+    """Validating entry for ``_flash_block_attention`` (same
+    signature).  The validation must live OUTSIDE the jit: this
+    wrapper runs while the caller's literal offsets are still Python
+    ints, so narrow_window misuse (nonzero offsets would make the
+    narrow grid skip K blocks the window actually covers — silently
+    wrong softmax) is caught at trace time; inside the jit every
+    offset is a tracer and no check can fire."""
+    if narrow_window and not (
+            isinstance(q_offset, int) and q_offset == 0
+            and isinstance(k_offset, int) and k_offset == 0):
+        raise ValueError(
+            "narrow_window requires literal zero offsets (the narrow "
+            f"grid's span math assumes them); got ({q_offset!r}, "
+            f"{k_offset!r})")
+    return _flash_block_attention(q, k, v, q_offset, k_offset,
+                                  narrow_window=narrow_window, **kwargs)
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret",
                                              "window", "narrow_window"))
-def flash_block_attention(q, k, v, q_offset, k_offset, *,
-                          causal: bool = True, scale: float | None = None,
-                          block_q: int = 512, block_k: int = 512,
-                          interpret: bool | None = None,
-                          window: int | None = None,
-                          narrow_window: bool = False,
-                          q_segments=None, k_segments=None,
-                          k_scale=None, v_scale=None):
+def _flash_block_attention(q, k, v, q_offset, k_offset, *,
+                           causal: bool = True,
+                           scale: float | None = None,
+                           block_q: int = 512, block_k: int = 512,
+                           interpret: bool | None = None,
+                           window: int | None = None,
+                           narrow_window: bool = False,
+                           q_segments=None, k_segments=None,
+                           k_scale=None, v_scale=None):
     """Unnormalized flash attention of q against one K/V block.
 
     q: [B, Tq, H, D]; k/v: [B, Tk, H_kv, D] where H is a multiple of
@@ -373,16 +394,13 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
     # skips compute and DMA but still pays every skipped step's grid
     # iteration + pipeline bookkeeping, which capped the measured win
     # at ~1.2x; the narrow grid makes skipped blocks cost NOTHING, so
-    # T=8192/W=1024 runs a 4x-smaller inner grid.  The STATIC
-    # ``narrow_window`` flag is how jitted callers opt in (the jit
-    # wrapper makes q_offset a tracer, so the isinstance fallback
-    # below only catches direct eager zero-offset calls — the trap a
-    # round-4 review caught: the narrow grid was unreachable from
-    # flash_attention); setting it asserts zero offsets.
-    narrow = window is not None and (
-        narrow_window
-        or (isinstance(q_offset, int) and isinstance(k_offset, int)
-            and q_offset == 0 and k_offset == 0))
+    # T=8192/W=1024 runs a 4x-smaller inner grid.  Engaged ONLY by
+    # the STATIC ``narrow_window`` flag: inside this jit the offsets
+    # are always tracers, so no isinstance fallback can work (the
+    # round-4 trap — the narrow grid was silently unreachable from
+    # flash_attention); the eager wrapper above validates that the
+    # flag comes with literal zero offsets.
+    narrow = window is not None and narrow_window
     if narrow:
         # widest span of any q-block's [lo, hi] range (+1 boundary)
         n_kw = min(n_k, (bq + window - 2) // bk + 2)

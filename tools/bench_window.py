@@ -1,0 +1,91 @@
+"""Record the sliding-window attention artifact
+(tools/attention_window_v5e.json).
+
+Windowed flash vs full causal at the VERDICT target shape
+(T=8192/W=1024) plus supporting shapes, through the narrow-grid
+kernel (ops/flash_attention.py): the innermost grid spans only the
+blocks a window touches, replacing the predicate-only design whose
+recorded win was 1.22x.  Each config runs ``attention_probe`` several
+times (differential-median harness with physical-floor validity,
+ops/collectives.py); the per-config median lands in the artifact with
+every run listed, so tunnel-timing outliers are visible rather than
+silently flattering.
+
+Run on an idle v5e chip from the repo root:
+    python tools/bench_window.py
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import platform
+import statistics
+import subprocess
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+OUT = pathlib.Path(__file__).parent / "attention_window_v5e.json"
+
+#: (batch, seq, heads, window) — None window = full causal baseline
+CONFIGS = [
+    (1, 8192, 8, None),
+    (1, 8192, 8, 1024),      # the VERDICT r03 weak-#5 target shape
+    (1, 8192, 8, 512),
+    (1, 4096, 8, None),
+    (1, 4096, 8, 512),
+    (4, 2048, 8, None),
+    (4, 2048, 8, 512),
+]
+
+
+def main() -> None:
+    import jax
+
+    from k8s_dra_driver_tpu.ops import attention_probe
+
+    rows = []
+    runs_per_config = 3
+    for b, t, h, window in CONFIGS:
+        runs = [attention_probe(batch=b, seq=t, heads=h, iters=16,
+                                window=window)
+                for _ in range(runs_per_config)]
+        # the row IS one actual run — the one at the median flash_ms
+        # over the VALID runs — so every derived field (naive_ms,
+        # speedup, tflops, valid) stays internally consistent and an
+        # invalid (physical-floor-rejected) reading can neither set
+        # the number nor borrow another run's valid flag
+        valid = [r for r in runs if r["valid"]]
+        pool = valid or runs
+        med = statistics.median_low([r["flash_ms"] for r in pool])
+        row = dict(next(r for r in pool if r["flash_ms"] == med))
+        row["flash_ms_runs"] = [
+            {"flash_ms": round(r["flash_ms"], 3), "valid": r["valid"]}
+            for r in runs]
+        rows.append({k: (round(v, 3) if isinstance(v, float) else v)
+                     for k, v in row.items()})
+    by_key = {(r["seq"], r.get("window")): r for r in rows}
+    out = {
+        "what": ("sliding-window flash attention vs full causal, v5e "
+                 "bf16, NARROW-GRID kernel (inner grid spans only the "
+                 "window's blocks), differential-median harness; "
+                 "median of runs per config, all runs listed"),
+        "host": platform.node(),
+        "device": str(jax.devices()[0]),
+        "commit": subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True).stdout.strip(),
+        "rows": rows,
+    }
+    full = by_key.get((8192, None))
+    win = by_key.get((8192, 1024))
+    if full and win and full["valid"] and win["valid"]:
+        out["window_speedup_t8192_w1024"] = round(
+            full["flash_ms"] / win["flash_ms"], 2)
+    OUT.write_text(json.dumps(out, indent=1))
+    print(json.dumps({k: v for k, v in out.items() if k != "rows"}))
+
+
+if __name__ == "__main__":
+    main()
