@@ -1,6 +1,8 @@
 """Workload models (proof-of-function for allocated TPUs)."""
 
 from .checkpoint import TrainCheckpointer
+from .data import (BatchLoader, as_global, load_token_file, local_rows,
+                   write_token_file)
 from .decode import (KVCache, decode_step, greedy_generate, init_cache,
                      prefill, sample_generate)
 from .quant import QTensor, quantize_params, quantized_bytes
@@ -9,8 +11,10 @@ from .transformer import (TransformerConfig, forward, init_params, loss_fn,
                           make_optimizer, make_train_step, param_specs,
                           shard_params)
 
-__all__ = ["KVCache", "QTensor", "TrainCheckpointer", "TransformerConfig",
-           "decode_step", "forward",
+__all__ = ["BatchLoader", "KVCache", "QTensor", "TrainCheckpointer",
+           "TransformerConfig", "as_global",
+           "decode_step", "forward", "load_token_file", "local_rows",
+           "write_token_file",
            "greedy_generate", "init_cache", "init_params", "loss_fn",
            "make_optimizer", "make_train_step", "param_specs", "prefill",
            "quantize_params", "quantized_bytes",

@@ -37,10 +37,15 @@ class TrainCheckpointer:
                 max_to_keep=keep, create=True))
 
     def save(self, step: int, params: Any, opt_state: Any,
-             wait: bool = True) -> None:
+             wait: bool = True, extra: dict | None = None) -> None:
+        """``extra``: small JSON-able sidecar state saved with the
+        step — e.g. the data loader's ``state_dict()`` so a resumed
+        run consumes exactly the batches the interrupted one had not
+        (models/data.py)."""
         self._mgr.save(step, args=ocp.args.Composite(
             params=ocp.args.StandardSave(params),
-            opt_state=ocp.args.StandardSave(opt_state)))
+            opt_state=ocp.args.StandardSave(opt_state),
+            extra=ocp.args.JsonSave(extra or {})))
         if wait:
             self._mgr.wait_until_finished()
 
@@ -69,6 +74,20 @@ class TrainCheckpointer:
             opt_state=ocp.args.StandardRestore(
                 as_abstract(opt_state_like))))
         return out["params"], out["opt_state"], step
+
+    def restore_extra(self, step: int | None = None) -> dict:
+        """The JSON sidecar saved with ``extra=`` (empty dict when the
+        step predates the sidecar)."""
+        step = self._mgr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {self.directory}")
+        try:
+            out = self._mgr.restore(step, args=ocp.args.Composite(
+                extra=ocp.args.JsonRestore()))
+        except (KeyError, ValueError, FileNotFoundError):
+            return {}
+        return out["extra"] or {}
 
     def close(self) -> None:
         self._mgr.close()

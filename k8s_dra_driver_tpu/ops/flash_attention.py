@@ -69,6 +69,7 @@ hermetic CPU test suite exercises the exact same code path.
 from __future__ import annotations
 
 import functools
+import operator
 
 import jax
 import jax.numpy as jnp
@@ -303,13 +304,17 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
     narrow grid skip K blocks the window actually covers — silently
     wrong softmax) is caught at trace time; inside the jit every
     offset is a tracer and no check can fire."""
-    if narrow_window and not (
-            isinstance(q_offset, int) and q_offset == 0
-            and isinstance(k_offset, int) and k_offset == 0):
-        raise ValueError(
-            "narrow_window requires literal zero offsets (the narrow "
-            f"grid's span math assumes them); got ({q_offset!r}, "
-            f"{k_offset!r})")
+    if narrow_window:
+        def _is_zero(off):
+            try:                     # accepts int AND numpy integers;
+                return operator.index(off) == 0   # tracers raise
+            except TypeError:
+                return False
+        if not (_is_zero(q_offset) and _is_zero(k_offset)):
+            raise ValueError(
+                "narrow_window requires literal zero offsets (the "
+                "narrow grid's span math assumes them); got "
+                f"({q_offset!r}, {k_offset!r})")
     return _flash_block_attention(q, k, v, q_offset, k_offset,
                                   narrow_window=narrow_window, **kwargs)
 

@@ -57,6 +57,7 @@ SLOW_PREFIXES = (
     "tests/test_gmm.py::TestGmmDispatch::test_train_reduces_loss",
     "tests/test_gmm.py::TestGmmDispatch::test_sharded_mesh_rejected",
     "tests/test_coordclient.py::TestAlternation",
+    "tests/test_data.py::TestMeshPlacement::test_train_step_consumes",
 )
 
 

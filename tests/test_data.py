@@ -1,0 +1,160 @@
+"""Data pipeline (models/data.py): determinism, exact resume, file
+round-trip, mesh placement, and end-to-end feeding of the sharded
+train step."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from k8s_dra_driver_tpu.models.data import (BatchLoader, as_global,
+                                            load_token_file, local_rows,
+                                            write_token_file)
+from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
+
+
+def corpus(n=4096, vocab=128, seed=7):
+    return np.random.default_rng(seed).integers(0, vocab, n)
+
+
+class TestTokenFile:
+    def test_roundtrip_uint16(self, tmp_path):
+        toks = corpus(vocab=128)
+        path = write_token_file(toks, tmp_path / "c.bin", vocab=128)
+        back = load_token_file(path, vocab=128)
+        assert back.dtype == np.uint16
+        np.testing.assert_array_equal(np.asarray(back), toks)
+
+    def test_roundtrip_uint32_for_large_vocab(self, tmp_path):
+        vocab = 100_000
+        toks = np.array([0, 99_999, 70_000])
+        path = write_token_file(toks, tmp_path / "c.bin", vocab=vocab)
+        back = load_token_file(path, vocab=vocab)
+        assert back.dtype == np.uint32
+        np.testing.assert_array_equal(np.asarray(back), toks)
+
+    def test_out_of_range_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="out of range"):
+            write_token_file([5, 200], tmp_path / "c.bin", vocab=128)
+
+
+class TestBatchLoader:
+    def test_batches_are_static_and_cover_corpus(self):
+        toks = corpus(n=1024)
+        dl = BatchLoader(toks, batch=4, seq_len=32, shuffle=False)
+        seen = []
+        for _ in range(dl.steps_per_epoch):
+            b = next(dl)
+            assert b.shape == (4, 32) and b.dtype == np.int32
+            seen.append(b)
+        # unshuffled epoch = the corpus in window order
+        flat = np.concatenate([b.reshape(-1) for b in seen])
+        np.testing.assert_array_equal(
+            flat, toks[:len(flat)].astype(np.int32))
+
+    def test_epoch_order_is_deterministic_permutation(self):
+        toks = corpus()
+        a = BatchLoader(toks, batch=4, seq_len=32, seed=3)
+        b = BatchLoader(toks, batch=4, seq_len=32, seed=3)
+        np.testing.assert_array_equal(next(a), next(b))
+        o0, o1 = a._epoch_order(0), a._epoch_order(1)
+        assert not np.array_equal(o0, o1)          # reshuffles
+        np.testing.assert_array_equal(np.sort(o1),
+                                      np.arange(a.n_windows))
+
+    def test_resume_reproduces_remaining_batches(self):
+        toks = corpus()
+        dl = BatchLoader(toks, batch=4, seq_len=32, seed=1)
+        for _ in range(5):
+            next(dl)
+        state = dl.state_dict()
+        want = [next(dl) for _ in range(7)]        # crosses an epoch?
+        fresh = BatchLoader(toks, batch=4, seq_len=32, seed=1)
+        fresh.load_state_dict(state)
+        got = [next(fresh) for _ in range(7)]
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+    def test_resume_across_epoch_boundary(self):
+        toks = corpus(n=4 * 32 * 3)                # 3 steps per epoch
+        dl = BatchLoader(toks, batch=4, seq_len=32, seed=2)
+        assert dl.steps_per_epoch == 3
+        for _ in range(3):
+            next(dl)
+        state = dl.state_dict()
+        want = [next(dl) for _ in range(2)]        # epoch-1 batches
+        fresh = BatchLoader(toks, batch=4, seq_len=32, seed=2)
+        fresh.load_state_dict(state)
+        got = [next(fresh) for _ in range(2)]
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+    def test_too_small_corpus_rejected(self):
+        with pytest.raises(ValueError, match="windows"):
+            BatchLoader(corpus(n=64), batch=4, seq_len=32)
+
+
+class TestMeshPlacement:
+    def test_as_global_shards_batch_axes(self):
+        mesh = make_mesh(MeshSpec(dp=2, ep=2, sp=2, tp=1))
+        batch = corpus(n=8 * 32).reshape(8, 32).astype(np.int32)
+        garr = as_global(local_rows(batch), mesh)
+        assert garr.shape == (8, 32)
+        spec = garr.sharding.spec
+        assert spec[0] == ("dp", "ep")
+        np.testing.assert_array_equal(np.asarray(garr), batch)
+
+    def test_train_step_consumes_loader_batches(self, tmp_path):
+        """File -> loader -> as_global -> sharded train step: the loss
+        decreases, proving the pipeline feeds real training."""
+        import dataclasses as dc
+
+        from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                               make_train_step)
+        cfg = TransformerConfig(vocab=128, d_model=64, n_layers=2,
+                                n_heads=4, d_head=16, d_ff=128,
+                                max_seq=32, dtype=jnp.float32)
+        mesh = make_mesh(MeshSpec(dp=2, sp=2, tp=2))
+        # a learnable corpus (periodic motif -> deterministic next
+        # token): fresh shuffled batches every step must still drive
+        # the loss down, unlike i.i.d. noise
+        motif = np.random.default_rng(0).integers(0, 128, 64)
+        path = write_token_file(np.tile(motif, 128),
+                                tmp_path / "c.bin", vocab=128)
+        dl = BatchLoader(load_token_file(path, vocab=128), batch=4,
+                         seq_len=32, seed=0)
+        step, init_state = make_train_step(cfg, mesh)
+        params, opt = init_state(jax.random.PRNGKey(0))
+        losses = []
+        for _ in range(8):
+            tokens = as_global(local_rows(next(dl)), mesh)
+            params, opt, loss = step(params, opt, tokens)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0], losses
+        assert np.isfinite(losses).all()
+
+
+class TestCheckpointIntegration:
+    def test_loader_state_rides_the_train_checkpoint(self, tmp_path):
+        """save(extra=loader.state_dict()) + restore_extra(): the
+        restored loader yields exactly the batches the interrupted
+        run had not consumed."""
+        from k8s_dra_driver_tpu.models import TrainCheckpointer
+        toks = corpus()
+        dl = BatchLoader(toks, batch=4, seq_len=32, seed=5)
+        for _ in range(3):
+            next(dl)
+        ckpt = TrainCheckpointer(tmp_path / "ckpt")
+        params = {"w": jnp.zeros((2, 2))}
+        opt = {"m": jnp.zeros((2, 2))}
+        ckpt.save(3, params, opt, extra={"loader": dl.state_dict()})
+        want = [next(dl) for _ in range(3)]
+
+        fresh = BatchLoader(toks, batch=4, seq_len=32, seed=5)
+        extra = ckpt.restore_extra()
+        fresh.load_state_dict(extra["loader"])
+        got = [next(fresh) for _ in range(3)]
+        ckpt.close()
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
