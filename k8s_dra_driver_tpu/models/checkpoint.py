@@ -76,17 +76,22 @@ class TrainCheckpointer:
         return out["params"], out["opt_state"], step
 
     def restore_extra(self, step: int | None = None) -> dict:
-        """The JSON sidecar saved with ``extra=`` (empty dict when the
-        step predates the sidecar)."""
+        """The JSON sidecar saved with ``extra=``.
+
+        Empty dict ONLY when the step genuinely predates the sidecar
+        (no ``extra`` item on disk); a present-but-unreadable sidecar
+        raises — swallowing it would silently restart the data loader
+        at epoch 0 and re-train on consumed batches, the exact bug
+        the sidecar exists to prevent."""
         step = self._mgr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(
                 f"no checkpoint under {self.directory}")
-        try:
-            out = self._mgr.restore(step, args=ocp.args.Composite(
-                extra=ocp.args.JsonRestore()))
-        except (KeyError, ValueError, FileNotFoundError):
+        step_dir = self._mgr.directory / str(step)
+        if not (step_dir / "extra").exists():
             return {}
+        out = self._mgr.restore(step, args=ocp.args.Composite(
+            extra=ocp.args.JsonRestore()))
         return out["extra"] or {}
 
     def close(self) -> None:

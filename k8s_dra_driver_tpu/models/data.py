@@ -77,6 +77,12 @@ class BatchLoader:
     seq_len: int
     seed: int = 0
     shuffle: bool = True
+    # multi-host striping: this process materializes ONLY its
+    # contiguous batch-row stripe (IO scales with the local stripe,
+    # not the global batch); the (seed, epoch)-deterministic order is
+    # global, so every process agrees on which windows form step s
+    stripe_index: int = 0
+    stripe_count: int = 1
     # resume state (the whole of it)
     epoch: int = 0
     step: int = 0
@@ -89,6 +95,13 @@ class BatchLoader:
             raise ValueError(
                 f"corpus has {self.n_windows} windows of {window} "
                 f"tokens; need at least batch={self.batch}")
+        if not 0 <= self.stripe_index < self.stripe_count:
+            raise ValueError(
+                f"stripe {self.stripe_index}/{self.stripe_count}")
+        if self.batch % self.stripe_count:
+            raise ValueError(
+                f"batch {self.batch} does not stripe over "
+                f"{self.stripe_count} processes")
         self.steps_per_epoch = self.n_windows // self.batch
 
     # -- determinism core ----------------------------------------------
@@ -111,6 +124,11 @@ class BatchLoader:
         order = self._epoch_order(epoch)
         starts = order[step * self.batch:(step + 1) * self.batch] \
             * self.seq_len
+        # contiguous per-process stripe, matching batch_sharding's
+        # device order so as_global reassembles rows in loader order
+        k = self.batch // self.stripe_count
+        starts = starts[self.stripe_index * k:
+                        (self.stripe_index + 1) * k]
         return np.stack([
             np.asarray(self.tokens[s:s + self.seq_len])
             for s in starts]).astype(np.int32)
@@ -139,19 +157,27 @@ class BatchLoader:
 
 
 def local_rows(batch: np.ndarray) -> np.ndarray:
-    """This process's row stripe of a global batch (striping depends
-    only on the process grid, not the mesh shape).
+    """This process's CONTIGUOUS row stripe of a global batch.
 
-    Multi-host gangs (jax.distributed initialized from the DRA
-    rendezvous contract, parallel/rendezvous.py) stripe rows by
-    process index; a single process keeps everything.
+    Contiguous — not strided — because ``batch_sharding`` lays global
+    rows out in device order: process p's addressable shard holds
+    global rows [p*k, (p+1)*k), so a strided stripe would silently
+    permute the assembled global batch (wrong per-row pairing even
+    though a mean loss can't see it).  Prefer constructing the
+    ``BatchLoader`` with ``stripe_index/stripe_count`` so only the
+    stripe is ever materialized; this helper serves already-global
+    arrays.  Multi-host gangs get their process grid from
+    jax.distributed (parallel/rendezvous.py); a single process keeps
+    everything.
     """
     n = jax.process_count()
     if batch.shape[0] % n:
         raise ValueError(
             f"global batch {batch.shape[0]} does not stripe over "
             f"{n} processes")
-    return batch[jax.process_index()::n]
+    k = batch.shape[0] // n
+    p = jax.process_index()
+    return batch[p * k:(p + 1) * k]
 
 
 def as_global(local_batch: np.ndarray, mesh: Mesh) -> jax.Array:

@@ -158,3 +158,45 @@ class TestCheckpointIntegration:
         ckpt.close()
         for g, w in zip(got, want):
             np.testing.assert_array_equal(g, w)
+
+
+class TestStriping:
+    def test_stripes_reassemble_in_loader_order(self):
+        """Contiguous stripes concatenated in stripe order must equal
+        the unsharded loader's batch row-for-row — the property that
+        makes as_global's assembled batch identical to single-host
+        (a strided stripe would silently permute rows)."""
+        toks = corpus()
+        whole = BatchLoader(toks, batch=8, seq_len=32, seed=4)
+        parts = [BatchLoader(toks, batch=8, seq_len=32, seed=4,
+                             stripe_index=i, stripe_count=2)
+                 for i in range(2)]
+        for _ in range(4):
+            want = next(whole)
+            got = np.concatenate([next(p) for p in parts])
+            np.testing.assert_array_equal(got, want)
+
+    def test_bad_stripe_rejected(self):
+        with pytest.raises(ValueError, match="stripe"):
+            BatchLoader(corpus(), batch=8, seq_len=32, stripe_index=2,
+                        stripe_count=2)
+        with pytest.raises(ValueError, match="stripe"):
+            BatchLoader(corpus(), batch=9, seq_len=32, stripe_count=2)
+
+    def test_restore_extra_absent_vs_corrupt(self, tmp_path):
+        """A checkpoint without the sidecar yields {}; a corrupted
+        sidecar raises instead of silently restarting the loader."""
+        import shutil
+
+        import jax.numpy as jnp
+        from k8s_dra_driver_tpu.models import TrainCheckpointer
+        ckpt = TrainCheckpointer(tmp_path / "c")
+        ckpt.save(1, {"w": jnp.zeros(2)}, {"m": jnp.zeros(2)},
+                  extra={"loader": {"epoch": 1, "step": 2}})
+        assert ckpt.restore_extra() == {"loader": {"epoch": 1,
+                                                   "step": 2}}
+        # absent sidecar (pre-sidecar checkpoint layout)
+        extra_dir = tmp_path / "c" / "1" / "extra"
+        shutil.rmtree(extra_dir)
+        assert ckpt.restore_extra() == {}
+        ckpt.close()

@@ -1,0 +1,105 @@
+"""Multi-process TRAINING over the rendezvous contract: two real OS
+processes initialize jax.distributed from driver-shaped env
+(parallel/rendezvous.py), build one global dp mesh, stripe a shared
+corpus with models/data.py, and run the full sharded train step —
+both must observe identical, decreasing losses.  This is the strongest
+multi-host training evidence a single machine can produce: everything
+from the injected env to the optimizer update crosses a real process
+boundary (the round-3 gap was that nothing *consumed* the contract;
+the gang psum test consumed it for one collective — this consumes it
+for the actual workload).
+"""
+
+import json
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+
+from k8s_dra_driver_tpu.utils.cpuproc import cpu_jax_env
+
+REPO = Path(__file__).parent.parent
+
+WORKER = r"""
+import json, os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from k8s_dra_driver_tpu.parallel.rendezvous import initialize
+spec = initialize(host_override="127.0.0.1")
+
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                       make_train_step)
+from k8s_dra_driver_tpu.models.data import BatchLoader, as_global
+from k8s_dra_driver_tpu.parallel.mesh import MESH_AXES
+
+cfg = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_head=8, d_ff=64, max_seq=16,
+                        dtype=jnp.float32)
+devs = np.array(jax.devices())          # 2 global, 1 per process
+mesh = Mesh(devs.reshape(2, 1, 1, 1, 1), MESH_AXES)
+
+# identical corpus + loader state on every worker (seeded), striped
+# rows per process
+motif = np.random.default_rng(0).integers(0, 64, 32)
+dl = BatchLoader(np.tile(motif, 64), batch=4, seq_len=16, seed=1,
+                 stripe_index=jax.process_index(),
+                 stripe_count=jax.process_count())
+
+step, init_state = make_train_step(cfg, mesh)
+params, opt = init_state(jax.random.PRNGKey(0))
+losses = []
+for _ in range(3):
+    tokens = as_global(next(dl), mesh)
+    params, opt, loss = step(params, opt, tokens)
+    losses.append(float(loss))
+print("RESULT " + json.dumps({
+    "worker_id": spec.worker_id,
+    "global_devices": jax.device_count(),
+    "losses": losses,
+}), flush=True)
+"""
+
+
+def test_two_process_dp_training_from_rendezvous_env(tmp_path):
+    free = socket.socket()
+    free.bind(("127.0.0.1", 0))
+    port = free.getsockname()[1]
+    free.close()
+    workers = []
+    for w in range(2):
+        env = cpu_jax_env(1)             # one CPU device per process
+        env.update({
+            "TPU_COORDINATOR_ADDRESS": f"slice-t-w0:{port}",
+            "TPU_WORKER_ID": str(w),
+            "TPU_NUM_WORKERS": "2",
+            "TPU_RENDEZVOUS_BARRIER_TIMEOUT_S": "120",
+        })
+        workers.append(subprocess.Popen(
+            [sys.executable, "-c", WORKER], cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    reports = []
+    try:
+        for p in workers:
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, err[-2000:]
+            line = next(ln for ln in out.splitlines()
+                        if ln.startswith("RESULT "))
+            reports.append(json.loads(line[len("RESULT "):]))
+    finally:
+        for p in workers:
+            if p.poll() is None:
+                p.kill()
+
+    assert {r["worker_id"] for r in reports} == {0, 1}
+    assert all(r["global_devices"] == 2 for r in reports)
+    # SPMD: every process computes the same global loss every step
+    np.testing.assert_allclose(reports[0]["losses"],
+                               reports[1]["losses"], rtol=1e-6)
+    losses = reports[0]["losses"]
+    assert losses[-1] < losses[0], losses
+    assert np.isfinite(losses).all()
