@@ -6,12 +6,14 @@ from .data import (BatchLoader, as_global, load_token_file, local_rows,
 from .decode import (KVCache, decode_step, greedy_generate, init_cache,
                      prefill, sample_generate)
 from .quant import QTensor, quantize_params, quantized_bytes
+from .serving import Finished, Request, ServingEngine
 from .speculative import speculative_generate
 from .transformer import (TransformerConfig, forward, init_params, loss_fn,
                           make_optimizer, make_train_step, param_specs,
                           shard_params)
 
-__all__ = ["BatchLoader", "KVCache", "QTensor", "TrainCheckpointer",
+__all__ = ["BatchLoader", "Finished", "KVCache", "QTensor",
+           "Request", "ServingEngine", "TrainCheckpointer",
            "TransformerConfig", "as_global",
            "decode_step", "forward", "load_token_file", "local_rows",
            "write_token_file",

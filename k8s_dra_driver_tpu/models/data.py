@@ -27,9 +27,11 @@ axes.  TPU-first design notes:
   ``jax.make_array_from_process_local_data`` over the mesh's batch
   sharding (dp×ep, parallel/mesh.py BATCH_AXES) — multi-host gangs
   feed their local rows and get one global array; a single process
-  holds every row and the same call is a device_put.  Each process
-  reads only its own row stripe (``process_index``-strided), so no
-  host ever touches another host's data.
+  holds every row and the same call is a device_put.  Construct the
+  loader with ``stripe_index/stripe_count`` and each process
+  materializes only its own CONTIGUOUS row stripe (contiguous to
+  match the sharding's device order — strided striping would
+  silently permute the assembled batch).
 """
 
 from __future__ import annotations

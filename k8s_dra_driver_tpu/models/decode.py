@@ -159,7 +159,10 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
     weight-quant lesson (models/quant.py _use_kernel) was that XLA
     sometimes beats the hand kernel.
     """
-    if k_scale is not None and os.environ.get("TPU_KV_KERNEL"):
+    if (k_scale is not None and os.environ.get("TPU_KV_KERNEL")
+            and jnp.ndim(pos) == 0):
+        # the kernel takes one scalar q_offset; per-row positions
+        # (continuous batching) use the XLA path
         return _kernel_cached_attention(q, k_cache, v_cache, pos, t,
                                         cfg, k_scale, v_scale)
     if k_scale is not None:
@@ -172,15 +175,21 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
     group = h // h_kv
     scale = cfg.d_head ** -0.5
     key_pos = jnp.arange(k_cache.shape[1])
-    q_pos = pos + jnp.arange(t)
-    mask = key_pos[None, :] <= q_pos[:, None]           # [T, S]
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:
+        q_pos = (pos + jnp.arange(t))[None]             # [1, T] shared
+    else:
+        # per-row positions (continuous batching: every slot at its
+        # own depth, models/serving.py)
+        q_pos = pos[:, None] + jnp.arange(t)[None]      # [B, T]
+    mask = key_pos[None, None, :] <= q_pos[:, :, None]  # [1|B, T, S]
     if cfg.attention_window:
-        mask &= (q_pos[:, None] - key_pos[None, :]) < \
+        mask &= (q_pos[:, :, None] - key_pos[None, None, :]) < \
             cfg.attention_window
     if group == 1:
         scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
                             preferred_element_type=jnp.float32) * scale
-        scores = jnp.where(mask[None, None], scores, -1e30)
+        scores = jnp.where(mask[:, None], scores, -1e30)
         p = jax.nn.softmax(scores, axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", p,
                           v_cache.astype(p.dtype)).astype(q.dtype)
@@ -188,7 +197,7 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
     qg = q.reshape(b, t, h_kv, group, dh)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_cache,
                         preferred_element_type=jnp.float32) * scale
-    scores = jnp.where(mask[None, None, None], scores, -1e30)
+    scores = jnp.where(mask[:, None, None], scores, -1e30)
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v_cache.astype(p.dtype))
     return out.reshape(b, t, h, dh).astype(q.dtype)
@@ -296,6 +305,74 @@ def decode_step(params: Params, token: jax.Array, cfg: TransformerConfig,
     """token [B, 1] -> (logits [B, vocab], cache).  The cache is
     donated so XLA updates it in place."""
     logits, cache = forward_with_cache(params, token, cfg, cache)
+    return logits[:, 0], cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def decode_step_rows(params: Params, token: jax.Array,
+                     cfg: TransformerConfig, cache: KVCache,
+                     pos_rows: jax.Array
+                     ) -> tuple[jax.Array, KVCache]:
+    """One decode step with PER-ROW positions: token [B, 1], pos_rows
+    [B] int32 (each slot's fill depth) -> (logits [B, vocab], cache).
+
+    The continuous-batching primitive (models/serving.py): every cache
+    slot advances independently, so finished sequences can be swapped
+    for queued requests without draining the batch.  ``cache.pos`` is
+    ignored — the caller owns per-slot positions; cache writes land at
+    each row's own offset and attention masks per row.
+    """
+    b, t = token.shape
+    if t != 1:
+        raise ValueError(f"decode_step_rows is one token per slot, "
+                         f"got T={t}")
+    positions = pos_rows[:, None]                        # [B, 1]
+    quantized = cache.k_scale is not None
+    x = take_rows(params["embed"], token, cfg.dtype)
+    new_k, new_v, new_ks, new_vs = [], [], [], []
+
+    def write_rows(dst, new):
+        # per-row dynamic_update_slice at (pos_b, 0, 0)
+        return jax.vmap(
+            lambda c, n, p: jax.lax.dynamic_update_slice(
+                c, n, (p, 0, 0)))(dst, new, pos_rows)
+
+    for i, (layer, k_cache, v_cache) in enumerate(
+            zip(params["layers"], cache.k, cache.v)):
+        h = rms_norm(x, layer["ln1"])
+        q = rotary(ein("btd,dhk->bthk", h, layer["wq"]), positions,
+                   cfg.rope_theta)
+        k = rotary(ein("btd,dhk->bthk", h, layer["wk"]), positions,
+                   cfg.rope_theta)
+        v = ein("btd,dhk->bthk", h, layer["wv"])
+        ks_cache = vs_cache = None
+        if quantized:
+            kq, ks = _quantize_rows(k)
+            vq, vs = _quantize_rows(v)
+            k_cache = write_rows(k_cache, kq)
+            v_cache = write_rows(v_cache, vq)
+            ks_cache = write_rows(cache.k_scale[i], ks)
+            vs_cache = write_rows(cache.v_scale[i], vs)
+            new_ks.append(ks_cache)
+            new_vs.append(vs_cache)
+        else:
+            k_cache = write_rows(k_cache, k)
+            v_cache = write_rows(v_cache, v)
+        new_k.append(k_cache)
+        new_v.append(v_cache)
+        o = _cached_attention(q, k_cache, v_cache, pos_rows, 1, cfg,
+                              ks_cache, vs_cache)
+        x = x + ein("bthk,hkd->btd", o, layer["wo"])
+        mlp_in = rms_norm(x, layer["ln2"])
+        if cfg.is_moe:
+            x = x + _moe_mlp(mlp_in, layer, _serving_cfg(cfg))
+        else:
+            x = x + _dense_mlp(mlp_in, layer)
+    x = rms_norm(x, params["ln_f"])
+    logits = ein("btd,dv->btv", x, params["unembed"])
+    cache = KVCache(k=new_k, v=new_v, pos=cache.pos,
+                    k_scale=new_ks if quantized else None,
+                    v_scale=new_vs if quantized else None)
     return logits[:, 0], cache
 
 

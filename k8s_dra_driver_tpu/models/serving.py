@@ -1,0 +1,196 @@
+"""Continuous-batching serving engine (slot-refill decode).
+
+The serving-throughput feature the static-batch generators cannot
+give: requests of different lengths share one fixed-size decode batch,
+and when a sequence finishes its SLOT is refilled from the queue
+instead of draining the whole batch — decode utilization stays at the
+active-slot count, not the slowest request.  TPU-first mechanics:
+
+- **One compiled decode program.**  Every step is
+  ``decode_step_rows`` (models/decode.py): static ``[slots, 1]``
+  shapes, per-row positions, per-row cache writes — slot occupancy is
+  DATA, so refills never retrace.  (The vLLM-style scheduler without
+  paged attention: cache blocks here are per-slot contiguous, the
+  right trade on TPU where attention reads like dense tiles and
+  dynamic gather/scatter of cache pages is the expensive thing.)
+- **Prefill per request.**  A new request prefills on a fresh [1, L]
+  cache (the flash-kernel path) and its K/V rows are copied into the
+  slot; prompt lengths compile one prefill program each — callers
+  with many distinct lengths should bucket/pad prompts (documented
+  trade; generation results are exact either way).
+- **Greedy decode**, EOS + per-request ``max_new`` + cache-capacity
+  stop conditions; host-side bookkeeping is plain numpy mirrors of
+  slot state (the device only ever sees static shapes).
+
+No reference analog (SURVEY.md §2.3 — the reference has no serving
+stack at all); beyond-parity workload tier alongside speculative
+decoding and the int8 cache.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from collections import deque
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .decode import KVCache, decode_step_rows, init_cache, prefill
+from .transformer import TransformerConfig
+
+
+@dataclasses.dataclass
+class Request:
+    uid: Any
+    prompt: np.ndarray              # [L] int32
+    max_new: int
+    eos_id: int | None = None
+
+
+@dataclasses.dataclass
+class Finished:
+    uid: Any
+    tokens: np.ndarray              # prompt + generated
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _adopt_slot(cache: KVCache, one: KVCache, slot) -> KVCache:
+    """Copy a freshly-prefilled [1, S] cache into row ``slot`` of the
+    engine cache — ONE jitted program with the engine cache donated,
+    so XLA updates the rows in place instead of copying the whole
+    multi-slot cache per layer per refill (slot is a traced scalar:
+    refills never retrace)."""
+    def put(dst, src):
+        return [jax.lax.dynamic_update_index_in_dim(d, s[0], slot, 0)
+                for d, s in zip(dst, src)]
+    return KVCache(
+        k=put(cache.k, one.k), v=put(cache.v, one.v), pos=cache.pos,
+        k_scale=(put(cache.k_scale, one.k_scale)
+                 if cache.k_scale is not None else None),
+        v_scale=(put(cache.v_scale, one.v_scale)
+                 if cache.v_scale is not None else None))
+
+
+class ServingEngine:
+    """Greedy continuous-batching engine over ``slots`` cache rows."""
+
+    def __init__(self, params, cfg: TransformerConfig, slots: int,
+                 max_seq: int | None = None):
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.max_seq = max_seq or cfg.max_seq
+        self.cache = init_cache(cfg, slots, self.max_seq)
+        self.queue: deque[Request] = deque()
+        # host-side slot state; None = free
+        self._req: list[Request | None] = [None] * slots
+        self._pos = np.zeros(slots, np.int32)       # fill depth
+        self._generated: list[list[int]] = [[] for _ in range(slots)]
+        self._last = np.zeros(slots, np.int32)      # next input token
+
+    # -- request intake --------------------------------------------------
+
+    def submit(self, req: Request) -> None:
+        prompt = np.asarray(req.prompt, np.int32)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("prompt must be a non-empty 1-D array")
+        if req.max_new < 1:
+            # same contract as greedy_generate's n_tokens >= 1
+            raise ValueError(f"max_new must be >= 1, got {req.max_new}")
+        if prompt.size + req.max_new > self.max_seq:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new ({req.max_new}) "
+                f"exceeds the {self.max_seq}-slot cache")
+        self.queue.append(dataclasses.replace(req, prompt=prompt))
+
+    @property
+    def active(self) -> int:
+        return sum(r is not None for r in self._req)
+
+    @property
+    def pending(self) -> int:
+        return len(self.queue)
+
+    # -- slot lifecycle --------------------------------------------------
+
+    def _fill_slot(self, slot: int, req: Request) -> None:
+        """Prefill the request on a fresh [1, L] cache and copy its
+        K/V rows into the slot."""
+        one = init_cache(self.cfg, 1, self.max_seq)
+        logits, one = prefill(self.params, req.prompt[None, :],
+                              self.cfg, one)
+        first = int(jnp.argmax(logits[0, -1]))
+        self.cache = _adopt_slot(self.cache, one, jnp.int32(slot))
+        self._req[slot] = req
+        self._pos[slot] = req.prompt.size
+        self._generated[slot] = [first]
+        self._last[slot] = first
+
+    def _finish_slot(self, slot: int, out: list[Finished]) -> None:
+        req = self._req[slot]
+        gen = self._generated[slot]               # eos token kept
+        out.append(Finished(
+            uid=req.uid,
+            tokens=np.concatenate([req.prompt,
+                                   np.asarray(gen, np.int32)])))
+        self._req[slot] = None
+        self._generated[slot] = []
+
+    def _done(self, slot: int) -> bool:
+        req = self._req[slot]
+        gen = self._generated[slot]
+        return (len(gen) >= req.max_new
+                or (req.eos_id is not None and gen
+                    and gen[-1] == req.eos_id)
+                or int(self._pos[slot]) + 1 >= self.max_seq)
+
+    # -- the step loop ---------------------------------------------------
+
+    def step(self) -> list[Finished]:
+        """Refill free slots from the queue, run ONE batched decode
+        step for every active slot, and return newly finished
+        requests.  No-op (empty list) when idle."""
+        finished: list[Finished] = []
+        for slot in range(self.slots):
+            # loop: a refilled request whose prefill token already
+            # finishes it (max_new=1 hitting eos, etc.) must complete
+            # HERE — letting it ride the decode step would emit one
+            # token past its budget and break engine==greedy exactness
+            while True:
+                if self._req[slot] is None and self.queue:
+                    self._fill_slot(slot, self.queue.popleft())
+                if self._req[slot] is not None and self._done(slot):
+                    self._finish_slot(slot, finished)
+                    continue
+                break
+        active = [s for s in range(self.slots)
+                  if self._req[s] is not None]
+        if not active:
+            return finished
+        tokens = jnp.asarray(self._last[:, None])
+        logits, self.cache = decode_step_rows(
+            self.params, tokens, self.cfg, self.cache,
+            jnp.asarray(self._pos))
+        nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        for slot in active:
+            self._pos[slot] += 1
+            self._generated[slot].append(int(nxt[slot]))
+            self._last[slot] = nxt[slot]
+            if self._done(slot):
+                self._finish_slot(slot, finished)
+        return finished
+
+    def run(self, max_steps: int = 10_000) -> list[Finished]:
+        """Drain queue + slots; returns every finished request."""
+        out: list[Finished] = []
+        for _ in range(max_steps):
+            out.extend(self.step())
+            if not self.queue and self.active == 0:
+                return out
+        raise RuntimeError(f"not drained after {max_steps} steps")
+
+
+__all__ = ["Request", "Finished", "ServingEngine"]

@@ -265,16 +265,20 @@ def rms_norm(x, weight, eps=1e-6):
 
 
 def rotary(x, positions, theta: float = 10000.0):
-    """Rotary position embedding; x [B,T,H,D], positions [T].
+    """Rotary position embedding; x [B,T,H,D], positions [T] (shared
+    across the batch) or [B,T] (per-row — continuous-batching decode,
+    models/serving.py, where every slot sits at its own depth).
 
     ``theta`` is the RoPE base: larger values stretch the rotation
     wavelengths, the standard knob for extending context beyond the
     training length (e.g. 500000 for 64k-token serving)."""
     d = x.shape[-1]
     freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
-    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]
-    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
-    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    if positions.ndim == 1:
+        angles = angles[None]                      # [1, T, F]
+    cos = jnp.cos(angles)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., 0::2], x[..., 1::2]
     out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
     return out.reshape(x.shape)

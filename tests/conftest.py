@@ -41,6 +41,7 @@ SLOW_MODULES = {
     "test_oop_gang",             # 4 plugin binaries + controller + jax
     "test_bench_smoke",          # drives the bench beds end-to-end
     "test_multihost_train",      # 2 jax.distributed processes training
+    "test_serving",              # per-prompt-length prefill compiles
 }
 
 SLOW_PREFIXES = (

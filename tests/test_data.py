@@ -195,8 +195,15 @@ class TestStriping:
                   extra={"loader": {"epoch": 1, "step": 2}})
         assert ckpt.restore_extra() == {"loader": {"epoch": 1,
                                                    "step": 2}}
-        # absent sidecar (pre-sidecar checkpoint layout)
+        # corrupt sidecar: present but unreadable must RAISE — a
+        # silent {} would restart the loader at epoch 0
         extra_dir = tmp_path / "c" / "1" / "extra"
+        for f in extra_dir.rglob("*"):
+            if f.is_file():
+                f.write_text("{not json")
+        with pytest.raises(Exception):
+            ckpt.restore_extra()
+        # absent sidecar (pre-sidecar checkpoint layout) yields {}
         shutil.rmtree(extra_dir)
         assert ckpt.restore_extra() == {}
         ckpt.close()

@@ -1,0 +1,132 @@
+"""Continuous-batching engine (models/serving.py): slot-refilled
+batched decode must be EXACTLY greedy generation per request —
+continuous batching is a scheduling optimization, never a math change.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                       greedy_generate, init_params)
+from k8s_dra_driver_tpu.models.serving import (Finished, Request,
+                                               ServingEngine)
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_head=8, d_ff=64, max_seq=48, n_kv_heads=2,
+                        dtype=jnp.float32)
+
+
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def prompt(seed, n):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab), np.int32)
+
+
+def reference(p, prompt_arr, n_new):
+    out = greedy_generate(p, jnp.asarray(prompt_arr)[None, :], CFG,
+                          n_tokens=n_new)
+    return np.asarray(out[0], np.int32)
+
+
+class TestServingEngine:
+    def test_single_request_matches_greedy(self):
+        p = params()
+        eng = ServingEngine(p, CFG, slots=2)
+        pr = prompt(1, 7)
+        eng.submit(Request(uid="a", prompt=pr, max_new=6))
+        done = eng.run()
+        assert [f.uid for f in done] == ["a"]
+        np.testing.assert_array_equal(done[0].tokens,
+                                      reference(p, pr, 6))
+
+    def test_mixed_lengths_share_slots_exactly(self):
+        """More requests than slots, different prompt lengths and
+        generation budgets: every output equals standalone greedy."""
+        p = params()
+        eng = ServingEngine(p, CFG, slots=2)
+        reqs = [("a", prompt(1, 5), 8), ("b", prompt(2, 9), 4),
+                ("c", prompt(3, 3), 10), ("d", prompt(4, 12), 6),
+                ("e", prompt(5, 7), 3)]
+        for uid, pr, n in reqs:
+            eng.submit(Request(uid=uid, prompt=pr, max_new=n))
+        done = {f.uid: f.tokens for f in eng.run()}
+        assert set(done) == {u for u, _, _ in reqs}
+        for uid, pr, n in reqs:
+            np.testing.assert_array_equal(
+                done[uid], reference(p, pr, n),
+                err_msg=f"request {uid} diverged from greedy")
+
+    def test_eos_stops_early(self):
+        p = params()
+        pr = prompt(6, 6)
+        ref = reference(p, pr, 10)
+        generated = ref[len(pr):]
+        eos = int(generated[2])                   # third generated tok
+        eng = ServingEngine(p, CFG, slots=1)
+        eng.submit(Request(uid="x", prompt=pr, max_new=10, eos_id=eos))
+        done = eng.run()
+        got = done[0].tokens
+        # stops AT the eos: prompt + 3 tokens, last == eos
+        np.testing.assert_array_equal(got, ref[:len(pr) + 3])
+        assert got[-1] == eos
+
+    def test_refill_reuses_slots(self):
+        p = params()
+        eng = ServingEngine(p, CFG, slots=1)
+        for uid in ("a", "b", "c"):
+            eng.submit(Request(uid=uid, prompt=prompt(7, 4), max_new=3))
+        done = eng.run()
+        assert [f.uid for f in done] == ["a", "b", "c"]
+        # same prompt -> identical greedy outputs, through slot reuse
+        np.testing.assert_array_equal(done[0].tokens, done[1].tokens)
+        np.testing.assert_array_equal(done[0].tokens, done[2].tokens)
+
+    def test_int8_cache_engine_runs(self):
+        cfg8 = dataclasses.replace(CFG, kv_cache_dtype="int8")
+        p = params()
+        eng = ServingEngine(p, cfg8, slots=2)
+        for uid in ("a", "b", "c"):
+            eng.submit(Request(uid=uid, prompt=prompt(8, 6), max_new=4))
+        done = eng.run()
+        assert len(done) == 3
+        assert all(f.tokens.shape == (10,) for f in done)
+
+    def test_capacity_rejected(self):
+        eng = ServingEngine(params(), CFG, slots=1)
+        with pytest.raises(ValueError, match="exceeds"):
+            eng.submit(Request(uid="x", prompt=prompt(9, 40),
+                               max_new=20))
+
+    def test_idle_step_is_noop(self):
+        eng = ServingEngine(params(), CFG, slots=1)
+        assert eng.step() == []
+        assert eng.active == 0 and eng.pending == 0
+
+    def test_max_new_one_emits_exactly_one(self):
+        """Chained instantly-done requests: each max_new=1 request is
+        exactly the prefill argmax token — a refilled slot must not
+        ride the decode step and emit a second token."""
+        p = params()
+        pr = prompt(10, 5)
+        ref = reference(p, pr, 1)
+        eng = ServingEngine(p, CFG, slots=1)
+        for uid in ("a", "b", "c"):
+            eng.submit(Request(uid=uid, prompt=pr, max_new=1))
+        done = eng.run()
+        assert [f.uid for f in done] == ["a", "b", "c"]
+        for f in done:
+            np.testing.assert_array_equal(f.tokens, ref,
+                                          err_msg=f.uid)
+
+    def test_zero_max_new_rejected(self):
+        eng = ServingEngine(params(), CFG, slots=1)
+        with pytest.raises(ValueError, match="max_new"):
+            eng.submit(Request(uid="x", prompt=prompt(11, 4),
+                               max_new=0))
