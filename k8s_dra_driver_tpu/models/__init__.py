@@ -10,7 +10,7 @@ from .serving import Finished, Request, ServingEngine
 from .speculative import speculative_generate
 from .transformer import (TransformerConfig, forward, init_params, loss_fn,
                           make_optimizer, make_train_step, param_specs,
-                          shard_params)
+                          shard_params, stage_params, unstage_params)
 
 __all__ = ["BatchLoader", "Finished", "KVCache", "QTensor",
            "Request", "ServingEngine", "TrainCheckpointer",
@@ -20,4 +20,5 @@ __all__ = ["BatchLoader", "Finished", "KVCache", "QTensor",
            "greedy_generate", "init_cache", "init_params", "loss_fn",
            "make_optimizer", "make_train_step", "param_specs", "prefill",
            "quantize_params", "quantized_bytes",
-           "sample_generate", "shard_params", "speculative_generate"]
+           "sample_generate", "shard_params", "speculative_generate",
+           "stage_params", "unstage_params"]

@@ -217,6 +217,7 @@ def forward_with_cache(params: Params, tokens: jax.Array,
     if asserted on a non-empty cache (earlier keys would be ignored);
     only ``prefill``/``greedy_generate`` set it, on fresh caches.
     """
+    params = _with_layers(params, cfg)
     b, t = tokens.shape
     if t > cache.k[0].shape[1]:
         raise ValueError(
@@ -227,33 +228,21 @@ def forward_with_cache(params: Params, tokens: jax.Array,
     x = take_rows(params["embed"], tokens, cfg.dtype)
     new_k, new_v = [], []
     new_ks, new_vs = [], []
+
+    def write(dst, new):
+        return jax.lax.dynamic_update_slice(dst, new, (0, pos, 0, 0))
+
     for i, (layer, k_cache, v_cache) in enumerate(
             zip(params["layers"], cache.k, cache.v)):
-        h = rms_norm(x, layer["ln1"])
-        q = rotary(ein("btd,dhk->bthk", h, layer["wq"]), positions,
-                   cfg.rope_theta)
-        k = rotary(ein("btd,dhk->bthk", h, layer["wk"]), positions,
-                   cfg.rope_theta)
-        v = ein("btd,dhk->bthk", h, layer["wv"])
-        ks_cache = vs_cache = None
+        (q, k, v, k_cache, v_cache, ks_cache, vs_cache) = \
+            _project_and_write(layer, x, positions, cfg, k_cache,
+                               v_cache,
+                               cache.k_scale[i] if quantized else None,
+                               cache.v_scale[i] if quantized else None,
+                               write)
         if quantized:
-            kq, ks = _quantize_rows(k)
-            vq, vs = _quantize_rows(v)
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, kq, (0, pos, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, vq, (0, pos, 0, 0))
-            ks_cache = jax.lax.dynamic_update_slice(
-                cache.k_scale[i], ks, (0, pos, 0, 0))
-            vs_cache = jax.lax.dynamic_update_slice(
-                cache.v_scale[i], vs, (0, pos, 0, 0))
             new_ks.append(ks_cache)
             new_vs.append(vs_cache)
-        else:
-            k_cache = jax.lax.dynamic_update_slice(
-                k_cache, k, (0, pos, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(
-                v_cache, v, (0, pos, 0, 0))
         new_k.append(k_cache)
         new_v.append(v_cache)
         if first_chunk and t > 1:
@@ -267,12 +256,7 @@ def forward_with_cache(params: Params, tokens: jax.Array,
         else:
             o = _cached_attention(q, k_cache, v_cache, pos, t, cfg,
                                   ks_cache, vs_cache)
-        x = x + ein("bthk,hkd->btd", o, layer["wo"])
-        mlp_in = rms_norm(x, layer["ln2"])
-        if cfg.is_moe:
-            x = x + _moe_mlp(mlp_in, layer, _serving_cfg(cfg))
-        else:
-            x = x + _dense_mlp(mlp_in, layer)
+        x = _attn_mlp_tail(x, o, layer, cfg)
     x = rms_norm(x, params["ln_f"])
     logits = ein("btd,dv->btv", x, params["unembed"])
     return logits, KVCache(k=new_k, v=new_v, pos=pos + t,
@@ -308,6 +292,58 @@ def decode_step(params: Params, token: jax.Array, cfg: TransformerConfig,
     return logits[:, 0], cache
 
 
+def _with_layers(params: Params, cfg: TransformerConfig) -> Params:
+    """Accept the pp staged layout everywhere decode iterates layers.
+
+    Unstaging is a per-call device gather — serving from a pp-trained
+    checkpoint should convert once up front (``unstage_params``) and
+    reuse; this shim just keeps staged params from crashing with a
+    bare KeyError."""
+    if "stages" in params:
+        from .transformer import unstage_params
+        return unstage_params(params, cfg)
+    return params
+
+
+def _project_and_write(layer, x, positions, cfg, k_cache, v_cache,
+                       ks_in, vs_in, write):
+    """Shared per-layer front half of cached decoding: q/k/v
+    projections + RoPE at ``positions`` ([T] shared or [B,T] per-row),
+    optional int8 quantization, and cache writes through ``write`` —
+    the ONLY part that differs between the aligned path
+    (forward_with_cache, scalar pos) and the continuous-batching path
+    (decode_step_rows, per-row pos) is the write offset and position
+    shape, so both paths share this body and cannot drift."""
+    h = rms_norm(x, layer["ln1"])
+    q = rotary(ein("btd,dhk->bthk", h, layer["wq"]), positions,
+               cfg.rope_theta)
+    k = rotary(ein("btd,dhk->bthk", h, layer["wk"]), positions,
+               cfg.rope_theta)
+    v = ein("btd,dhk->bthk", h, layer["wv"])
+    ks_cache = vs_cache = None
+    if ks_in is not None:
+        kq, ks = _quantize_rows(k)
+        vq, vs = _quantize_rows(v)
+        k_cache = write(k_cache, kq)
+        v_cache = write(v_cache, vq)
+        ks_cache = write(ks_in, ks)
+        vs_cache = write(vs_in, vs)
+    else:
+        k_cache = write(k_cache, k)
+        v_cache = write(v_cache, v)
+    return q, k, v, k_cache, v_cache, ks_cache, vs_cache
+
+
+def _attn_mlp_tail(x, o, layer, cfg):
+    """Shared per-layer back half: attention output projection +
+    residual + MLP (dense or serving-config MoE)."""
+    x = x + ein("bthk,hkd->btd", o, layer["wo"])
+    mlp_in = rms_norm(x, layer["ln2"])
+    if cfg.is_moe:
+        return x + _moe_mlp(mlp_in, layer, _serving_cfg(cfg))
+    return x + _dense_mlp(mlp_in, layer)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
 def decode_step_rows(params: Params, token: jax.Array,
                      cfg: TransformerConfig, cache: KVCache,
@@ -322,6 +358,7 @@ def decode_step_rows(params: Params, token: jax.Array,
     ignored — the caller owns per-slot positions; cache writes land at
     each row's own offset and attention masks per row.
     """
+    params = _with_layers(params, cfg)
     b, t = token.shape
     if t != 1:
         raise ValueError(f"decode_step_rows is one token per slot, "
@@ -339,35 +376,20 @@ def decode_step_rows(params: Params, token: jax.Array,
 
     for i, (layer, k_cache, v_cache) in enumerate(
             zip(params["layers"], cache.k, cache.v)):
-        h = rms_norm(x, layer["ln1"])
-        q = rotary(ein("btd,dhk->bthk", h, layer["wq"]), positions,
-                   cfg.rope_theta)
-        k = rotary(ein("btd,dhk->bthk", h, layer["wk"]), positions,
-                   cfg.rope_theta)
-        v = ein("btd,dhk->bthk", h, layer["wv"])
-        ks_cache = vs_cache = None
+        (q, k, v, k_cache, v_cache, ks_cache, vs_cache) = \
+            _project_and_write(layer, x, positions, cfg, k_cache,
+                               v_cache,
+                               cache.k_scale[i] if quantized else None,
+                               cache.v_scale[i] if quantized else None,
+                               write_rows)
         if quantized:
-            kq, ks = _quantize_rows(k)
-            vq, vs = _quantize_rows(v)
-            k_cache = write_rows(k_cache, kq)
-            v_cache = write_rows(v_cache, vq)
-            ks_cache = write_rows(cache.k_scale[i], ks)
-            vs_cache = write_rows(cache.v_scale[i], vs)
             new_ks.append(ks_cache)
             new_vs.append(vs_cache)
-        else:
-            k_cache = write_rows(k_cache, k)
-            v_cache = write_rows(v_cache, v)
         new_k.append(k_cache)
         new_v.append(v_cache)
         o = _cached_attention(q, k_cache, v_cache, pos_rows, 1, cfg,
                               ks_cache, vs_cache)
-        x = x + ein("bthk,hkd->btd", o, layer["wo"])
-        mlp_in = rms_norm(x, layer["ln2"])
-        if cfg.is_moe:
-            x = x + _moe_mlp(mlp_in, layer, _serving_cfg(cfg))
-        else:
-            x = x + _dense_mlp(mlp_in, layer)
+        x = _attn_mlp_tail(x, o, layer, cfg)
     x = rms_norm(x, params["ln_f"])
     logits = ein("btd,dv->btv", x, params["unembed"])
     cache = KVCache(k=new_k, v=new_v, pos=cache.pos,

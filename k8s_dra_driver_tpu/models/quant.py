@@ -390,7 +390,11 @@ def quantize_params(params: dict[str, Any], cfg) -> dict[str, Any]:
     Works on the pytree from ``init_params`` (transformer.py); the
     result drops into ``forward``/``forward_with_cache``/the generate
     functions unchanged — their einsums go through :func:`ein`.
+    pp staged params are unstaged first (serving is single-device).
     """
+    if "stages" in params:
+        from .transformer import unstage_params
+        params = unstage_params(params, cfg)
     moe = cfg.is_moe
     out: dict[str, Any] = {
         "embed": quantize(params["embed"], (1,)),   # per-row for gather

@@ -213,12 +213,52 @@ def _layer_specs(cfg: TransformerConfig) -> dict[str, P]:
 
 def param_specs(cfg: TransformerConfig) -> Params:
     layer = _layer_specs(cfg)
-    return {
+    head = {
         "embed": P(None, "tp"),
         "unembed": P("tp", None),
         "ln_f": P(None),
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
     }
+    if cfg.pp_stages > 1:
+        # staged layout: leaves [S, L/S, ...] — stage axis on pp, the
+        # per-layer spec shifted right; params LIVE per stage instead
+        # of replicated across the pipeline
+        head["stages"] = {
+            name: P("pp", None, *tuple(spec))
+            for name, spec in layer.items()
+        }
+        return head
+    head["layers"] = [dict(layer) for _ in range(cfg.n_layers)]
+    return head
+
+
+def stage_params(params: Params, cfg: TransformerConfig) -> Params:
+    """layers-list params -> staged layout for pipeline parallelism:
+    ``params["stages"]`` leaves lead with [S, L/S, ...] (stage axis
+    shardable on pp).  Inverse: ``unstage_params``."""
+    from ..parallel.pipeline import split_layers
+    lps = split_layers(cfg.n_layers, cfg.pp_stages)
+    layers = params["layers"]
+    stages = jax.tree.map(
+        lambda *xs: jnp.stack([jnp.stack(xs[s * lps:(s + 1) * lps])
+                               for s in range(cfg.pp_stages)]),
+        *layers)
+    out = {k: v for k, v in params.items() if k != "layers"}
+    out["stages"] = stages
+    return out
+
+
+def unstage_params(params: Params, cfg: TransformerConfig) -> Params:
+    """Staged layout -> layers list (e.g. to run the sequential
+    reference path or restore onto a pp-less mesh)."""
+    from ..parallel.pipeline import split_layers
+    lps = split_layers(cfg.n_layers, cfg.pp_stages)
+    layers = [
+        jax.tree.map(lambda a, s=s, i=i: a[s, i], params["stages"])
+        for s in range(cfg.pp_stages) for i in range(lps)
+    ]
+    out = {k: v for k, v in params.items() if k != "stages"}
+    out["layers"] = layers
+    return out
 
 
 def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
@@ -250,6 +290,8 @@ def init_params(cfg: TransformerConfig, key: jax.Array) -> Params:
 def shard_params(params: Params, cfg: TransformerConfig,
                  mesh: Mesh) -> Params:
     specs = param_specs(cfg)
+    if cfg.pp_stages > 1 and "layers" in params:
+        params = stage_params(params, cfg)   # pp wants staged residency
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params, specs)
@@ -504,34 +546,35 @@ def _layer_forward(x, layer, cfg: TransformerConfig, mesh: Mesh | None,
         else out
 
 
-def _pipelined_layers(x, layers, cfg: TransformerConfig, mesh: Mesh):
+def _pipelined_layers(x, params, cfg: TransformerConfig, mesh: Mesh):
     """The layer stack as ``pp_stages`` pipelined stage groups.
 
-    Layer params are stacked [S, L/S, ...] at trace time and
-    constrained onto the pp axis; each stage applies its L/S layers
-    with the single-device compute path (dp/ep stay automatic inside
-    the pipeline — jax.shard_map(axis_names={'pp'})).
+    With STAGED params (``params["stages"]``, the layout
+    ``shard_params`` produces for pp configs) the [S, L/S, ...]
+    leaves live sharded on the pp axis — per-stage parameter AND
+    optimizer residency, no per-step restack.  A layers-list params
+    dict still works (stacked at trace time + constrained onto pp)
+    so ad-hoc callers keep running, at a per-step reshard cost.
 
-    Honest limitation: ``param_specs`` stores layers as a list, so
-    params and optimizer state stay replicated across pp and the
-    stack+reshard here re-runs every step — this integration buys the
-    pipelined *compute* schedule (and its DCN-friendly neighbor
-    traffic), not per-stage parameter residency; that needs
-    stage-stacked parameter storage end to end (init/checkpoint),
-    tracked as future work.
-
-    ``cfg.remat`` maps to the pipeline's stage-level checkpoint (the
-    natural granularity: stage inputs are saved, in-stage activations
-    recomputed) — never combined with the per-layer wrap, which would
-    recompute every layer twice.
+    Each stage applies its L/S layers with the single-device compute
+    path (dp/ep stay automatic inside the pipeline —
+    jax.shard_map(axis_names={'pp'})).  ``cfg.remat`` maps to the
+    pipeline's stage-level checkpoint (the natural granularity:
+    stage inputs are saved, in-stage activations recomputed) — never
+    combined with the per-layer wrap, which would recompute every
+    layer twice.
     """
     from ..parallel.pipeline import (pipeline_apply, split_layers,
                                      stack_stages)
     lps = split_layers(cfg.n_layers, cfg.pp_stages)
-    stages = [stack_stages(layers[s * lps:(s + 1) * lps])
-              for s in range(cfg.pp_stages)]
-    stacked = jax.lax.with_sharding_constraint(
-        stack_stages(stages), NamedSharding(mesh, P("pp")))
+    if "stages" in params:
+        stacked = params["stages"]          # already pp-resident
+    else:
+        layers = params["layers"]
+        stages = [stack_stages(layers[s * lps:(s + 1) * lps])
+                  for s in range(cfg.pp_stages)]
+        stacked = jax.lax.with_sharding_constraint(
+            stack_stages(stages), NamedSharding(mesh, P("pp")))
 
     def stage_fn(stage, x):
         # the real mesh flows into the stage body: sp==1 is validated
@@ -585,8 +628,12 @@ def forward(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     if pipelined:
         # falls through to the shared rms_norm/unembed tail below so
         # the model tail cannot diverge between the two paths
-        x = _pipelined_layers(x, params["layers"], cfg, mesh)
+        x = _pipelined_layers(x, params, cfg, mesh)
     else:
+        if "stages" in params:
+            # staged params on the sequential/reference path (e.g. a
+            # pp-trained checkpoint evaluated unsharded)
+            params = unstage_params(params, cfg)
         layer_fn = functools.partial(_layer_forward, cfg=cfg, mesh=mesh,
                                      segment_ids=segment_ids,
                                      with_aux=return_aux)

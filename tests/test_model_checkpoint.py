@@ -100,3 +100,37 @@ def test_missing_checkpoint_raises(tmp_path):
     with pytest.raises(FileNotFoundError):
         ckpt.restore(params, opt)
     ckpt.close()
+
+
+def test_pp_staged_state_resumes_exact_trajectory(tmp_path):
+    """Pipeline-parallel (staged-residency) training state round-trips
+    the checkpoint: restore onto a fresh pp mesh continues the exact
+    loss trajectory, with the stage leaves still pp-sharded."""
+    import dataclasses
+
+    cfg = dataclasses.replace(CFG, n_layers=4, pp_stages=4)
+    mesh = make_mesh(MeshSpec(dp=2, pp=4))
+    step, init_state = make_train_step(cfg, mesh)
+    params, opt = init_state(jax.random.PRNGKey(0))
+    batch = tokens(batch=8)
+
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    for _ in range(2):
+        params, opt, _ = step(params, opt, batch)
+    ckpt.save(2, params, opt)
+    p_ref, o_ref = params, opt
+    ref_losses = []
+    for _ in range(2):
+        p_ref, o_ref, loss = step(p_ref, o_ref, batch)
+        ref_losses.append(float(loss))
+
+    params2, opt2 = init_state(jax.random.PRNGKey(9))
+    params2, opt2, at = ckpt.restore(params2, opt2)
+    assert at == 2
+    assert params2["stages"]["wq"].sharding.spec[0] == "pp"
+    losses = []
+    for _ in range(2):
+        params2, opt2, loss = step(params2, opt2, batch)
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses, ref_losses, rtol=1e-6)
+    ckpt.close()

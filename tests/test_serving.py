@@ -88,15 +88,23 @@ class TestServingEngine:
         np.testing.assert_array_equal(done[0].tokens, done[1].tokens)
         np.testing.assert_array_equal(done[0].tokens, done[2].tokens)
 
-    def test_int8_cache_engine_runs(self):
+    def test_int8_cache_engine_matches_greedy(self):
+        """Exactness holds through the int8 cache too: the engine's
+        per-row quantized writes/reads must equal standalone greedy
+        generation under the same int8 config, token for token."""
         cfg8 = dataclasses.replace(CFG, kv_cache_dtype="int8")
         p = params()
+        prompts = [prompt(8, 6), prompt(12, 9), prompt(13, 4)]
+        refs = [np.asarray(greedy_generate(
+            p, jnp.asarray(pr)[None, :], cfg8, n_tokens=4)[0],
+            np.int32) for pr in prompts]
         eng = ServingEngine(p, cfg8, slots=2)
-        for uid in ("a", "b", "c"):
-            eng.submit(Request(uid=uid, prompt=prompt(8, 6), max_new=4))
-        done = eng.run()
-        assert len(done) == 3
-        assert all(f.tokens.shape == (10,) for f in done)
+        for i, pr in enumerate(prompts):
+            eng.submit(Request(uid=i, prompt=pr, max_new=4))
+        done = {f.uid: f.tokens for f in eng.run()}
+        for i, ref in enumerate(refs):
+            np.testing.assert_array_equal(done[i], ref,
+                                          err_msg=f"request {i}")
 
     def test_capacity_rejected(self):
         eng = ServingEngine(params(), CFG, slots=1)
