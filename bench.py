@@ -510,6 +510,19 @@ def _tpu_probes():
     # weights + the full static cache each token, so ms/token should
     # track the respective byte halvings; all recorded so the
     # comparison is an artifact, not a claim.
+    def shaped(label, res, errs):
+        """One recorded probe dict: rounded fields + retry evidence;
+        None result -> error record keeping every attempt's error."""
+        if res is None:
+            return {"error": errs[-1] if errs else "no attempts",
+                    "retries": errs}
+        probe = {"shape": label, **{
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in res.items()}}
+        if errs:
+            probe["retries"] = errs
+        return probe
+
     base = None
     for key, kwargs in [("decode", {}),
                         ("decode_int8", dict(int8=True)),
@@ -519,22 +532,26 @@ def _tpu_probes():
             [(lbl, lambda kw=kw, kwargs=kwargs:
               decode_probe(**kwargs, **kw))
              for lbl, kw in decode_shapes])
-        if res is None:
-            yield key, {"error": errs[-1] if errs else "no attempts",
-                        "retries": errs}
-            continue
-        probe = {"shape": label, **{
-            k: (round(v, 3) if isinstance(v, float) else v)
-            for k, v in res.items()}}
-        if errs:
-            probe["retries"] = errs
-        if key == "decode":
-            base = (label, res)
-        elif (base and base[0] == label and base[1].get("valid")
-                and res.get("valid")):
-            probe["speedup_vs_bf16"] = round(
-                base[1]["ms_per_token"] / res["ms_per_token"], 2)
+        probe = shaped(label, res, errs)
+        if res is not None:
+            if key == "decode":
+                base = (label, res)
+            elif (base and base[0] == label and base[1].get("valid")
+                    and res.get("valid")):
+                probe["speedup_vs_bf16"] = round(
+                    base[1]["ms_per_token"] / res["ms_per_token"], 2)
         yield key, probe
+
+    # Continuous batching: mixed-length requests through the
+    # slot-refill engine (models/serving.py)
+    from k8s_dra_driver_tpu.ops import serving_probe
+    label, res, errs = _retry_probe(
+        [("s8_r24", lambda: serving_probe())] if on_accel else
+        [("tiny", lambda: serving_probe(
+            slots=2, n_requests=4, n_layers=2, d_model=128, heads=4,
+            kv_heads=2, d_ff=256, prompt_len=12, max_new=6,
+            max_seq=64))])
+    yield "serving", shaped(label, res, errs)
 
 
 def tpu_probe_stream() -> None:
