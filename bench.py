@@ -396,17 +396,25 @@ def _tpu_probes():
     # on a CPU host would take hours (6000 x 4096^3 matmuls).
     on_accel = platform not in ("cpu", "none")
 
-    def run(attempts, fields):
-        label, res, errs = _retry_probe(attempts)
+    def shaped(label, res, errs, fields=None):
+        """One recorded probe dict: fields (default: rounded floats)
+        + retry evidence; None result -> error record keeping EVERY
+        attempt's error (the headline shape's transient failure is
+        evidence too)."""
         if res is None:
-            # keep EVERY attempt's error, not just the last: the
-            # headline shape's transient failure is evidence too
             return {"error": errs[-1] if errs else "no attempts",
-                    "retries": errs}, None
-        probe = {"shape": label, **fields(res)}
+                    "retries": errs}
+        vals = fields(res) if fields else {
+            k: (round(v, 3) if isinstance(v, float) else v)
+            for k, v in res.items()}
+        probe = {"shape": label, **vals}
         if errs:
             probe["retries"] = errs
-        return probe, res
+        return probe
+
+    def run(attempts, fields):
+        label, res, errs = _retry_probe(attempts)
+        return shaped(label, res, errs, fields), res
 
     def attn_fields(res):
         return {"flash_ms": round(res["flash_ms"], 3),
@@ -510,19 +518,6 @@ def _tpu_probes():
     # weights + the full static cache each token, so ms/token should
     # track the respective byte halvings; all recorded so the
     # comparison is an artifact, not a claim.
-    def shaped(label, res, errs):
-        """One recorded probe dict: rounded fields + retry evidence;
-        None result -> error record keeping every attempt's error."""
-        if res is None:
-            return {"error": errs[-1] if errs else "no attempts",
-                    "retries": errs}
-        probe = {"shape": label, **{
-            k: (round(v, 3) if isinstance(v, float) else v)
-            for k, v in res.items()}}
-        if errs:
-            probe["retries"] = errs
-        return probe
-
     base = None
     for key, kwargs in [("decode", {}),
                         ("decode_int8", dict(int8=True)),
