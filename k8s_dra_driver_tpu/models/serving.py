@@ -15,9 +15,13 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   dynamic gather/scatter of cache pages is the expensive thing.)
 - **Prefill per request.**  A new request prefills on a fresh [1, L]
   cache (the flash-kernel path) and its K/V rows are copied into the
-  slot; prompt lengths compile one prefill program each — callers
-  with many distinct lengths should bucket/pad prompts (documented
-  trade; generation results are exact either way).
+  slot.  Whole-prompt prefill compiles one program per distinct
+  length; ``prefill_chunk=C`` instead feeds the prompt in C-token
+  chunks (the first through the flash path, the rest through the
+  position-masked path), bounding compilation to ≤2C programs total
+  across ALL prompt lengths (each size ≤C can occur as a first chunk
+  and as a trailing remainder) — generation results are exact either
+  way (chunked prefill is mathematically the same append).
 - **Greedy decode**, EOS + per-request ``max_new`` + cache-capacity
   stop conditions; host-side bookkeeping is plain numpy mirrors of
   slot state (the device only ever sees static shapes).
@@ -78,10 +82,14 @@ class ServingEngine:
     """Greedy continuous-batching engine over ``slots`` cache rows."""
 
     def __init__(self, params, cfg: TransformerConfig, slots: int,
-                 max_seq: int | None = None):
+                 max_seq: int | None = None,
+                 prefill_chunk: int | None = None):
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
         self.params = params
         self.cfg = cfg
         self.slots = slots
+        self.prefill_chunk = prefill_chunk
         self.max_seq = max_seq or cfg.max_seq
         self.cache = init_cache(cfg, slots, self.max_seq)
         self.queue: deque[Request] = deque()
@@ -120,8 +128,22 @@ class ServingEngine:
         """Prefill the request on a fresh [1, L] cache and copy its
         K/V rows into the slot."""
         one = init_cache(self.cfg, 1, self.max_seq)
-        logits, one = prefill(self.params, req.prompt[None, :],
-                              self.cfg, one)
+        if self.prefill_chunk is None:
+            logits, one = prefill(self.params, req.prompt[None, :],
+                                  self.cfg, one)
+        else:
+            # chunked: ≤2C compiled programs across all lengths (each
+            # size ≤C as first chunk and as remainder), exact at any
+            # split.  first_chunk is STATICALLY known here (off == 0)
+            # — calling _prefill_jit directly skips prefill()'s
+            # cache.pos readback, one blocking RTT per chunk on
+            # tunneled backends
+            from .decode import _prefill_jit
+            c = self.prefill_chunk
+            for off in range(0, req.prompt.size, c):
+                logits, one = _prefill_jit(
+                    self.params, req.prompt[None, off:off + c],
+                    self.cfg, one, off == 0)
         first = int(jnp.argmax(logits[0, -1]))
         self.cache = _adopt_slot(self.cache, one, jnp.int32(slot))
         self._req[slot] = req

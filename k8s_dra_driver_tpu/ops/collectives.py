@@ -454,7 +454,9 @@ def serving_probe(slots: int = 8, n_requests: int = 24,
     wall = time.perf_counter() - t0
     generated = sum(len(f.tokens) - prompt_len_of[f.uid]
                     for f in done)
-    steps = -(-n_requests * max_new // slots)   # lower bound on steps
+    # each request's FIRST token comes from its prefill argmax, so
+    # decode steps emit max_new-1 tokens per request
+    steps = -(-n_requests * (max_new - 1) // slots)   # min decode steps
     return {
         "slots": slots,
         "requests": n_requests,

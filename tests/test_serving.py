@@ -133,6 +133,24 @@ class TestServingEngine:
             np.testing.assert_array_equal(f.tokens, ref,
                                           err_msg=f.uid)
 
+    @pytest.mark.parametrize("chunk", [1, 4, 5, 64])
+    def test_chunked_prefill_is_exact(self, chunk):
+        """prefill_chunk is a compile-count optimization, never a math
+        change: chunked engines produce the same tokens as whole-
+        prompt prefill and standalone greedy, at chunk sizes that
+        divide, straddle, and exceed the prompt lengths."""
+        p = params()
+        eng = ServingEngine(p, CFG, slots=2, prefill_chunk=chunk)
+        reqs = [("a", prompt(20, 5), 6), ("b", prompt(21, 9), 4),
+                ("c", prompt(22, 13), 5)]
+        for uid, pr, n in reqs:
+            eng.submit(Request(uid=uid, prompt=pr, max_new=n))
+        done = {f.uid: f.tokens for f in eng.run()}
+        for uid, pr, n in reqs:
+            np.testing.assert_array_equal(
+                done[uid], reference(p, pr, n),
+                err_msg=f"request {uid} chunk {chunk}")
+
     def test_zero_max_new_rejected(self):
         eng = ServingEngine(params(), CFG, slots=1)
         with pytest.raises(ValueError, match="max_new"):
