@@ -441,6 +441,38 @@ def greedy_generate(params: Params, prompt: jax.Array,
     return jnp.concatenate([prompt, generated], axis=1)
 
 
+def sample_token(logits, key, temperature, top_k: int = 0,
+                 top_p: float = 0.0):
+    """The temperature/top-k/top-p transform + categorical draw:
+    ``[..., V]`` logits -> ``[...]`` token ids.
+
+    Shared by ``sample_generate`` and the continuous-batching
+    engine's per-slot sampling (models/serving.py) so the two cannot
+    drift; ``temperature`` may be a scalar or broadcastable over the
+    leading dims (per-slot temperatures).  Ties with the smallest
+    kept nucleus logit also survive (standard >=-on-raw-logits
+    behavior); only exact float ties at the boundary over-keep."""
+    temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
+    if temp.ndim:
+        temp = temp[..., None]          # per-row over the vocab dim
+    scaled = logits.astype(jnp.float32) / temp
+    if top_k:
+        kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
+        scaled = jnp.where(scaled >= kth, scaled, -1e30)
+    if top_p and top_p < 1.0:
+        # nucleus: drop tokens outside the smallest prefix of the
+        # sorted distribution with cumulative mass >= p (the top
+        # token always survives: its cumsum term includes itself)
+        srt = jnp.sort(scaled, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(srt, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p                  # [..., V] sorted
+        cutoff = jnp.max(jnp.where(keep, srt, -jnp.inf), axis=-1,
+                         keepdims=True)
+        scaled = jnp.where(scaled >= cutoff, scaled, -1e30)
+    return jax.random.categorical(key, scaled, axis=-1)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "n_tokens", "max_seq",
                                              "top_k", "top_p"))
 def sample_generate(params: Params, prompt: jax.Array,
@@ -460,26 +492,7 @@ def sample_generate(params: Params, prompt: jax.Array,
                                        max_seq)
 
     def pick(logits, key):
-        scaled = logits.astype(jnp.float32) / jnp.maximum(
-            jnp.float32(temperature), 1e-6)
-        if top_k:
-            kth = jax.lax.top_k(scaled, top_k)[0][..., -1:]
-            scaled = jnp.where(scaled >= kth, scaled, -1e30)
-        if top_p and top_p < 1.0:
-            # nucleus: drop tokens outside the smallest prefix of the
-            # sorted distribution with cumulative mass >= p (the top
-            # token always survives: its cumsum term includes itself).
-            # Ties with the smallest kept logit also survive (standard
-            # implementations share this >= -on-raw-logits behavior);
-            # only exact float ties at the boundary over-keep.
-            srt = jnp.sort(scaled, axis=-1)[..., ::-1]
-            probs = jax.nn.softmax(srt, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            keep = cum - probs < top_p              # [B, V] sorted
-            cutoff = jnp.max(jnp.where(keep, srt, -jnp.inf), axis=-1,
-                             keepdims=True)
-            scaled = jnp.where(scaled >= cutoff, scaled, -1e30)
-        return jax.random.categorical(key, scaled, axis=-1)
+        return sample_token(logits, key, temperature, top_k, top_p)
 
     key, sub = jax.random.split(key)
     first = pick(logits[:, -1], sub).astype(prompt.dtype)

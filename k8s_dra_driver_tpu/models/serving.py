@@ -42,7 +42,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .decode import KVCache, decode_step_rows, init_cache, prefill
+from .decode import (KVCache, decode_step_rows, init_cache, prefill,
+                     sample_token)
 from .transformer import TransformerConfig
 
 
@@ -52,12 +53,35 @@ class Request:
     prompt: np.ndarray              # [L] int32
     max_new: int
     eos_id: int | None = None
+    # temperature > 0 samples this request (per-slot PRNG stream from
+    # ``seed``, identical to ``sample_generate``'s); 0 = greedy.
+    # top_k/top_p are engine-level (static program shape).
+    temperature: float = 0.0
+    seed: int = 0
 
 
 @dataclasses.dataclass
 class Finished:
     uid: Any
     tokens: np.ndarray              # prompt + generated
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
+def _next_tokens(logits, keys, temps, top_k: int, top_p: float):
+    """[B,V] logits + [B,2] per-slot keys + [B] temps -> (next [B],
+    new keys): greedy rows (temp==0) take argmax, sampled rows draw
+    from their own key stream — ONE program, one readback, keys stay
+    device-resident (per-step host churn is the cost that dominates
+    tunneled backends)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    split = jax.vmap(jax.random.split)(keys)
+    sampled = jax.vmap(
+        lambda l, k, t: sample_token(l, k, t, top_k, top_p))(
+        logits, split[:, 1], temps)
+    live = temps > 0
+    nxt = jnp.where(live, sampled, greedy).astype(jnp.int32)
+    new_keys = jnp.where(live[:, None], split[:, 0], keys)
+    return nxt, new_keys
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -83,13 +107,18 @@ class ServingEngine:
 
     def __init__(self, params, cfg: TransformerConfig, slots: int,
                  max_seq: int | None = None,
-                 prefill_chunk: int | None = None):
+                 prefill_chunk: int | None = None,
+                 top_k: int = 0, top_p: float = 0.0):
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
+        if not 0.0 <= top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {top_p}")
         self.params = params
         self.cfg = cfg
         self.slots = slots
         self.prefill_chunk = prefill_chunk
+        self.top_k = top_k
+        self.top_p = top_p
         self.max_seq = max_seq or cfg.max_seq
         self.cache = init_cache(cfg, slots, self.max_seq)
         self.queue: deque[Request] = deque()
@@ -98,6 +127,11 @@ class ServingEngine:
         self._pos = np.zeros(slots, np.int32)       # fill depth
         self._generated: list[list[int]] = [[] for _ in range(slots)]
         self._last = np.zeros(slots, np.int32)      # next input token
+        # per-slot sampling state: device-resident PRNG key streams +
+        # temperatures (0 = greedy row, selected by mask inside one
+        # fused program — no per-step key up/downloads)
+        self._keys = jnp.tile(jax.random.PRNGKey(0)[None], (slots, 1))
+        self._temps = np.zeros(slots, np.float32)
 
     # -- request intake --------------------------------------------------
 
@@ -144,7 +178,18 @@ class ServingEngine:
                 logits, one = _prefill_jit(
                     self.params, req.prompt[None, off:off + c],
                     self.cfg, one, off == 0)
-        first = int(jnp.argmax(logits[0, -1]))
+        if req.temperature > 0:
+            # the exact sample_generate key stream: split before the
+            # first token, then once per decode step
+            key, sub = jax.random.split(jax.random.PRNGKey(req.seed))
+            first = int(sample_token(logits[0, -1], sub,
+                                     req.temperature, self.top_k,
+                                     self.top_p))
+            self._keys = self._keys.at[slot].set(key)
+            self._temps[slot] = req.temperature
+        else:
+            first = int(jnp.argmax(logits[0, -1]))
+            self._temps[slot] = 0.0
         self.cache = _adopt_slot(self.cache, one, jnp.int32(slot))
         self._req[slot] = req
         self._pos[slot] = req.prompt.size
@@ -160,6 +205,7 @@ class ServingEngine:
                                    np.asarray(gen, np.int32)])))
         self._req[slot] = None
         self._generated[slot] = []
+        self._temps[slot] = 0.0
 
     def _done(self, slot: int) -> bool:
         req = self._req[slot]
@@ -196,7 +242,16 @@ class ServingEngine:
         logits, self.cache = decode_step_rows(
             self.params, tokens, self.cfg, self.cache,
             jnp.asarray(self._pos))
-        nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        if self._temps.any():
+            # one fused program merges greedy + sampled rows and
+            # advances each sampled slot's key stream exactly as
+            # sample_generate would; single readback
+            nxt_dev, self._keys = _next_tokens(
+                logits, self._keys, jnp.asarray(self._temps),
+                self.top_k, self.top_p)
+            nxt = np.asarray(nxt_dev, np.int32)
+        else:
+            nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
         for slot in active:
             self._pos[slot] += 1
             self._generated[slot].append(int(nxt[slot]))

@@ -456,7 +456,8 @@ def serving_probe(slots: int = 8, n_requests: int = 24,
                     for f in done)
     # each request's FIRST token comes from its prefill argmax, so
     # decode steps emit max_new-1 tokens per request
-    steps = -(-n_requests * (max_new - 1) // slots)   # min decode steps
+    # min decode steps (>=1: max_new=1 drains with prefills alone)
+    steps = max(-(-n_requests * (max_new - 1) // slots), 1)
     return {
         "slots": slots,
         "requests": n_requests,

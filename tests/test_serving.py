@@ -151,6 +151,29 @@ class TestServingEngine:
                 done[uid], reference(p, pr, n),
                 err_msg=f"request {uid} chunk {chunk}")
 
+    def test_sampled_requests_match_sample_generate(self):
+        """Per-request sampling: a sampled request's tokens equal
+        standalone sample_generate with the same key stream, even
+        mixed with greedy requests in the same batch."""
+        from k8s_dra_driver_tpu.models import sample_generate
+        p = params()
+        pr_s, pr_g = prompt(30, 6), prompt(31, 9)
+        n = 5
+        temp, top_k, top_p = 0.8, 8, 0.9
+        want_sampled = np.asarray(sample_generate(
+            p, jnp.asarray(pr_s)[None, :], CFG, n,
+            jax.random.PRNGKey(123), temperature=temp, top_k=top_k,
+            top_p=top_p)[0], np.int32)
+        want_greedy = reference(p, pr_g, n)
+
+        eng = ServingEngine(p, CFG, slots=2, top_k=top_k, top_p=top_p)
+        eng.submit(Request(uid="s", prompt=pr_s, max_new=n,
+                           temperature=temp, seed=123))
+        eng.submit(Request(uid="g", prompt=pr_g, max_new=n))
+        done = {f.uid: f.tokens for f in eng.run()}
+        np.testing.assert_array_equal(done["s"], want_sampled)
+        np.testing.assert_array_equal(done["g"], want_greedy)
+
     def test_zero_max_new_rejected(self):
         eng = ServingEngine(params(), CFG, slots=1)
         with pytest.raises(ValueError, match="max_new"):
