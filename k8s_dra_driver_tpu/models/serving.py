@@ -22,9 +22,11 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   across ALL prompt lengths (each size ≤C can occur as a first chunk
   and as a trailing remainder) — generation results are exact either
   way (chunked prefill is mathematically the same append).
-- **Greedy decode**, EOS + per-request ``max_new`` + cache-capacity
-  stop conditions; host-side bookkeeping is plain numpy mirrors of
-  slot state (the device only ever sees static shapes).
+- **Greedy or sampled decode per request** (``temperature``/``seed``
+  on the Request, engine-level ``top_k``/``top_p``), EOS +
+  per-request ``max_new`` + cache-capacity stop conditions;
+  host-side bookkeeping is plain numpy mirrors of slot state (the
+  device only ever sees static shapes).
 
 No reference analog (SURVEY.md §2.3 — the reference has no serving
 stack at all); beyond-parity workload tier alongside speculative
@@ -67,6 +69,14 @@ class Finished:
 
 
 @functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
+def _sample_one(logits, key, temperature, top_k: int, top_p: float):
+    """Refill-path first-token draw as ONE compiled program (eager
+    sample_token would dispatch its ops one RTT each on tunneled
+    backends)."""
+    return sample_token(logits, key, temperature, top_k, top_p)
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
 def _next_tokens(logits, keys, temps, top_k: int, top_p: float):
     """[B,V] logits + [B,2] per-slot keys + [B] temps -> (next [B],
     new keys): greedy rows (temp==0) take argmax, sampled rows draw
@@ -103,7 +113,9 @@ def _adopt_slot(cache: KVCache, one: KVCache, slot) -> KVCache:
 
 
 class ServingEngine:
-    """Greedy continuous-batching engine over ``slots`` cache rows."""
+    """Continuous-batching engine over ``slots`` cache rows:
+    greedy by default, per-request sampling via
+    ``Request(temperature=..., seed=...)``."""
 
     def __init__(self, params, cfg: TransformerConfig, slots: int,
                  max_seq: int | None = None,
@@ -182,9 +194,9 @@ class ServingEngine:
             # the exact sample_generate key stream: split before the
             # first token, then once per decode step
             key, sub = jax.random.split(jax.random.PRNGKey(req.seed))
-            first = int(sample_token(logits[0, -1], sub,
-                                     req.temperature, self.top_k,
-                                     self.top_p))
+            first = int(_sample_one(logits[0, -1], sub,
+                                    jnp.float32(req.temperature),
+                                    self.top_k, self.top_p))
             self._keys = self._keys.at[slot].set(key)
             self._temps[slot] = req.temperature
         else:

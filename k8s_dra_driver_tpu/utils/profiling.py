@@ -1,0 +1,49 @@
+"""Workload-side profiling: XProf traces + device memory snapshots.
+
+The driver binaries carry their own observability (Prometheus +
+pprof analogs, utils/httpendpoint.py — beating the reference's
+controller-only endpoint, main.go:194-241); THIS module is the
+workload half: capture an XLA/XProf trace of a training or serving
+region for TensorBoard's profile plugin, annotate phases so they are
+findable in the timeline, and snapshot device memory.  Thin by
+design — ``jax.profiler`` already speaks TPU natively (trace events
+come from the runtime, not host sampling); wrapping it keeps the
+call sites uniform and testable.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from pathlib import Path
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str | Path):
+    """Capture everything inside the block as an XProf trace under
+    ``log_dir`` (TensorBoard: `tensorboard --logdir <dir>`, Profile
+    tab).  Compilation, dispatch, and device compute all land in the
+    timeline; keep regions to a few steps — traces are verbose."""
+    jax.profiler.start_trace(str(log_dir))
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Name a region inside an active trace (shows as a span in the
+    timeline): ``with annotate("train-step"): ...``."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory_profile(path: str | Path) -> Path:
+    """Write a pprof-format device memory snapshot (what is live on
+    the accelerator right now) — the OOM post-mortem tool."""
+    path = Path(path)
+    path.write_bytes(jax.profiler.device_memory_profile())
+    return path
+
+
+__all__ = ["trace", "annotate", "device_memory_profile"]

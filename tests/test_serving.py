@@ -151,6 +151,19 @@ class TestServingEngine:
                 done[uid], reference(p, pr, n),
                 err_msg=f"request {uid} chunk {chunk}")
 
+    def test_int8_weights_engine_matches_greedy(self):
+        """Weight-only int8 params (models/quant.py) drop into the
+        engine unchanged and stay exact vs standalone greedy on the
+        same quantized params."""
+        from k8s_dra_driver_tpu.models import quantize_params
+        p = quantize_params(params(), CFG)
+        pr = prompt(40, 7)
+        eng = ServingEngine(p, CFG, slots=2)
+        eng.submit(Request(uid="q", prompt=pr, max_new=5))
+        done = eng.run()
+        np.testing.assert_array_equal(done[0].tokens,
+                                      reference(p, pr, 5))
+
     def test_sampled_requests_match_sample_generate(self):
         """Per-request sampling: a sampled request's tokens equal
         standalone sample_generate with the same key stream, even
