@@ -144,6 +144,11 @@ class ServingEngine:
         # fused program — no per-step key up/downloads)
         self._keys = jnp.tile(jax.random.PRNGKey(0)[None], (slots, 1))
         self._temps = np.zeros(slots, np.float32)
+        # lifetime counters (stats())
+        self._finished_total = 0
+        self._cancelled = 0
+        self._tokens_total = 0
+        self._steps_total = 0
 
     # -- request intake --------------------------------------------------
 
@@ -158,6 +163,11 @@ class ServingEngine:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new ({req.max_new}) "
                 f"exceeds the {self.max_seq}-slot cache")
+        if any(r.uid == req.uid for r in self.queue) or any(
+                r is not None and r.uid == req.uid for r in self._req):
+            # uid is the cancel/finished-stream handle; a duplicate
+            # would make cancel() ambiguous
+            raise ValueError(f"uid {req.uid!r} already in flight")
         self.queue.append(dataclasses.replace(req, prompt=prompt))
 
     @property
@@ -167,6 +177,40 @@ class ServingEngine:
     @property
     def pending(self) -> int:
         return len(self.queue)
+
+    def cancel(self, uid) -> bool:
+        """Drop a request by uid — queued (removed before it ever
+        runs) or active (its slot frees immediately; the next step
+        refills it).  Returns whether anything was cancelled; a
+        cancelled request never appears in the finished stream.  Its
+        already-generated tokens still count in
+        ``generated_tokens_total`` (the work happened)."""
+        for req in self.queue:
+            if req.uid == uid:
+                self.queue.remove(req)
+                self._cancelled += 1
+                return True
+        for slot, req in enumerate(self._req):
+            if req is not None and req.uid == uid:
+                self._tokens_total += len(self._generated[slot])
+                self._req[slot] = None
+                self._generated[slot] = []
+                self._temps[slot] = 0.0
+                self._cancelled += 1
+                return True
+        return False
+
+    def stats(self) -> dict:
+        """Counters for scrapers/logs (utils/metrics.py style)."""
+        return {
+            "slots": self.slots,
+            "active": self.active,
+            "pending": self.pending,
+            "finished_total": self._finished_total,
+            "cancelled_total": self._cancelled,
+            "generated_tokens_total": self._tokens_total,
+            "decode_steps_total": self._steps_total,
+        }
 
     # -- slot lifecycle --------------------------------------------------
 
@@ -215,6 +259,8 @@ class ServingEngine:
             uid=req.uid,
             tokens=np.concatenate([req.prompt,
                                    np.asarray(gen, np.int32)])))
+        self._finished_total += 1
+        self._tokens_total += len(gen)
         self._req[slot] = None
         self._generated[slot] = []
         self._temps[slot] = 0.0
@@ -264,6 +310,7 @@ class ServingEngine:
             nxt = np.asarray(nxt_dev, np.int32)
         else:
             nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        self._steps_total += 1
         for slot in active:
             self._pos[slot] += 1
             self._generated[slot].append(int(nxt[slot]))

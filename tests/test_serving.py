@@ -112,6 +112,39 @@ class TestServingEngine:
             eng.submit(Request(uid="x", prompt=prompt(9, 40),
                                max_new=20))
 
+    def test_cancel_queued_and_active(self):
+        """cancel() drops a queued request before it runs and frees an
+        active slot immediately; cancelled uids never reach the
+        finished stream and the freed slot serves later requests."""
+        p = params()
+        eng = ServingEngine(p, CFG, slots=1)
+        for uid in ("a", "b", "c"):
+            eng.submit(Request(uid=uid, prompt=prompt(50, 4),
+                               max_new=6))
+        assert eng.cancel("b") is True            # still queued
+        eng.step()                                # "a" fills the slot
+        assert eng.cancel("a") is True            # active
+        assert eng.cancel("zzz") is False
+        done = eng.run()
+        assert [f.uid for f in done] == ["c"]
+        np.testing.assert_array_equal(
+            done[0].tokens, reference(p, prompt(50, 4), 6))
+        stats = eng.stats()
+        assert stats["finished_total"] == 1
+        assert stats["cancelled_total"] == 2      # queued AND active
+        # "a" was cancelled after its prefill token + one decode step
+        # (the first step() both fills and decodes): that work counts
+        assert stats["generated_tokens_total"] == 6 + 2
+        assert stats["active"] == 0 and stats["pending"] == 0
+        assert stats["decode_steps_total"] > 0
+
+    def test_duplicate_uid_rejected(self):
+        eng = ServingEngine(params(), CFG, slots=1)
+        eng.submit(Request(uid="x", prompt=prompt(51, 4), max_new=2))
+        with pytest.raises(ValueError, match="in flight"):
+            eng.submit(Request(uid="x", prompt=prompt(52, 4),
+                               max_new=2))
+
     def test_idle_step_is_noop(self):
         eng = ServingEngine(params(), CFG, slots=1)
         assert eng.step() == []
