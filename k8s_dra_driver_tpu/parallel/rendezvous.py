@@ -119,7 +119,6 @@ def gang_psum(value: float) -> float:
     """Cross-process psum over the global mesh; every worker returns
     the same total = sum of all workers' values."""
     import jax
-    import jax.numpy as jnp  # noqa: F401  (keeps jit dtype promotion)
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 

@@ -108,8 +108,6 @@ class TestMeshPlacement:
     def test_train_step_consumes_loader_batches(self, tmp_path):
         """File -> loader -> as_global -> sharded train step: the loss
         decreases, proving the pipeline feeds real training."""
-        import dataclasses as dc
-
         from k8s_dra_driver_tpu.models import (TransformerConfig,
                                                make_train_step)
         cfg = TransformerConfig(vocab=128, d_model=64, n_layers=2,

@@ -12,8 +12,7 @@ import pytest
 
 from k8s_dra_driver_tpu.models import (TransformerConfig,
                                        greedy_generate, init_params)
-from k8s_dra_driver_tpu.models.serving import (Finished, Request,
-                                               ServingEngine)
+from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
 
 CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
                         d_head=8, d_ff=64, max_seq=48, n_kv_heads=2,
