@@ -486,17 +486,22 @@ class TestPipelineParallelModel:
 
     CFG = dataclasses.replace(SMALL, n_layers=4, pp_stages=4)
 
-    def test_forward_matches_sequential(self):
-        mesh = make_mesh(MeshSpec(dp=2, pp=4))
-        params = init_params(self.CFG, jax.random.PRNGKey(0))
+    @staticmethod
+    def _assert_pp_matches_seq(cfg):
+        """Shared pp-vs-sequential forward equivalence check."""
+        mesh = make_mesh(MeshSpec(dp=2, pp=cfg.pp_stages))
+        params = init_params(cfg, jax.random.PRNGKey(0))
         tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0,
-                                    self.CFG.vocab)
-        out_pp = jax.jit(lambda p, t: forward(p, t, self.CFG, mesh))(
+                                    cfg.vocab)
+        out_pp = jax.jit(lambda p, t: forward(p, t, cfg, mesh))(
             params, tokens)
-        out_seq = forward(params, tokens, self.CFG, mesh=None)
+        out_seq = forward(params, tokens, cfg, mesh=None)
         np.testing.assert_allclose(np.asarray(out_pp),
                                    np.asarray(out_seq),
                                    atol=2e-4, rtol=2e-4)
+
+    def test_forward_matches_sequential(self):
+        self._assert_pp_matches_seq(self.CFG)
 
     def test_train_step_reduces_loss(self):
         mesh = make_mesh(MeshSpec(dp=2, pp=4))
@@ -587,6 +592,14 @@ class TestPipelineParallelModel:
         back = unstage_params(staged, self.CFG)
         jax.tree.map(lambda a, b: np.testing.assert_array_equal(
             np.asarray(a), np.asarray(b)), params, back)
+
+    def test_composes_with_gqa_and_window(self):
+        """pp stages run the full attention feature set: GQA head
+        routing and sliding-window masking inside the pipelined layer
+        body must match the sequential reference exactly."""
+        self._assert_pp_matches_seq(dataclasses.replace(
+            SMALL, n_layers=4, pp_stages=4, n_kv_heads=2,
+            attention_window=8))
 
     def test_staged_params_decode_and_quantize(self):
         """A pp-trained (staged) state must flow into the serving
