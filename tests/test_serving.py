@@ -144,6 +144,48 @@ class TestServingEngine:
             eng.submit(Request(uid="x", prompt=prompt(52, 4),
                                max_new=2))
 
+    def test_random_schedule_fuzz_stays_exact(self):
+        """Seeded fuzz of the scheduler: random interleavings of
+        submits and cancels across steps must leave every surviving
+        request EXACTLY equal to its standalone greedy reference —
+        slot assignment, refill order, and cancellation timing are
+        scheduling details that can never leak into the math."""
+        p = params()
+        rng = np.random.default_rng(0)
+        eng = ServingEngine(p, CFG, slots=2)
+        submitted: dict = {}
+        cancelled: set = set()
+        finished: dict = {}
+        uid = 0
+        for step_i in range(40):
+            if rng.random() < 0.5 and len(submitted) < 12:
+                n_p, n_new = int(rng.integers(3, 11)), \
+                    int(rng.integers(1, 6))
+                pr = prompt(100 + uid, n_p)
+                eng.submit(Request(uid=uid, prompt=pr, max_new=n_new))
+                submitted[uid] = (pr, n_new)
+                uid += 1
+            if rng.random() < 0.15:
+                in_flight = [u for u in submitted
+                             if u not in cancelled
+                             and u not in finished]
+                if in_flight:
+                    victim = int(rng.choice(in_flight))
+                    if eng.cancel(victim):
+                        cancelled.add(victim)
+            for f in eng.step():
+                finished[f.uid] = f.tokens
+        for f in eng.run():
+            finished[f.uid] = f.tokens
+
+        expected = {u for u in submitted if u not in cancelled}
+        assert set(finished) == expected
+        for u in expected:
+            pr, n_new = submitted[u]
+            np.testing.assert_array_equal(
+                finished[u], reference(p, pr, n_new),
+                err_msg=f"request {u} diverged under fuzzed schedule")
+
     def test_idle_step_is_noop(self):
         eng = ServingEngine(params(), CFG, slots=1)
         assert eng.step() == []
