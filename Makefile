@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: all build lint test bench image native clean
+.PHONY: all build lint test test-fast bench image native clean
 
 all: build
 
@@ -21,6 +21,11 @@ lint:
 test:
 	-$(MAKE) -C native
 	$(PYTHON) -m pytest tests/ -q
+
+# the pre-commit loop (<4 min): everything but the compile-heavy and
+# real-subprocess tiers (tests/conftest.py SLOW_MODULES/SLOW_PREFIXES)
+test-fast:
+	$(PYTHON) -m pytest tests/ -m "not slow" -q
 
 bench:
 	$(PYTHON) bench.py
