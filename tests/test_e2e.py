@@ -202,6 +202,7 @@ class TestMultiHostGang:
         assert {v["TPU_TOPOLOGY"] for v in views} == {"4x4"}
         assert len({v["TPU_COORDINATOR_ADDRESS"] for v in views}) == 1
         assert {v["TPU_WORKER_ID"] for v in views} == {"0", "1", "2", "3"}
+        assert {v["TPU_NUM_WORKERS"] for v in views} == {"4"}
         assert len({v["TPU_RENDEZVOUS_CHANNEL"] for v in views}) == 1
         assert {v["TPU_SLICE_ID"] for v in views} == {"slice-a"}
 
