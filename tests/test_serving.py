@@ -144,6 +144,22 @@ class TestServingEngine:
             eng.submit(Request(uid="x", prompt=prompt(52, 4),
                                max_new=2))
 
+    def test_staged_pp_params_serve_exactly(self):
+        """A pp-trained (stage-stacked) checkpoint drops into the
+        engine unchanged: decode unstages internally and the outputs
+        stay exact vs the same params served unstaged."""
+        from k8s_dra_driver_tpu.models import stage_params
+        cfg = dataclasses.replace(CFG, n_layers=2, pp_stages=2)
+        p = init_params(cfg, jax.random.PRNGKey(0))
+        staged = stage_params(p, cfg)
+        pr = prompt(60, 6)
+        want = np.asarray(greedy_generate(
+            p, jnp.asarray(pr)[None, :], cfg, n_tokens=4)[0], np.int32)
+        eng = ServingEngine(staged, cfg, slots=2)
+        eng.submit(Request(uid="pp", prompt=pr, max_new=4))
+        done = eng.run()
+        np.testing.assert_array_equal(done[0].tokens, want)
+
     def test_random_schedule_fuzz_stays_exact(self):
         """Seeded fuzz of the scheduler: random interleavings of
         submits and cancels across steps must leave every surviving
