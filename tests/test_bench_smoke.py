@@ -32,6 +32,19 @@ def test_gang_path_hermetic_tier():
     assert out["samples"] == 2
 
 
+def test_serving_probe_tiny():
+    """The continuous-batching probe's bookkeeping (warmup, drain,
+    lower-bound fields) at the hermetic CPU shape bench.py streams."""
+    from k8s_dra_driver_tpu.ops import serving_probe
+    out = serving_probe(slots=2, n_requests=4, n_layers=2, d_model=128,
+                        heads=4, kv_heads=2, d_ff=256, prompt_len=12,
+                        max_new=6, max_seq=64)
+    assert out["valid"] is True
+    assert out["generated_tokens"] == 4 * 6
+    assert out["tokens_per_s_lower_bound"] > 0
+    assert out["per_step_ms_upper_bound"] > 0
+
+
 def test_rendezvous_gang_probe():
     """The contract→collective probe at reduced width: two real
     processes consume a real prepare's env and psum across processes."""
