@@ -90,6 +90,10 @@ class BatchLoader:
     step: int = 0
 
     def __post_init__(self):
+        if self.batch < 1 or self.seq_len < 1:
+            raise ValueError(
+                f"batch ({self.batch}) and seq_len ({self.seq_len}) "
+                "must be >= 1")
         n = len(self.tokens)
         window = self.seq_len
         self.n_windows = n // window

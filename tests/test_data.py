@@ -94,6 +94,12 @@ class TestBatchLoader:
         with pytest.raises(ValueError, match="windows"):
             BatchLoader(corpus(n=64), batch=4, seq_len=32)
 
+    def test_degenerate_shape_rejected(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            BatchLoader(corpus(), batch=4, seq_len=0)
+        with pytest.raises(ValueError, match=">= 1"):
+            BatchLoader(corpus(), batch=0, seq_len=32)
+
 
 class TestMeshPlacement:
     def test_as_global_shards_batch_axes(self):
