@@ -78,16 +78,37 @@ class CoordinatedSettings:
     # quantity strings ("8Gi") or ints (bytes).
     per_device_hbm_limits: dict[str, str | int] = dataclasses.field(
         default_factory=dict)
+    # Daemon-side enforcement (claim-driven, not just daemon flags):
+    # SIGSTOP/SIGCONT registered workers to the schedule and act on
+    # violations (HBM overage, unregistered /dev/accel* holders).
+    # The rendered coordinator pod runs hostPID+privileged either
+    # way (the scan needs it); these choose what it DOES.
+    enforce: bool = False
+    # "report" records violations in status.json; "terminate"
+    # additionally SIGTERMs violators when enforcing.
+    violation_action: str = "report"
 
     def normalize(self) -> None:
         if self.duty_cycle_percent == 0:
             self.duty_cycle_percent = 100
+        if not self.violation_action:
+            self.violation_action = "report"
 
     def validate(self) -> None:
         if not 1 <= self.duty_cycle_percent <= 100:
             raise ConfigError(
                 f"dutyCyclePercent must be in [1,100], got "
                 f"{self.duty_cycle_percent}")
+        if not isinstance(self.enforce, bool):
+            # a truthy string like "false" must not silently enable
+            # SIGSTOP/SIGTERM enforcement — the opposite of intent
+            raise ConfigError(
+                f"enforce must be a JSON boolean, got "
+                f"{self.enforce!r}")
+        if self.violation_action not in ("report", "terminate"):
+            raise ConfigError(
+                f"violationAction must be 'report' or 'terminate', "
+                f"got {self.violation_action!r}")
         for key, val in self.per_device_hbm_limits.items():
             try:
                 parse_quantity(val)

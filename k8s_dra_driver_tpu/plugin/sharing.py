@@ -157,6 +157,8 @@ class CoordinatorDaemon:
             visible_chips=",".join(str(c) for c in chips),
             coordination_dir=str(cdir),
             policy_dir=str(self.manager.policy_dir),
+            enforce="true" if self.settings.enforce else "false",
+            hbm_action=self.settings.violation_action,
         )
         manifest = yaml.safe_load(spec_text)
         deployment = Deployment(

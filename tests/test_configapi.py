@@ -111,6 +111,27 @@ class TestSharingValidation:
             with pytest.raises(ConfigError):
                 s.validate()
 
+    def test_enforcement_fields_decode_and_validate(self):
+        """Claim-driven enforcement: enforce/violationAction ride the
+        opaque config into the coordinator deployment."""
+        cfg = decode({"apiVersion": API_VERSION,
+                      "kind": "TpuChipConfig",
+                      "sharing": {"strategy": "Coordinated",
+                                  "coordinated": {
+                                      "dutyCyclePercent": 50,
+                                      "enforce": True,
+                                      "violationAction": "terminate"}}})
+        cfg.normalize(); cfg.validate()
+        assert cfg.sharing.coordinated.enforce is True
+        assert cfg.sharing.coordinated.violation_action == "terminate"
+        bad = CoordinatedSettings(violation_action="reboot")
+        with pytest.raises(ConfigError, match="violationAction"):
+            bad.validate()
+        # a truthy STRING must not silently enable enforcement
+        sneaky = CoordinatedSettings(enforce="false")
+        with pytest.raises(ConfigError, match="boolean"):
+            sneaky.validate()
+
 
 class TestHbmLimitResolution:
     """Table-driven, mirroring sharing_test.go's coverage of

@@ -160,7 +160,8 @@ class TestTemplateBuildCoherence:
             duty_cycle_percent="50",
             preemption_ms="0", hbm_limits="", visible_chips="0",
             coordination_dir=str(tmp_path / "c"),
-            policy_dir=str(tmp_path / "p"))
+            policy_dir=str(tmp_path / "p"),
+            enforce="true", hbm_action="terminate")
         return yaml.safe_load(text)
 
     def test_command_is_a_declared_entrypoint(self, tmp_path):
@@ -181,6 +182,27 @@ class TestTemplateBuildCoherence:
         assert ns.coordination_dir == "/coordination"
         assert ns.duty_cycle_percent == 50
         assert ns.policy_dir == "/policy"
+        assert ns.hbm_action == "terminate"
+
+    def test_enforcement_posture_is_complete(self, tmp_path):
+        """Claim-driven enforcement end to end: the pod that may
+        SIGSTOP/SIGTERM host pids and scan /proc/*/fd must carry
+        hostPID + privileged + the ENFORCE env the binary reads, and
+        host /dev so the holder scan's path resolution works — with
+        the termination log moved off the now-read-only /dev."""
+        manifest = self.render(tmp_path)
+        pod = manifest["spec"]["template"]["spec"]
+        ctr = pod["containers"][0]
+        assert pod["hostPID"] is True
+        assert ctr["securityContext"]["privileged"] is True
+        env = {e["name"]: e["value"] for e in ctr["env"]}
+        assert env["ENFORCE"] == "true"
+        assert ctr["terminationMessagePath"].startswith("/coordination")
+        dev_mounts = [m for m in ctr["volumeMounts"]
+                      if m["mountPath"] == "/dev"]
+        assert dev_mounts and dev_mounts[0]["readOnly"] is True
+        vols = {v["name"]: v for v in pod["volumes"]}
+        assert vols["dev"]["hostPath"]["path"] == "/dev"
 
     def test_readiness_probe_matches_ready_file(self, tmp_path):
         manifest = self.render(tmp_path)
