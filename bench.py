@@ -550,7 +550,15 @@ def _tpu_probes():
 
 
 def tpu_probe_stream() -> None:
-    """Child-process entry: stream one JSON line per finished probe."""
+    """Child-process entry: stream one JSON line per finished probe.
+
+    Persistent compilation cache first (utils/compcache.py): probe
+    wall time on the tunneled chip is compile-dominated, and a warm
+    cache is the difference between every probe landing and the child
+    dying at the deadline with decode/serving still queued.
+    """
+    from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
+    enable_persistent_cache()
     for key, res in _tpu_probes():
         print(json.dumps({"probe": key, "result": res}), flush=True)
 

@@ -32,10 +32,17 @@ def make_allocated_claim(name, assignments, configs=(), namespace="default",
     return claim
 
 
+def _sharing_config(kind, strategy, kw):
+    return {"apiVersion": API_VERSION, "kind": kind,
+            "sharing": {"strategy": strategy, **kw}}
+
+
 def chip_config(strategy="Exclusive", **kw):
-    p = {"apiVersion": API_VERSION, "kind": "TpuChipConfig",
-         "sharing": {"strategy": strategy, **kw}}
-    return p
+    return _sharing_config("TpuChipConfig", strategy, kw)
+
+
+def partition_config(strategy="Exclusive", **kw):
+    return _sharing_config("TpuPartitionConfig", strategy, kw)
 
 
 def _resolve_mounts(pod_spec: dict) -> dict[str, str]:

@@ -45,6 +45,31 @@ def test_serving_probe_tiny():
     assert out["per_step_ms_upper_bound"] > 0
 
 
+def test_persistent_compile_cache_populates(tmp_path):
+    """utils/compcache.py: the perf harnesses' shared compilation
+    cache actually caches — a jit compile in a fresh process with the
+    cache enabled leaves serialized executables on disk (isolated
+    subprocess: the cache config is process-global)."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import cpu_jax_env
+
+    code = (
+        "from k8s_dra_driver_tpu.utils.compcache import "
+        "enable_persistent_cache\n"
+        f"assert enable_persistent_cache({str(tmp_path)!r}, "
+        "min_compile_s=0.0)\n"
+        "import jax, jax.numpy as jnp\n"
+        "jax.jit(lambda x: jnp.dot(x, x).sum())"
+        "(jnp.ones((256, 256))).block_until_ready()\n")
+    res = subprocess.run([sys.executable, "-c", code],
+                         cwd=Path(__file__).parent.parent,
+                         env=cpu_jax_env(1), capture_output=True,
+                         text=True, timeout=120)
+    assert res.returncode == 0, res.stderr[-500:]
+    assert any(tmp_path.iterdir()), "no cache entries written"
+
+
 def test_rendezvous_gang_probe():
     """The contract→collective probe at reduced width: two real
     processes consume a real prepare's env and psum across processes."""

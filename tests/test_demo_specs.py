@@ -20,7 +20,8 @@ from k8s_dra_driver_tpu.plugin import DeviceState
 
 from testbed import E2EBed
 
-SPEC_DIR = Path(__file__).parent.parent / "demo" / "specs" / "quickstart"
+SPECS_ROOT = Path(__file__).parent.parent / "demo" / "specs"
+SPEC_DIR = SPECS_ROOT / "quickstart"
 
 
 @pytest.fixture(autouse=True)
@@ -28,12 +29,21 @@ def no_sleep(monkeypatch):
     monkeypatch.setattr(DeviceState, "_sleep", staticmethod(lambda s: None))
 
 
-def load(name: str) -> dict[str, list[dict]]:
+def load(name: str, subdir: str = "quickstart") -> dict[str, list[dict]]:
     """Load a spec file, grouped by kind."""
     out: dict[str, list[dict]] = {}
-    for doc in yaml.safe_load_all((SPEC_DIR / name).read_text()):
+    for doc in yaml.safe_load_all((SPECS_ROOT / subdir / name).read_text()):
         if doc:
             out.setdefault(doc["kind"], []).append(doc)
+    return out
+
+
+def load_many(subdir: str, *names: str) -> dict[str, list[dict]]:
+    """Load several spec files of one demo tree, merged by kind."""
+    out: dict[str, list[dict]] = {}
+    for name in names:
+        for kind, docs in load(name, subdir=subdir).items():
+            out.setdefault(kind, []).extend(docs)
     return out
 
 
@@ -239,6 +249,107 @@ def test_slice_test1_gang(tmp_path):
         assert topos == {"4x4"}
         for v_chip, _ in views:
             assert len(v_chip.visible_chips) == 4
+    finally:
+        bed.shutdown()
+
+
+def test_selectors_demo_inference_vs_training(tmp_path):
+    """demo/specs/selectors/: the modernized v1alpha2 selector demo —
+    CEL steers inference to a v5p core partition and training to an
+    ICI-contiguous 2x2 slice on a mixed-generation fleet."""
+    bed = E2EBed(tmp_path, [FakeHost(generation="v5p", hostname="p0"),
+                            FakeHost(hostname="e0")])
+    try:
+        r = SpecRunner(bed, load_many("selectors", "claims.yaml",
+                                      "pods.yaml"))
+        by_name = {p["metadata"]["name"]: p for p in r.pods}
+        vi = r.run(by_name["inference-pod"])
+        pairs = vi.env["TPU_VISIBLE_CORES"].split(",")
+        assert len(pairs) == 1, "inference gets exactly one core"
+        assert vi.node == "p0", "generation selector pins to the v5p host"
+        vt = r.run(by_name["training-pod"])
+        assert len(vt.visible_chips) == 4, "2x2 slice = four chips"
+        assert vt.node == "e0"
+    finally:
+        bed.shutdown()
+
+
+def test_sharing_demo_matrix(tmp_path):
+    """demo/specs/partition+coordinated/: the modernized mig+mps
+    sharing demo — one Job, two replicas, four shared claims covering
+    {chip, core} × {TimeSlicing, Coordinated, Exclusive}; replicas
+    must land on the SAME devices per claim and the two coordinated
+    claims get one coordinator Deployment each."""
+    import copy
+
+    bed = E2EBed(tmp_path, [FakeHost(generation="v5p", hostname="p0")])
+    try:
+        docs = load_many("partition+coordinated",
+                         "sharing-demo-claims.yaml",
+                         "sharing-demo-job.yaml")
+        (job,) = docs["Job"]
+        replicas = int(job["spec"]["parallelism"])
+        docs["Pod"] = [
+            {"kind": "Pod",
+             "metadata": {"name": f"sharing-demo-job-{i}",
+                          "namespace": "sharing-demo"},
+             "spec": copy.deepcopy(job["spec"]["template"]["spec"])}
+            for i in range(replicas)]
+        r = SpecRunner(bed, docs)
+        assert set(r.shared) == {"chip-ts-sharing", "chip-co-sharing",
+                                 "core-co-sharing", "core-exclusive"}
+        # each replica's views arrive in resourceClaims order
+        views = [r.run(p) for p in docs["Pod"]]
+        claim_names = [c["name"]
+                       for c in job["spec"]["template"]["spec"]
+                       ["resourceClaims"]]
+        per_claim = dict(zip(claim_names, zip(*views)))
+        for name, vs in per_claim.items():
+            devs = {tuple(v.visible_chips) for v in vs}
+            assert len(devs) == 1, f"{name}: replicas must share devices"
+        ts_view = per_claim["chip-ts-sharing"][0]
+        assert ts_view.env["TPU_RUNTIME_PREEMPTION_MS"] == "5"  # Medium
+        co_view = per_claim["chip-co-sharing"][0]
+        assert co_view.env["TPU_COORDINATOR_DUTY_CYCLE_PCT"] == "50"
+        core_co = per_claim["core-co-sharing"][0]
+        assert core_co.env["TPU_COORDINATOR_DUTY_CYCLE_PCT"] == "25"
+        assert len(core_co.env["TPU_VISIBLE_CORES"].split(",")) == 1
+        # distinct core partitions for the two core claims
+        assert (per_claim["core-co-sharing"][0].env["TPU_VISIBLE_CORES"]
+                != per_claim["core-exclusive"][0]
+                .env["TPU_VISIBLE_CORES"])
+        # one coordinator Deployment per coordinated claim, shared by
+        # both replicas (not one per consumer)
+        assert len(bed.cluster.list("Deployment")) == 2
+    finally:
+        bed.shutdown()
+
+
+def test_partition_timeslicing_rejected(tmp_path):
+    """The matrix cell with no TPU equivalent: TimeSlicing on a core
+    partition fails validation in-band at prepare, mirroring the
+    reference's TimeSlicing-on-MIG rejection (sharing.go:103-110)."""
+    from helpers import partition_config
+
+    bed = E2EBed(tmp_path, [FakeHost(generation="v5p", hostname="p0")])
+    try:
+        claim = bed.create_claim(resource.ResourceClaim(
+            metadata=resource.ObjectMeta(name="bad-ts",
+                                         namespace="sharing-demo"),
+            spec=resource.ResourceClaimSpec(
+                devices=resource.DeviceClaim(
+                    requests=[resource.DeviceRequest(
+                        name="core",
+                        device_class_name="tpu-core.google.com",
+                        count=1)],
+                    config=[resource.ClaimConfig(
+                        opaque=resource.OpaqueConfig(
+                            driver="tpu.google.com",
+                            parameters=partition_config(
+                                "TimeSlicing",
+                                timeSlicing={"interval": "Short"})))]))))
+        with pytest.raises(RuntimeError, match="TimeSlicing"):
+            bed.run_pod(claim)
     finally:
         bed.shutdown()
 

@@ -72,6 +72,8 @@ def measure(shape: dict, int8: bool, kernel: bool = False,
 
 
 def main() -> None:
+    from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
+    enable_persistent_cache()
     import jax
     out = {
         "what": ("decode ms/token for bf16 vs weight-only int8, kernel "

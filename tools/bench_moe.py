@@ -78,6 +78,8 @@ def bench_shape(base, batch, seq):
 
 
 def main() -> None:
+    from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
+    enable_persistent_cache()
     import jax
 
     from k8s_dra_driver_tpu.models import TransformerConfig

@@ -41,6 +41,8 @@ CONFIGS = [
 
 
 def main() -> None:
+    from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
+    enable_persistent_cache()
     import jax
 
     from k8s_dra_driver_tpu.ops import attention_probe
