@@ -22,8 +22,11 @@ runs in a child process that streams one JSON line per finished probe;
 the parent never imports jax, enforces a hard deadline on the child,
 keeps whatever streamed out before a kill, builds the result dict
 incrementally, and flushes it on SIGTERM/SIGINT.  A wall budget
-(``BENCH_WALL_BUDGET_S``, default 420 s) gates each section so the
-harness timeout is never the thing that ends the run.
+(``BENCH_WALL_BUDGET_S``, default 630 s) gates each section so the
+harness timeout is never the thing that ends the run; the full probe
+chain measured 495 s warm-cache end-to-end, and even if a stricter
+harness SIGTERMs first, the handler still flushes every finished
+section.
 """
 
 from __future__ import annotations
@@ -43,7 +46,7 @@ sys.path.insert(0, str(REPO / "tests"))
 
 REFERENCE_MPS_BACKOFF_FLOOR_MS = 1000.0
 
-_WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", "420"))
+_WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", "630"))
 _DEADLINE = time.monotonic() + _WALL_BUDGET_S
 
 
@@ -417,16 +420,25 @@ def _tpu_probes():
         return shaped(label, res, errs, fields), res
 
     def attn_fields(res):
-        return {"flash_ms": round(res["flash_ms"], 3),
-                "naive_ms": round(res["naive_ms"], 3),
-                "flash_tflops": round(res["flash_tflops"], 2),
-                "speedup_vs_naive": round(res["speedup"], 2),
-                "valid": res["valid"]}
+        out = {"flash_ms": round(res["flash_ms"], 3),
+               "naive_ms": round(res["naive_ms"], 3),
+               "flash_tflops": round(res["flash_tflops"], 2),
+               "speedup_vs_naive": round(res["speedup"], 2),
+               "valid": res["valid"]}
+        if "flash_ms_runs" in res:
+            out["flash_ms_runs"] = res["flash_ms_runs"]
+        return out
 
     def attn_attempts(shapes, probe=attention_probe):
+        # median-of-3 flash sampling over ONE compiled chain pair
+        # (measure_chain_samples): sub-ms flash times jitter up to
+        # ~2x on the tunneled backend — a one-shot GQA probe once
+        # recorded 2.7 ms where repetition shows 0.52 ms — and the
+        # extra samples are measurement-priced, not compile-priced.
+        kw = {"samples": 3} if on_accel else {}
         return [(f"b{b}_t{t}_h{h}",
                  lambda b=b, t=t, h=h, i=i: probe(
-                     batch=b, seq=t, heads=h, iters=i))
+                     batch=b, seq=t, heads=h, iters=i, **kw))
                 for b, t, h, i in shapes]
 
     # flash-vs-naive attention (compiled pallas, blocks from the

@@ -3,13 +3,16 @@
 Decode on TPU is HBM-bound: every generated token re-streams the full
 weight set (plus the static KV cache), so tokens/s tracks the byte
 count — compute is nowhere near the bottleneck.  Recorded on v5e
-(tools/int8_decode_v5e.json, best-valid over interleaved rounds,
+(tools/int8_decode_v5e.json, differential-median harness,
 physical-floor-checked over weights+cache bytes): int8 decode (the
-default XLA path) is **1.3x** bf16 at 154M params and **3.7x** at
-660M (0.84 vs 3.13 ms/token, ~950 GB/s implied on the int8 working
-set); int8 weights + int8 KV cache reached **2.0x** at 154M.  This
-module quantizes weights to int8 with **per-output-channel symmetric
-scales**, shaped so the matmul itself consumes only the int8 tensor:
+default XLA path) wins in the weight-bound regime — **1.58x** bf16
+tokens/s at 660M in the latest capture (3.7x in an earlier one) —
+while at 154M, where bf16 decode already streams near HBM peak,
+captures disagree within tunnel jitter (the latest shows int8+int8-KV
+*regressing* there; see the artifact before claiming any 154M
+ratio).  This module quantizes weights to int8 with
+**per-output-channel symmetric scales**, shaped so the matmul itself
+consumes only the int8 tensor:
 
 - quantize:  ``scale = max|w| / 127`` over the *contraction* dims,
   ``q = round(w / scale)`` — one scale per output channel, no zero
@@ -313,14 +316,18 @@ def qeinsum(spec: str, x: jax.Array, w: QTensor) -> jax.Array:
     the dot reads int8: exact int8->dtype convert fused into the
     contraction, per-channel rescale on the output.
 
-    The default is the XLA einsum: measured on v5e it fuses the int8
-    convert into the dot and is the fastest int8 path at every
-    recorded decode shape (tools/int8_decode_v5e.json — 1.3x bf16 at
-    154M, 3.7x at 660M params).  ``TPU_QUANT_KERNEL=1`` routes
+    The default is the XLA einsum: it fuses the int8 convert into
+    the dot and wins where int8 weights pay at all — the weight-bound
+    regime (tools/int8_decode_v5e.json: 1.58x bf16 at 660M in the
+    latest capture, 3.7x in an earlier one; at 154M, where decode
+    already streams near HBM peak, captures disagree on sign and the
+    deltas are tunnel-jitter-sized).  ``TPU_QUANT_KERNEL=1`` routes
     small-M contractions (the autoregressive decode shape) through
     the pallas ``int8_matmul``/``int8_bmm`` kernels instead, which
     convert int8->bf16 in VMEM so the traffic is int8-sized by
-    construction rather than by XLA's fusion choice.
+    construction rather than by XLA's fusion choice; it has not
+    beaten the XLA path at a weight-bound shape in any capture, so
+    it stays opt-in.
 
     Differentiable in ``x`` only (pallas has no JVP rule — same
     custom-VJP treatment as the flash kernels): the int8 weights are

@@ -79,13 +79,13 @@ class TransformerConfig:
     # and SPMD-shardable (the dispatch einsums partition along ep);
     # "gmm" is the dropless single-device pallas grouped-matmul path
     # (ops/gmm.py): tokens sorted by expert, no dispatch tensors, no
-    # drops.  Recorded v5e train-step medians
-    # (tools/moe_dispatch_v5e.json): capacity 4.25x dense and gmm
-    # 2.5x dense at E16/dff4096 — capacity is the fastest measured,
-    # gmm the fastest *exact* (drop-free) option.  (That artifact
-    # predates the index-only dispatch rewrite in _moe_mlp_gmm —
-    # float-row scatters replaced by int32-index scatters + row
-    # gathers — and is refreshed at the next hardware window.)
+    # drops.  Recorded v5e train-step medians, index-only dispatch
+    # rewrite included (tools/moe_dispatch_v5e.json): capacity 3.55x
+    # dense and gmm 2.58x at E16/dff4096; 1.37x vs 1.17x at E8 mixed.
+    # Guidance: default to "capacity" for throughput — it beats gmm
+    # at every recorded shape; reach for "gmm" only when token drops
+    # are unacceptable (exact routing), and expect ~25-40% slower
+    # steps than capacity for that guarantee.
     moe_dispatch: str = "dense"
     capacity_factor: float = 1.25
     # Router auxiliary losses (training-quality guards; 0 disables):
@@ -100,15 +100,15 @@ class TransformerConfig:
     # Serving KV-cache storage: "model" keeps cache entries in the
     # model dtype; "int8" stores them quantized with one symmetric
     # scale per (batch, position, kv-head) — always halves cache
-    # *storage* (2x the batch x context per chip).  Speed, per the
-    # recorded artifact (tools/int8_decode_v5e.json, v5e): use int8
-    # KV when the CACHE dominates streamed bytes per token — 2.0x
-    # tokens/s at 154M/B8 (cache >> weights) — and keep "model" when
-    # the WEIGHTS dominate: at 660M the read-side dequant did not
-    # fuse and int8-weights-alone decoded 3x faster than
-    # int8-weights + int8-KV (0.84 vs 2.54 ms/token).  Rule of thumb:
-    # int8 KV for context capacity and cache-bound shapes; measure
-    # before enabling it on weight-bound ones.
+    # *storage* (2x the batch x context per chip); that capacity
+    # claim is structural.  Speed is capture-dependent on the
+    # tunneled v5e (tools/int8_decode_v5e.json): latest capture has
+    # int8 weights + int8 KV at 1.34x bf16 tokens/s at 660M (weights
+    # -only int8 is faster still, 1.58x) and a clear regression at
+    # 154M where bf16 decode already streams near HBM peak.  Rule of
+    # thumb: enable int8 KV for context capacity; treat any speed
+    # delta as shape-specific and measure at yours before relying on
+    # it.
     kv_cache_dtype: str = "model"
     # RoPE base; raise (e.g. 500000) to stretch rotation wavelengths
     # for long-context serving beyond the training length.
@@ -453,9 +453,10 @@ def _moe_mlp_gmm(x, gates, layer, cfg: TransformerConfig):
     [n, k, d] weighted sum) because TPU scatters of wide float rows
     serialize where gathers pipeline.  Under ``jax.grad`` the
     gathers' transposes are still scatter-adds (autodiff), so the
-    training-step benefit is bounded by the forward half;
-    tools/moe_dispatch_v5e.json predates this rewrite and is the
-    artifact to refresh before claiming any ratio.
+    training-step benefit is bounded by the forward half.  Recorded
+    with this rewrite (tools/moe_dispatch_v5e.json): 2.58x dense at
+    E16 (capacity: 3.55x), 1.17x at E8 mixed (capacity: 1.37x) —
+    exact routing costs ~25-40% of a step vs capacity's drops.
     """
     from ..ops.gmm import gmm
 

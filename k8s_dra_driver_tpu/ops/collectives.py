@@ -9,6 +9,7 @@ algorithmic bus bandwidth reported the way collective benchmarks do
 from __future__ import annotations
 
 import functools
+import statistics
 import time
 
 import jax
@@ -120,6 +121,22 @@ _PEAK_TFLOPS_CEILING = 400.0
 _PEAK_HBM_GBPS_CEILING = 2000.0
 
 
+def _measure_pair(long_fn, short_fn, arg, iters: int, short: int,
+                  floor_s: float, retries: int) -> tuple[float, bool]:
+    """One differential measurement over an already-built chain pair,
+    with the invalid-retry loop (non-positive or below-floor
+    differentials are artifacts, either direction)."""
+    elapsed, valid = None, False
+    for _ in range(retries):
+        elapsed, valid, _ = _differential_median(
+            long_fn, short_fn, arg, iters, short)
+        if valid and elapsed < floor_s:
+            valid = False
+        if valid:
+            break
+    return elapsed, valid
+
+
 def measure_chain(make, arg, iters: int, floor_s: float = 0.0,
                   retries: int = 3) -> tuple[float, bool]:
     """Differential-median timing with artifact rejection.
@@ -132,22 +149,41 @@ def measure_chain(make, arg, iters: int, floor_s: float = 0.0,
     """
     short = max(iters // 4, 1)
     long_fn, short_fn = make(iters), make(short)
-    elapsed, valid = None, False
-    for _ in range(retries):
-        elapsed, valid, _ = _differential_median(
-            long_fn, short_fn, arg, iters, short)
-        if valid and elapsed < floor_s:
-            valid = False
-        if valid:
-            break
-    return elapsed, valid
+    return _measure_pair(long_fn, short_fn, arg, iters, short,
+                         floor_s, retries)
+
+
+def measure_chain_samples(make, arg, iters: int, floor_s: float = 0.0,
+                          samples: int = 3, retries: int = 3
+                          ) -> tuple[float, bool, list]:
+    """Median-of-``samples`` differential timing, ONE compiled pair.
+
+    Single differential measurements on the tunneled backend jitter
+    up to ~2x in either direction (a one-shot GQA probe once recorded
+    2.7 ms where repetition shows 0.52 ms); re-running a whole probe
+    recompiles its chains (fresh jit closures), so the repetition
+    lives here instead — the pair compiles once and only the
+    measurement repeats.  Returns ``(median_elapsed, valid, runs)``
+    with every sample listed as ``{"ms", "valid"}`` so outliers stay
+    visible in recorded artifacts.
+    """
+    short = max(iters // 4, 1)
+    long_fn, short_fn = make(iters), make(short)
+    runs = [_measure_pair(long_fn, short_fn, arg, iters, short,
+                          floor_s, retries) for _ in range(samples)]
+    pool = [e for e, v in runs if v] or [e for e, _ in runs]
+    med = statistics.median_low(pool)
+    valid = any(v for e, v in runs if e == med)
+    return med, valid, [{"ms": round(e * 1000, 3), "valid": v}
+                        for e, v in runs]
 
 
 def _attention_differential(batch, seq, heads, head_dim, iters, dtype,
                             interpret, block_q, block_k,
                             matmuls, make_body,
                             kv_heads: int | None = None,
-                            window: int | None = None) -> dict:
+                            window: int | None = None,
+                            samples: int = 1) -> dict:
     """Shared flash-vs-naive harness behind both attention probes.
 
     Identical q/k/v generation, physical-floor computation, chain
@@ -155,7 +191,12 @@ def _attention_differential(batch, seq, heads, head_dim, iters, dtype,
     per-iteration body (``make_body(attn, k, v) -> fori body``) and the
     matmul count that sets the FLOP model.  ``kv_heads`` < heads
     probes the grouped-query path (score/output FLOPs are unchanged —
-    GQA trims K/V HBM traffic, not MXU work).
+    GQA trims K/V HBM traffic, not MXU work).  ``samples`` > 1 takes
+    the median of that many flash measurements over ONE compiled
+    chain pair (measure_chain_samples) and lists every run under
+    ``flash_ms_runs`` — sub-ms flash times jitter up to ~2x on the
+    tunneled backend, and a single unlucky run must not set a
+    recorded number.
     """
     from .flash_attention import flash_attention
     from .ring_attention import attention_reference
@@ -197,11 +238,16 @@ def _attention_differential(batch, seq, heads, head_dim, iters, dtype,
                               block_k=block_k, window=window)
     naive = functools.partial(attention_reference, causal=True,
                               window=window)
-    t_flash, flash_valid = measure_chain(make_chain(flash), q, iters,
-                                         floor_s)
+    flash_runs = None
+    if samples > 1:
+        t_flash, flash_valid, flash_runs = measure_chain_samples(
+            make_chain(flash), q, iters, floor_s, samples=samples)
+    else:
+        t_flash, flash_valid = measure_chain(make_chain(flash), q,
+                                             iters, floor_s)
     t_naive, naive_valid = measure_chain(make_chain(naive), q, iters,
                                          naive_floor_s)
-    return {
+    out = {
         "batch": batch, "seq": seq, "heads": heads, "head_dim": head_dim,
         "kv_heads": kv_heads or heads, "window": window,
         "flash_ms": t_flash * 1000, "naive_ms": t_naive * 1000,
@@ -210,6 +256,9 @@ def _attention_differential(batch, seq, heads, head_dim, iters, dtype,
         "speedup": t_naive / t_flash,
         "valid": flash_valid and naive_valid,
     }
+    if flash_runs is not None:
+        out["flash_ms_runs"] = flash_runs
+    return out
 
 
 def attention_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
@@ -218,7 +267,8 @@ def attention_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
                     block_q: int | None = None,
                     block_k: int | None = None,
                     kv_heads: int | None = None,
-                    window: int | None = None) -> dict:
+                    window: int | None = None,
+                    samples: int = 1) -> dict:
     """Flash (pallas) vs naive (XLA) causal attention on the device.
 
     The fused-kernel half of the BASELINE workload story: same chained
@@ -237,7 +287,8 @@ def attention_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
     # forward only: 2 matmuls
     return _attention_differential(batch, seq, heads, head_dim, iters,
                                    dtype, interpret, block_q, block_k,
-                                   2, make_body, kv_heads, window)
+                                   2, make_body, kv_heads, window,
+                                   samples)
 
 
 def attention_grad_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
@@ -246,7 +297,8 @@ def attention_grad_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
                          interpret: bool | None = None,
                          block_q: int | None = None,
                          block_k: int | None = None,
-                         kv_heads: int | None = None) -> dict:
+                         kv_heads: int | None = None,
+                         samples: int = 1) -> dict:
     """Training-path probe: full fwd+bwd attention, pallas flash
     (forward kernel + pallas flash backward) vs naive XLA autodiff.
     Same hardened differential harness as attention_probe."""
@@ -265,7 +317,8 @@ def attention_grad_probe(batch: int = 4, seq: int = 2048, heads: int = 8,
     # fwd 2 matmuls + bwd 5 matmuls
     return _attention_differential(batch, seq, heads, head_dim, iters,
                                    dtype, interpret, block_q, block_k,
-                                   7, make_body, kv_heads)
+                                   7, make_body, kv_heads,
+                                   samples=samples)
 
 
 def matmul_tflops(dim: int = 4096, iters: int = 400,

@@ -44,9 +44,11 @@ Grouped-query/multi-query attention is native: k/v may carry H_kv < H
 heads (H a multiple of H_kv) and the kernels' K/V BlockSpec index maps
 route each query head's programs to its group's block — no repeated
 K/V tensor in HBM, forward or backward.  Measured on v5e at
-B4/T2048/H8/D64: H_kv=2 runs the forward kernel 1.9x faster than
-H_kv=8 (0.25 ms vs 0.47 ms, 10.5x naive XLA) because the kernel is
-K/V-bandwidth-bound at that shape.
+B4/T2048/H8/D64 the forward kernel runs at MHA speed (~0.52 ms for
+H_kv ∈ {2, 8}); the win is the 4x smaller K/V footprint in HBM and
+cache, never a compute penalty.  (An earlier capture showed H_kv=2
+1.9x faster; repeated measurement attributes that to tunnel timing
+jitter — treat single-run deltas on this backend as noise.)
 
 Sliding-window (local) attention: ``window=W`` masks each query to its
 W most recent positions and — in the single-device (zero-offset) path
@@ -54,13 +56,18 @@ W most recent positions and — in the single-device (zero-offset) path
 only the ≤ceil((block+W)/block)+1 blocks the window can touch, with
 index maps translating window-relative to absolute blocks.  Skipped
 blocks get no grid step at all (structurally: T=8192/W=1024 at
-512-blocks runs a 4-step inner grid instead of 16) — replacing the
+1024-blocks runs a 3-step inner grid instead of 8) — replacing the
 predicate-only design whose skipped steps still paid their iteration
 overhead and which measured just 1.2x vs full causal at
-T=8192/W=1024 (tools/attention_window_v5e.json; that artifact
-predates this redesign — the narrow grid's own measured numbers
-replace it when recorded).  Ring-sharded windows keep the hop-level
-skip instead (ops/ring_attention.py).
+T=8192/W=1024.  Recorded with the narrow grid
+(tools/attention_window_v5e.json): ~1.8x vs full-causal flash
+(1.77/1.89 across captures) and 13.8x vs naive XLA at
+T=8192/W=1024, ~15x naive at W=512 — the
+residual gap to the ~4x computed-block ratio is block granularity
+(the band rounds up to ``bq + W + bk`` wide), and narrowing blocks
+to tighten the band measurably loses more to per-program DMA
+amortization than it saves (see ``pick_blocks``).  Ring-sharded
+windows keep the hop-level skip instead (ops/ring_attention.py).
 
 On non-TPU backends the kernel runs in interpreter mode, so the
 hermetic CPU test suite exercises the exact same code path.
@@ -792,7 +799,7 @@ def flash_block_grads(q, k, v, do, delta, lse, q_offset, k_offset, *,
     tk = k.shape[1]
     h_kv, group = _kv_heads(h, k)
     if block_q is None or block_k is None:
-        auto_q, auto_k = pick_blocks(tq, tk, d)
+        auto_q, auto_k = pick_blocks(tq, tk, d, window=window)
         block_q = block_q if block_q is not None else auto_q
         block_k = block_k if block_k is not None else auto_k
     bq, tq_pad = _block_and_pad(tq, block_q, _Q_TILE)
@@ -952,7 +959,8 @@ def attention_delta(do, out):
 # Normalized single-device flash attention, differentiable.
 # --------------------------------------------------------------------------
 
-def pick_blocks(tq: int, tk: int, head_dim: int) -> tuple[int, int]:
+def pick_blocks(tq: int, tk: int, head_dim: int,
+                window: int | None = None) -> tuple[int, int]:
     """Autotuned ``(block_q, block_k)`` by shape.
 
     Derived from a v5e sweep (bf16, causal, tools/sweep_attention.py,
@@ -967,6 +975,14 @@ def pick_blocks(tq: int, tk: int, head_dim: int) -> tuple[int, int]:
     The one real exception: short sequences at D=64 prefer (512, 1024)
     — at T=2048/D=64 the halved q-block keeps enough programs in
     flight to cover DMA latency (6.25x vs 4.86x).
+
+    Sliding-window runs use the SAME table: the narrow grid computes
+    a band ~``bq + window + bk`` keys wide per q-block, so smaller
+    blocks narrow the band — but measured (T=8192/W=1024, two 3-run
+    captures), (512, 512)'s ~35% fewer MACs LOST to (1024, 1024)'s
+    per-program DMA amortization (0.87 ms vs 0.69 ms), and at W=512
+    the two tie within jitter.  Band-narrowing via block choice does
+    not pay on v5e; the window win comes from the narrow grid alone.
     """
     bq = 512 if (head_dim < 128 and tq <= 2048) else 1024
     bq = min(bq, _round_up(tq, _Q_TILE))
@@ -978,7 +994,8 @@ def _flash_forward(q, k, v, segment_ids, causal, scale, interpret,
                    block_q, block_k, window):
     """Normalized output + logsumexp (the flash residual pair)."""
     if block_q is None or block_k is None:
-        auto_q, auto_k = pick_blocks(q.shape[1], k.shape[1], q.shape[-1])
+        auto_q, auto_k = pick_blocks(q.shape[1], k.shape[1], q.shape[-1],
+                                     window=window)
         block_q = block_q if block_q is not None else auto_q
         block_k = block_k if block_k is not None else auto_k
     o, m, l = flash_block_attention(q, k, v, 0, 0, causal=causal,

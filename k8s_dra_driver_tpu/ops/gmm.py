@@ -7,9 +7,10 @@ expert FLOPs scale with the *routed* token count (top_k), not with
 ``n_experts`` the way dense dispatch does, and with no ``[B,T,E,C]``
 one-hot dispatch tensors and no dropped tokens.  Recorded v5e
 train-step medians (tools/moe_dispatch_v5e.json, differential-median
-harness): 2.5x dense dispatch at E16/dff4096.  Capacity routing
-measures faster still (4.25x) at that shape but drops over-budget
-tokens; gmm is the fastest *exact* path.
+harness): 2.58x dense dispatch at E16/dff4096 (1.17x at E8 mixed).
+Capacity routing measures faster still (3.55x / 1.37x at those
+shapes) but drops over-budget tokens; gmm is the fastest *exact*
+path — budget ~25-40% of a step vs capacity for that guarantee.
 
 TPU mapping: the row-block -> expert assignment rides in as a
 scalar-prefetch argument (``pltpu.PrefetchScalarGridSpec``), so the

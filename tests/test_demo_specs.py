@@ -21,7 +21,6 @@ from k8s_dra_driver_tpu.plugin import DeviceState
 from testbed import E2EBed
 
 SPECS_ROOT = Path(__file__).parent.parent / "demo" / "specs"
-SPEC_DIR = SPECS_ROOT / "quickstart"
 
 
 @pytest.fixture(autouse=True)
