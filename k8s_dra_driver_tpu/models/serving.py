@@ -27,6 +27,11 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   per-request ``max_new`` + cache-capacity stop conditions;
   host-side bookkeeping is plain numpy mirrors of slot state (the
   device only ever sees static shapes).
+- **Automatic prefix caching** (``prefix_cache=N``): the last N
+  fills' K/V rows are retained and a new request adopts its longest
+  remembered prompt prefix zero-copy, prefilling only the suffix —
+  chunked prefill with the first chunk memoized, so generation is
+  exactly what the uncached engine produces (``PrefixCache``).
 
 No reference analog (SURVEY.md §2.3 — the reference has no serving
 stack at all); beyond-parity workload tier alongside speculative
@@ -94,6 +99,78 @@ def _next_tokens(logits, keys, temps, top_k: int, top_p: float):
     return nxt, new_keys
 
 
+class PrefixCache:
+    """LRU store of prompt-prefix K/V (automatic prefix caching).
+
+    Serving workloads repeat prompt prefixes constantly (system
+    prompts, few-shot preambles, multi-turn history); recomputing
+    their K/V per request is pure waste.  Entries map a prompt's
+    token tuple to the [1, max_seq] ``KVCache`` its fill produced
+    (``pos`` = prompt length); a later request adopts the longest
+    common prefix ZERO-COPY — the entry's arrays are reused with
+    ``pos`` lowered to the match length ``p``, correct because
+    position-masked attention never reads rows >= pos and the suffix
+    prefill functionally rewrites [p, L) without donating the entry's
+    buffers.  Reuse is therefore exactly chunked prefill with the
+    first chunk memoized, and chunked prefill is pinned exact
+    (tests/test_serving.py) — so cached and uncached engines generate
+    identical tokens.
+
+    Memory: each entry retains a full cache row (~one extra slot:
+    2 x layers x max_seq x H_kv x D KV bytes), which is why the
+    store is small and LRU-bounded (``entries``).  No reference
+    analog (the reference has no serving stack); this is the
+    vLLM-style "automatic prefix caching" feature, static-shape
+    TPU-first: adoption is pointer reuse + one scalar, never a
+    gather.
+    """
+
+    def __init__(self, entries: int):
+        if entries < 1:
+            raise ValueError("prefix cache needs >= 1 entry")
+        self.entries = entries
+        # dict insertion order IS the LRU order (oldest first)
+        self._store: dict[tuple, KVCache] = {}
+        self.hits = 0
+        self.tokens_reused = 0
+
+    def _touch(self, key: tuple) -> None:
+        self._store[key] = self._store.pop(key)
+
+    def longest_prefix(self, prompt: np.ndarray
+                       ) -> tuple[int, KVCache | None]:
+        """(p, entry) with ``p`` the longest common prefix length
+        over all entries, capped at len(prompt)-1 so the last prompt
+        token is always re-prefilled (its logits seed generation).
+        Rows of the entry beyond ``p`` are junk for the new prompt
+        but are masked (pos=p) and overwritten by the suffix fill."""
+        toks = prompt.tolist()
+        cap = len(toks) - 1
+        best_p, best_key = 0, None
+        for key in self._store:
+            p = 0
+            for a, b in zip(key, toks[:cap]):
+                if a != b:
+                    break
+                p += 1
+            if p > best_p:
+                best_p, best_key = p, key
+        if best_key is None:
+            return 0, None
+        self.hits += 1
+        self.tokens_reused += best_p
+        self._touch(best_key)
+        return best_p, self._store[best_key]
+
+    def insert(self, prompt: np.ndarray, filled: KVCache) -> None:
+        """Remember a fill's full-prompt cache (pos == len(prompt))."""
+        key = tuple(prompt.tolist())
+        self._store.pop(key, None)            # re-insert = most recent
+        self._store[key] = filled
+        while len(self._store) > self.entries:
+            self._store.pop(next(iter(self._store)))
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _adopt_slot(cache: KVCache, one: KVCache, slot) -> KVCache:
     """Copy a freshly-prefilled [1, S] cache into row ``slot`` of the
@@ -120,7 +197,8 @@ class ServingEngine:
     def __init__(self, params, cfg: TransformerConfig, slots: int,
                  max_seq: int | None = None,
                  prefill_chunk: int | None = None,
-                 top_k: int = 0, top_p: float = 0.0):
+                 top_k: int = 0, top_p: float = 0.0,
+                 prefix_cache: int = 0):
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         if not 0.0 <= top_p <= 1.0:
@@ -128,6 +206,10 @@ class ServingEngine:
         self.params = params
         self.cfg = cfg
         self.slots = slots
+        # prefix_cache=N retains the last N fills' K/V for zero-copy
+        # prompt-prefix reuse (PrefixCache docstring; ~one cache
+        # slot's memory per entry); 0 disables.
+        self._prefix = PrefixCache(prefix_cache) if prefix_cache else None
         self.prefill_chunk = prefill_chunk
         self.top_k = top_k
         self.top_p = top_p
@@ -202,7 +284,7 @@ class ServingEngine:
 
     def stats(self) -> dict:
         """Counters for scrapers/logs (utils/metrics.py style)."""
-        return {
+        out = {
             "slots": self.slots,
             "active": self.active,
             "pending": self.pending,
@@ -211,29 +293,51 @@ class ServingEngine:
             "generated_tokens_total": self._tokens_total,
             "decode_steps_total": self._steps_total,
         }
+        if self._prefix is not None:
+            out["prefix_hits_total"] = self._prefix.hits
+            out["prefix_tokens_reused_total"] = self._prefix.tokens_reused
+        return out
 
     # -- slot lifecycle --------------------------------------------------
 
     def _fill_slot(self, slot: int, req: Request) -> None:
         """Prefill the request on a fresh [1, L] cache and copy its
-        K/V rows into the slot."""
-        one = init_cache(self.cfg, 1, self.max_seq)
-        if self.prefill_chunk is None:
+        K/V rows into the slot.  With the prefix cache on, the fill
+        starts from the longest remembered common prefix instead of
+        token 0 — zero-copy adoption, then a normal (chunked or
+        whole) suffix prefill; equivalent to chunked prefill with the
+        first chunk memoized, so generation stays exact."""
+        start = 0
+        if self._prefix is not None:
+            p, entry = self._prefix.longest_prefix(req.prompt)
+            if p > 0:
+                one = KVCache(k=entry.k, v=entry.v,
+                              pos=jnp.int32(p),
+                              k_scale=entry.k_scale,
+                              v_scale=entry.v_scale)
+                start = p
+        if start == 0:
+            one = init_cache(self.cfg, 1, self.max_seq)
+        if self.prefill_chunk is None and start == 0:
             logits, one = prefill(self.params, req.prompt[None, :],
                                   self.cfg, one)
         else:
             # chunked: ≤2C compiled programs across all lengths (each
             # size ≤C as first chunk and as remainder), exact at any
-            # split.  first_chunk is STATICALLY known here (off == 0)
-            # — calling _prefill_jit directly skips prefill()'s
-            # cache.pos readback, one blocking RTT per chunk on
-            # tunneled backends
+            # split.  first_chunk is STATICALLY known here (absolute
+            # offset 0) — calling _prefill_jit directly skips
+            # prefill()'s cache.pos readback, one blocking RTT per
+            # chunk on tunneled backends.  A prefix-cache hit enters
+            # here too (start > 0): its suffix rides the same
+            # masked-path programs chunked prefill compiles.
             from .decode import _prefill_jit
-            c = self.prefill_chunk
-            for off in range(0, req.prompt.size, c):
+            c = self.prefill_chunk or req.prompt.size
+            for off in range(start, req.prompt.size, c):
                 logits, one = _prefill_jit(
                     self.params, req.prompt[None, off:off + c],
                     self.cfg, one, off == 0)
+        if self._prefix is not None:
+            self._prefix.insert(req.prompt, one)
         if req.temperature > 0:
             # the exact sample_generate key stream: split before the
             # first token, then once per decode step

@@ -84,8 +84,9 @@ class TransformerConfig:
     # dense and gmm 2.58x at E16/dff4096; 1.37x vs 1.17x at E8 mixed.
     # Guidance: default to "capacity" for throughput — it beats gmm
     # at every recorded shape; reach for "gmm" only when token drops
-    # are unacceptable (exact routing), and expect ~25-40% slower
-    # steps than capacity for that guarantee.
+    # are unacceptable (exact routing), and expect ~18-38% slower
+    # steps than capacity for that guarantee (17.8% at E8 mixed,
+    # 37.5% at E16 heavy, per the artifact).
     moe_dispatch: str = "dense"
     capacity_factor: float = 1.25
     # Router auxiliary losses (training-quality guards; 0 disables):
@@ -456,7 +457,7 @@ def _moe_mlp_gmm(x, gates, layer, cfg: TransformerConfig):
     training-step benefit is bounded by the forward half.  Recorded
     with this rewrite (tools/moe_dispatch_v5e.json): 2.58x dense at
     E16 (capacity: 3.55x), 1.17x at E8 mixed (capacity: 1.37x) —
-    exact routing costs ~25-40% of a step vs capacity's drops.
+    exact routing costs ~18-38% of a step vs capacity's drops.
     """
     from ..ops.gmm import gmm
 

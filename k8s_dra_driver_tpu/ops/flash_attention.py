@@ -43,12 +43,13 @@ max-shift in a stable softmax.
 Grouped-query/multi-query attention is native: k/v may carry H_kv < H
 heads (H a multiple of H_kv) and the kernels' K/V BlockSpec index maps
 route each query head's programs to its group's block — no repeated
-K/V tensor in HBM, forward or backward.  Measured on v5e at
-B4/T2048/H8/D64 the forward kernel runs at MHA speed (~0.52 ms for
-H_kv ∈ {2, 8}); the win is the 4x smaller K/V footprint in HBM and
-cache, never a compute penalty.  (An earlier capture showed H_kv=2
-1.9x faster; repeated measurement attributes that to tunnel timing
-jitter — treat single-run deltas on this backend as noise.)
+K/V tensor in HBM, forward or backward.  Recorded on v5e at
+B4/T2048/H8/D64 (tools/kernel_claims_v5e.json, median-of-5): the
+forward runs 0.57/0.45/0.51 ms at H_kv = 8/4/2 — grouped heads cost
+no kernel time (the differences are within the backend's jitter);
+the real win is the 4x smaller K/V footprint in HBM and cache.  (An
+earlier single-run capture showed 1.9x; treat single-run deltas on
+this backend as jitter.)
 
 Sliding-window (local) attention: ``window=W`` masks each query to its
 W most recent positions and — in the single-device (zero-offset) path
@@ -799,7 +800,7 @@ def flash_block_grads(q, k, v, do, delta, lse, q_offset, k_offset, *,
     tk = k.shape[1]
     h_kv, group = _kv_heads(h, k)
     if block_q is None or block_k is None:
-        auto_q, auto_k = pick_blocks(tq, tk, d, window=window)
+        auto_q, auto_k = pick_blocks(tq, tk, d)
         block_q = block_q if block_q is not None else auto_q
         block_k = block_k if block_k is not None else auto_k
     bq, tq_pad = _block_and_pad(tq, block_q, _Q_TILE)
@@ -959,8 +960,7 @@ def attention_delta(do, out):
 # Normalized single-device flash attention, differentiable.
 # --------------------------------------------------------------------------
 
-def pick_blocks(tq: int, tk: int, head_dim: int,
-                window: int | None = None) -> tuple[int, int]:
+def pick_blocks(tq: int, tk: int, head_dim: int) -> tuple[int, int]:
     """Autotuned ``(block_q, block_k)`` by shape.
 
     Derived from a v5e sweep (bf16, causal, tools/sweep_attention.py,
@@ -976,13 +976,14 @@ def pick_blocks(tq: int, tk: int, head_dim: int,
     — at T=2048/D=64 the halved q-block keeps enough programs in
     flight to cover DMA latency (6.25x vs 4.86x).
 
-    Sliding-window runs use the SAME table: the narrow grid computes
-    a band ~``bq + window + bk`` keys wide per q-block, so smaller
-    blocks narrow the band — but measured (T=8192/W=1024, two 3-run
-    captures), (512, 512)'s ~35% fewer MACs LOST to (1024, 1024)'s
-    per-program DMA amortization (0.87 ms vs 0.69 ms), and at W=512
-    the two tie within jitter.  Band-narrowing via block choice does
-    not pay on v5e; the window win comes from the narrow grid alone.
+    Sliding-window runs use the SAME table (deliberately — there is
+    no window parameter here): the narrow grid computes a band
+    ~``bq + window + bk`` keys wide per q-block, so smaller blocks
+    narrow the band — but recorded at T=8192/W=1024
+    (tools/kernel_claims_v5e.json, median-of-5), (512, 512)'s ~35%
+    fewer MACs LOSE to (1024, 1024)'s per-program DMA amortization:
+    0.94 ms vs 0.69 ms.  Band-narrowing via block choice does not
+    pay on v5e; the window win comes from the narrow grid alone.
     """
     bq = 512 if (head_dim < 128 and tq <= 2048) else 1024
     bq = min(bq, _round_up(tq, _Q_TILE))
@@ -994,8 +995,7 @@ def _flash_forward(q, k, v, segment_ids, causal, scale, interpret,
                    block_q, block_k, window):
     """Normalized output + logsumexp (the flash residual pair)."""
     if block_q is None or block_k is None:
-        auto_q, auto_k = pick_blocks(q.shape[1], k.shape[1], q.shape[-1],
-                                     window=window)
+        auto_q, auto_k = pick_blocks(q.shape[1], k.shape[1], q.shape[-1])
         block_q = block_q if block_q is not None else auto_q
         block_k = block_k if block_k is not None else auto_k
     o, m, l = flash_block_attention(q, k, v, 0, 0, causal=causal,

@@ -10,7 +10,7 @@ train-step medians (tools/moe_dispatch_v5e.json, differential-median
 harness): 2.58x dense dispatch at E16/dff4096 (1.17x at E8 mixed).
 Capacity routing measures faster still (3.55x / 1.37x at those
 shapes) but drops over-budget tokens; gmm is the fastest *exact*
-path — budget ~25-40% of a step vs capacity for that guarantee.
+path — budget ~18-38% of a step vs capacity for that guarantee.
 
 TPU mapping: the row-block -> expert assignment rides in as a
 scalar-prefetch argument (``pltpu.PrefetchScalarGridSpec``), so the

@@ -277,6 +277,104 @@ class TestServingEngine:
         np.testing.assert_array_equal(done["s"], want_sampled)
         np.testing.assert_array_equal(done["g"], want_greedy)
 
+    @pytest.mark.parametrize("chunk", [None, 4])
+    def test_prefix_cache_exact_with_hits(self, chunk):
+        """Prefix-cached engine generates EXACTLY what the uncached
+        one does while actually reusing prefixes: shared system-
+        prompt-style prefixes across requests, mixed greedy/sampled,
+        whole and chunked prefill."""
+        p = params()
+        sys_pre = prompt(11, 9)
+        reqs = [
+            ("a", np.concatenate([sys_pre, prompt(12, 4)]), 5, 0.0),
+            ("b", np.concatenate([sys_pre, prompt(13, 6)]), 4, 0.0),
+            ("c", np.concatenate([sys_pre, prompt(12, 4)]), 5, 0.9),
+            ("d", prompt(14, 7), 4, 0.0),
+        ]
+
+        def run(prefix_cache):
+            eng = ServingEngine(p, CFG, slots=2, prefill_chunk=chunk,
+                                prefix_cache=prefix_cache)
+            for uid, pr, n, temp in reqs:
+                eng.submit(Request(uid=uid, prompt=pr, max_new=n,
+                                   temperature=temp, seed=7))
+            return ({f.uid: f.tokens for f in eng.run()}, eng.stats())
+
+        plain, plain_stats = run(0)
+        cached, stats = run(4)
+        assert set(cached) == {u for u, *_ in reqs}
+        for uid in plain:
+            np.testing.assert_array_equal(
+                cached[uid], plain[uid],
+                err_msg=f"prefix cache changed request {uid}")
+        # b and c both share sys_pre with an earlier fill ("c" shares
+        # ALL of "a"'s prompt, capped at L-1)
+        assert stats["prefix_hits_total"] >= 2
+        assert stats["prefix_tokens_reused_total"] >= 2 * len(sys_pre)
+        assert "prefix_hits_total" not in plain_stats
+
+    def test_prefix_cache_prefills_only_the_suffix(self):
+        """A hit must skip recomputation: count tokens pushed through
+        the prefill program and compare against the adopted length."""
+        from k8s_dra_driver_tpu.models import decode as decode_mod
+
+        p = params()
+        seen = []
+        real = decode_mod._prefill_jit
+
+        def counting(params_, tokens, cfg, cache, first_chunk):
+            seen.append(int(tokens.shape[1]))
+            return real(params_, tokens, cfg, cache, first_chunk)
+
+        eng = ServingEngine(p, CFG, slots=1, prefix_cache=2)
+        pr = prompt(21, 10)
+        longer = np.concatenate([pr, prompt(22, 3)])
+        try:
+            decode_mod._prefill_jit = counting
+            eng.submit(Request(uid="a", prompt=pr, max_new=2))
+            while eng.active or eng.pending:
+                eng.step()
+            assert sum(seen) == len(pr)
+            seen.clear()
+            eng.submit(Request(uid="b", prompt=longer, max_new=2))
+            while eng.active or eng.pending:
+                eng.step()
+            # all 10 prefix tokens adopted; only the 3-token suffix
+            # (plus nothing else) prefilled
+            assert sum(seen) == len(longer) - len(pr)
+        finally:
+            decode_mod._prefill_jit = real
+
+    def test_prefix_cache_eviction_bounds_entries(self):
+        p = params()
+        eng = ServingEngine(p, CFG, slots=1, prefix_cache=1)
+        for i, uid in enumerate("abc"):
+            eng.submit(Request(uid=uid, prompt=prompt(30 + i, 6),
+                               max_new=1))
+        while eng.active or eng.pending:
+            eng.step()
+        assert len(eng._prefix._store) == 1
+
+    def test_prefix_cache_int8_kv_exact(self):
+        """Prefix adoption composes with the int8 KV cache: scales
+        ride along with the K/V rows."""
+        cfg = dataclasses.replace(CFG, kv_cache_dtype="int8")
+        p = params()
+        pre = prompt(41, 8)
+        reqs = [("a", np.concatenate([pre, prompt(42, 3)]), 4),
+                ("b", np.concatenate([pre, prompt(43, 5)]), 4)]
+
+        def run(prefix_cache):
+            eng = ServingEngine(p, cfg, slots=2,
+                                prefix_cache=prefix_cache)
+            for uid, pr, n in reqs:
+                eng.submit(Request(uid=uid, prompt=pr, max_new=n))
+            return {f.uid: f.tokens for f in eng.run()}
+
+        plain, cached = run(0), run(2)
+        for uid in plain:
+            np.testing.assert_array_equal(cached[uid], plain[uid])
+
     def test_zero_max_new_rejected(self):
         eng = ServingEngine(params(), CFG, slots=1)
         with pytest.raises(ValueError, match="max_new"):

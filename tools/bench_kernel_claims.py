@@ -1,0 +1,85 @@
+"""Record the kernel-claims evidence artifact
+(tools/kernel_claims_v5e.json).
+
+Two docstring claims in ops/flash_attention.py previously traced to
+session measurements only; this tool records them properly
+(CLAUDE.md: perf claims must trace to a recorded artifact):
+
+- **gqa_parity** — the GQA forward costs no kernel time vs MHA (a
+  modest gain from the reduced K/V traffic; the big win is the K/V
+  footprint): ``attention_probe`` at B4/T2048/H8/D64 across
+  H_kv ∈ {8, 4, 2}, median-of-5 flash samples over one compiled
+  chain pair (measure_chain_samples).
+- **window_blocks** — narrowing blocks to tighten the window's
+  computed band does NOT pay: the windowed kernel at T=8192/W=1024
+  under the causal-optimum (1024, 1024) blocks vs the band-narrowing
+  (512, 512) choice ``pick_blocks`` deliberately rejects.
+
+Run on an idle v5e chip from the repo root:
+    python tools/bench_kernel_claims.py
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import platform
+import subprocess
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+OUT = pathlib.Path(__file__).parent / "kernel_claims_v5e.json"
+
+
+def main() -> None:
+    from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
+    enable_persistent_cache()
+    import jax
+
+    from k8s_dra_driver_tpu.ops import attention_probe
+
+    def row(**kw):
+        r = attention_probe(batch=4, seq=2048, heads=8, iters=16,
+                            samples=5, **kw)
+        return {k: (round(v, 3) if isinstance(v, float) else v)
+                for k, v in r.items()}
+
+    gqa = [row(kv_heads=kv) for kv in (None, 4, 2)]
+
+    win = []
+    for bq, bk in ((None, None), (512, 512)):
+        r = attention_probe(batch=1, seq=8192, heads=8, iters=16,
+                            window=1024, samples=5,
+                            block_q=bq, block_k=bk)
+        r["blocks"] = "auto(1024,1024)" if bq is None else f"({bq},{bk})"
+        win.append({k: (round(v, 3) if isinstance(v, float) else v)
+                    for k, v in r.items()})
+
+    out = {
+        "what": ("evidence for two flash-kernel docstring claims: "
+                 "GQA forward never costs kernel time vs MHA (modest "
+                 "gain from reduced K/V traffic; the footprint is the "
+                 "big win) and window block choice (band-narrowing "
+                 "(512,512) loses to the causal-optimum (1024,1024)); "
+                 "median-of-5 flash samples per row, all runs listed"),
+        "host": platform.node(),
+        "device": str(jax.devices()[0]),
+        "commit": subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True).stdout.strip(),
+        "gqa_parity_b4_t2048_h8": gqa,
+        "window_blocks_t8192_w1024": win,
+    }
+    OUT.write_text(json.dumps(out, indent=1))
+    summary = {
+        "gqa_flash_ms_by_kv_heads": {str(r["kv_heads"]): r["flash_ms"]
+                                     for r in gqa},
+        "window_flash_ms_by_blocks": {r["blocks"]: r["flash_ms"]
+                                      for r in win},
+    }
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
