@@ -46,6 +46,12 @@ sys.path.insert(0, str(REPO / "tests"))
 
 REFERENCE_MPS_BACKOFF_FLOOR_MS = 1000.0
 
+#: the hermetic (CPU) shape for the serving probes — shared with the
+#: smoke tests so they pin exactly what bench streams
+TINY_SERVING_KWARGS = dict(slots=2, n_requests=4, n_layers=2,
+                           d_model=128, heads=4, kv_heads=2, d_ff=256,
+                           prompt_len=12, max_new=6, max_seq=64)
+
 _WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", "630"))
 _DEADLINE = time.monotonic() + _WALL_BUDGET_S
 
@@ -554,11 +560,18 @@ def _tpu_probes():
     from k8s_dra_driver_tpu.ops import serving_probe
     label, res, errs = _retry_probe(
         [("s8_r24", lambda: serving_probe())] if on_accel else
-        [("tiny", lambda: serving_probe(
-            slots=2, n_requests=4, n_layers=2, d_model=128, heads=4,
-            kv_heads=2, d_ff=256, prompt_len=12, max_new=6,
-            max_seq=64))])
+        [("tiny", lambda: serving_probe(**TINY_SERVING_KWARGS))])
     yield "serving", shaped(label, res, errs)
+
+    # the system-prompt pattern: every request shares a leading
+    # prefix; the engine's automatic prefix cache adopts it zero-copy
+    # and prefills only the tail (models/serving.py:PrefixCache)
+    label, res, errs = _retry_probe(
+        [("s8_r24_px64", lambda: serving_probe(
+            prefix_cache=8, shared_prefix=64))] if on_accel else
+        [("tiny_px", lambda: serving_probe(
+            prefix_cache=2, shared_prefix=8, **TINY_SERVING_KWARGS))])
+    yield "serving_prefix", shaped(label, res, errs)
 
 
 def tpu_probe_stream() -> None:

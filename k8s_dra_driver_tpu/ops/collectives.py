@@ -448,7 +448,9 @@ def serving_probe(slots: int = 8, n_requests: int = 24,
                   n_layers: int = 8, d_model: int = 1024,
                   heads: int = 16, kv_heads: int = 4, d_ff: int = 4096,
                   prompt_len: int = 96, max_new: int = 48,
-                  max_seq: int = 2048, seed: int = 0) -> dict:
+                  max_seq: int = 2048, seed: int = 0,
+                  prefix_cache: int = 0,
+                  shared_prefix: int = 0) -> dict:
     """Continuous-batching throughput (models/serving.py): mixed-length
     requests drained through a fixed-slot engine; reports decode
     tokens/s over the whole drain.
@@ -463,6 +465,13 @@ def serving_probe(slots: int = 8, n_requests: int = 24,
     differential number, and perf claims must cite that, not this.
     Prefill compiles are excluded by a warmup pass at the measured
     slot count, one request per distinct prompt length.
+
+    ``shared_prefix`` > 0 makes every prompt share that many leading
+    tokens (the system-prompt pattern), with the mixed-length class
+    structure preserved in the TAILS (four distinct tail lengths), and
+    ``prefix_cache`` sizes the engine's automatic prefix cache —
+    together they measure the zero-copy prefix-adoption path at drain
+    scale, with hit/reuse counters in the result.
     """
     from ..models import TransformerConfig, init_params
     from ..models.serving import Request, ServingEngine
@@ -473,31 +482,46 @@ def serving_probe(slots: int = 8, n_requests: int = 24,
         max_seq=max_seq, dtype=jnp.bfloat16)
     params = init_params(cfg, jax.random.PRNGKey(0))
     rng = np.random.default_rng(seed)
-    lengths = [prompt_len, prompt_len // 2, prompt_len * 3 // 4,
-               prompt_len // 4]
+    if shared_prefix:
+        # keep four DISTINCT length classes in the tails so the drain
+        # stays mixed-length (the floor keeps every tail >= 1 token)
+        tb = max(prompt_len - shared_prefix, 8)
+        lengths = [max(t, 1) for t in (tb, tb // 2, tb * 3 // 4,
+                                       tb // 4)]
+        pre = rng.integers(0, cfg.vocab, shared_prefix)
+    else:
+        lengths = [prompt_len, prompt_len // 2, prompt_len * 3 // 4,
+                   prompt_len // 4]
+        pre = None
+
+    def one_prompt(i):
+        part = rng.integers(0, cfg.vocab, lengths[i % len(lengths)])
+        return part if pre is None else np.concatenate([pre, part])
 
     def requests(tag):
-        return [Request(uid=f"{tag}{i}",
-                        prompt=rng.integers(
-                            0, cfg.vocab, lengths[i % len(lengths)]),
+        return [Request(uid=f"{tag}{i}", prompt=one_prompt(i),
                         max_new=max_new)
                 for i in range(n_requests)]
 
+    def engine():
+        return ServingEngine(params, cfg, slots=slots,
+                             prefix_cache=prefix_cache)
+
     # warmup at the MEASURED slot count (decode/adopt programs key on
     # the slot shape — a smaller warm engine would leave the [slots,1]
-    # compiles inside the timed drain), one request per distinct
-    # prompt length for the prefill programs
-    warm = ServingEngine(params, cfg, slots=slots)
-    for i, n in enumerate(lengths):
-        warm.submit(Request(uid=f"w{i}",
-                            prompt=rng.integers(0, cfg.vocab, n),
+    # compiles inside the timed drain), two requests per distinct
+    # prompt length so both the fresh-fill and (with a prefix cache)
+    # the suffix-fill programs compile outside the timed drain
+    warm = engine()
+    for i in range((2 if prefix_cache else 1) * len(lengths)):
+        warm.submit(Request(uid=f"w{i}", prompt=one_prompt(i),
                             max_new=2))
     warm.run()
     del warm         # its [slots, max_seq] cache must not share HBM
                      # with the measured engine (compiles are
                      # process-global and survive)
 
-    eng = ServingEngine(params, cfg, slots=slots)
+    eng = engine()
     reqs = requests("r")
     prompt_len_of = {r.uid: len(r.prompt) for r in reqs}
     for req in reqs:
@@ -511,7 +535,7 @@ def serving_probe(slots: int = 8, n_requests: int = 24,
     # decode steps emit max_new-1 tokens per request
     # min decode steps (>=1: max_new=1 drains with prefills alone)
     steps = max(-(-n_requests * (max_new - 1) // slots), 1)
-    return {
+    out = {
         "slots": slots,
         "requests": n_requests,
         "generated_tokens": int(generated),
@@ -525,3 +549,10 @@ def serving_probe(slots: int = 8, n_requests: int = 24,
                  "decode ceiling is decode_probe's differential "
                  "number)"),
     }
+    if shared_prefix:
+        out["shared_prefix"] = shared_prefix
+    if prefix_cache:
+        stats = eng.stats()
+        out["prefix_hits"] = stats["prefix_hits_total"]
+        out["prefix_tokens_reused"] = stats["prefix_tokens_reused_total"]
+    return out

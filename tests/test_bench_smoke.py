@@ -36,13 +36,23 @@ def test_serving_probe_tiny():
     """The continuous-batching probe's bookkeeping (warmup, drain,
     lower-bound fields) at the hermetic CPU shape bench.py streams."""
     from k8s_dra_driver_tpu.ops import serving_probe
-    out = serving_probe(slots=2, n_requests=4, n_layers=2, d_model=128,
-                        heads=4, kv_heads=2, d_ff=256, prompt_len=12,
-                        max_new=6, max_seq=64)
+    out = serving_probe(**bench.TINY_SERVING_KWARGS)
     assert out["valid"] is True
     assert out["generated_tokens"] == 4 * 6
     assert out["tokens_per_s_lower_bound"] > 0
     assert out["per_step_ms_upper_bound"] > 0
+
+
+def test_serving_probe_prefix_tiny():
+    """The shared-prefix scenario bench.py streams as serving_prefix
+    (same kwargs object, so this pins what actually streams): drain
+    completes and the prefix cache actually hits."""
+    from k8s_dra_driver_tpu.ops import serving_probe
+    out = serving_probe(prefix_cache=2, shared_prefix=8,
+                        **bench.TINY_SERVING_KWARGS)
+    assert out["valid"] is True
+    assert out["prefix_hits"] >= 3      # every fill after the first
+    assert out["prefix_tokens_reused"] >= 3 * 8
 
 
 def test_persistent_compile_cache_populates(tmp_path):
