@@ -28,10 +28,13 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   host-side bookkeeping is plain numpy mirrors of slot state (the
   device only ever sees static shapes).
 - **Automatic prefix caching** (``prefix_cache=N``): the last N
-  fills' K/V rows are retained and a new request adopts its longest
-  remembered prompt prefix zero-copy, prefilling only the suffix —
-  chunked prefill with the first chunk memoized, so generation is
-  exactly what the uncached engine produces (``PrefixCache``).
+  fills' AND finishes' K/V rows are retained and a new request
+  adopts its longest remembered prefix zero-copy, prefilling only
+  the suffix — chunked prefill with the first chunk memoized, so
+  generation is exactly what the uncached engine produces
+  (``PrefixCache``).  Finish-time capture is what makes multi-turn
+  chat cheap: a follow-up prompt (prompt + generated + new text)
+  adopts the whole previous conversation's K/V.
 
 No reference analog (SURVEY.md §2.3 — the reference has no serving
 stack at all); beyond-parity workload tier alongside speculative
@@ -162,13 +165,42 @@ class PrefixCache:
         self._touch(best_key)
         return best_p, self._store[best_key]
 
-    def insert(self, prompt: np.ndarray, filled: KVCache) -> None:
-        """Remember a fill's full-prompt cache (pos == len(prompt))."""
-        key = tuple(prompt.tolist())
+    def insert(self, tokens: np.ndarray, filled: KVCache) -> None:
+        """Remember a [1, S] cache whose first ``len(tokens)`` rows
+        are the K/V of ``tokens`` (``pos == len(tokens)``).  Two kinds
+        of entries arrive here: fill-time full-prompt caches and
+        finish-time conversation captures (prompt + generated)."""
+        key = tuple(tokens.tolist())
         self._store.pop(key, None)            # re-insert = most recent
         self._store[key] = filled
         while len(self._store) > self.entries:
             self._store.pop(next(iter(self._store)))
+
+    def drop(self, tokens: np.ndarray) -> None:
+        """Forget an entry (no-op if absent) — used when a finish
+        capture strictly dominates its fill-time prompt entry."""
+        self._store.pop(tuple(tokens.tolist()), None)
+
+
+@jax.jit
+def _extract_slot(cache: KVCache, slot, pos) -> KVCache:
+    """Copy row ``slot`` of the engine cache out as a [1, S] cache
+    with ``pos`` tokens valid — the finish-time capture that turns a
+    completed conversation (prompt + generated) into a prefix-cache
+    entry for its follow-up turn.  ``slot`` and ``pos`` are traced
+    scalars (finishes at any slot/length share one program).  NOT
+    donated: the engine cache keeps serving; the extracted entry owns
+    fresh buffers, so later donated decode steps can't corrupt it."""
+    take = lambda lst: [jax.lax.dynamic_index_in_dim(a, slot, 0,
+                                                     keepdims=True)
+                        for a in lst]
+    return KVCache(
+        k=take(cache.k), v=take(cache.v),
+        pos=jnp.asarray(pos, jnp.int32),
+        k_scale=(take(cache.k_scale)
+                 if cache.k_scale is not None else None),
+        v_scale=(take(cache.v_scale)
+                 if cache.v_scale is not None else None))
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -359,6 +391,24 @@ class ServingEngine:
     def _finish_slot(self, slot: int, out: list[Finished]) -> None:
         req = self._req[slot]
         gen = self._generated[slot]               # eos token kept
+        if self._prefix is not None and len(gen) > 1:
+            # multi-turn reuse: remember the finished conversation's
+            # K/V so a follow-up prompt (prompt + generated + new
+            # text) adopts the whole history.  Rows written so far =
+            # prompt + gen[:-1] (the last token was sampled but never
+            # fed back), which is exactly _pos[slot]; decode wrote
+            # each row identically to what prefilling the same tokens
+            # would, so adoption stays exact.
+            written = np.concatenate(
+                [req.prompt, np.asarray(gen[:-1], np.int32)])
+            assert len(written) == int(self._pos[slot])
+            # the fill-time prompt entry is a strict prefix of this
+            # one and can never win longest_prefix again — drop it so
+            # each conversation costs one LRU slot, not two
+            self._prefix.drop(req.prompt)
+            self._prefix.insert(
+                written, _extract_slot(self.cache, jnp.int32(slot),
+                                       int(self._pos[slot])))
         out.append(Finished(
             uid=req.uid,
             tokens=np.concatenate([req.prompt,

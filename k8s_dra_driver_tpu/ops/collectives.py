@@ -464,7 +464,8 @@ def serving_probe(slots: int = 8, n_requests: int = 24,
     the compiled decode path's ceiling is ``decode_probe``'s
     differential number, and perf claims must cite that, not this.
     Prefill compiles are excluded by a warmup pass at the measured
-    slot count, one request per distinct prompt length.
+    slot count — one request per distinct prompt length, doubled when
+    a prefix cache is on so the suffix-fill programs compile too.
 
     ``shared_prefix`` > 0 makes every prompt share that many leading
     tokens (the system-prompt pattern), with the mixed-length class
@@ -485,9 +486,8 @@ def serving_probe(slots: int = 8, n_requests: int = 24,
     if shared_prefix:
         # keep four DISTINCT length classes in the tails so the drain
         # stays mixed-length (the floor keeps every tail >= 1 token)
-        tb = max(prompt_len - shared_prefix, 8)
-        lengths = [max(t, 1) for t in (tb, tb // 2, tb * 3 // 4,
-                                       tb // 4)]
+        tb = max(prompt_len - shared_prefix, 8)   # floor: tails >= 2
+        lengths = [tb, tb // 2, tb * 3 // 4, tb // 4]
         pre = rng.integers(0, cfg.vocab, shared_prefix)
     else:
         lengths = [prompt_len, prompt_len // 2, prompt_len * 3 // 4,

@@ -345,6 +345,36 @@ class TestServingEngine:
         finally:
             decode_mod._prefill_jit = real
 
+    def test_prefix_cache_multi_turn_adopts_conversation(self):
+        """Finish-time capture: a follow-up turn whose prompt extends
+        the previous turn's full conversation (prompt + generated +
+        new text) adopts the whole history — and generates exactly
+        what the uncached engine does."""
+        p = params()
+        turn1 = prompt(70, 8)
+
+        def run(prefix_cache):
+            eng = ServingEngine(p, CFG, slots=1,
+                                prefix_cache=prefix_cache)
+            eng.submit(Request(uid="t1", prompt=turn1, max_new=5))
+            (done1,) = eng.run()
+            turn2 = np.concatenate([done1.tokens,
+                                    prompt(71, 4)])
+            eng.submit(Request(uid="t2", prompt=turn2, max_new=4))
+            (done2,) = eng.run()
+            return done1, done2, eng
+
+        d1, d2, cached_eng = run(4)
+        p1, p2, _ = run(0)
+        np.testing.assert_array_equal(d1.tokens, p1.tokens)
+        np.testing.assert_array_equal(d2.tokens, p2.tokens)
+        stats = cached_eng.stats()
+        # turn 2 adopted at least the finish-capture entry: prompt +
+        # generated[:-1] of turn 1 (12 rows) — a prompt-only entry
+        # could reuse at most len(turn1) = 8
+        assert stats["prefix_hits_total"] >= 1
+        assert stats["prefix_tokens_reused_total"] >= len(turn1) + 4
+
     def test_prefix_cache_eviction_bounds_entries(self):
         p = params()
         eng = ServingEngine(p, CFG, slots=1, prefix_cache=1)
