@@ -344,28 +344,20 @@ def _attn_mlp_tail(x, o, layer, cfg):
     return x + _dense_mlp(mlp_in, layer)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
-def decode_step_rows(params: Params, token: jax.Array,
-                     cfg: TransformerConfig, cache: KVCache,
-                     pos_rows: jax.Array
-                     ) -> tuple[jax.Array, KVCache]:
-    """One decode step with PER-ROW positions: token [B, 1], pos_rows
-    [B] int32 (each slot's fill depth) -> (logits [B, vocab], cache).
-
-    The continuous-batching primitive (models/serving.py): every cache
-    slot advances independently, so finished sequences can be swapped
-    for queued requests without draining the batch.  ``cache.pos`` is
-    ignored — the caller owns per-slot positions; cache writes land at
-    each row's own offset and attention masks per row.
-    """
+def _rows_forward(params: Params, tokens: jax.Array,
+                  cfg: TransformerConfig, cache: KVCache,
+                  pos_rows: jax.Array
+                  ) -> tuple[jax.Array, KVCache]:
+    """tokens [B, T] appended at PER-ROW positions -> (logits
+    [B, T, vocab], cache).  The shared body behind decode_step_rows
+    (T=1) and decode_window_rows (T=draft_len+1): ``cache.pos`` is
+    ignored — the caller owns per-slot positions; writes land at each
+    row's own offset and attention masks per row and position."""
     params = _with_layers(params, cfg)
-    b, t = token.shape
-    if t != 1:
-        raise ValueError(f"decode_step_rows is one token per slot, "
-                         f"got T={t}")
-    positions = pos_rows[:, None]                        # [B, 1]
+    b, t = tokens.shape
+    positions = pos_rows[:, None] + jnp.arange(t)[None]  # [B, T]
     quantized = cache.k_scale is not None
-    x = take_rows(params["embed"], token, cfg.dtype)
+    x = take_rows(params["embed"], tokens, cfg.dtype)
     new_k, new_v, new_ks, new_vs = [], [], [], []
 
     def write_rows(dst, new):
@@ -387,7 +379,7 @@ def decode_step_rows(params: Params, token: jax.Array,
             new_vs.append(vs_cache)
         new_k.append(k_cache)
         new_v.append(v_cache)
-        o = _cached_attention(q, k_cache, v_cache, pos_rows, 1, cfg,
+        o = _cached_attention(q, k_cache, v_cache, pos_rows, t, cfg,
                               ks_cache, vs_cache)
         x = _attn_mlp_tail(x, o, layer, cfg)
     x = rms_norm(x, params["ln_f"])
@@ -395,7 +387,71 @@ def decode_step_rows(params: Params, token: jax.Array,
     cache = KVCache(k=new_k, v=new_v, pos=cache.pos,
                     k_scale=new_ks if quantized else None,
                     v_scale=new_vs if quantized else None)
+    return logits, cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def decode_step_rows(params: Params, token: jax.Array,
+                     cfg: TransformerConfig, cache: KVCache,
+                     pos_rows: jax.Array
+                     ) -> tuple[jax.Array, KVCache]:
+    """One decode step with PER-ROW positions: token [B, 1], pos_rows
+    [B] int32 (each slot's fill depth) -> (logits [B, vocab], cache).
+
+    The continuous-batching primitive (models/serving.py): every cache
+    slot advances independently, so finished sequences can be swapped
+    for queued requests without draining the batch.
+    """
+    b, t = token.shape
+    if t != 1:
+        raise ValueError(f"decode_step_rows is one token per slot, "
+                         f"got T={t}")
+    logits, cache = _rows_forward(params, token, cfg, cache, pos_rows)
     return logits[:, 0], cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def decode_window_rows(params: Params, tokens: jax.Array,
+                       cfg: TransformerConfig, cache: KVCache,
+                       pos_rows: jax.Array
+                       ) -> tuple[jax.Array, KVCache]:
+    """Multi-token per-row step: tokens [B, K] appended at each
+    row's own position -> (logits [B, K, vocab], cache).
+
+    The target-scoring half of speculative continuous batching
+    (models/serving.py): one stream of the big weights scores a whole
+    draft window per slot; rejected rows beyond the accepted prefix
+    stay in the cache but are position-masked and overwritten by the
+    next window at the same offsets (the ``speculative_generate``
+    rollback trick, row-wise)."""
+    logits, cache = _rows_forward(params, tokens, cfg, cache, pos_rows)
+    return logits, cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "k"),
+                   donate_argnums=(3,))
+def draft_propose_rows(params: Params, last: jax.Array,
+                       cfg: TransformerConfig, cache: KVCache,
+                       pos_rows: jax.Array, k: int
+                       ) -> tuple[jax.Array, KVCache]:
+    """Greedy-draft ``k`` proposals per row as ONE compiled scan.
+
+    Feeds ``last`` [B] then each proposal autoregressively — k+1
+    steps, so the LAST proposal's K/V row also lands (the
+    ``_greedy_draft`` lesson: a full accept advances past it, and a
+    missing row silently degrades every later draft).  Returns
+    (proposals [B, k], cache); rows written pos..pos+k."""
+    def step(carry, _):
+        tok, cache, pos = carry
+        logits, cache = _rows_forward(params, tok[:, None], cfg,
+                                      cache, pos)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return (nxt, cache, pos + 1), nxt
+    (_, cache, _), toks = jax.lax.scan(
+        step, (last, cache, jnp.asarray(pos_rows)), None, length=k + 1)
+    # toks [k+1, B] = d1..d_{k+1}; the last is drafted past the
+    # window and discarded (its purpose was writing d_k's K/V row)
+    return toks[:k].T, cache
 
 
 def _validated_prefill(params, prompt, cfg, n_tokens, max_seq):

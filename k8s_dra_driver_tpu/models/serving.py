@@ -27,6 +27,17 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   per-request ``max_new`` + cache-capacity stop conditions;
   host-side bookkeeping is plain numpy mirrors of slot state (the
   device only ever sees static shapes).
+- **Speculative continuous batching** (``draft_params``/
+  ``draft_cfg``/``draft_len``): a draft model proposes ``draft_len``
+  greedy tokens per slot in ONE compiled scan
+  (``draft_propose_rows``), the target scores every slot's whole
+  window in ONE ``decode_window_rows`` pass, and each row emits its
+  accepted prefix + the target's correction/bonus token — up to
+  ``draft_len+1`` tokens per big-weight stream instead of one,
+  per-row acceptance (no lockstep minimum), output identical to the
+  plain engine's greedy decode.  Greedy-only; rollback is just not
+  advancing ``_pos`` (rejected rows stay position-masked and are
+  overwritten by the next window).
 - **Automatic prefix caching** (``prefix_cache=N``): the last N
   fills' AND finishes' K/V rows are retained and a new request
   adopts its longest remembered prefix zero-copy, prefilling only
@@ -52,7 +63,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .decode import (KVCache, decode_step_rows, init_cache, prefill,
+from .decode import (KVCache, decode_step_rows, decode_window_rows,
+                     draft_propose_rows, init_cache, prefill,
                      sample_token)
 from .transformer import TransformerConfig
 
@@ -230,11 +242,18 @@ class ServingEngine:
                  max_seq: int | None = None,
                  prefill_chunk: int | None = None,
                  top_k: int = 0, top_p: float = 0.0,
-                 prefix_cache: int = 0):
+                 prefix_cache: int = 0,
+                 draft_params=None,
+                 draft_cfg: TransformerConfig | None = None,
+                 draft_len: int = 4):
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         if not 0.0 <= top_p <= 1.0:
             raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+        if (draft_params is None) != (draft_cfg is None):
+            raise ValueError("draft_params and draft_cfg go together")
+        if draft_params is not None and draft_len < 1:
+            raise ValueError("draft_len must be >= 1")
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -242,11 +261,22 @@ class ServingEngine:
         # prompt-prefix reuse (PrefixCache docstring; ~one cache
         # slot's memory per entry); 0 disables.
         self._prefix = PrefixCache(prefix_cache) if prefix_cache else None
+        # speculative continuous batching: a draft model proposes
+        # draft_len greedy tokens per slot, the target scores the
+        # whole window in one decode_window_rows pass — greedy-only
+        # (submit rejects sampled requests when a draft is set)
+        self.draft_params = draft_params
+        self.draft_cfg = draft_cfg
+        self.draft_len = draft_len
+        self._spec_windows = 0
+        self._spec_accepted = 0
         self.prefill_chunk = prefill_chunk
         self.top_k = top_k
         self.top_p = top_p
         self.max_seq = max_seq or cfg.max_seq
         self.cache = init_cache(cfg, slots, self.max_seq)
+        self._draft_cache = (init_cache(draft_cfg, slots, self.max_seq)
+                             if draft_params is not None else None)
         self.queue: deque[Request] = deque()
         # host-side slot state; None = free
         self._req: list[Request | None] = [None] * slots
@@ -273,10 +303,22 @@ class ServingEngine:
         if req.max_new < 1:
             # same contract as greedy_generate's n_tokens >= 1
             raise ValueError(f"max_new must be >= 1, got {req.max_new}")
-        if prompt.size + req.max_new > self.max_seq:
+        # a speculative window's first write is the last emitted
+        # token's own row; only the draft_len proposal rows lie past
+        # it, so that is the scratch margin the capacity guard
+        # reserves
+        margin = (self.draft_len
+                  if self.draft_params is not None else 0)
+        if prompt.size + req.max_new + margin > self.max_seq:
             raise ValueError(
-                f"prompt ({prompt.size}) + max_new ({req.max_new}) "
-                f"exceeds the {self.max_seq}-slot cache")
+                f"prompt ({prompt.size}) + max_new ({req.max_new})"
+                + (f" + speculative margin ({margin})" if margin
+                   else "")
+                + f" exceeds the {self.max_seq}-slot cache")
+        if self.draft_params is not None and req.temperature > 0:
+            raise ValueError(
+                "speculative serving is greedy-only; submit sampled "
+                "requests to a non-speculative engine")
         if any(r.uid == req.uid for r in self.queue) or any(
                 r is not None and r.uid == req.uid for r in self._req):
             # uid is the cancel/finished-stream handle; a duplicate
@@ -328,6 +370,9 @@ class ServingEngine:
         if self._prefix is not None:
             out["prefix_hits_total"] = self._prefix.hits
             out["prefix_tokens_reused_total"] = self._prefix.tokens_reused
+        if self.draft_params is not None:
+            out["speculative_windows_total"] = self._spec_windows
+            out["speculative_accepted_total"] = self._spec_accepted
         return out
 
     # -- slot lifecycle --------------------------------------------------
@@ -370,6 +415,27 @@ class ServingEngine:
                     self.cfg, one, off == 0)
         if self._prefix is not None:
             self._prefix.insert(req.prompt, one)
+        if self.draft_params is not None:
+            # the draft needs its own K/V of the prompt (prefix
+            # entries store target K/V only); it honors prefill_chunk
+            # too — compile count is per-shape regardless of model
+            # size, so an unchunked draft fill would reintroduce the
+            # per-length compile tail prefill_chunk exists to bound
+            one_d = init_cache(self.draft_cfg, 1, self.max_seq)
+            if self.prefill_chunk is None:
+                _, one_d = prefill(self.draft_params,
+                                   req.prompt[None, :],
+                                   self.draft_cfg, one_d)
+            else:
+                from .decode import _prefill_jit
+                c = self.prefill_chunk
+                for off in range(0, req.prompt.size, c):
+                    _, one_d = _prefill_jit(
+                        self.draft_params,
+                        req.prompt[None, off:off + c],
+                        self.draft_cfg, one_d, off == 0)
+            self._draft_cache = _adopt_slot(self._draft_cache, one_d,
+                                            jnp.int32(slot))
         if req.temperature > 0:
             # the exact sample_generate key stream: split before the
             # first token, then once per decode step
@@ -431,8 +497,9 @@ class ServingEngine:
 
     def step(self) -> list[Finished]:
         """Refill free slots from the queue, run ONE batched decode
-        step for every active slot, and return newly finished
-        requests.  No-op (empty list) when idle."""
+        step (or, with a draft model, one speculative window) for
+        every active slot, and return newly finished requests.
+        No-op (empty list) when idle."""
         finished: list[Finished] = []
         for slot in range(self.slots):
             # loop: a refilled request whose prefill token already
@@ -450,6 +517,8 @@ class ServingEngine:
                   if self._req[s] is not None]
         if not active:
             return finished
+        if self.draft_params is not None:
+            return self._spec_step(active, finished)
         tokens = jnp.asarray(self._last[:, None])
         logits, self.cache = decode_step_rows(
             self.params, tokens, self.cfg, self.cache,
@@ -469,6 +538,63 @@ class ServingEngine:
             self._pos[slot] += 1
             self._generated[slot].append(int(nxt[slot]))
             self._last[slot] = nxt[slot]
+            if self._done(slot):
+                self._finish_slot(slot, finished)
+        return finished
+
+    def _spec_step(self, active: list[int],
+                   finished: list[Finished]) -> list[Finished]:
+        """One speculative window: draft proposes ``draft_len``
+        tokens per slot (one compiled scan), the target scores the
+        whole window in one ``decode_window_rows`` pass, and each
+        row emits its accepted prefix plus the target's correction
+        (or bonus) token — every emitted token is still the target's
+        own greedy choice for its actual prefix, so output equals the
+        non-speculative engine's.  Inactive rows ride along with
+        stale positions; their writes land beyond any live fill line
+        and refills overwrite the whole row (same contract as the
+        plain step).  Rejected rows stay in both caches position-
+        masked and are overwritten by the next window at the same
+        offsets — rollback is just not advancing ``_pos``."""
+        k = self.draft_len
+        last = jnp.asarray(self._last)
+        pos = jnp.asarray(self._pos)
+        proposals, self._draft_cache = draft_propose_rows(
+            self.draft_params, last, self.draft_cfg,
+            self._draft_cache, pos, k)
+        window = jnp.concatenate([last[:, None], proposals], axis=1)
+        logits, self.cache = decode_window_rows(
+            self.params, window, self.cfg, self.cache, pos)
+        greedy = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        props = np.asarray(proposals, np.int32)
+        self._steps_total += 1
+        self._spec_windows += 1
+        for slot in active:
+            # accepted prefix: proposals matching the target's own
+            # greedy choices; then the correction/bonus token
+            a = 0
+            while a < k and props[slot, a] == greedy[slot, a]:
+                a += 1
+            emit = list(props[slot, :a]) + [greedy[slot, a]]
+            appended = 0
+            for tok in emit:
+                self._generated[slot].append(int(tok))
+                self._last[slot] = tok
+                appended += 1
+                if self._done(slot):
+                    break
+            # acceptance counts only drafts actually EMITTED (an
+            # eos/max_new truncation discards the rest — counting
+            # them would let accepted exceed generated)
+            self._spec_accepted += min(appended, a)
+            # valid rows grew by one per appended token: the window
+            # wrote last + every accepted draft, and the FINAL
+            # appended token's own row stays unwritten either way
+            # (the correction/bonus was never fed; a finishing draft
+            # token's row is written but past prompt+gen[:-1]) — the
+            # same gen[-1]-unwritten invariant as the plain step, so
+            # the finish-time prefix capture sees a consistent _pos
+            self._pos[slot] += appended
             if self._done(slot):
                 self._finish_slot(slot, finished)
         return finished

@@ -405,6 +405,90 @@ class TestServingEngine:
         for uid in plain:
             np.testing.assert_array_equal(cached[uid], plain[uid])
 
+    def _spec_engines(self, draft_quality, prefix_cache=0):
+        """(plain_engine_factory, spec_engine_factory) over one target;
+        draft_quality picks the draft: 'self' = the target itself
+        (every proposal accepted), 'weak' = an independently random
+        tiny model (mostly rejected)."""
+        p = params()
+        if draft_quality == "self":
+            dcfg, dp = CFG, p
+        else:
+            dcfg = dataclasses.replace(CFG, d_model=16, n_layers=1,
+                                       n_heads=2, d_head=8, d_ff=32)
+            dp = init_params(dcfg, jax.random.PRNGKey(9))
+        return (p,
+                lambda: ServingEngine(p, CFG, slots=2,
+                                      prefix_cache=prefix_cache),
+                lambda: ServingEngine(p, CFG, slots=2,
+                                      prefix_cache=prefix_cache,
+                                      draft_params=dp, draft_cfg=dcfg,
+                                      draft_len=3))
+
+    @pytest.mark.parametrize("draft_quality", ["self", "weak"])
+    def test_speculative_engine_matches_plain(self, draft_quality):
+        """Speculative continuous batching is a latency optimization,
+        never a math change: with ANY draft (perfect or mostly
+        rejected), outputs equal the plain engine token for token —
+        across refills, eos stops, and mixed lengths."""
+        p, plain_f, spec_f = self._spec_engines(draft_quality)
+        reqs = [("a", prompt(80, 5), 8), ("b", prompt(81, 9), 4),
+                ("c", prompt(82, 3), 9), ("d", prompt(83, 7), 6)]
+        ref = reference(p, reqs[0][1], 20)
+        eos = int(ref[len(reqs[0][1]) + 3])     # make "a" stop early
+
+        def run(make):
+            eng = make()
+            for uid, pr, n in reqs:
+                eng.submit(Request(uid=uid, prompt=pr, max_new=n,
+                                   eos_id=eos if uid == "a" else None))
+            return {f.uid: f.tokens for f in eng.run()}, eng
+
+        plain, _ = run(plain_f)
+        spec, eng = run(spec_f)
+        assert set(spec) == set(plain)
+        for uid in plain:
+            np.testing.assert_array_equal(
+                spec[uid], plain[uid],
+                err_msg=f"speculation changed request {uid}")
+        stats = eng.stats()
+        assert stats["speculative_windows_total"] > 0
+        if draft_quality == "self":
+            # a perfect draft accepts every proposal in every window
+            assert stats["speculative_accepted_total"] >= \
+                stats["speculative_windows_total"] * 2
+
+    def test_speculative_composes_with_prefix_cache(self):
+        """Both serving optimizations at once stay exact."""
+        p, plain_f, spec_f = self._spec_engines("self", prefix_cache=4)
+        pre = prompt(85, 6)
+        reqs = [("a", np.concatenate([pre, prompt(86, 3)]), 5),
+                ("b", np.concatenate([pre, prompt(87, 4)]), 5)]
+
+        def run(make):
+            eng = make()
+            for uid, pr, n in reqs:
+                eng.submit(Request(uid=uid, prompt=pr, max_new=n))
+            return {f.uid: f.tokens for f in eng.run()}, eng
+
+        plain, _ = run(plain_f)
+        spec, eng = run(spec_f)
+        for uid in plain:
+            np.testing.assert_array_equal(spec[uid], plain[uid])
+        assert eng.stats()["prefix_hits_total"] >= 1
+
+    def test_speculative_rejects_sampled_and_tight_capacity(self):
+        _, _, spec_f = self._spec_engines("self")
+        eng = spec_f()
+        with pytest.raises(ValueError, match="greedy-only"):
+            eng.submit(Request(uid="s", prompt=prompt(88, 4), max_new=2,
+                               temperature=0.7))
+        # draft_len+1 margin: a request that fits a plain engine is
+        # rejected when speculation needs scratch rows past max_new
+        with pytest.raises(ValueError, match="speculative margin"):
+            eng.submit(Request(uid="c", prompt=prompt(89, 30),
+                               max_new=CFG.max_seq - 30))
+
     def test_zero_max_new_rejected(self):
         eng = ServingEngine(params(), CFG, slots=1)
         with pytest.raises(ValueError, match="max_new"):
