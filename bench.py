@@ -800,13 +800,21 @@ def compact_summary(result: dict, sidecar: Path | None = None) -> dict:
         errors.append("tpu_child")
     if "fatal" in detail:
         errors.append("fatal")
+    invalid: list[str] = []
     for probe, key, field in _PROBE_SCALARS:
         rec = tpu.get(probe)
         if not isinstance(rec, dict):
             continue
         if "error" in rec:
             errors.append(probe)
-        elif field in rec:
+            continue
+        if rec.get("valid") is False:
+            # a jitter-invalidated measurement must not read as a
+            # clean headline number in the one line the round is
+            # judged by — the sidecar keeps the details
+            invalid.append(probe)
+            continue
+        if field in rec:
             s[key] = rec[field]
         # serving probes report a wall-clock lower bound under a
         # distinct name; surface it under the same compact key
@@ -815,6 +823,8 @@ def compact_summary(result: dict, sidecar: Path | None = None) -> dict:
             s[key] = rec["tokens_per_s_lower_bound"]
     if "truncated" in tpu or "truncated" in detail:
         s["truncated"] = True
+    if invalid:
+        s["invalid"] = invalid[:10]
     if errors:
         s["errors"] = errors[:10]
     line = {k: result[k] for k in ("metric", "value", "unit",

@@ -467,7 +467,14 @@ class ServingEngine:
             # would, so adoption stays exact.
             written = np.concatenate(
                 [req.prompt, np.asarray(gen[:-1], np.int32)])
-            assert len(written) == int(self._pos[slot])
+            if len(written) != int(self._pos[slot]):
+                # a violated invariant must fail fast, not poison the
+                # prefix cache with misaligned K/V (a bare assert
+                # would vanish under ``python -O``)
+                raise RuntimeError(
+                    f"prefix-capture invariant broken on slot {slot}: "
+                    f"{len(written)} written rows vs pos "
+                    f"{int(self._pos[slot])}")
             # the fill-time prompt entry is a strict prefix of this
             # one and can never win longest_prefix again — drop it so
             # each conversation costs one LRU slot, not two
@@ -485,13 +492,21 @@ class ServingEngine:
         self._generated[slot] = []
         self._temps[slot] = 0.0
 
-    def _done(self, slot: int) -> bool:
+    def _done(self, slot: int, pos_offset: int = 0) -> bool:
+        """``pos_offset``: tokens appended this step but not yet
+        folded into ``_pos`` — the speculative emit loop advances
+        ``_pos`` only after the loop, so the capacity clause must be
+        told the effective position to test the window it is actually
+        in (advisor r04: with offset 0 it tested stale pre-window
+        positions; unreachable today only because submit() reserves
+        the draft_len margin)."""
         req = self._req[slot]
         gen = self._generated[slot]
         return (len(gen) >= req.max_new
                 or (req.eos_id is not None and gen
                     and gen[-1] == req.eos_id)
-                or int(self._pos[slot]) + 1 >= self.max_seq)
+                or int(self._pos[slot]) + pos_offset + 1
+                >= self.max_seq)
 
     # -- the step loop ---------------------------------------------------
 
@@ -581,7 +596,7 @@ class ServingEngine:
                 self._generated[slot].append(int(tok))
                 self._last[slot] = tok
                 appended += 1
-                if self._done(slot):
+                if self._done(slot, pos_offset=appended):
                     break
             # acceptance counts only drafts actually EMITTED (an
             # eos/max_new truncation discards the rest — counting

@@ -171,9 +171,13 @@ def measure_chain_samples(make, arg, iters: int, floor_s: float = 0.0,
     long_fn, short_fn = make(iters), make(short)
     runs = [_measure_pair(long_fn, short_fn, arg, iters, short,
                           floor_s, retries) for _ in range(samples)]
-    pool = [e for e, v in runs if v] or [e for e, _ in runs]
-    med = statistics.median_low(pool)
-    valid = any(v for e, v in runs if e == med)
+    # median_low over (elapsed, valid) PAIRS: validity comes from the
+    # sample actually selected, not from a float-equality match that
+    # an elapsed-value collision (or an all-invalid fallback pool)
+    # could decide wrongly
+    pool = sorted([r for r in runs if r[1]] or runs,
+                  key=lambda r: r[0])
+    med, valid = pool[(len(pool) - 1) // 2]
     return med, valid, [{"ms": round(e * 1000, 3), "valid": v}
                         for e, v in runs]
 

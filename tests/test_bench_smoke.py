@@ -161,6 +161,18 @@ def test_emit_writes_sidecar_and_compact_line(tmp_path, capsys,
     assert full["detail"]["tpu"]["attention"]["retries"]
 
 
+def test_invalid_probe_scalar_stays_out_of_the_line():
+    """A probe whose recorded valid flag is False must not surface
+    its scalar as a clean judge-facing number — it lands in the
+    summary's 'invalid' list instead (the sidecar keeps the detail)."""
+    res = _worst_case_result()
+    res["detail"]["tpu"]["attention"]["valid"] = False
+    line = bench.compact_summary(res)
+    assert "attention_x" not in line["summary"]
+    assert "attention" in line["summary"]["invalid"]
+    assert line["summary"]["attn_long_x"] == 12345.678  # others intact
+
+
 def test_summary_survives_malformed_sections_and_surfaces_crashes():
     """compact_summary must not raise on non-dict sections (a stray
     scalar parsed from a child's stdout) and must surface the

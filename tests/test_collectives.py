@@ -1,0 +1,53 @@
+"""Measurement-harness semantics (ops/collectives.py).
+
+The differential-median harness is what every recorded perf artifact
+traces to (CLAUDE.md), so its selection logic gets pinned directly:
+validity must come from the sample actually chosen by the median, not
+from a float-equality match over the pool (advisor r04: an elapsed
+collision between a valid and an invalid run, or the all-invalid
+fallback pool, could mislabel the result).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent))
+
+from k8s_dra_driver_tpu.ops import collectives
+
+
+def _with_samples(monkeypatch, outcomes):
+    """Run measure_chain_samples with _measure_pair stubbed to return
+    the scripted (elapsed, valid) outcomes in order."""
+    it = iter(outcomes)
+    monkeypatch.setattr(collectives, "_measure_pair",
+                        lambda *a, **k: next(it))
+    return collectives.measure_chain_samples(
+        lambda n: None, None, iters=4, samples=len(outcomes))
+
+
+def test_median_prefers_valid_pool(monkeypatch):
+    med, valid, runs = _with_samples(
+        monkeypatch, [(0.002, True), (0.009, False), (0.004, True)])
+    assert med == 0.002         # median_low of the valid pool {2,4}
+    assert valid is True
+    assert [r["valid"] for r in runs] == [True, False, True]
+
+
+def test_value_collision_does_not_launder_validity(monkeypatch):
+    """An invalid run whose elapsed exactly equals a valid run's must
+    not decide the flag: the selected sample is drawn from the valid
+    pool, so the result stays valid — and symmetrically, an
+    all-invalid pool can never report valid even when values collide
+    with nothing."""
+    med, valid, _ = _with_samples(
+        monkeypatch, [(0.003, True), (0.003, False), (0.005, True)])
+    assert med == 0.003 and valid is True
+
+
+def test_all_invalid_pool_reports_invalid(monkeypatch):
+    med, valid, runs = _with_samples(
+        monkeypatch, [(0.004, False), (0.002, False), (0.006, False)])
+    assert med == 0.004         # median_low over the fallback pool
+    assert valid is False
+    assert all(not r["valid"] for r in runs)
