@@ -454,6 +454,120 @@ def draft_propose_rows(params: Params, last: jax.Array,
     return toks[:k].T, cache
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "k", "top_k",
+                                             "top_p"),
+                   donate_argnums=(3,))
+def draft_sample_rows(params: Params, last: jax.Array,
+                      cfg: TransformerConfig, cache: KVCache,
+                      pos_rows: jax.Array, k: int, keys: jax.Array,
+                      temps: jax.Array, top_k: int = 0,
+                      top_p: float = 0.0
+                      ) -> tuple[jax.Array, jax.Array, KVCache,
+                                 jax.Array]:
+    """Sampled-draft proposals for rejection-sampling speculative
+    decoding: ``k`` tokens per row, each DRAWN from the draft's
+    filtered distribution at the row's temperature (``temps`` [B];
+    temp==0 rows take argmax, matching the greedy path), plus the
+    per-step filtered draft distributions the acceptance test needs.
+
+    Same k+1-step scan contract as ``draft_propose_rows`` (the last
+    proposal's K/V row lands; the extra token is discarded).  Returns
+    (proposals [B, k], q_probs [B, k, V], cache, new keys [B, 2]) —
+    ``q_probs[b, i]`` is exactly the distribution proposal ``i`` was
+    sampled from, which is what ``spec_accept_rows``'s accept ratio
+    and residual must use (standard speculative sampling, Leviathan/
+    Chen et al.; the reference has no serving stack — SURVEY §2.3)."""
+    def step(carry, _):
+        tok, cache, pos, keys = carry
+        logits, cache = _rows_forward(params, tok[:, None], cfg,
+                                      cache, pos)
+        filt = _filter_logits(logits[:, 0], temps, top_k, top_p)
+        split = jax.vmap(jax.random.split)(keys)
+        sampled = jax.vmap(jax.random.categorical)(split[:, 1], filt)
+        greedy = jnp.argmax(logits[:, 0], axis=-1)
+        nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        q = jax.nn.softmax(filt, axis=-1)
+        new_keys = jnp.where((temps > 0)[:, None], split[:, 0], keys)
+        return (nxt, cache, pos + 1, new_keys), (nxt, q)
+    (_, cache, _, keys), (toks, qs) = jax.lax.scan(
+        step, (last, cache, jnp.asarray(pos_rows), keys), None,
+        length=k + 1)
+    return toks[:k].T, jnp.moveaxis(qs[:k], 0, 1), cache, keys
+
+
+@functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
+def spec_accept_rows(logits: jax.Array, proposals: jax.Array,
+                     q_probs: jax.Array, keys: jax.Array,
+                     temps: jax.Array, top_k: int = 0,
+                     top_p: float = 0.0
+                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-row speculative acceptance, greedy and sampled rows in ONE
+    program: target ``logits`` [B, K+1, V] over the window, draft
+    ``proposals`` [B, K] with their distributions ``q_probs``
+    [B, K, V], per-row ``keys``/``temps`` -> (emit [B, K+1],
+    accepts [B], new keys).
+
+    Greedy rows (temp==0): the exact-match rule — accepted prefix is
+    proposals matching the target's raw argmax, correction/bonus is
+    the argmax at the first mismatch (identical to the host loop it
+    replaces, so speculative == plain greedy stays bit-exact).
+
+    Sampled rows: standard rejection sampling — accept draft token i
+    w.p. ``min(1, p_i(x_i) / q_i(x_i))`` with both distributions
+    under the SAME temperature/top-k/top-p filter the samplers use;
+    on the first reject, resample from the residual
+    ``norm(max(p_i - q_i, 0))``; on a full accept, draw the bonus
+    token from ``p_K``.  Each emitted token is therefore distributed
+    exactly as non-speculative sampling of the target would produce
+    (the Leviathan/Chen guarantee), pinned empirically by
+    tests/test_speculative.py on a small vocab.
+
+    ``emit[b, :accepts[b]+1]`` are the tokens to append; positions
+    past that are padding.  Greedy rows leave their key untouched.
+    """
+    b, k1, v = logits.shape
+    k = k1 - 1
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    p = jax.nn.softmax(
+        _filter_logits(logits, temps[:, None], top_k, top_p), axis=-1)
+    split = jax.vmap(lambda key: jax.random.split(key, 3))(keys)
+    new_keys, u_sub, r_sub = split[:, 0], split[:, 1], split[:, 2]
+    u = jax.vmap(lambda key: jax.random.uniform(key, (k,)))(u_sub)
+    p_x = jnp.take_along_axis(p[:, :k], proposals[..., None],
+                              axis=-1)[..., 0]
+    q_x = jnp.take_along_axis(q_probs, proposals[..., None],
+                              axis=-1)[..., 0]
+    accept_s = u < jnp.minimum(p_x / jnp.maximum(q_x, 1e-30), 1.0)
+    accept_g = proposals == greedy_tok[:, :k]
+    accept = jnp.where((temps > 0)[:, None], accept_s, accept_g)
+    a = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    # correction/bonus distribution at the first-reject position (or
+    # the bonus position K on a full accept, where nothing is
+    # subtracted)
+    p_a = jnp.take_along_axis(p, a[:, None, None], axis=1)[:, 0]
+    q_a = jnp.take_along_axis(q_probs,
+                              jnp.minimum(a, k - 1)[:, None, None],
+                              axis=1)[:, 0]
+    residual = jnp.where((a < k)[:, None],
+                         jnp.maximum(p_a - q_a, 0.0), p_a)
+    mass = jnp.sum(residual, axis=-1, keepdims=True)
+    # zero residual mass means p <= q everywhere, which forces
+    # acceptance prob 1 — reachable only through float round-off, and
+    # then p_a itself is the right fallback
+    safe = jnp.where(mass > 0, residual / jnp.maximum(mass, 1e-30),
+                     p_a)
+    corr_s = jax.vmap(jax.random.categorical)(
+        r_sub, jnp.log(jnp.maximum(safe, 1e-30)))
+    corr_g = jnp.take_along_axis(greedy_tok, a[:, None], axis=1)[:, 0]
+    corr = jnp.where(temps > 0, corr_s, corr_g).astype(jnp.int32)
+    padded = jnp.concatenate(
+        [proposals, jnp.zeros((b, 1), jnp.int32)], axis=1)
+    emit = jnp.where(jnp.arange(k + 1)[None] == a[:, None],
+                     corr[:, None], padded)
+    new_keys = jnp.where((temps > 0)[:, None], new_keys, keys)
+    return emit, a, new_keys
+
+
 def _validated_prefill(params, prompt, cfg, n_tokens, max_seq):
     """Shared generation front half: static bounds checks + flash
     prefill of a fresh cache."""
@@ -497,17 +611,13 @@ def greedy_generate(params: Params, prompt: jax.Array,
     return jnp.concatenate([prompt, generated], axis=1)
 
 
-def sample_token(logits, key, temperature, top_k: int = 0,
-                 top_p: float = 0.0):
-    """The temperature/top-k/top-p transform + categorical draw:
-    ``[..., V]`` logits -> ``[...]`` token ids.
-
-    Shared by ``sample_generate`` and the continuous-batching
-    engine's per-slot sampling (models/serving.py) so the two cannot
-    drift; ``temperature`` may be a scalar or broadcastable over the
-    leading dims (per-slot temperatures).  Ties with the smallest
-    kept nucleus logit also survive (standard >=-on-raw-logits
-    behavior); only exact float ties at the boundary over-keep."""
+def _filter_logits(logits, temperature, top_k: int = 0,
+                   top_p: float = 0.0):
+    """The temperature/top-k/top-p transform on raw logits; softmax of
+    the result is the distribution sampling actually draws from —
+    factored out so rejection-sampling speculative decoding can score
+    draft/target probabilities under the SAME filter the sampler uses
+    (``sample_token`` == categorical over these)."""
     temp = jnp.maximum(jnp.asarray(temperature, jnp.float32), 1e-6)
     if temp.ndim:
         temp = temp[..., None]          # per-row over the vocab dim
@@ -526,7 +636,23 @@ def sample_token(logits, key, temperature, top_k: int = 0,
         cutoff = jnp.max(jnp.where(keep, srt, -jnp.inf), axis=-1,
                          keepdims=True)
         scaled = jnp.where(scaled >= cutoff, scaled, -1e30)
-    return jax.random.categorical(key, scaled, axis=-1)
+    return scaled
+
+
+def sample_token(logits, key, temperature, top_k: int = 0,
+                 top_p: float = 0.0):
+    """The temperature/top-k/top-p transform + categorical draw:
+    ``[..., V]`` logits -> ``[...]`` token ids.
+
+    Shared by ``sample_generate`` and the continuous-batching
+    engine's per-slot sampling (models/serving.py) so the two cannot
+    drift; ``temperature`` may be a scalar or broadcastable over the
+    leading dims (per-slot temperatures).  Ties with the smallest
+    kept nucleus logit also survive (standard >=-on-raw-logits
+    behavior); only exact float ties at the boundary over-keep."""
+    return jax.random.categorical(
+        key, _filter_logits(logits, temperature, top_k, top_p),
+        axis=-1)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n_tokens", "max_seq",

@@ -29,15 +29,18 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   device only ever sees static shapes).
 - **Speculative continuous batching** (``draft_params``/
   ``draft_cfg``/``draft_len``): a draft model proposes ``draft_len``
-  greedy tokens per slot in ONE compiled scan
-  (``draft_propose_rows``), the target scores every slot's whole
-  window in ONE ``decode_window_rows`` pass, and each row emits its
-  accepted prefix + the target's correction/bonus token — up to
+  tokens per slot in ONE compiled scan, the target scores every
+  slot's whole window in ONE ``decode_window_rows`` pass, and each
+  row emits its accepted prefix + a correction/bonus token — up to
   ``draft_len+1`` tokens per big-weight stream instead of one,
-  per-row acceptance (no lockstep minimum), output identical to the
-  plain engine's greedy decode.  Greedy-only; rollback is just not
-  advancing ``_pos`` (rejected rows stay position-masked and are
-  overwritten by the next window).
+  per-row acceptance (no lockstep minimum).  Greedy rows use
+  exact-match acceptance (output identical to the plain engine);
+  sampled rows (``temperature > 0``) use standard rejection
+  sampling (accept draft i w.p. ``min(1, p/q)``, residual resample
+  on reject — ``spec_accept_rows``), so every emitted token is
+  distributed exactly as plain sampling of the target.  Rollback is
+  just not advancing ``_pos`` (rejected rows stay position-masked
+  and are overwritten by the next window).
 - **Automatic prefix caching** (``prefix_cache=N``): the last N
   fills' AND finishes' K/V rows are retained and a new request
   adopts its longest remembered prefix zero-copy, prefilling only
@@ -64,8 +67,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from .decode import (KVCache, decode_step_rows, decode_window_rows,
-                     draft_propose_rows, init_cache, prefill,
-                     sample_token)
+                     draft_propose_rows, draft_sample_rows, init_cache,
+                     prefill, sample_token, spec_accept_rows)
 from .transformer import TransformerConfig
 
 
@@ -262,12 +265,19 @@ class ServingEngine:
         # slot's memory per entry); 0 disables.
         self._prefix = PrefixCache(prefix_cache) if prefix_cache else None
         # speculative continuous batching: a draft model proposes
-        # draft_len greedy tokens per slot, the target scores the
-        # whole window in one decode_window_rows pass — greedy-only
-        # (submit rejects sampled requests when a draft is set)
+        # draft_len tokens per slot, the target scores the whole
+        # window in one decode_window_rows pass.  Greedy rows use
+        # exact-match acceptance; sampled rows (temperature > 0) use
+        # per-row rejection sampling (spec_accept_rows), so both
+        # compose with the draft in the same batch.
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.draft_len = draft_len
+        # draft-side PRNG streams for sampled rows, independent of
+        # the target streams (_keys) — any independent scheme
+        # preserves the output distribution
+        self._draft_keys = jnp.tile(jax.random.PRNGKey(1)[None],
+                                    (slots, 1))
         self._spec_windows = 0
         self._spec_accepted = 0
         self.prefill_chunk = prefill_chunk
@@ -315,10 +325,6 @@ class ServingEngine:
                 + (f" + speculative margin ({margin})" if margin
                    else "")
                 + f" exceeds the {self.max_seq}-slot cache")
-        if self.draft_params is not None and req.temperature > 0:
-            raise ValueError(
-                "speculative serving is greedy-only; submit sampled "
-                "requests to a non-speculative engine")
         if any(r.uid == req.uid for r in self.queue) or any(
                 r is not None and r.uid == req.uid for r in self._req):
             # uid is the cancel/finished-stream handle; a duplicate
@@ -444,6 +450,16 @@ class ServingEngine:
                                     jnp.float32(req.temperature),
                                     self.top_k, self.top_p))
             self._keys = self._keys.at[slot].set(key)
+            if self.draft_params is not None:
+                # independent draft-side stream for this request.
+                # NOT fold_in(key, 0|1): threefry's split(k) IS
+                # [fold_in(k, 0), fold_in(k, 1)], so those collide
+                # with the key/sub pair above and the proposals would
+                # correlate with the first emitted token, breaking
+                # the rejection-sampling guarantee
+                self._draft_keys = self._draft_keys.at[slot].set(
+                    jax.random.fold_in(jax.random.PRNGKey(req.seed),
+                                       7919))
             self._temps[slot] = req.temperature
         else:
             first = int(jnp.argmax(logits[0, -1]))
@@ -562,35 +578,65 @@ class ServingEngine:
         """One speculative window: draft proposes ``draft_len``
         tokens per slot (one compiled scan), the target scores the
         whole window in one ``decode_window_rows`` pass, and each
-        row emits its accepted prefix plus the target's correction
-        (or bonus) token — every emitted token is still the target's
-        own greedy choice for its actual prefix, so output equals the
-        non-speculative engine's.  Inactive rows ride along with
-        stale positions; their writes land beyond any live fill line
-        and refills overwrite the whole row (same contract as the
-        plain step).  Rejected rows stay in both caches position-
-        masked and are overwritten by the next window at the same
-        offsets — rollback is just not advancing ``_pos``."""
+        row emits its accepted prefix plus a correction/bonus token.
+
+        Greedy rows: accepted prefix = proposals matching the
+        target's own greedy choices, so output equals the
+        non-speculative engine's exactly.  Sampled rows
+        (temperature > 0): the draft SAMPLES its proposals and the
+        target runs per-row rejection sampling over the window
+        (``spec_accept_rows``), so each emitted token is distributed
+        exactly as plain sampling of the target — both kinds coexist
+        in one batch, decided per row inside one fused program.
+
+        Inactive rows ride along with stale positions; their writes
+        land beyond any live fill line and refills overwrite the
+        whole row (same contract as the plain step).  Rejected rows
+        stay in both caches position-masked and are overwritten by
+        the next window at the same offsets — rollback is just not
+        advancing ``_pos``."""
         k = self.draft_len
         last = jnp.asarray(self._last)
         pos = jnp.asarray(self._pos)
-        proposals, self._draft_cache = draft_propose_rows(
-            self.draft_params, last, self.draft_cfg,
-            self._draft_cache, pos, k)
+        sampled_mode = bool(self._temps.any())
+        if sampled_mode:
+            temps = jnp.asarray(self._temps)
+            (proposals, q_probs, self._draft_cache,
+             self._draft_keys) = draft_sample_rows(
+                self.draft_params, last, self.draft_cfg,
+                self._draft_cache, pos, k, self._draft_keys, temps,
+                self.top_k, self.top_p)
+        else:
+            proposals, self._draft_cache = draft_propose_rows(
+                self.draft_params, last, self.draft_cfg,
+                self._draft_cache, pos, k)
         window = jnp.concatenate([last[:, None], proposals], axis=1)
         logits, self.cache = decode_window_rows(
             self.params, window, self.cfg, self.cache, pos)
-        greedy = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
-        props = np.asarray(proposals, np.int32)
+        if sampled_mode:
+            emit_dev, a_dev, self._keys = spec_accept_rows(
+                logits, proposals, q_probs, self._keys, temps,
+                self.top_k, self.top_p)
+            emit_all = np.asarray(emit_dev, np.int32)
+            a_all = np.asarray(a_dev, np.int32)
+        else:
+            # lean greedy-only path: no filtered-softmax or key
+            # bookkeeping; acceptance is a host-side prefix match
+            greedy = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+            props = np.asarray(proposals, np.int32)
         self._steps_total += 1
         self._spec_windows += 1
         for slot in active:
-            # accepted prefix: proposals matching the target's own
-            # greedy choices; then the correction/bonus token
-            a = 0
-            while a < k and props[slot, a] == greedy[slot, a]:
-                a += 1
-            emit = list(props[slot, :a]) + [greedy[slot, a]]
+            if sampled_mode:
+                a = int(a_all[slot])
+                emit = list(emit_all[slot, :a + 1])
+            else:
+                # accepted prefix: proposals matching the target's
+                # own greedy choices; then the correction/bonus token
+                a = 0
+                while a < k and props[slot, a] == greedy[slot, a]:
+                    a += 1
+                emit = list(props[slot, :a]) + [greedy[slot, a]]
             appended = 0
             for tok in emit:
                 self._generated[slot].append(int(tok))

@@ -477,17 +477,49 @@ class TestServingEngine:
             np.testing.assert_array_equal(spec[uid], plain[uid])
         assert eng.stats()["prefix_hits_total"] >= 1
 
-    def test_speculative_rejects_sampled_and_tight_capacity(self):
+    def test_speculative_rejects_tight_capacity(self):
         _, _, spec_f = self._spec_engines("self")
         eng = spec_f()
-        with pytest.raises(ValueError, match="greedy-only"):
-            eng.submit(Request(uid="s", prompt=prompt(88, 4), max_new=2,
-                               temperature=0.7))
         # draft_len+1 margin: a request that fits a plain engine is
         # rejected when speculation needs scratch rows past max_new
         with pytest.raises(ValueError, match="speculative margin"):
             eng.submit(Request(uid="c", prompt=prompt(89, 30),
                                max_new=CFG.max_seq - 30))
+
+    @pytest.mark.parametrize("draft_quality", ["self", "weak"])
+    def test_speculative_sampled_mixed_batch(self, draft_quality):
+        """Sampled requests compose with the draft (rejection
+        sampling): a mixed greedy+sampled batch drains, the greedy
+        request still matches the plain engine bit-exactly, the
+        sampled request is deterministic in its seed, and with a
+        perfect draft (q == p, acceptance ratio exactly 1) every
+        proposal is accepted."""
+        p, plain_f, spec_f = self._spec_engines(draft_quality)
+        reqs = [("g", prompt(90, 5), 7, 0.0),
+                ("s", prompt(91, 8), 6, 0.9),
+                ("s2", prompt(92, 4), 5, 1.3)]
+
+        def run(make):
+            eng = make()
+            for uid, pr, n, temp in reqs:
+                eng.submit(Request(uid=uid, prompt=pr, max_new=n,
+                                   temperature=temp, seed=41))
+            return {f.uid: f.tokens for f in eng.run()}, eng
+
+        plain, _ = run(plain_f)
+        spec, eng = run(spec_f)
+        spec2, _ = run(spec_f)
+        assert set(spec) == {u for u, *_ in reqs}
+        np.testing.assert_array_equal(spec["g"], plain["g"])
+        for uid, pr, n, _ in reqs:
+            assert spec[uid].size == pr.size + n     # no eos: full budget
+            np.testing.assert_array_equal(spec[uid], spec2[uid])
+        stats = eng.stats()
+        assert stats["speculative_windows_total"] > 0
+        if draft_quality == "self":
+            # q == p at every position: min(1, p/q) = 1, u < 1 always
+            assert stats["speculative_accepted_total"] >= \
+                stats["speculative_windows_total"] * 2
 
     def test_zero_max_new_rejected(self):
         eng = ServingEngine(params(), CFG, slots=1)

@@ -109,3 +109,108 @@ def test_quantized_target_still_exact():
     got, _ = speculative_generate(qtarget, draft, prompt, CFG, DRAFT,
                                   12, draft_len=3)
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestRejectionSampling:
+    """spec_accept_rows (models/decode.py): the sampled-speculative
+    acceptance math.  The Leviathan/Chen guarantee — emitted tokens
+    are distributed exactly as plain sampling of the target — is
+    pinned empirically on a small vocab with many parallel rows
+    (fixed per-position logits, so the per-position marginals are
+    known in closed form)."""
+
+    V, K, ROWS = 8, 2, 16384
+
+    def _fixtures(self, temp=0.9, top_k=0, top_p=0.0, draft_seed=5):
+        from k8s_dra_driver_tpu.models.decode import _filter_logits
+        tl = jax.random.normal(jax.random.PRNGKey(3),
+                               (self.K + 1, self.V))
+        dl = jax.random.normal(jax.random.PRNGKey(draft_seed),
+                               (self.K, self.V))
+        p = jax.nn.softmax(_filter_logits(tl, temp, top_k, top_p), -1)
+        q = jax.nn.softmax(_filter_logits(dl, temp, top_k, top_p), -1)
+        # proposals: each row samples its window from q — exactly the
+        # distribution recorded for the acceptance ratio
+        keys = jax.vmap(jax.random.PRNGKey)(
+            jnp.arange(self.ROWS) + 100)
+        props = jax.vmap(
+            lambda k: jax.vmap(jax.random.categorical)(
+                jax.random.split(k, self.K),
+                _filter_logits(dl, temp, top_k, top_p)))(keys)
+        return tl, p, q, props.astype(jnp.int32), keys
+
+    def _accept(self, tl, q, props, temp=0.9, top_k=0, top_p=0.0):
+        from k8s_dra_driver_tpu.models.decode import spec_accept_rows
+        logits = jnp.tile(tl[None], (self.ROWS, 1, 1))
+        q_probs = jnp.tile(q[None], (self.ROWS, 1, 1))
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(self.ROWS))
+        temps = jnp.full((self.ROWS,), temp, jnp.float32)
+        return spec_accept_rows(logits, props, q_probs, keys, temps,
+                                top_k, top_p)
+
+    @staticmethod
+    def _tv(tokens, want, v):
+        emp = np.bincount(np.asarray(tokens), minlength=v) / len(tokens)
+        return 0.5 * np.abs(emp - np.asarray(want)).sum()
+
+    @pytest.mark.parametrize("filters", [(0, 0.0), (4, 0.0), (0, 0.8)])
+    def test_first_emitted_token_follows_target(self, filters):
+        """The first emitted token's marginal equals the filtered
+        target distribution p_0 regardless of the draft — THE
+        distribution-preservation property (accept w.p. min(1, p/q),
+        residual resample on reject)."""
+        top_k, top_p = filters
+        tl, p, q, props, _ = self._fixtures(top_k=top_k, top_p=top_p)
+        emit, _, _ = self._accept(tl, q, props, top_k=top_k,
+                                  top_p=top_p)
+        assert self._tv(emit[:, 0], p[0], self.V) < 0.03
+
+    def test_bonus_token_follows_target_tail(self):
+        """Full-accept rows draw their bonus from p_K (nothing is
+        subtracted at the bonus position)."""
+        tl, p, q, props, _ = self._fixtures()
+        emit, a, _ = self._accept(tl, q, props)
+        full = np.asarray(a) == self.K
+        assert full.sum() > 2000          # enough mass to test on
+        assert self._tv(np.asarray(emit)[full, self.K], p[self.K],
+                        self.V) < 0.05
+
+    def test_perfect_draft_accepts_everything(self):
+        """q == p at every position makes the acceptance ratio
+        exactly 1: every row fully accepts (u < 1 always)."""
+        # draft IS the target: same logits seed, same filter -> q == p
+        _, _, qq, props, _ = self._fixtures(draft_seed=3)
+        tl_q = jax.random.normal(jax.random.PRNGKey(3),
+                                 (self.K + 1, self.V))
+        emit, a, _ = self._accept(tl_q, qq, props)
+        assert np.asarray(a).min() == self.K
+
+    def test_greedy_rows_match_argmax_semantics(self):
+        """temp==0 rows reproduce the host-side exact-match rule the
+        fused program replaced (prefix match against raw argmax, then
+        the argmax correction/bonus)."""
+        from k8s_dra_driver_tpu.models.decode import spec_accept_rows
+        rows = 64
+        tl = jax.random.normal(jax.random.PRNGKey(7),
+                               (rows, self.K + 1, self.V))
+        props = jax.random.randint(jax.random.PRNGKey(8),
+                                   (rows, self.K), 0, self.V,
+                                   jnp.int32)
+        q = jnp.full((rows, self.K, self.V), 1.0 / self.V)
+        keys = jax.vmap(jax.random.PRNGKey)(jnp.arange(rows))
+        temps = jnp.zeros((rows,), jnp.float32)
+        emit, a, new_keys = spec_accept_rows(tl, props, q, keys, temps)
+        greedy = np.asarray(jnp.argmax(tl, -1))
+        props_n, emit_n, a_n = (np.asarray(props), np.asarray(emit),
+                                np.asarray(a))
+        for r in range(rows):
+            want_a = 0
+            while (want_a < self.K
+                   and props_n[r, want_a] == greedy[r, want_a]):
+                want_a += 1
+            assert a_n[r] == want_a
+            np.testing.assert_array_equal(
+                emit_n[r, :want_a + 1],
+                list(props_n[r, :want_a]) + [greedy[r, want_a]])
+        np.testing.assert_array_equal(np.asarray(new_keys),
+                                      np.asarray(keys))
