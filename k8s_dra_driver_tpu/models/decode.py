@@ -454,6 +454,105 @@ def draft_propose_rows(params: Params, last: jax.Array,
     return toks[:k].T, cache
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "max_seq", "top_k",
+                                             "top_p"),
+                   donate_argnums=(3,))
+def prefill_adopt_rows(params: Params, prompts: jax.Array,
+                       cfg: TransformerConfig, cache: KVCache,
+                       slot_ids: jax.Array, keys0: jax.Array,
+                       temps: jax.Array, max_seq: int,
+                       top_k: int = 0, top_p: float = 0.0
+                       ) -> tuple[jax.Array, KVCache, jax.Array]:
+    """Fused fresh-fill of ``n`` same-length requests in ONE program:
+    zero-init an [n, max_seq] cache, flash-prefill ``prompts``
+    [n, L], scatter the K/V rows into the donated engine cache at
+    ``slot_ids``, and draw each request's first token (argmax for
+    temp==0 rows, the exact ``sample_generate`` key schedule — split
+    the request's base key ``keys0`` [n, 2] (built host-side from
+    PRNGKey(seed), so any Python-int seed round-trips exactly),
+    sample with split[1], carry split[0] — for sampled rows).
+    Returns (first tokens [n], cache, carried keys [n, 2]).
+
+    Callers pad their group to a FIXED n by repeating a real row
+    (duplicate scatter indices then write identical values, which is
+    deterministic), so compilation keys only on the prompt length —
+    the same compile surface as per-request fills.
+
+    Exists because a per-request fill is 3+ program launches
+    (init zeros, prefill, adopt) and tunneled/remote backends pay
+    ~100 ms of launch latency per program regardless of compute —
+    r05 measured 8 separate fills at 925 ms server-side vs sub-ms of
+    actual prefill FLOPs.  One launch per same-length group turns
+    refill cost from per-request RTT into per-round RTT."""
+    one = init_cache(cfg, prompts.shape[0], max_seq)
+    logits, one = forward_with_cache(params, prompts, cfg, one,
+                                     first_chunk=True)
+
+    def put(dst, src):
+        return [d.at[slot_ids].set(s) for d, s in zip(dst, src)]
+
+    cache = KVCache(
+        k=put(cache.k, one.k), v=put(cache.v, one.v), pos=cache.pos,
+        k_scale=(put(cache.k_scale, one.k_scale)
+                 if cache.k_scale is not None else None),
+        v_scale=(put(cache.v_scale, one.v_scale)
+                 if cache.v_scale is not None else None))
+    last = logits[:, -1]
+    split = jax.vmap(jax.random.split)(keys0)
+    greedy = jnp.argmax(last, axis=-1)
+    sampled = jax.vmap(
+        lambda l, k, t: sample_token(l, k, t, top_k, top_p))(
+        last, split[:, 1], temps)
+    first = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+    return first, cache, split[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "k", "top_k",
+                                             "top_p"),
+                   donate_argnums=(3,))
+def decode_chain_rows(params: Params, last: jax.Array,
+                      cfg: TransformerConfig, cache: KVCache,
+                      pos_rows: jax.Array, k: int, keys: jax.Array,
+                      temps: jax.Array, top_k: int = 0,
+                      top_p: float = 0.0
+                      ) -> tuple[jax.Array, KVCache, jax.Array]:
+    """``k`` consecutive per-row decode steps in ONE dispatch: a
+    ``lax.scan`` over the ``decode_step_rows`` body, so the host pays
+    one round-trip per k tokens-per-slot instead of per token — the
+    dispatch-amortization lever for continuous batching on
+    high-latency (tunneled/remote) backends, where per-step RTT
+    dominates the compiled step time ~300x (BENCH_r04 serving vs
+    decode probes).
+
+    Greedy rows take argmax; sampled rows (``temps`` > 0) draw
+    through the same per-row filter/key-stream advance as the
+    engine's per-step ``_next_tokens`` (split, sample split[1], carry
+    split[0]; greedy rows leave their key untouched) — so a chained
+    drain emits byte-identical tokens to the step-at-a-time engine,
+    and the host just checks finish flags every k steps, discarding
+    any overshoot past eos/max_new (per-row continuations are
+    independent, so a discarded tail never affects the kept prefix).
+    Returns (tokens [B, k], cache, new keys)."""
+    def step(carry, _):
+        tok, cache, pos, keys = carry
+        logits, cache = _rows_forward(params, tok[:, None], cfg,
+                                      cache, pos)
+        lg = logits[:, 0]
+        greedy = jnp.argmax(lg, axis=-1)
+        split = jax.vmap(jax.random.split)(keys)
+        sampled = jax.vmap(
+            lambda l, kk, t: sample_token(l, kk, t, top_k, top_p))(
+            lg, split[:, 1], temps)
+        live = temps > 0
+        nxt = jnp.where(live, sampled, greedy).astype(jnp.int32)
+        new_keys = jnp.where(live[:, None], split[:, 0], keys)
+        return (nxt, cache, pos + 1, new_keys), nxt
+    (_, cache, _, keys), toks = jax.lax.scan(
+        step, (last, cache, jnp.asarray(pos_rows), keys), None,
+        length=k)
+    return toks.T, cache, keys
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "k", "top_k",
                                              "top_p"),
                    donate_argnums=(3,))

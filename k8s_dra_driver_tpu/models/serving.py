@@ -41,6 +41,14 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   distributed exactly as plain sampling of the target.  Rollback is
   just not advancing ``_pos`` (rejected rows stay position-masked
   and are overwritten by the next window).
+- **Chained decode** (``chain_steps=K``): K decode steps per
+  dispatch via a ``lax.scan`` over the per-row step
+  (``decode_chain_rows``), finish/refill handled host-side at chain
+  boundaries with overshoot discarded — identical outputs, one host
+  round-trip per K tokens-per-slot.  THE lever on high-RTT
+  (tunneled/remote) backends where dispatch dominates the compiled
+  step ~300x; per-phase wall clocks in ``stats()`` separate engine
+  host overhead from dispatch so artifacts record which is which.
 - **Automatic prefix caching** (``prefix_cache=N``): the last N
   fills' AND finishes' K/V rows are retained and a new request
   adopts its longest remembered prefix zero-copy, prefilling only
@@ -59,6 +67,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from collections import deque
 from typing import Any
 
@@ -66,9 +75,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .decode import (KVCache, decode_step_rows, decode_window_rows,
-                     draft_propose_rows, draft_sample_rows, init_cache,
-                     prefill, sample_token, spec_accept_rows)
+from . import decode as _decode
+from .decode import (KVCache, decode_chain_rows, decode_step_rows,
+                     decode_window_rows, draft_propose_rows,
+                     draft_sample_rows, init_cache, prefill_adopt_rows,
+                     sample_token, spec_accept_rows)
 from .transformer import TransformerConfig
 
 
@@ -248,7 +259,8 @@ class ServingEngine:
                  prefix_cache: int = 0,
                  draft_params=None,
                  draft_cfg: TransformerConfig | None = None,
-                 draft_len: int = 4):
+                 draft_len: int = 4,
+                 chain_steps: int = 1):
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         if not 0.0 <= top_p <= 1.0:
@@ -257,6 +269,14 @@ class ServingEngine:
             raise ValueError("draft_params and draft_cfg go together")
         if draft_params is not None and draft_len < 1:
             raise ValueError("draft_len must be >= 1")
+        if chain_steps < 1:
+            raise ValueError("chain_steps must be >= 1")
+        if chain_steps > 1 and draft_params is not None:
+            # both amortize the per-step dispatch; composing them
+            # would chain whole speculative windows, which the
+            # rollback bookkeeping does not support
+            raise ValueError("chain_steps and draft_params are "
+                             "mutually exclusive")
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -280,9 +300,22 @@ class ServingEngine:
                                     (slots, 1))
         self._spec_windows = 0
         self._spec_accepted = 0
+        # chain_steps=K runs K decode steps per dispatch
+        # (decode_chain_rows): finish/refill checks move to chain
+        # boundaries and overshoot past eos/max_new is discarded, so
+        # outputs stay identical while the per-step host RTT is paid
+        # once per K tokens-per-slot
+        self.chain_steps = chain_steps
         self.prefill_chunk = prefill_chunk
         self.top_k = top_k
         self.top_p = top_p
+        # per-phase host accounting (stats()): prefill wall, decode
+        # dispatch+readback wall, and everything else (host
+        # scheduling) — what separates engine overhead from backend
+        # RTT in recorded artifacts
+        self._time_prefill = 0.0
+        self._time_decode = 0.0
+        self._time_host = 0.0
         self.max_seq = max_seq or cfg.max_seq
         self.cache = init_cache(cfg, slots, self.max_seq)
         self._draft_cache = (init_cache(draft_cfg, slots, self.max_seq)
@@ -316,13 +349,15 @@ class ServingEngine:
         # a speculative window's first write is the last emitted
         # token's own row; only the draft_len proposal rows lie past
         # it, so that is the scratch margin the capacity guard
-        # reserves
+        # reserves.  A chained drain similarly overshoots by up to
+        # chain_steps-1 discarded writes past the finish line.
         margin = (self.draft_len
-                  if self.draft_params is not None else 0)
+                  if self.draft_params is not None
+                  else self.chain_steps - 1)
         if prompt.size + req.max_new + margin > self.max_seq:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new ({req.max_new})"
-                + (f" + speculative margin ({margin})" if margin
+                + (f" + scratch margin ({margin})" if margin
                    else "")
                 + f" exceeds the {self.max_seq}-slot cache")
         if any(r.uid == req.uid for r in self.queue) or any(
@@ -373,6 +408,11 @@ class ServingEngine:
             "generated_tokens_total": self._tokens_total,
             "decode_steps_total": self._steps_total,
         }
+        # per-phase host wall (seconds): what a recorded artifact
+        # needs to separate engine overhead from backend dispatch RTT
+        out["time_prefill_s"] = round(self._time_prefill, 4)
+        out["time_decode_dispatch_s"] = round(self._time_decode, 4)
+        out["time_host_s"] = round(self._time_host, 4)
         if self._prefix is not None:
             out["prefix_hits_total"] = self._prefix.hits
             out["prefix_tokens_reused_total"] = self._prefix.tokens_reused
@@ -383,13 +423,17 @@ class ServingEngine:
 
     # -- slot lifecycle --------------------------------------------------
 
-    def _fill_slot(self, slot: int, req: Request) -> None:
+    def _fill_dispatch(self, slot: int, req: Request) -> jax.Array:
         """Prefill the request on a fresh [1, L] cache and copy its
-        K/V rows into the slot.  With the prefix cache on, the fill
-        starts from the longest remembered common prefix instead of
-        token 0 — zero-copy adoption, then a normal (chunked or
-        whole) suffix prefill; equivalent to chunked prefill with the
-        first chunk memoized, so generation stays exact."""
+        K/V rows into the slot; returns the first generated token as
+        a DEVICE scalar so callers can batch the blocking readback
+        across fills (each readback is a full RTT on tunneled
+        backends — r04's serving drain spent 93% of its wall in
+        per-fill syncs).  With the prefix cache on, the fill starts
+        from the longest remembered common prefix instead of token 0
+        — zero-copy adoption, then a normal (chunked or whole) suffix
+        prefill; equivalent to chunked prefill with the first chunk
+        memoized, so generation stays exact."""
         start = 0
         if self._prefix is not None:
             p, entry = self._prefix.longest_prefix(req.prompt)
@@ -402,8 +446,12 @@ class ServingEngine:
         if start == 0:
             one = init_cache(self.cfg, 1, self.max_seq)
         if self.prefill_chunk is None and start == 0:
-            logits, one = prefill(self.params, req.prompt[None, :],
-                                  self.cfg, one)
+            # first_chunk is statically True on a fresh cache —
+            # calling the jit directly skips prefill()'s cache.pos
+            # device_get, a blocking RTT per fill
+            logits, one = _decode._prefill_jit(self.params,
+                                       req.prompt[None, :],
+                                       self.cfg, one, True)
         else:
             # chunked: ≤2C compiled programs across all lengths (each
             # size ≤C as first chunk and as remainder), exact at any
@@ -413,10 +461,9 @@ class ServingEngine:
             # chunk on tunneled backends.  A prefix-cache hit enters
             # here too (start > 0): its suffix rides the same
             # masked-path programs chunked prefill compiles.
-            from .decode import _prefill_jit
             c = self.prefill_chunk or req.prompt.size
             for off in range(start, req.prompt.size, c):
-                logits, one = _prefill_jit(
+                logits, one = _decode._prefill_jit(
                     self.params, req.prompt[None, off:off + c],
                     self.cfg, one, off == 0)
         if self._prefix is not None:
@@ -429,14 +476,13 @@ class ServingEngine:
             # per-length compile tail prefill_chunk exists to bound
             one_d = init_cache(self.draft_cfg, 1, self.max_seq)
             if self.prefill_chunk is None:
-                _, one_d = prefill(self.draft_params,
-                                   req.prompt[None, :],
-                                   self.draft_cfg, one_d)
+                _, one_d = _decode._prefill_jit(self.draft_params,
+                                        req.prompt[None, :],
+                                        self.draft_cfg, one_d, True)
             else:
-                from .decode import _prefill_jit
                 c = self.prefill_chunk
                 for off in range(0, req.prompt.size, c):
-                    _, one_d = _prefill_jit(
+                    _, one_d = _decode._prefill_jit(
                         self.draft_params,
                         req.prompt[None, off:off + c],
                         self.draft_cfg, one_d, off == 0)
@@ -446,9 +492,9 @@ class ServingEngine:
             # the exact sample_generate key stream: split before the
             # first token, then once per decode step
             key, sub = jax.random.split(jax.random.PRNGKey(req.seed))
-            first = int(_sample_one(logits[0, -1], sub,
-                                    jnp.float32(req.temperature),
-                                    self.top_k, self.top_p))
+            first = _sample_one(logits[0, -1], sub,
+                                jnp.float32(req.temperature),
+                                self.top_k, self.top_p)
             self._keys = self._keys.at[slot].set(key)
             if self.draft_params is not None:
                 # independent draft-side stream for this request.
@@ -462,11 +508,15 @@ class ServingEngine:
                                        7919))
             self._temps[slot] = req.temperature
         else:
-            first = int(jnp.argmax(logits[0, -1]))
+            first = jnp.argmax(logits[0, -1])
             self._temps[slot] = 0.0
         self.cache = _adopt_slot(self.cache, one, jnp.int32(slot))
         self._req[slot] = req
         self._pos[slot] = req.prompt.size
+        return first
+
+    def _fill_finalize(self, slot: int, first: int) -> None:
+        """Record the resolved first token for a dispatched fill."""
         self._generated[slot] = [first]
         self._last[slot] = first
 
@@ -528,28 +578,31 @@ class ServingEngine:
 
     def step(self) -> list[Finished]:
         """Refill free slots from the queue, run ONE batched decode
-        step (or, with a draft model, one speculative window) for
-        every active slot, and return newly finished requests.
-        No-op (empty list) when idle."""
+        step (with a draft model: one speculative window; with
+        ``chain_steps`` > 1: one K-step chain) for every active slot,
+        and return newly finished requests.  No-op (empty list) when
+        idle."""
+        t_step = time.perf_counter()
+        fill0, dec0 = self._time_prefill, self._time_decode
+        try:
+            return self._step_inner()
+        finally:
+            self._time_host += ((time.perf_counter() - t_step)
+                                - (self._time_prefill - fill0)
+                                - (self._time_decode - dec0))
+
+    def _step_inner(self) -> list[Finished]:
         finished: list[Finished] = []
-        for slot in range(self.slots):
-            # loop: a refilled request whose prefill token already
-            # finishes it (max_new=1 hitting eos, etc.) must complete
-            # HERE — letting it ride the decode step would emit one
-            # token past its budget and break engine==greedy exactness
-            while True:
-                if self._req[slot] is None and self.queue:
-                    self._fill_slot(slot, self.queue.popleft())
-                if self._req[slot] is not None and self._done(slot):
-                    self._finish_slot(slot, finished)
-                    continue
-                break
+        self._refill(finished)
         active = [s for s in range(self.slots)
                   if self._req[s] is not None]
         if not active:
             return finished
         if self.draft_params is not None:
             return self._spec_step(active, finished)
+        if self.chain_steps > 1:
+            return self._chain_step(active, finished)
+        t_dec = time.perf_counter()
         tokens = jnp.asarray(self._last[:, None])
         logits, self.cache = decode_step_rows(
             self.params, tokens, self.cfg, self.cache,
@@ -564,6 +617,7 @@ class ServingEngine:
             nxt = np.asarray(nxt_dev, np.int32)
         else:
             nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        self._time_decode += time.perf_counter() - t_dec
         self._steps_total += 1
         for slot in active:
             self._pos[slot] += 1
@@ -571,6 +625,116 @@ class ServingEngine:
             self._last[slot] = nxt[slot]
             if self._done(slot):
                 self._finish_slot(slot, finished)
+        return finished
+
+    def _refill(self, finished: list[Finished]) -> None:
+        """Fill free slots from the queue in BATCHED rounds: every
+        free slot's prefill is dispatched first, then the first
+        tokens are resolved in ONE readback (each readback is a full
+        RTT on tunneled backends — per-fill syncs were 93% of r04's
+        drain wall).  A refilled request whose prefill token already
+        finishes it (max_new=1 hitting eos, etc.) must complete HERE
+        — riding the decode step would emit one token past its
+        budget and break engine==greedy exactness — so its freed
+        slot feeds the next round."""
+        for slot in range(self.slots):
+            if self._req[slot] is not None and self._done(slot):
+                self._finish_slot(slot, finished)
+        fused_ok = (self._prefix is None and self.prefill_chunk is None
+                    and self.draft_params is None)
+        while self.queue and any(r is None for r in self._req):
+            t_fill = time.perf_counter()
+            batch = []
+            for slot in range(self.slots):
+                if self._req[slot] is None and self.queue:
+                    batch.append((slot, self.queue.popleft()))
+            if fused_ok:
+                firsts = self._fill_fused_round(batch)
+            else:
+                firsts = np.asarray(jnp.stack(
+                    [self._fill_dispatch(s, r) for s, r in batch]))
+            self._time_prefill += time.perf_counter() - t_fill
+            for (slot, _), first in zip(batch, firsts):
+                self._fill_finalize(slot, int(first))
+                if self._done(slot):
+                    self._finish_slot(slot, finished)
+
+    def _fill_fused_round(self, batch: list) -> np.ndarray:
+        """One round of fresh fills through ``prefill_adopt_rows``:
+        requests grouped by prompt length (static shapes), ONE
+        program launch per group, ONE readback for the whole round.
+        Each group is PADDED to the full slot count by repeating its
+        first row (duplicate scatter index, identical values —
+        deterministic), so compilation keys only on the prompt
+        length, the same compile surface as per-request fills.  Only
+        the plain fresh-fill configuration routes here (prefix cache
+        / chunked prefill / draft engines keep the per-fill path,
+        whose extra work is per-request by nature); outputs are
+        identical — the fused program runs the same flash prefill,
+        scatter-adopt, and first-token key schedule, with base keys
+        built host-side (PRNGKey(seed) accepts any Python int the
+        unbatched path did)."""
+        groups: dict[int, list] = {}
+        for slot, req in batch:
+            groups.setdefault(req.prompt.size, []).append((slot, req))
+        outs = []
+        for grp in groups.values():
+            n, pad = len(grp), self.slots - len(grp)
+            slots_v = jnp.asarray(
+                [s for s, _ in grp] + [grp[0][0]] * pad, jnp.int32)
+            prompts = jnp.asarray(np.stack(
+                [r.prompt for _, r in grp]
+                + [grp[0][1].prompt] * pad))
+            keys0 = jnp.stack(
+                [jax.random.PRNGKey(r.seed) for _, r in grp]
+                + [jax.random.PRNGKey(grp[0][1].seed)] * pad)
+            temps = jnp.asarray(
+                [r.temperature for _, r in grp] + [0.0] * pad,
+                jnp.float32)
+            first, self.cache, carry = prefill_adopt_rows(
+                self.params, prompts, self.cfg, self.cache, slots_v,
+                keys0, temps, self.max_seq, self.top_k, self.top_p)
+            if any(r.temperature > 0 for _, r in grp):
+                self._keys = self._keys.at[slots_v[:n]].set(carry[:n])
+            for slot, req in grp:
+                self._req[slot] = req
+                self._pos[slot] = req.prompt.size
+                self._temps[slot] = req.temperature
+            outs.append(first[:n])
+        firsts = np.asarray(jnp.concatenate(outs))
+        # concatenation follows group order; map back to batch order
+        order = [s for grp in groups.values() for s, _ in grp]
+        by_slot = dict(zip(order, firsts))
+        return np.asarray([by_slot[s] for s, _ in batch])
+
+    def _chain_step(self, active: list[int],
+                    finished: list[Finished]) -> list[Finished]:
+        """``chain_steps`` decode steps in ONE dispatch
+        (decode_chain_rows): the host reads back a [slots, K] token
+        block, then replays the per-token bookkeeping — appending,
+        finish checks, _pos advance — exactly as K plain steps would,
+        except refills wait for the chain boundary and tokens past a
+        row's finish line are discarded (identical outputs: per-row
+        continuations are independent of other rows' refill timing).
+        The capacity overshoot (up to K-1 discarded cache writes past
+        the finish line) is reserved by submit()'s scratch margin."""
+        k = self.chain_steps
+        t_dec = time.perf_counter()
+        toks_dev, self.cache, self._keys = decode_chain_rows(
+            self.params, jnp.asarray(self._last), self.cfg,
+            self.cache, jnp.asarray(self._pos), k, self._keys,
+            jnp.asarray(self._temps), self.top_k, self.top_p)
+        toks = np.asarray(toks_dev, np.int32)
+        self._time_decode += time.perf_counter() - t_dec
+        self._steps_total += k
+        for slot in active:
+            for j in range(k):
+                self._pos[slot] += 1
+                self._generated[slot].append(int(toks[slot, j]))
+                self._last[slot] = toks[slot, j]
+                if self._done(slot):
+                    self._finish_slot(slot, finished)
+                    break
         return finished
 
     def _spec_step(self, active: list[int],
@@ -596,6 +760,7 @@ class ServingEngine:
         the next window at the same offsets — rollback is just not
         advancing ``_pos``."""
         k = self.draft_len
+        t_dec = time.perf_counter()
         last = jnp.asarray(self._last)
         pos = jnp.asarray(self._pos)
         sampled_mode = bool(self._temps.any())
@@ -624,6 +789,7 @@ class ServingEngine:
             # bookkeeping; acceptance is a host-side prefix match
             greedy = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
             props = np.asarray(proposals, np.int32)
+        self._time_decode += time.perf_counter() - t_dec
         self._steps_total += 1
         self._spec_windows += 1
         for slot in active:

@@ -43,6 +43,23 @@ def test_serving_probe_tiny():
     assert out["per_step_ms_upper_bound"] > 0
 
 
+def test_serving_probe_chain_tiny():
+    """The dispatch-amortized scenario bench.py streams as
+    serving_chain: the chained drain completes, reports ENGINE
+    throughput under the tokens_per_s key the compact line picks up,
+    and carries the per-phase host accounting that separates engine
+    overhead from dispatch RTT."""
+    from k8s_dra_driver_tpu.ops import serving_probe
+    out = serving_probe(chain_steps=3, **bench.TINY_SERVING_KWARGS)
+    assert out["valid"] is True
+    assert out["generated_tokens"] == 4 * 6
+    assert out["chain_steps"] == 3
+    assert out["tokens_per_s"] > 0
+    for phase in ("prefill_s", "decode_dispatch_s", "host_s"):
+        assert phase in out
+    assert out["decode_dispatch_s"] > 0
+
+
 def test_serving_probe_prefix_tiny():
     """The shared-prefix scenario bench.py streams as serving_prefix
     (same kwargs object, so this pins what actually streams): drain

@@ -482,7 +482,7 @@ class TestServingEngine:
         eng = spec_f()
         # draft_len+1 margin: a request that fits a plain engine is
         # rejected when speculation needs scratch rows past max_new
-        with pytest.raises(ValueError, match="speculative margin"):
+        with pytest.raises(ValueError, match="scratch margin"):
             eng.submit(Request(uid="c", prompt=prompt(89, 30),
                                max_new=CFG.max_seq - 30))
 
@@ -520,6 +520,120 @@ class TestServingEngine:
             # q == p at every position: min(1, p/q) = 1, u < 1 always
             assert stats["speculative_accepted_total"] >= \
                 stats["speculative_windows_total"] * 2
+
+    @pytest.mark.parametrize("chain", [2, 3, 5])
+    def test_chained_engine_matches_plain(self, chain):
+        """chain_steps=K is a dispatch optimization, never a math
+        change: mixed greedy+sampled requests with eos stops and
+        refills produce byte-identical outputs to the step-at-a-time
+        engine (overshoot past a finish line is discarded; per-row
+        continuations don't depend on refill timing)."""
+        p = params()
+        reqs = [("a", prompt(60, 5), 8, 0.0), ("b", prompt(61, 9), 4, 0.0),
+                ("c", prompt(62, 3), 9, 0.9), ("d", prompt(63, 7), 6, 0.0),
+                ("e", prompt(64, 6), 5, 1.2)]
+        ref = reference(p, reqs[0][1], 20)
+        eos = int(ref[len(reqs[0][1]) + 3])     # make "a" stop early
+
+        def run(chain_steps):
+            eng = ServingEngine(p, CFG, slots=2, top_k=8,
+                                chain_steps=chain_steps)
+            for uid, pr, n, temp in reqs:
+                eng.submit(Request(uid=uid, prompt=pr, max_new=n,
+                                   temperature=temp, seed=17,
+                                   eos_id=eos if uid == "a" else None))
+            return {f.uid: f.tokens for f in eng.run()}, eng
+
+        plain, _ = run(1)
+        chained, eng = run(chain)
+        assert set(chained) == set(plain)
+        for uid in plain:
+            np.testing.assert_array_equal(
+                chained[uid], plain[uid],
+                err_msg=f"chaining changed request {uid}")
+        assert eng.stats()["decode_steps_total"] % chain == 0
+
+    def test_chained_engine_composes_with_prefix_cache(self):
+        """Finish-time prefix capture stays exact under chaining: the
+        overshoot writes past _pos are never captured (extract takes
+        the first _pos rows), so a follow-up turn adopting the
+        conversation K/V generates exactly the unchained result."""
+        p = params()
+        turn1 = prompt(70, 6)
+
+        def run(chain_steps):
+            eng = ServingEngine(p, CFG, slots=2, prefix_cache=4,
+                                chain_steps=chain_steps)
+            eng.submit(Request(uid="t1", prompt=turn1, max_new=5))
+            done = {f.uid: f.tokens for f in eng.run()}
+            turn2 = np.concatenate(
+                [done["t1"], prompt(71, 3)]).astype(np.int32)
+            eng.submit(Request(uid="t2", prompt=turn2, max_new=4))
+            done.update({f.uid: f.tokens for f in eng.run()})
+            return done, eng.stats()
+
+        plain, _ = run(1)
+        chained, stats = run(3)
+        for uid in plain:
+            np.testing.assert_array_equal(chained[uid], plain[uid])
+        assert stats["prefix_hits_total"] >= 1
+
+    def test_chain_validation_and_margin(self):
+        p = params()
+        with pytest.raises(ValueError, match="chain_steps"):
+            ServingEngine(p, CFG, slots=1, chain_steps=0)
+        dcfg = dataclasses.replace(CFG, d_model=16, n_heads=2,
+                                   d_head=8, d_ff=32, n_layers=1)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            ServingEngine(p, CFG, slots=1, chain_steps=2,
+                          draft_params=init_params(
+                              dcfg, jax.random.PRNGKey(3)),
+                          draft_cfg=dcfg)
+        eng = ServingEngine(p, CFG, slots=1, chain_steps=4)
+        # chain overshoot (K-1 rows) is reserved like the draft margin
+        with pytest.raises(ValueError, match="scratch margin"):
+            eng.submit(Request(uid="c", prompt=prompt(72, 30),
+                               max_new=CFG.max_seq - 30 - 2))
+
+    def test_phase_accounting_in_stats(self):
+        """Per-phase wall clocks (prefill / decode dispatch / host)
+        land in stats() and roughly add up to the drain wall — the
+        accounting that separates engine overhead from backend RTT in
+        recorded serving artifacts."""
+        import time as _time
+        p = params()
+        eng = ServingEngine(p, CFG, slots=2)
+        for i in range(3):
+            eng.submit(Request(uid=i, prompt=prompt(73 + i, 5 + i),
+                               max_new=4))
+        t0 = _time.perf_counter()
+        eng.run()
+        wall = _time.perf_counter() - t0
+        s = eng.stats()
+        assert s["time_prefill_s"] > 0
+        assert s["time_decode_dispatch_s"] > 0
+        assert s["time_host_s"] >= 0
+        total = (s["time_prefill_s"] + s["time_decode_dispatch_s"]
+                 + s["time_host_s"])
+        assert total <= wall * 1.05
+        assert total >= wall * 0.5      # phases cover the bulk
+
+    def test_large_seed_survives_fused_fill(self):
+        """Request.seed accepts any Python int (sample_generate
+        parity): seeds past int32 must neither crash the fused fill
+        path nor change the key schedule vs standalone sampling."""
+        from k8s_dra_driver_tpu.models import sample_generate
+        p = params()
+        pr = prompt(95, 6)
+        big = 2 ** 31 + 7
+        want = np.asarray(sample_generate(
+            p, jnp.asarray(pr)[None, :], CFG, 4,
+            jax.random.PRNGKey(big), temperature=0.8)[0], np.int32)
+        eng = ServingEngine(p, CFG, slots=2)
+        eng.submit(Request(uid="s", prompt=pr, max_new=4,
+                           temperature=0.8, seed=big))
+        done = eng.run()
+        np.testing.assert_array_equal(done[0].tokens, want)
 
     def test_zero_max_new_rejected(self):
         eng = ServingEngine(params(), CFG, slots=1)
