@@ -454,6 +454,29 @@ def draft_propose_rows(params: Params, last: jax.Array,
     return toks[:k].T, cache
 
 
+def select_next_tokens(logits, keys, temps, top_k: int = 0,
+                       top_p: float = 0.0):
+    """Per-row greedy/sampled next-token merge + key advance —
+    ``[B, V]`` logits, ``[B, 2]`` keys, ``[B]`` temps -> (next [B],
+    new keys).  Greedy rows (temp==0) take raw argmax and leave
+    their key untouched; sampled rows split, draw with
+    ``sample_token(split[1])``, and carry ``split[0]`` — the exact
+    ``sample_generate`` schedule.  THE single definition behind the
+    engine's per-step program, the chained scan body, and the fused
+    fill tail (models/serving.py), so the "byte-identical across
+    dispatch strategies" guarantee holds by construction, not just
+    by test."""
+    greedy = jnp.argmax(logits, axis=-1)
+    split = jax.vmap(jax.random.split)(keys)
+    sampled = jax.vmap(
+        lambda l, k, t: sample_token(l, k, t, top_k, top_p))(
+        logits, split[:, 1], temps)
+    live = temps > 0
+    nxt = jnp.where(live, sampled, greedy).astype(jnp.int32)
+    new_keys = jnp.where(live[:, None], split[:, 0], keys)
+    return nxt, new_keys
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "max_seq", "top_k",
                                              "top_p"),
                    donate_argnums=(3,))
@@ -497,14 +520,9 @@ def prefill_adopt_rows(params: Params, prompts: jax.Array,
                  if cache.k_scale is not None else None),
         v_scale=(put(cache.v_scale, one.v_scale)
                  if cache.v_scale is not None else None))
-    last = logits[:, -1]
-    split = jax.vmap(jax.random.split)(keys0)
-    greedy = jnp.argmax(last, axis=-1)
-    sampled = jax.vmap(
-        lambda l, k, t: sample_token(l, k, t, top_k, top_p))(
-        last, split[:, 1], temps)
-    first = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-    return first, cache, split[:, 0]
+    first, carry = select_next_tokens(logits[:, -1], keys0, temps,
+                                      top_k, top_p)
+    return first, cache, carry
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "k", "top_k",
@@ -537,15 +555,8 @@ def decode_chain_rows(params: Params, last: jax.Array,
         tok, cache, pos, keys = carry
         logits, cache = _rows_forward(params, tok[:, None], cfg,
                                       cache, pos)
-        lg = logits[:, 0]
-        greedy = jnp.argmax(lg, axis=-1)
-        split = jax.vmap(jax.random.split)(keys)
-        sampled = jax.vmap(
-            lambda l, kk, t: sample_token(l, kk, t, top_k, top_p))(
-            lg, split[:, 1], temps)
-        live = temps > 0
-        nxt = jnp.where(live, sampled, greedy).astype(jnp.int32)
-        new_keys = jnp.where(live[:, None], split[:, 0], keys)
+        nxt, new_keys = select_next_tokens(logits[:, 0], keys, temps,
+                                           top_k, top_p)
         return (nxt, cache, pos + 1, new_keys), nxt
     (_, cache, _, keys), toks = jax.lax.scan(
         step, (last, cache, jnp.asarray(pos_rows), keys), None,

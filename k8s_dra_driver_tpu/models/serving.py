@@ -113,19 +113,11 @@ def _sample_one(logits, key, temperature, top_k: int, top_p: float):
 @functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
 def _next_tokens(logits, keys, temps, top_k: int, top_p: float):
     """[B,V] logits + [B,2] per-slot keys + [B] temps -> (next [B],
-    new keys): greedy rows (temp==0) take argmax, sampled rows draw
-    from their own key stream — ONE program, one readback, keys stay
-    device-resident (per-step host churn is the cost that dominates
-    tunneled backends)."""
-    greedy = jnp.argmax(logits, axis=-1)
-    split = jax.vmap(jax.random.split)(keys)
-    sampled = jax.vmap(
-        lambda l, k, t: sample_token(l, k, t, top_k, top_p))(
-        logits, split[:, 1], temps)
-    live = temps > 0
-    nxt = jnp.where(live, sampled, greedy).astype(jnp.int32)
-    new_keys = jnp.where(live[:, None], split[:, 0], keys)
-    return nxt, new_keys
+    new keys): the shared ``select_next_tokens`` merge as ONE
+    program, one readback, keys device-resident (per-step host churn
+    is the cost that dominates tunneled backends)."""
+    return _decode.select_next_tokens(logits, keys, temps, top_k,
+                                      top_p)
 
 
 class PrefixCache:

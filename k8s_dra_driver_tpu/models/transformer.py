@@ -77,16 +77,19 @@ class TransformerConfig:
     # drop that expert's contribution (their other top-k picks still
     # apply), identical math to "dense" whenever capacity suffices,
     # and SPMD-shardable (the dispatch einsums partition along ep);
-    # "gmm" is the dropless single-device pallas grouped-matmul path
-    # (ops/gmm.py): tokens sorted by expert, no dispatch tensors, no
-    # drops.  Recorded v5e train-step medians, index-only dispatch
-    # rewrite included (tools/moe_dispatch_v5e.json): capacity 3.55x
+    # "gmm" is the dropless pallas grouped-matmul path (ops/gmm.py):
+    # tokens sorted by expert, no dispatch tensors, no drops — on a
+    # sharded mesh it runs per-expert-shard under shard_map with
+    # ep-resident weights (_moe_mlp_gmm_sharded; not under pp).
+    # Recorded v5e train-step medians, index-only dispatch rewrite
+    # included (tools/moe_dispatch_v5e.json): capacity 3.55x
     # dense and gmm 2.58x at E16/dff4096; 1.37x vs 1.17x at E8 mixed.
     # Guidance: default to "capacity" for throughput — it beats gmm
     # at every recorded shape; reach for "gmm" only when token drops
     # are unacceptable (exact routing), and expect ~18-38% slower
     # steps than capacity for that guarantee (17.8% at E8 mixed,
-    # 37.5% at E16 heavy, per the artifact).
+    # 37.5% at E16 heavy, per the artifact), plus the sharded
+    # static-bound caveat in _moe_mlp_gmm_sharded's docstring.
     moe_dispatch: str = "dense"
     capacity_factor: float = 1.25
     # Router auxiliary losses (training-quality guards; 0 disables):
@@ -436,16 +439,20 @@ def _moe_mlp_capacity(x, gates, layer, cfg: TransformerConfig):
 _GMM_BLOCK_M = 128
 
 
-def _moe_mlp_gmm(x, gates, layer, cfg: TransformerConfig):
-    """Dropless sparse MoE via the pallas grouped matmul (ops/gmm.py).
+def _gmm_dispatch_combine(xf, gate_vals, expert_ids, w_in, w_out, e,
+                          bm):
+    """The sort → grouped-matmul → unsort-combine core shared by the
+    single-device and ep-sharded gmm paths: ``xf`` [n, d] tokens,
+    per-token ``gate_vals``/``expert_ids`` [n, k] over ``e`` experts
+    (``w_in`` [e, d, f], ``w_out`` [e, f, d]) -> [n, d].
 
-    Tokens are sorted by routed expert, each expert's rows padded to a
-    ``_GMM_BLOCK_M`` multiple (static row bound: top_k*N + E*block),
-    and the two expert matmuls run as grouped matmuls whose FLOPs
-    scale with top_k — no ``[B,T,E,C]`` one-hot dispatch tensors, no
-    dropped tokens.  Routing (top-k, argsort, scatter/gather, gate
-    combine) is plain XLA and differentiates normally; the grouped
-    matmuls carry a custom VJP.
+    Tokens are sorted by routed expert, each expert's rows padded to
+    a ``bm`` multiple (static row bound: k*n + e*bm), and the two
+    expert matmuls run as grouped matmuls whose FLOPs scale with
+    top_k — no ``[B,T,E,C]`` one-hot dispatch tensors, no dropped
+    tokens.  Routing (argsort, scatter/gather, gate combine) is
+    plain XLA and differentiates normally; the grouped matmuls carry
+    a custom VJP.
 
     Dispatch traffic note (round-3 weak #6: gmm barely beat dense at
     E8): in the FORWARD pass the sort/unsort permutations move only
@@ -461,11 +468,8 @@ def _moe_mlp_gmm(x, gates, layer, cfg: TransformerConfig):
     """
     from ..ops.gmm import gmm
 
-    b, t, d = x.shape
-    e, k = cfg.n_experts, cfg.top_k
-    n = b * t
-    bm = _GMM_BLOCK_M
-    gate_vals, expert_ids = jax.lax.top_k(gates.reshape(n, e), k)
+    n, d = xf.shape
+    k = expert_ids.shape[1]
     flat_e = expert_ids.reshape(-1)                       # [n*k]
     flat_tok = jnp.repeat(jnp.arange(n), k)
 
@@ -480,23 +484,99 @@ def _moe_mlp_gmm(x, gates, layer, cfg: TransformerConfig):
     src_tok = flat_tok[order]
 
     m_pad = -(-(n * k) // bm) * bm + e * bm               # static bound
-    xf = x.reshape(n, d)
     # int32 scatters build the row maps; the activations themselves
     # only ever flow through gathers.  Padding rows point at token 0
     # and are zero-masked (their compute lands in no token's output
     # anyway — nothing reads them back).
     tok_of_row = jnp.zeros((m_pad,), jnp.int32).at[dest].set(src_tok)
-    row_live = jnp.zeros((m_pad, 1), x.dtype).at[dest].set(1)
+    row_live = jnp.zeros((m_pad, 1), xf.dtype).at[dest].set(1)
     x_sorted = xf[tok_of_row] * row_live
-    h = jax.nn.gelu(gmm(x_sorted, layer["w_in"], padded, bm))
-    y = gmm(h, layer["w_out"], padded, bm)                # [m_pad, d]
+    h = jax.nn.gelu(gmm(x_sorted, w_in, padded, bm))
+    y = gmm(h, w_out, padded, bm)                         # [m_pad, d]
     # unsort-combine: token-major view of each token's k expert rows,
     # weighted by its gates — a gather + small reduction, not a
     # [n*k, d] scatter-add
     row_of_slot = jnp.zeros((n * k,), jnp.int32).at[order].set(dest)
     y_tok = y[row_of_slot].reshape(n, k, d)
     out = jnp.einsum("nk,nkd->nd", gate_vals.astype(y.dtype), y_tok)
-    return out.reshape(b, t, d).astype(x.dtype)
+    return out.astype(xf.dtype)
+
+
+def _moe_mlp_gmm(x, gates, layer, cfg: TransformerConfig):
+    """Dropless sparse MoE via the pallas grouped matmul (ops/gmm.py),
+    single-device: top-k routing then ``_gmm_dispatch_combine`` (see
+    its docstring for the dispatch design and recorded trade-offs)."""
+    b, t, d = x.shape
+    e, k = cfg.n_experts, cfg.top_k
+    gate_vals, expert_ids = jax.lax.top_k(gates.reshape(b * t, e), k)
+    out = _gmm_dispatch_combine(x.reshape(b * t, d), gate_vals,
+                                expert_ids, layer["w_in"],
+                                layer["w_out"], e, _GMM_BLOCK_M)
+    return out.reshape(b, t, d)
+
+
+def _moe_mlp_gmm_sharded(x, gates, layer, cfg: TransformerConfig,
+                         mesh: Mesh):
+    """Dropless gmm over the ep/tp-sharded mesh (``jax.shard_map``).
+
+    Layout: expert weights stay ep-sharded (P("ep", None, "tp") /
+    P("ep", "tp", None) — per-shard parameter AND optimizer
+    residency, the point of ep), tokens ride the batch axes
+    (("dp","ep"), "sp").  Per shard: all_gather the ep-portion of
+    the batch, route EVERY gathered token against the shard's local
+    experts only (non-local assignments divert to a zero-weight
+    "dead" expert group with their gates zeroed, so exactly one
+    shard owns each (token, expert) slot), run the same
+    ``_gmm_dispatch_combine`` core, then psum the f-partial over tp
+    and reduce-scatter the owner-sum back over ep.  Outputs equal
+    the single-device gmm exactly (pinned on the 8-device CPU mesh,
+    tests/test_gmm.py).
+
+    Static-bound caveat, stated honestly: XLA's static shapes can't
+    prove router balance, so each shard's grouped matmul keeps the
+    full gathered-token row bound (k*n_gathered + (e_local+1)*bm) —
+    ep here buys dropless exactness at ep-scale WEIGHT memory, not
+    per-shard FLOP scaling; tp shards the FLOPs.  Capacity dispatch
+    remains the balanced-compute strategy at scale
+    (tools/moe_dispatch_v5e.json guidance).
+    """
+    e, k = cfg.n_experts, cfg.top_k
+    ep = mesh.shape["ep"]
+    if e % ep:
+        raise ValueError(
+            f"moe_dispatch='gmm' needs n_experts ({e}) divisible by "
+            f"the ep axis ({ep})")
+    e_local = e // ep
+    bm = _GMM_BLOCK_M
+
+    def block(x_b, gates_b, w_in_b, w_out_b):
+        xg = jax.lax.all_gather(x_b, "ep", axis=0, tiled=True)
+        gg = jax.lax.all_gather(gates_b, "ep", axis=0, tiled=True)
+        bg, tl, d = xg.shape
+        n = bg * tl
+        gate_vals, expert_ids = jax.lax.top_k(gg.reshape(n, e), k)
+        ep_idx = jax.lax.axis_index("ep")
+        local = expert_ids - ep_idx * e_local
+        mine = (local >= 0) & (local < e_local)
+        local_ids = jnp.where(mine, local, e_local)       # dead group
+        gate_loc = jnp.where(mine, gate_vals, 0.0)
+        zero = jnp.zeros((1,) + w_in_b.shape[1:], w_in_b.dtype)
+        zero_o = jnp.zeros((1,) + w_out_b.shape[1:], w_out_b.dtype)
+        out = _gmm_dispatch_combine(
+            xg.reshape(n, d), gate_loc, local_ids,
+            jnp.concatenate([w_in_b, zero]),
+            jnp.concatenate([w_out_b, zero_o]), e_local + 1, bm)
+        out = jax.lax.psum(out.reshape(bg, tl, d), "tp")
+        return jax.lax.psum_scatter(out, "ep", scatter_dimension=0,
+                                    tiled=True)
+
+    batch_spec = P(BATCH_AXES, "sp", None)
+    fn = jax.shard_map(
+        block, mesh=mesh,
+        in_specs=(batch_spec, batch_spec, P("ep", None, "tp"),
+                  P("ep", "tp", None)),
+        out_specs=batch_spec, check_vma=False)
+    return fn(x, gates, layer["w_in"], layer["w_out"])
 
 
 def _moe_mlp(x, layer, cfg: TransformerConfig, mesh: Mesh | None = None,
@@ -511,18 +591,22 @@ def _moe_mlp(x, layer, cfg: TransformerConfig, mesh: Mesh | None = None,
     if cfg.moe_dispatch == "capacity":
         out = _moe_mlp_capacity(x, gates, layer, cfg)
     elif cfg.moe_dispatch == "gmm":
-        if mesh is not None:
-            raise NotImplementedError(
-                "moe_dispatch='gmm' is a single-device kernel path; "
-                "sharded meshes use 'capacity' (SPMD one-hot dispatch) "
-                "or 'dense'")
         from .quant import QTensor
         if isinstance(layer["w_in"], QTensor):
             raise NotImplementedError(
                 "moe_dispatch='gmm' expects full-precision expert "
                 "weights; quantized serving runs the dense dispatch "
                 "(models/decode.py:_serving_cfg)")
-        out = _moe_mlp_gmm(x, gates, layer, cfg)
+        if mesh is not None and cfg.pp_stages > 1:
+            # the pipelined stack already runs inside a pp shard_map
+            # and the sharded gmm opens its own — no nesting
+            raise NotImplementedError(
+                "moe_dispatch='gmm' does not compose with pp stages; "
+                "pipelined MoE configs use 'capacity'")
+        if mesh is not None:
+            out = _moe_mlp_gmm_sharded(x, gates, layer, cfg, mesh)
+        else:
+            out = _moe_mlp_gmm(x, gates, layer, cfg)
     else:
         g = gates.astype(x.dtype)
         h = jax.nn.gelu(ein("btd,edf->btef", x, layer["w_in"]))

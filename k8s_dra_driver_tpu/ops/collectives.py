@@ -569,7 +569,7 @@ def serving_probe(slots: int = 8, n_requests: int = 24,
     if chain_steps > 1:
         # dispatch amortized over K steps: wall-clock now measures
         # the engine, so report it as engine throughput (the compact
-        # bench line picks this field up as serving_tok_s)
+        # bench line picks this field up as serving_chain_tok_s)
         out["chain_steps"] = chain_steps
         out["tokens_per_s"] = round(generated / wall, 1)
         out["note"] = (
