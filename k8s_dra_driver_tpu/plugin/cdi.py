@@ -155,7 +155,14 @@ class CDIHandler:
 
     def _write(self, filename: str, spec: dict) -> Path:
         """Atomic write (tmp + rename) so the container runtime never
-        reads a torn spec."""
+        reads a torn spec; every spec is validated against the
+        vendored CDI v0.x schema first (cdi_schema.py) — the
+        runtime-boundary proof available without a container runtime,
+        and the same fail-at-generation discipline the reference gets
+        from building specs through the validated CDI library types
+        (cdi.go:50-298)."""
+        from .cdi_schema import validate_spec
+        validate_spec(spec)
         path = self.cdi_root / filename
         tmp = path.with_suffix(".tmp")
         tmp.write_text(json.dumps(spec, indent=2, sort_keys=True) + "\n")
