@@ -29,7 +29,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -144,22 +143,25 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
     With scales (int8 cache), entries are dequantized at read:
     ``k = k_q * k_scale`` per (batch, position, head).  Whether HBM
     sees int8 or a materialized dequantized copy is XLA's fusion
-    choice (recorded both ways — tools/int8_decode_v5e.json: 2.0x at
-    154M with int8 weights, a regression at 660M); the structural
-    guarantee of the int8 cache is *storage* — twice the
-    batch x context per chip.
+    choice; the r05 idle-machine capture has the int8 cache WINNING
+    with int8 weights at both scales (tools/int8_decode_v5e.json:
+    1.23x bf16 at 154M, 1.15x at 660M — earlier captures disagreed
+    within tunnel jitter), and the structural guarantee is *storage*
+    either way — twice the batch x context per chip.
 
-    ``TPU_KV_KERNEL=1`` (opt-in, read at TRACE time like
-    TPU_QUANT_KERNEL — flipping it later does not retrace cached
-    executables) routes the read through the pallas flash kernel
-    with in-VMEM dequantization (ops/flash_attention.py k_scale/
-    v_scale): HBM then streams int8 bytes by construction, the
-    structural fix for the 660M fusion regression.  Stays opt-in
-    until a recorded artifact shows where it wins — the
-    weight-quant lesson (models/quant.py _use_kernel) was that XLA
-    sometimes beats the hand kernel.
+    ``TPU_KV_KERNEL=1`` (opt-in; ``0``/unset disables, the same
+    parsing as TPU_QUANT_KERNEL so symmetric ``=0`` settings force
+    the pure-XLA path; read at TRACE time — flipping it later does
+    not retrace cached executables) routes the read through the
+    pallas flash kernel with in-VMEM dequantization
+    (ops/flash_attention.py k_scale/v_scale): HBM then streams int8
+    bytes by construction, insurance against an XLA dequant-fusion
+    regression.  Stays opt-in: every capture so far has XLA's fused
+    read beating it (the weight-quant lesson, models/quant.py
+    _use_kernel).
     """
-    if (k_scale is not None and os.environ.get("TPU_KV_KERNEL")
+    from ..utils.flags import env_flag
+    if (k_scale is not None and env_flag("TPU_KV_KERNEL")
             and jnp.ndim(pos) == 0):
         # the kernel takes one scalar q_offset; per-row positions
         # (continuous batching) use the XLA path

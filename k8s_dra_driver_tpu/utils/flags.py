@@ -28,6 +28,19 @@ def env_default(name: str, fallback=None, cast=None):
     return cast(raw) if cast else raw
 
 
+def env_flag(name: str, default: bool = False) -> bool:
+    """One boolean-env convention for the whole tree: unset ->
+    ``default``; ``""``, ``"0"``, ``"false"`` (any case) -> False;
+    anything else -> True.  Shared by the FAKE_CLUSTER argparse
+    default and the kernel opt-ins (TPU_QUANT_KERNEL /
+    TPU_KV_KERNEL, models/quant.py + models/decode.py) so ``=0`` and
+    ``=false`` mean "off" everywhere and the parsers cannot drift."""
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.lower() not in ("", "0", "false")
+
+
 # --------------------------------------------------------------------------
 # Kube client flags (KubeClientConfig analog)
 # --------------------------------------------------------------------------

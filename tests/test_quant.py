@@ -201,3 +201,23 @@ def test_quantized_forward_is_differentiable_in_x():
     assert g.shape == (2, 8, cfg.d_model)
     assert bool(jnp.all(jnp.isfinite(g)))
     assert float(jnp.max(jnp.abs(g))) > 0
+
+
+def test_kernel_gate_is_opt_in(monkeypatch):
+    """_use_kernel: the pallas path requires TPU_QUANT_KERNEL truthy
+    AND a decode-shaped m — the XLA einsum is the stable,
+    artifact-backed default (the kernel's capture-to-capture variance
+    is why; see quant.py).  '0' and '' disable like unset, matching
+    TPU_KV_KERNEL's parsing so symmetric =0 settings force the pure
+    XLA path for measurements."""
+    from k8s_dra_driver_tpu.models.quant import _use_kernel
+
+    monkeypatch.delenv("TPU_QUANT_KERNEL", raising=False)
+    assert _use_kernel(8) is False             # default: XLA
+    monkeypatch.setenv("TPU_QUANT_KERNEL", "1")
+    assert _use_kernel(8) is True              # opt-in
+    assert _use_kernel(512) is False           # m cap still binds
+    monkeypatch.setenv("TPU_QUANT_KERNEL", "0")
+    assert _use_kernel(8) is False             # explicit off
+    monkeypatch.setenv("TPU_QUANT_KERNEL", "")
+    assert _use_kernel(8) is False             # empty = off
