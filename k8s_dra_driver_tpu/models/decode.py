@@ -33,6 +33,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..utils.flags import env_flag
 from .quant import ein, take_rows
 from .transformer import (Params, TransformerConfig, _dense_mlp, _moe_mlp,
                           rms_norm, rotary)
@@ -143,11 +144,13 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
     With scales (int8 cache), entries are dequantized at read:
     ``k = k_q * k_scale`` per (batch, position, head).  Whether HBM
     sees int8 or a materialized dequantized copy is XLA's fusion
-    choice; the r05 idle-machine capture has the int8 cache WINNING
-    with int8 weights at both scales (tools/int8_decode_v5e.json:
-    1.23x bf16 at 154M, 1.15x at 660M — earlier captures disagreed
-    within tunnel jitter), and the structural guarantee is *storage*
-    either way — twice the batch x context per chip.
+    choice; in the r05 idle-machine capture int8-weights +
+    int8-cache beats the BF16 baseline at both scales
+    (tools/int8_decode_v5e.json: 1.23x at 154M, 1.15x at 660M) but
+    at 660M it is ~1.4x SLOWER than the config a throughput user
+    would otherwise run (int8 weights with a bf16 cache, 1.61x) —
+    the int8 cache is a CAPACITY lever (the structural guarantee is
+    storage: twice the batch x context per chip), not a speed one.
 
     ``TPU_KV_KERNEL=1`` (opt-in; ``0``/unset disables, the same
     parsing as TPU_QUANT_KERNEL so symmetric ``=0`` settings force
@@ -160,7 +163,6 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
     read beating it (the weight-quant lesson, models/quant.py
     _use_kernel).
     """
-    from ..utils.flags import env_flag
     if (k_scale is not None and env_flag("TPU_KV_KERNEL")
             and jnp.ndim(pos) == 0):
         # the kernel takes one scalar q_offset; per-row positions

@@ -48,6 +48,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.flags import env_flag
+
 
 @dataclasses.dataclass
 class QTensor:
@@ -294,7 +296,6 @@ def _use_kernel(m: int) -> bool:
     afterwards (XLA caches the traced program).  Measurements that
     flip the flag must use a fresh process per setting, as
     tools/bench_int8.py does."""
-    from ..utils.flags import env_flag
     return m <= _KERNEL_MAX_M and env_flag("TPU_QUANT_KERNEL")
 
 

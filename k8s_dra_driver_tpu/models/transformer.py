@@ -85,11 +85,16 @@ class TransformerConfig:
     # included (tools/moe_dispatch_v5e.json): capacity 3.55x
     # dense and gmm 2.58x at E16/dff4096; 1.37x vs 1.17x at E8 mixed.
     # Guidance: default to "capacity" for throughput — it beats gmm
-    # at every recorded shape; reach for "gmm" only when token drops
-    # are unacceptable (exact routing), and expect ~18-38% slower
-    # steps than capacity for that guarantee (17.8% at E8 mixed,
-    # 37.5% at E16 heavy, per the artifact), plus the sharded
-    # static-bound caveat in _moe_mlp_gmm_sharded's docstring.
+    # at every recorded shape; reach for "gmm" when token drops are
+    # unacceptable, and expect ~18-38% slower steps than capacity
+    # for that guarantee (17.8% at E8 mixed, 37.5% at E16 heavy, per
+    # the artifact), plus the sharded static-bound caveat in
+    # _moe_mlp_gmm_sharded's docstring.  What exactness buys is now
+    # recorded too (tools/moe_quality_v5e.json, same-seed training
+    # on a learnable task): capacity's drops cost +0.023 final loss
+    # at the default factor 1.25, +0.014 at 1.0, and +0.101 at a
+    # tight 0.5 vs dropless gmm — small at generous factors, decisive
+    # when capacity is squeezed for speed/memory.
     moe_dispatch: str = "dense"
     capacity_factor: float = 1.25
     # Router auxiliary losses (training-quality guards; 0 disables):

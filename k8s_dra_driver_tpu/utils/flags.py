@@ -71,9 +71,7 @@ class KubeClientConfig:
                        help="client-side burst toward the API server "
                             "[env KUBE_API_BURST] (default 10)")
         g.add_argument("--fake-cluster", action="store_true",
-                       default=env_default("FAKE_CLUSTER", False,
-                                           lambda v: v not in ("", "0",
-                                                               "false")),
+                       default=env_flag("FAKE_CLUSTER"),
                        help="use the in-memory fake cluster backend "
                             "(hermetic demos/tests) [env FAKE_CLUSTER]")
 

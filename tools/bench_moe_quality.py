@@ -1,0 +1,146 @@
+"""Does capacity dispatch's token-dropping cost training quality?
+
+The counterweight that justifies the dropless gmm path existing
+(VERDICT r04 weak #5): the throughput artifact
+(tools/moe_dispatch_v5e.json) shows capacity beating gmm on step time
+at every recorded shape, so "exact" must buy something measurable or
+gmm is dead weight.  This experiment trains the SAME MoE (same init,
+same data stream, same optimizer/seed) under:
+
+- ``gmm``            — dropless grouped matmul (the exact path);
+- ``capacity @ f``   — GShard one-hot dispatch at several capacity
+  factors (tokens beyond an expert's budget C = f * top_k * T / E
+  lose that expert's contribution);
+
+on a learnable synthetic task (bigram-structured sequences: a fixed
+random transition matrix generates the tokens, so next-token loss has
+real signal), and records the loss curves.  Expectation: at generous
+factors the drop rate is low and capacity tracks gmm; at tight
+factors dropped tokens show up as a persistent loss gap — which is
+the quantified price of capacity, and the recorded reason to reach
+for gmm when exactness matters.
+
+Writes tools/moe_quality_v5e.json; run on an idle machine (see
+int8_decode_v5e_loaded_host.json for why).
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import platform
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import dataclasses
+
+import numpy as np
+
+
+def bigram_batches(vocab: int, batch: int, seq: int, steps: int,
+                   seed: int):
+    """A fixed sparse-ish bigram chain: every token's successor is
+    drawn from that token's own 4-way distribution — enough structure
+    that a trained model beats the unigram floor by a wide margin."""
+    rng = np.random.default_rng(seed)
+    succ = rng.integers(0, vocab, (vocab, 4))
+    probs = rng.dirichlet(np.ones(4) * 0.5, size=vocab)
+    out = np.empty((steps, batch, seq), np.int32)
+    state = rng.integers(0, vocab, batch)
+    for s in range(steps):
+        for t in range(seq):
+            out[s, :, t] = state
+            choice = np.array([rng.choice(4, p=probs[tok])
+                               for tok in state])
+            state = succ[state, choice]
+    return out
+
+
+def run_variant(dispatch: str, factor: float, data: np.ndarray,
+                steps: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                           init_params, make_optimizer)
+    from k8s_dra_driver_tpu.models.transformer import loss_fn
+
+    cfg = TransformerConfig(
+        vocab=256, d_model=128, n_layers=2, n_heads=4, d_head=32,
+        d_ff=256, n_experts=8, top_k=2, max_seq=data.shape[2],
+        dtype=jnp.float32, moe_dispatch=dispatch,
+        capacity_factor=factor, aux_loss_weight=0.01)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    opt = make_optimizer(3e-3)
+    state = opt.init(params)
+
+    @jax.jit
+    def step(params, state, tokens):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, tokens, cfg))(params)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    losses = []
+    for s in range(steps):
+        params, state, loss = step(params, state,
+                                   jnp.asarray(data[s]))
+        losses.append(float(loss))
+    tail = float(np.mean(losses[-20:]))
+    return {
+        "dispatch": dispatch,
+        "capacity_factor": factor if dispatch == "capacity" else None,
+        "final_loss_mean_last20": round(tail, 4),
+        "loss_curve_every10": [round(v, 4) for v in losses[::10]],
+    }
+
+
+def main() -> None:
+    from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
+    enable_persistent_cache()
+    import jax
+
+    steps, batch, seq = 300, 16, 128
+    data = bigram_batches(256, batch, seq, steps, seed=7)
+    # factor is irrelevant for gmm (dropless) but must validate > 0
+    variants = [("gmm", 1.25), ("capacity", 1.25), ("capacity", 1.0),
+                ("capacity", 0.5)]
+    out = {
+        "what": ("same-seed MoE training, dropless gmm vs capacity "
+                 "dispatch at several capacity factors, on a "
+                 "learnable bigram task — the quality counterweight "
+                 "to capacity's recorded step-time win "
+                 "(tools/moe_dispatch_v5e.json)"),
+        "host": platform.node(),
+        "device": str(jax.devices()[0]),
+        "commit": subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True).stdout.strip(),
+        "recorded_unix": int(time.time()),
+        "config": {"steps": steps, "batch": batch, "seq": seq,
+                   "vocab": 256, "d_model": 128, "n_layers": 2,
+                   "n_experts": 8, "top_k": 2,
+                   "aux_loss_weight": 0.01, "lr": 3e-3, "seed": 0},
+        "runs": [],
+    }
+    for dispatch, factor in variants:
+        res = run_variant(dispatch, factor, data, steps)
+        out["runs"].append(res)
+        print(json.dumps({k: res[k] for k in
+                          ("dispatch", "capacity_factor",
+                           "final_loss_mean_last20")}))
+    gmm_tail = out["runs"][0]["final_loss_mean_last20"]
+    for r in out["runs"][1:]:
+        r["loss_gap_vs_gmm"] = round(
+            r["final_loss_mean_last20"] - gmm_tail, 4)
+    path = pathlib.Path(__file__).parent / "moe_quality_v5e.json"
+    path.write_text(json.dumps(out, indent=1) + "\n")
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()
