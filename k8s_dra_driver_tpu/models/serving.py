@@ -100,6 +100,9 @@ class Request:
 class Finished:
     uid: Any
     tokens: np.ndarray              # prompt + generated
+    # prompt length, so consumers (stream()) can split generated
+    # tokens out of ``tokens`` without re-holding the Request
+    n_prompt: int = 0
 
 
 @functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
@@ -543,7 +546,8 @@ class ServingEngine:
         out.append(Finished(
             uid=req.uid,
             tokens=np.concatenate([req.prompt,
-                                   np.asarray(gen, np.int32)])))
+                                   np.asarray(gen, np.int32)]),
+            n_prompt=req.prompt.size))
         self._finished_total += 1
         self._tokens_total += len(gen)
         self._req[slot] = None
@@ -825,6 +829,44 @@ class ServingEngine:
             out.extend(self.step())
             if not self.queue and self.active == 0:
                 return out
+        raise RuntimeError(f"not drained after {max_steps} steps")
+
+    def stream(self, max_steps: int = 10_000):
+        """Drain like :meth:`run` but yield events incrementally:
+        ``("token", uid, token_id)`` for every newly generated token
+        and ``("finished", uid, tokens)`` when a request completes —
+        the delivery API serving frontends need (run() holds
+        everything until the drain ends).
+
+        Token events for one request arrive in generation order;
+        across requests the interleaving follows slot order within
+        each step.  A chained or speculative step delivers its whole
+        accepted block at the step boundary (that is the dispatch
+        granularity).  Cancelled requests simply stop producing
+        events — no "finished" is emitted, matching run()."""
+        yielded: dict[Any, int] = {}
+        for _ in range(max_steps):
+            # prune counters whose request left without finishing
+            # (cancel): a RESUBMITTED uid must restart at token 0,
+            # not silently skip its first tokens behind a stale count
+            live = {r.uid for r in self._req if r is not None}
+            yielded = {u: n for u, n in yielded.items() if u in live}
+            finished = self.step()
+            for slot in range(self.slots):
+                req = self._req[slot]
+                if req is None:
+                    continue
+                gen = self._generated[slot]
+                for tok in gen[yielded.get(req.uid, 0):]:
+                    yield ("token", req.uid, int(tok))
+                yielded[req.uid] = len(gen)
+            for f in finished:
+                gen = f.tokens[f.n_prompt:]
+                for tok in gen[yielded.pop(f.uid, 0):]:
+                    yield ("token", f.uid, int(tok))
+                yield ("finished", f.uid, f.tokens)
+            if not self.queue and self.active == 0:
+                return
         raise RuntimeError(f"not drained after {max_steps} steps")
 
 

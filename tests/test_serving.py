@@ -635,6 +635,89 @@ class TestServingEngine:
         done = eng.run()
         np.testing.assert_array_equal(done[0].tokens, want)
 
+    @pytest.mark.parametrize("engine_kw", [
+        {}, {"chain_steps": 3}, {"prefix_cache": 2}])
+    def test_stream_yields_every_token_then_finished(self, engine_kw):
+        """stream() is run() delivered incrementally: per-request
+        token events arrive in generation order, every generated
+        token is yielded exactly once, each request ends with one
+        finished event carrying the same tokens run() would return —
+        across plain, chained, and prefix-cached engines."""
+        p = params()
+        reqs = [("a", prompt(75, 5), 6, 0.0), ("b", prompt(76, 8), 4, 0.9),
+                ("c", prompt(77, 3), 7, 0.0)]
+
+        def submit_all(eng):
+            for uid, pr, n, temp in reqs:
+                eng.submit(Request(uid=uid, prompt=pr, max_new=n,
+                                   temperature=temp, seed=5))
+
+        ref_eng = ServingEngine(p, CFG, slots=2, **engine_kw)
+        submit_all(ref_eng)
+        want = {f.uid: f.tokens for f in ref_eng.run()}
+
+        eng = ServingEngine(p, CFG, slots=2, **engine_kw)
+        submit_all(eng)
+        tokens: dict = {u: [] for u, *_ in reqs}
+        done: dict = {}
+        for ev in eng.stream():
+            if ev[0] == "token":
+                assert ev[1] not in done, "token after finished"
+                tokens[ev[1]].append(ev[2])
+            else:
+                done[ev[1]] = ev[2]
+        assert set(done) == set(want)
+        for uid, pr, n, _ in reqs:
+            np.testing.assert_array_equal(done[uid], want[uid])
+            # the streamed tokens ARE the generated suffix, in order
+            np.testing.assert_array_equal(
+                np.asarray(tokens[uid], np.int32),
+                want[uid][pr.size:])
+            assert len(tokens[uid]) == n
+
+    def test_stream_cancel_then_resubmit_same_uid(self):
+        """A uid cancelled mid-stream and resubmitted must stream its
+        new request from token 0 — a stale per-uid counter would
+        silently swallow the leading tokens (review r05)."""
+        p = params()
+        eng = ServingEngine(p, CFG, slots=1)
+        eng.submit(Request(uid="x", prompt=prompt(79, 5), max_new=6))
+        pr2 = prompt(80, 4)
+        want = reference(p, pr2, 5)
+        tokens, done = [], []
+        stream = eng.stream()
+        seen = 0
+        for ev in stream:
+            if ev[0] == "token":
+                seen += 1
+                if seen == 3:       # cancel mid-flight, reuse the uid
+                    assert eng.cancel("x")
+                    eng.submit(Request(uid="x", prompt=pr2, max_new=5))
+                    continue
+                if seen > 3:
+                    tokens.append(ev[2])
+            else:
+                done.append(ev)
+        assert len(done) == 1       # only the resubmission finishes
+        np.testing.assert_array_equal(done[0][2], want)
+        np.testing.assert_array_equal(
+            np.asarray(tokens, np.int32), want[pr2.size:])
+
+    def test_stream_speculative_engine(self):
+        """Streaming composes with speculative decoding: accepted
+        blocks arrive at window boundaries, totals and order match
+        the batch drain."""
+        p, _, spec_f = self._spec_engines("weak")
+        pr = prompt(78, 6)
+        eng = spec_f()
+        eng.submit(Request(uid="s", prompt=pr, max_new=7))
+        events = list(eng.stream())
+        toks = [e[2] for e in events if e[0] == "token"]
+        fin = [e for e in events if e[0] == "finished"]
+        assert len(fin) == 1
+        np.testing.assert_array_equal(
+            np.asarray(toks, np.int32), fin[0][2][pr.size:])
+
     def test_zero_max_new_rejected(self):
         eng = ServingEngine(params(), CFG, slots=1)
         with pytest.raises(ValueError, match="max_new"):

@@ -1,0 +1,60 @@
+"""Regenerate tools/serving_engine_v5e.json on a live chip.
+
+The artifact behind the serving-engine throughput claims
+(README/WORKLOADS: chained continuous batching + fused grouped
+prefill vs the per-step drain and the compiled decode ceiling).
+Run on an IDLE machine — see tools/int8_decode_v5e_loaded_host.json
+for what a loaded host does to recorded baselines.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import platform
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
+    enable_persistent_cache()
+    import jax
+
+    from k8s_dra_driver_tpu.ops import decode_probe, serving_probe
+
+    rec = {
+        "what": ("continuous-batching engine throughput: chained drain "
+                 "(chain_steps=47, one dispatch per decode wave) with "
+                 "fused grouped prefill, vs the per-step drain and the "
+                 "compiled decode ceiling; per-phase wall clocks "
+                 "(prefill_s / decode_dispatch_s / host_s) separate "
+                 "engine overhead from tunnel dispatch RTT"),
+        "host": platform.node(),
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+        "commit": subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True).stdout.strip(),
+        "harness": "ops/collectives.py serving_probe / decode_probe",
+        "recorded_unix": int(time.time()),
+        "serving_chain47": serving_probe(chain_steps=47),
+        "serving_chain47_prefix": serving_probe(
+            chain_steps=47, prefix_cache=8, shared_prefix=64),
+        "serving_per_step": serving_probe(),
+        "decode_ceiling": decode_probe(),
+    }
+    path = pathlib.Path(__file__).parent / "serving_engine_v5e.json"
+    path.write_text(json.dumps(rec, indent=1) + "\n")
+    print(json.dumps({
+        k: (v.get("tokens_per_s") or v.get("tokens_per_s_lower_bound"))
+        for k, v in rec.items()
+        if isinstance(v, dict) and "tokens_per_s" in str(v)}))
+    print("wrote", path)
+
+
+if __name__ == "__main__":
+    main()
