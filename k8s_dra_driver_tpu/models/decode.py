@@ -514,19 +514,69 @@ def prefill_adopt_rows(params: Params, prompts: jax.Array,
     one = init_cache(cfg, prompts.shape[0], max_seq)
     logits, one = forward_with_cache(params, prompts, cfg, one,
                                      first_chunk=True)
+    cache = scatter_cache(
+        cache, one,
+        lambda dst, src: [d.at[slot_ids].set(s)
+                          for d, s in zip(dst, src)])
+    first, carry = select_next_tokens(logits[:, -1], keys0, temps,
+                                      top_k, top_p)
+    return first, cache, carry
 
-    def put(dst, src):
-        return [d.at[slot_ids].set(s) for d, s in zip(dst, src)]
 
-    cache = KVCache(
+def scatter_cache(cache: KVCache, one: KVCache, put) -> KVCache:
+    """Rebuild ``cache`` with ``put(dst_list, src_list)`` applied to
+    every per-layer tensor family (k/v and, when quantized, their
+    scales) — THE single definition of the cache layout for the
+    adopt-style scatters (serving._adopt_slot, prefill_adopt_rows,
+    suffix_fill_adopt), so a layout change cannot silently diverge
+    across those jit bodies."""
+    return KVCache(
         k=put(cache.k, one.k), v=put(cache.v, one.v), pos=cache.pos,
         k_scale=(put(cache.k_scale, one.k_scale)
                  if cache.k_scale is not None else None),
         v_scale=(put(cache.v_scale, one.v_scale)
                  if cache.v_scale is not None else None))
-    first, carry = select_next_tokens(logits[:, -1], keys0, temps,
-                                      top_k, top_p)
-    return first, cache, carry
+
+
+def adopt_one_slot(cache: KVCache, one: KVCache, slot) -> KVCache:
+    """Traceable copy of a [1, S] cache into row ``slot`` (scalar)."""
+    return scatter_cache(
+        cache, one,
+        lambda dst, src: [jax.lax.dynamic_update_index_in_dim(
+            d, s[0], slot, 0) for d, s in zip(dst, src)])
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "top_k", "top_p"),
+                   donate_argnums=(4,))
+def suffix_fill_adopt(params: Params, entry: KVCache,
+                      suffix: jax.Array, cfg: TransformerConfig,
+                      cache: KVCache, slot: jax.Array,
+                      key0: jax.Array, temp: jax.Array,
+                      top_k: int = 0, top_p: float = 0.0
+                      ) -> tuple[jax.Array, KVCache, jax.Array,
+                                 KVCache]:
+    """Fused prefix-HIT fill: append ``suffix`` [Ls] to an adopted
+    prefix-cache ``entry`` (its ``pos`` counts the reused rows), copy
+    the result into row ``slot`` of the donated engine ``cache``, and
+    draw the first token with the standard key schedule — ONE program
+    launch where the stepwise path takes three (suffix forward,
+    adopt, sample), the same per-launch-latency economics as
+    ``prefill_adopt_rows`` applied to the prefix-adoption path.
+
+    The entry's buffers are NOT donated (later hits reuse them; the
+    functional ``dynamic_update_slice`` writes produce fresh arrays),
+    and they never alias the donated engine cache —
+    ``_extract_slot`` copies finish-time captures into fresh buffers
+    for exactly that reason.  The suffix-filled [1, S] cache is
+    returned so the caller can memoize it as the new prefix entry.
+    Returns (first token [], cache, carried key [2], suffix-filled
+    entry)."""
+    logits, one = forward_with_cache(params, suffix[None, :], cfg,
+                                     entry)
+    cache = adopt_one_slot(cache, one, slot)
+    first, carry = select_next_tokens(logits[:, -1], key0[None],
+                                      temp[None], top_k, top_p)
+    return first[0], cache, carry[0], one
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "k", "top_k",

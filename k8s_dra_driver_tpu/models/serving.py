@@ -230,16 +230,10 @@ def _adopt_slot(cache: KVCache, one: KVCache, slot) -> KVCache:
     engine cache — ONE jitted program with the engine cache donated,
     so XLA updates the rows in place instead of copying the whole
     multi-slot cache per layer per refill (slot is a traced scalar:
-    refills never retrace)."""
-    def put(dst, src):
-        return [jax.lax.dynamic_update_index_in_dim(d, s[0], slot, 0)
-                for d, s in zip(dst, src)]
-    return KVCache(
-        k=put(cache.k, one.k), v=put(cache.v, one.v), pos=cache.pos,
-        k_scale=(put(cache.k_scale, one.k_scale)
-                 if cache.k_scale is not None else None),
-        v_scale=(put(cache.v_scale, one.v_scale)
-                 if cache.v_scale is not None else None))
+    refills never retrace).  The scatter body is the shared
+    ``decode.adopt_one_slot`` so the cache layout cannot drift
+    between this, the fused fills, and ``prefill_adopt_rows``."""
+    return _decode.adopt_one_slot(cache, one, slot)
 
 
 class ServingEngine:
@@ -438,6 +432,25 @@ class ServingEngine:
                               k_scale=entry.k_scale,
                               v_scale=entry.v_scale)
                 start = p
+        if (start > 0 and self.prefill_chunk is None
+                and self.draft_params is None):
+            # fused HIT fill: suffix forward + slot adopt + first
+            # token in ONE launch (suffix_fill_adopt) — the same
+            # launch-amortization prefill_adopt_rows gives fresh
+            # fills, applied to the prefix-adoption path
+            first, self.cache, carry, one = _decode.suffix_fill_adopt(
+                self.params, one,
+                jnp.asarray(req.prompt[start:]), self.cfg,
+                self.cache, jnp.int32(slot),
+                jax.random.PRNGKey(req.seed),
+                jnp.float32(req.temperature), self.top_k, self.top_p)
+            self._prefix.insert(req.prompt, one)
+            if req.temperature > 0:
+                self._keys = self._keys.at[slot].set(carry)
+            self._temps[slot] = req.temperature
+            self._req[slot] = req
+            self._pos[slot] = req.prompt.size
+            return first
         if start == 0:
             one = init_cache(self.cfg, 1, self.max_seq)
         if self.prefill_chunk is None and start == 0:

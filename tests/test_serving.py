@@ -315,22 +315,30 @@ class TestServingEngine:
 
     def test_prefix_cache_prefills_only_the_suffix(self):
         """A hit must skip recomputation: count tokens pushed through
-        the prefill program and compare against the adopted length."""
+        BOTH fill entry points (fresh prefill and the fused suffix
+        fill) and compare against the adopted length."""
         from k8s_dra_driver_tpu.models import decode as decode_mod
 
         p = params()
         seen = []
-        real = decode_mod._prefill_jit
+        real_prefill = decode_mod._prefill_jit
+        real_suffix = decode_mod.suffix_fill_adopt
 
-        def counting(params_, tokens, cfg, cache, first_chunk):
+        def counting_prefill(params_, tokens, cfg, cache, first_chunk):
             seen.append(int(tokens.shape[1]))
-            return real(params_, tokens, cfg, cache, first_chunk)
+            return real_prefill(params_, tokens, cfg, cache,
+                                first_chunk)
+
+        def counting_suffix(params_, entry, suffix, *a, **kw):
+            seen.append(int(suffix.shape[0]))
+            return real_suffix(params_, entry, suffix, *a, **kw)
 
         eng = ServingEngine(p, CFG, slots=1, prefix_cache=2)
         pr = prompt(21, 10)
         longer = np.concatenate([pr, prompt(22, 3)])
         try:
-            decode_mod._prefill_jit = counting
+            decode_mod._prefill_jit = counting_prefill
+            decode_mod.suffix_fill_adopt = counting_suffix
             eng.submit(Request(uid="a", prompt=pr, max_new=2))
             while eng.active or eng.pending:
                 eng.step()
@@ -340,10 +348,11 @@ class TestServingEngine:
             while eng.active or eng.pending:
                 eng.step()
             # all 10 prefix tokens adopted; only the 3-token suffix
-            # (plus nothing else) prefilled
+            # (plus nothing else) computed, through the fused path
             assert sum(seen) == len(longer) - len(pr)
         finally:
-            decode_mod._prefill_jit = real
+            decode_mod._prefill_jit = real_prefill
+            decode_mod.suffix_fill_adopt = real_suffix
 
     def test_prefix_cache_multi_turn_adopts_conversation(self):
         """Finish-time capture: a follow-up turn whose prompt extends
