@@ -1,11 +1,15 @@
-"""Cluster access: client interface, fake API server, object kinds."""
+"""Cluster access: client interface, fake API server, object kinds,
+fault injection."""
 
-from .client import (ClusterClient, ConflictError, EVENT_ADDED, EVENT_DELETED,
+from .client import (ApiServerError, ApiUnavailableError, ClusterClient,
+                     ConflictError, EVENT_ADDED, EVENT_DELETED,
                      EVENT_MODIFIED, FakeCluster, NotFoundError, match_labels)
+from .faults import FaultPlan, FaultRule, FaultyClusterClient
 from .objects import Deployment, Node, Pod
 
 __all__ = [
-    "ClusterClient", "ConflictError", "Deployment", "EVENT_ADDED",
-    "EVENT_DELETED", "EVENT_MODIFIED", "FakeCluster", "Node",
-    "NotFoundError", "Pod", "match_labels",
+    "ApiServerError", "ApiUnavailableError", "ClusterClient",
+    "ConflictError", "Deployment", "EVENT_ADDED", "EVENT_DELETED",
+    "EVENT_MODIFIED", "FakeCluster", "FaultPlan", "FaultRule",
+    "FaultyClusterClient", "Node", "NotFoundError", "Pod", "match_labels",
 ]

@@ -35,6 +35,32 @@ class ConflictError(RuntimeError):
     pass
 
 
+class ApiServerError(RuntimeError):
+    """A server-side or transport failure that is not NotFound/Conflict.
+
+    Raised by the REST client once its classified-retry budget is
+    exhausted, and by the fault-injection layer for scripted 429/5xx
+    responses.  ``status`` is the HTTP status (0 for connection-level
+    failures), ``retry_after_s`` carries a parsed Retry-After when the
+    server sent one.
+    """
+
+    def __init__(self, message: str, status: int = 500,
+                 retry_after_s: float | None = None):
+        super().__init__(message)
+        self.status = status
+        self.retry_after_s = retry_after_s
+
+
+class ApiUnavailableError(ApiServerError):
+    """Connection-level failure: refused, reset, or timed out before a
+    response arrived (URLError/timeout analog)."""
+
+    def __init__(self, message: str,
+                 retry_after_s: float | None = None):
+        super().__init__(message, status=0, retry_after_s=retry_after_s)
+
+
 def _kind_of(obj: Any) -> str:
     return type(obj).__name__
 

@@ -1,0 +1,294 @@
+"""Deterministic fault injection for the control plane.
+
+The reference's resilience story (kubelet restarts, apiserver blips,
+torn checkpoints — reference cmd/nvidia-dra-plugin/checkpoint.go and
+device_state.go:94-190) is exercised only by hand on kind clusters;
+nothing there can provoke a 429 storm or a crash window on demand.
+This module is the missing instrument: a seeded, scripted ``FaultPlan``
+that injects failures at the ``ClusterClient`` boundary (in-process,
+via ``FaultyClusterClient``), at the wire (``tests/miniapi.py`` consults
+the same plan server-side behind ``POST /faults``), and at named crash
+points inside a plugin process (``crashpoint``, armed through the
+``TPU_DRA_FAULT_PLAN`` env var by ``cmd/plugin.py``).
+
+Determinism contract: a plan is a pure function of (seed, rules, call
+sequence).  Rule matching consumes per-rule counters in call order and
+probabilistic rules draw from one seeded RNG, so replaying the same
+call sequence against an identical plan yields the identical injection
+log — the property the chaos suite asserts.
+
+Plan JSON schema (one rule per dict, evaluated in order, first match
+wins)::
+
+    {"seed": 7, "rules": [
+      {"verb": "create",        # create|update|get|list|delete|watch,
+                                #   a crashpoint name, or "*"
+       "kind": "ResourceSlice", # object kind or "*" (glob ok)
+       "name": "*",             # object name glob; subresource writes
+                                #   match as "<name>/status"
+       "skip": 0,               # let this many matching calls through
+       "times": 3,              # then affect this many (-1 = forever)
+       "probability": 1.0,      # seeded coin flip per candidate call
+       "error": "429",          # 429|500|502|503|conflict|notfound|
+                                #   drop|crash|"" (latency only)
+       "retry_after_s": 0.05,   # Retry-After for 429/503 responses
+       "latency_s": 0.0}]}      # injected delay before the outcome
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+import logging
+import os
+import random
+import threading
+import time
+from typing import Any, Callable
+
+from .client import (ApiServerError, ApiUnavailableError, ClusterClient,
+                     ConflictError, NotFoundError, WatchHandler)
+
+log = logging.getLogger(__name__)
+
+# Exit code a scripted crash dies with — distinguishable from real
+# plugin failures in subprocess tests.
+CRASH_EXIT_CODE = 86
+
+# Verbs a ClusterClient call can carry; crashpoints use free-form names
+# (namespaced like "checkpoint.saved") that never collide with these.
+VERBS = ("create", "update", "get", "list", "delete", "watch")
+
+ERROR_KINDS = ("429", "500", "502", "503", "conflict", "notfound",
+               "drop", "crash", "")
+
+# Injection-log cap: plans live for one test scenario; a runaway loop
+# must not turn the log into the test's memory hog.
+_LOG_CAP = 10000
+
+
+@dataclasses.dataclass
+class FaultRule:
+    verb: str = "*"
+    kind: str = "*"
+    name: str = "*"
+    skip: int = 0
+    times: int = 1
+    probability: float = 1.0
+    error: str = ""
+    retry_after_s: float | None = None
+    latency_s: float = 0.0
+
+    def __post_init__(self):
+        if self.error not in ERROR_KINDS:
+            raise ValueError(
+                f"unknown fault error {self.error!r}; one of {ERROR_KINDS}")
+        # per-rule match counter (calls that matched verb/kind/name,
+        # before the skip/times window is applied)
+        self.seen = 0
+
+    def matches(self, verb: str, kind: str, name: str) -> bool:
+        return (fnmatch.fnmatchcase(verb, self.verb)
+                and fnmatch.fnmatchcase(kind, self.kind)
+                and fnmatch.fnmatchcase(name, self.name))
+
+    def to_json(self) -> dict:
+        return {k: getattr(self, k) for k in (
+            "verb", "kind", "name", "skip", "times", "probability",
+            "error", "retry_after_s", "latency_s")}
+
+
+@dataclasses.dataclass
+class Decision:
+    """What to do to one call (returned by ``FaultPlan.decide``)."""
+
+    error: str
+    retry_after_s: float | None = None
+    latency_s: float = 0.0
+    rule_index: int = -1
+
+
+class FaultPlan:
+    """Ordered fault rules + one seeded RNG + an injection log."""
+
+    def __init__(self, rules: list[FaultRule] | None = None, seed: int = 0):
+        self.rules = list(rules or [])
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        # (verb, kind, name, outcome) per call, in decision order
+        self.log: list[tuple[str, str, str, str]] = []
+
+    # -- construction ----------------------------------------------------
+
+    @classmethod
+    def from_json(cls, data: dict | str) -> "FaultPlan":
+        if isinstance(data, str):
+            data = json.loads(data)
+        rules = [FaultRule(**r) for r in data.get("rules", [])]
+        return cls(rules, seed=data.get("seed", 0))
+
+    def to_json(self) -> dict:
+        return {"seed": self.seed,
+                "rules": [r.to_json() for r in self.rules]}
+
+    # -- the decision point ----------------------------------------------
+
+    def decide(self, verb: str, kind: str = "",
+               name: str = "") -> Decision | None:
+        """First matching rule wins; ``None`` means pass through.
+
+        Counters and RNG draws advance under one lock so concurrent
+        callers serialize into a single deterministic decision order.
+        """
+        with self._lock:
+            decision = None
+            for idx, rule in enumerate(self.rules):
+                if not rule.matches(verb, kind, name):
+                    continue
+                rule.seen += 1
+                if rule.seen <= rule.skip:
+                    continue
+                if rule.times >= 0 and rule.seen - rule.skip > rule.times:
+                    continue
+                if rule.probability < 1.0 and \
+                        self._rng.random() >= rule.probability:
+                    continue
+                decision = Decision(
+                    error=rule.error, retry_after_s=rule.retry_after_s,
+                    latency_s=rule.latency_s, rule_index=idx)
+                break
+            if len(self.log) < _LOG_CAP:
+                self.log.append((verb, kind, name,
+                                 decision.error if decision else "pass"))
+            return decision
+
+    def raise_for(self, decision: Decision, context: str) -> None:
+        """Translate a decision's error into the typed exception the
+        hardened client paths classify (latency already applied)."""
+        err = decision.error
+        if not err:
+            return
+        if err == "conflict":
+            raise ConflictError(f"injected conflict: {context}")
+        if err == "notfound":
+            raise NotFoundError(f"injected not-found: {context}")
+        if err == "drop":
+            raise ApiUnavailableError(f"injected connection drop: {context}")
+        if err == "crash":
+            log.warning("fault plan: crashing process at %s", context)
+            os._exit(CRASH_EXIT_CODE)
+        raise ApiServerError(f"injected HTTP {err}: {context}",
+                             status=int(err),
+                             retry_after_s=decision.retry_after_s)
+
+
+class FaultyClusterClient(ClusterClient):
+    """``ClusterClient`` wrapper executing a ``FaultPlan`` in front of a
+    real backend — the in-process twin of the wire-level injection in
+    ``tests/miniapi.py``.  Latency is applied before the outcome; error
+    decisions fail the call before it reaches the backend (the request
+    never happened, matching a rejected/HTTP-erroring API call)."""
+
+    def __init__(self, inner: ClusterClient, plan: FaultPlan,
+                 sleep: Callable[[float], None] = time.sleep):
+        self.inner = inner
+        self.plan = plan
+        self._sleep = sleep
+
+    def _gate(self, verb: str, kind: str, name: str) -> None:
+        decision = self.plan.decide(verb, kind, name)
+        if decision is None:
+            return
+        if decision.latency_s > 0:
+            self._sleep(decision.latency_s)
+        self.plan.raise_for(decision, f"{verb} {kind} {name}")
+
+    def create(self, obj: Any) -> Any:
+        self._gate("create", type(obj).__name__, obj.metadata.name)
+        return self.inner.create(obj)
+
+    def update(self, obj: Any) -> Any:
+        self._gate("update", type(obj).__name__, obj.metadata.name)
+        return self.inner.update(obj)
+
+    def apply(self, obj: Any) -> Any:
+        # compose from gated create/update so scripted conflicts steer
+        # the upsert exactly like a real 409 would
+        try:
+            return self.create(obj)
+        except ConflictError:
+            return self.update(obj)
+
+    def delete(self, kind: str, namespace: str, name: str) -> None:
+        self._gate("delete", kind, name)
+        self.inner.delete(kind, namespace, name)
+
+    def get(self, kind: str, namespace: str, name: str) -> Any:
+        self._gate("get", kind, name)
+        return self.inner.get(kind, namespace, name)
+
+    def list(self, kind: str, namespace: str | None = None,
+             label_selector: dict[str, str] | None = None) -> list[Any]:
+        self._gate("list", kind, "")
+        return self.inner.list(kind, namespace, label_selector)
+
+    def watch(self, kind: str, handler: WatchHandler) -> Callable[[], None]:
+        self._gate("watch", kind, "")
+        return self.inner.watch(kind, handler)
+
+    def close(self) -> None:
+        close = getattr(self.inner, "close", None)
+        if close:
+            close()
+
+
+# --------------------------------------------------------------------------
+# process-level plan: crash windows inside a plugin binary
+# --------------------------------------------------------------------------
+
+# Named crash points the tree currently exposes (callers pass free-form
+# names; these constants keep tests and call sites in sync).
+CRASH_CHECKPOINT_TMP_WRITTEN = "checkpoint.tmp-written"
+CRASH_CHECKPOINT_SAVED = "checkpoint.saved"
+
+FAULT_PLAN_ENV = "TPU_DRA_FAULT_PLAN"
+
+_process_plan: FaultPlan | None = None
+
+
+def install_process_plan(plan: FaultPlan | None) -> None:
+    """Arm (or disarm, with None) crashpoints process-wide."""
+    global _process_plan
+    _process_plan = plan
+
+
+def load_plan_from_env() -> FaultPlan | None:
+    """Plan from the JSON file named by ``TPU_DRA_FAULT_PLAN`` — how a
+    subprocess bed scripts faults into a real plugin binary."""
+    path = os.environ.get(FAULT_PLAN_ENV, "")
+    if not path:
+        return None
+    from pathlib import Path
+    return FaultPlan.from_json(Path(path).read_text())
+
+
+def crashpoint(point: str) -> None:
+    """Die here if the process plan says so; no-op otherwise.
+
+    Call sites name windows the reference's crash-safety contract cares
+    about (e.g. between a checkpoint save and the next API write) so a
+    subprocess bed can kill the binary inside them deterministically.
+    """
+    plan = _process_plan
+    if plan is None:
+        return
+    decision = plan.decide(point)
+    if decision is None:
+        return
+    if decision.latency_s > 0:
+        time.sleep(decision.latency_s)
+    if decision.error == "crash":
+        log.warning("fault plan: crashing process at crashpoint %s", point)
+        os._exit(CRASH_EXIT_CODE)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import atexit
 import base64
+import copy
 import json
 import logging
 import shutil
@@ -35,11 +36,13 @@ from urllib.parse import quote
 from typing import Any, Callable
 
 from ..api import resource
+from ..utils.backoff import Backoff
 from ..utils.flags import TokenBucket
 from ..utils.quantity import format_quantity as _quantity_to_wire
 from ..utils.quantity import parse_quantity as _quantity_from_wire
-from .client import (ClusterClient, ConflictError, NotFoundError,
-                     WatchHandler, match_labels)
+from .client import (ApiServerError, ApiUnavailableError, ClusterClient,
+                     ConflictError, NotFoundError, WatchHandler,
+                     match_labels)
 from .objects import Deployment, Node, Pod
 
 log = logging.getLogger(__name__)
@@ -392,13 +395,38 @@ def _load_in_cluster() -> tuple[str, dict]:
 # the client
 # --------------------------------------------------------------------------
 
+# HTTP statuses worth a client-side retry: throttling and server-side
+# blips (client-go's default retriable set for idempotent requests).
+RETRYABLE_STATUS = frozenset({429, 500, 502, 503, 504})
+
+
+def _parse_retry_after(headers) -> float | None:
+    """Seconds form only; the HTTP-date form is not worth the parse."""
+    raw = headers.get("Retry-After") if headers else None
+    if raw is None:
+        return None
+    try:
+        return max(0.0, float(raw))
+    except ValueError:
+        return None
+
+
 class RestClusterClient(ClusterClient):
     def __init__(self, server: str, auth: dict, qps: float = 5.0,
-                 burst: int = 10, request_timeout: float = 30.0):
+                 burst: int = 10, request_timeout: float = 30.0,
+                 retry_backoff: Backoff | None = None,
+                 conflict_retries: int = 4):
         self.server = server.rstrip("/")
         self.auth = auth
         self.limiter = TokenBucket(qps, burst)
         self.timeout = request_timeout
+        # Per-call retry budget for transient failures: bounded both by
+        # step count and by a wall-clock deadline (the classified-retry
+        # analog of client-go's request retry + flowcontrol wait).
+        self.retry_backoff = retry_backoff or Backoff(
+            duration_s=0.25, factor=2.0, jitter=0.2, steps=5, cap_s=5.0,
+            deadline_s=60.0)
+        self.conflict_retries = conflict_retries
         self._stop = threading.Event()
         self._watch_threads: list[threading.Thread] = []
 
@@ -456,6 +484,53 @@ class RestClusterClient(ClusterClient):
 
     def _request(self, method: str, url: str, body: dict | None = None,
                  stream: bool = False, timeout: float | None = None):
+        """One API call with classified retries.
+
+        URLError/timeout/429/5xx are retried on idempotent verbs
+        (GET/PUT/DELETE); POST retries only failures that provably
+        never executed (429, connection refused) so a create cannot
+        run twice.  Retry-After is honored when longer than our own
+        backoff step, and the whole loop is bounded both by
+        ``retry_backoff.steps`` and its wall-clock deadline.  Streamed
+        (watch) requests never retry here — the watch loop owns that
+        backoff.
+        """
+        delays = self.retry_backoff.delays()
+        deadline_s = self.retry_backoff.deadline_s
+        deadline = (time.monotonic() + deadline_s
+                    if deadline_s is not None else None)
+        attempt = 0
+        while True:
+            try:
+                return self._request_once(method, url, body, stream,
+                                          timeout)
+            except ApiServerError as e:
+                if stream or self._stop.is_set() \
+                        or not self._retryable(method, e) \
+                        or attempt >= len(delays):
+                    raise
+                delay = delays[attempt]
+                attempt += 1
+                if e.retry_after_s is not None:
+                    delay = max(delay, e.retry_after_s)
+                if deadline is not None and \
+                        time.monotonic() + delay > deadline:
+                    raise
+                log.warning("%s %s failed (%s); retry %d/%d in %.2fs",
+                            method, url, e, attempt, len(delays), delay)
+                time.sleep(delay)
+
+    @staticmethod
+    def _retryable(method: str, e: ApiServerError) -> bool:
+        if e.status and e.status not in RETRYABLE_STATUS:
+            return False
+        if method in ("GET", "PUT", "DELETE"):
+            return True
+        # POST: only failures where the request provably never ran
+        return e.status == 429 or getattr(e, "unsent", False)
+
+    def _request_once(self, method: str, url: str, body: dict | None,
+                      stream: bool, timeout: float | None):
         self.limiter.acquire()
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)
@@ -468,18 +543,26 @@ class RestClusterClient(ClusterClient):
         try:
             resp = urllib.request.urlopen(
                 req, timeout=timeout or self.timeout, context=self._ssl_ctx)
+            if stream:
+                return resp
+            with resp:
+                return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")[:500]
             if e.code == 404:
                 raise NotFoundError(f"{method} {url}: {detail}") from None
             if e.code == 409:
                 raise ConflictError(f"{method} {url}: {detail}") from None
-            raise RuntimeError(
-                f"{method} {url}: HTTP {e.code}: {detail}") from None
-        if stream:
-            return resp
-        with resp:
-            return json.loads(resp.read() or b"{}")
+            raise ApiServerError(
+                f"{method} {url}: HTTP {e.code}: {detail}", status=e.code,
+                retry_after_s=_parse_retry_after(e.headers)) from None
+        except (urllib.error.URLError, OSError) as e:
+            # connection refused/reset/timeout — the server never
+            # answered; mark provably-unsent failures for POST retry
+            err = ApiUnavailableError(f"{method} {url}: {e}")
+            err.unsent = isinstance(getattr(e, "reason", e),
+                                    ConnectionRefusedError)
+            raise err from None
 
     # -- ClusterClient ---------------------------------------------------
 
@@ -492,38 +575,93 @@ class RestClusterClient(ClusterClient):
         return _FROM_WIRE[kind](out)
 
     def update(self, obj: Any) -> Any:
+        """PUT with bounded conflict re-read-and-retry: on 409, fetch
+        the current object, rebase our modeled fields onto its
+        resourceVersion (and, for raw-merge kinds, its raw body so
+        unmodeled concurrent edits survive), and retry — at most
+        ``conflict_retries`` times.  The caller's object is never
+        mutated; retries operate on shallow working copies."""
         kind = type(obj).__name__
-        wire = _TO_WIRE[kind](obj)
-        if not wire["metadata"].get("resourceVersion"):
-            current = self._request(
-                "GET", self._url(kind, obj.metadata.namespace,
-                                 obj.metadata.name))
-            wire["metadata"]["resourceVersion"] = (
-                current["metadata"]["resourceVersion"])
-        out = self._request(
-            "PUT", self._url(kind, obj.metadata.namespace,
-                             obj.metadata.name), wire)
-        # Status lives behind a subresource on real API servers; a PUT
-        # to the main resource silently drops it, so claim status needs
-        # a second write to .../status — including an empty status, or
-        # deallocation (allocation = None) would never clear server-side.
-        if kind == "ResourceClaim" and obj.status is not None:
-            status_wire = _claim_status_wire(obj)
-            status_wire["metadata"]["resourceVersion"] = (
-                out["metadata"]["resourceVersion"])
-            out = self._request(
-                "PUT",
-                self._url(kind, obj.metadata.namespace,
-                          obj.metadata.name) + "/status",
-                status_wire)
-        return _FROM_WIRE[kind](out)
+        url = self._url(kind, obj.metadata.namespace, obj.metadata.name)
+        work = obj
+        last: ConflictError | None = None
+        for _ in range(self.conflict_retries + 1):
+            wire = _TO_WIRE[kind](work)
+            if not wire["metadata"].get("resourceVersion"):
+                current = self._request("GET", url)
+                wire["metadata"]["resourceVersion"] = (
+                    current["metadata"]["resourceVersion"])
+            try:
+                out = self._request("PUT", url, wire)
+            except ConflictError as e:
+                last = e
+                fresh = self._request("GET", url)
+                work = self._rebase(work, fresh)
+                continue
+            # Status lives behind a subresource on real API servers; a
+            # PUT to the main resource silently drops it, so claim
+            # status needs a second write to .../status — including an
+            # empty status, or deallocation (allocation = None) would
+            # never clear server-side.
+            if kind == "ResourceClaim" and work.status is not None:
+                out = self._put_claim_status(work, out)
+            return _FROM_WIRE[kind](out)
+        raise ConflictError(
+            f"update {kind} {obj.metadata.namespace}/{obj.metadata.name}: "
+            f"still conflicting after {self.conflict_retries} re-reads: "
+            f"{last}") from last
+
+    @staticmethod
+    def _rebase(obj: Any, fresh: dict) -> Any:
+        """Working copy of ``obj`` carried onto ``fresh``'s
+        resourceVersion (and raw body, for the merge-on-write kinds)."""
+        work = copy.copy(obj)
+        work.metadata = copy.copy(obj.metadata)
+        rv = fresh.get("metadata", {}).get("resourceVersion", "0")
+        work.metadata.resource_version = \
+            int(rv) if str(rv).isdigit() else 0
+        if hasattr(work, "raw"):
+            work.raw = fresh
+        return work
+
+    def _put_claim_status(self, obj: Any, main_out: dict) -> dict:
+        """The second half of a claim write.  A failure here would
+        leave a half-written claim (spec updated, status stale), so
+        conflicts re-read the resourceVersion and retry before the
+        error surfaces; transient 5xx/429 are already retried one
+        level down in ``_request``."""
+        url = self._url("ResourceClaim", obj.metadata.namespace,
+                        obj.metadata.name) + "/status"
+        status_wire = _claim_status_wire(obj)
+        rv = main_out["metadata"]["resourceVersion"]
+        last: ConflictError | None = None
+        for _ in range(self.conflict_retries + 1):
+            status_wire["metadata"]["resourceVersion"] = rv
+            try:
+                return self._request("PUT", url, status_wire)
+            except ConflictError as e:
+                last = e
+                fresh = self._request(
+                    "GET", self._url("ResourceClaim",
+                                     obj.metadata.namespace,
+                                     obj.metadata.name))
+                rv = fresh["metadata"]["resourceVersion"]
+        raise ApiServerError(
+            f"claim {obj.metadata.namespace}/{obj.metadata.name}: main "
+            f"resource updated but the status write kept conflicting "
+            f"({last}); claim is half-written", status=409) from last
 
     def apply(self, obj: Any) -> Any:
         try:
             return self.create(obj)
         except ConflictError:
-            obj.metadata.resource_version = 0
-            return self.update(obj)
+            # rv=0 forces update() to fetch the current version; set it
+            # on a working copy — mutating the caller's object would
+            # corrupt shared state when an apply is retried
+            work = copy.copy(obj)
+            work.metadata = copy.copy(obj.metadata)
+            work.metadata.resource_version = 0
+            return self.update(work)
 
     def delete(self, kind: str, namespace: str, name: str) -> None:
         self._request("DELETE", self._url(kind, namespace, name))

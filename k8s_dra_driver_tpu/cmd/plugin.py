@@ -171,6 +171,17 @@ def run(args: argparse.Namespace, client=None, backend=None,
     client = client or KubeClientConfig.build_client(args)
     backend = backend or build_backend(args)
 
+    # Deterministic fault injection (test/chaos tooling): a plan file
+    # named by TPU_DRA_FAULT_PLAN scripts API-call failures and named
+    # crash windows into this process (cluster/faults.py).
+    from ..cluster import faults
+    fault_plan = faults.load_plan_from_env()
+    if fault_plan is not None:
+        faults.install_process_plan(fault_plan)
+        client = faults.FaultyClusterClient(client, fault_plan)
+        log.warning("fault injection ACTIVE: %d rule(s) from $%s",
+                    len(fault_plan.rules), faults.FAULT_PLAN_ENV)
+
     state = DeviceState(backend, client, DeviceStateConfig(
         plugin_root=args.plugin_root, cdi_root=args.cdi_root,
         node_name=args.node_name, driver_root=args.driver_root,

@@ -15,6 +15,7 @@ API surface and UID-checked before preparing (driver.go:120-127).
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from concurrent import futures
@@ -24,12 +25,15 @@ import grpc
 
 from ..api import resource
 from ..cluster import ClusterClient, NotFoundError
+from ..utils.backoff import Backoff
 from ..utils.metrics import DriverMetrics
 from . import publisher as publisher_mod
 from .device_state import DRIVER_NAME, DeviceState
 from ..proto import (dra_pb2, registration_pb2, DRAPluginServicer,
                      RegistrationServicer, add_dra_servicer,
                      add_registration_servicer)
+
+log = logging.getLogger(__name__)
 
 PLUGIN_SOCKET_NAME = "plugin.sock"
 REGISTRAR_SOCKET_NAME = "tpu.google.com-reg.sock"
@@ -56,16 +60,33 @@ class _Registrar(RegistrationServicer):
         return registration_pb2.RegistrationStatusResponse()
 
 
+# Boot-publication retry: ~13 attempts over roughly two minutes of
+# capped exponential backoff; after that the periodic health monitor
+# owns the republish (its _publish_pending analog), so the bounded
+# budget here never turns into an abandoned node.
+PUBLISH_BACKOFF = Backoff(duration_s=0.5, factor=2.0, jitter=0.2,
+                          steps=13, cap_s=15.0, deadline_s=120.0)
+
+
 class Driver(DRAPluginServicer):
     def __init__(self, state: DeviceState, client: ClusterClient,
                  plugin_dir: str, metrics: DriverMetrics | None = None,
-                 registrar_dir: str | None = None):
+                 registrar_dir: str | None = None,
+                 publish_backoff: Backoff | None = None):
         self.state = state
         self.client = client
         self.plugin_dir = Path(plugin_dir)
         self.plugin_dir.mkdir(parents=True, exist_ok=True)
         self.metrics = metrics or DriverMetrics()
         self._lock = threading.Lock()   # serializes all prepares on a node
+        self._publish_lock = threading.Lock()
+        self._publish_backoff = publish_backoff or PUBLISH_BACKOFF
+        self._publish_stop = threading.Event()
+        self._publish_thread: threading.Thread | None = None
+        # True while node label + ResourceSlices are not known to be
+        # current on the API server (the health monitor republishes on
+        # its next tick when the bounded boot retry gives up).
+        self.publish_pending = False
         self._servers: list[grpc.Server] = []
         self.plugin_socket = self.plugin_dir / PLUGIN_SOCKET_NAME
         # Real kubelets discover plugins via a separate registry dir
@@ -89,13 +110,26 @@ class Driver(DRAPluginServicer):
         reg_server.start()
 
         self._servers = [plugin_server, reg_server]
-        self._ensure_node_label()
-        self.publish_resources()
+        # The gRPC servers are up — kubelet can already call prepare
+        # (prepared claims re-fetch through the same client and fail
+        # in-band).  Publication must not take the whole plugin down
+        # with an apiserver that is merely unreachable at boot.
+        try:
+            self._ensure_node_label()
+            self.publish_resources()
+        except Exception as e:
+            log.warning("apiserver unreachable at boot (%s); queuing "
+                        "resource publication behind backoff", e)
+            self._queue_publish()
 
     def shutdown(self, grace: float = 1.0) -> None:
+        self._publish_stop.set()
         for s in self._servers:
             s.stop(grace)
         self._servers = []
+        if self._publish_thread is not None:
+            self._publish_thread.join(timeout=5)
+            self._publish_thread = None
 
     def _ensure_node_label(self) -> None:
         """Self-label this Node with its slice identity so the controller
@@ -117,16 +151,56 @@ class Driver(DRAPluginServicer):
     # -- publication ------------------------------------------------------
 
     def publish_resources(self) -> None:
-        devices = [dev.to_device()
-                   for _, dev in sorted(self.state.allocatable.items())]
-        pool = publisher_mod.PoolSpec(
-            name=self.state.config.node_name, devices=devices,
-            node_name=self.state.config.node_name)
-        pub = publisher_mod.ResourceSlicePublisher(
-            self.client, DRIVER_NAME,
-            owner_id=f"node-{self.state.config.node_name}",
-            metrics=self.metrics)
-        pub.publish([pool])
+        """Reconcile this node's ResourceSlices; raises on failure (the
+        health monitor's _publish_pending pattern relies on that)."""
+        with self._publish_lock:
+            self.publish_pending = True
+            devices = [dev.to_device()
+                       for _, dev in sorted(self.state.allocatable.items())]
+            pool = publisher_mod.PoolSpec(
+                name=self.state.config.node_name, devices=devices,
+                node_name=self.state.config.node_name)
+            pub = publisher_mod.ResourceSlicePublisher(
+                self.client, DRIVER_NAME,
+                owner_id=f"node-{self.state.config.node_name}",
+                metrics=self.metrics)
+            pub.publish([pool])
+            self.publish_pending = False
+
+    def _queue_publish(self) -> None:
+        """Retry node label + publication on a daemon thread with a
+        bounded backoff (steps AND deadline).  On exhaustion the
+        publish_pending flag stays set so the periodic health monitor
+        keeps reconciling — bounded retry, unbounded ownership."""
+        if self._publish_thread is not None and \
+                self._publish_thread.is_alive():
+            return
+        self.publish_pending = True
+
+        def attempt() -> bool:
+            if self._publish_stop.is_set():
+                return True              # shutting down: stop retrying
+            try:
+                self._ensure_node_label()
+                self.publish_resources()
+                log.info("queued resource publication succeeded")
+                return True
+            except Exception as e:
+                log.warning("queued resource publication failed (%s); "
+                            "backing off", e)
+                return False
+
+        def run() -> None:
+            done = self._publish_backoff.poll(
+                attempt, sleep=lambda s: self._publish_stop.wait(s))
+            if not done and not self._publish_stop.is_set():
+                log.error("resource publication still failing after "
+                          "bounded retries; health monitor will keep "
+                          "trying on its interval")
+
+        self._publish_thread = threading.Thread(
+            target=run, name="tpu-publish-retry", daemon=True)
+        self._publish_thread.start()
 
     # -- DRA service ------------------------------------------------------
 

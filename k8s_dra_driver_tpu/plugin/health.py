@@ -62,7 +62,11 @@ class HealthMonitor:
         state = self.driver.state
         before = dict(state.unhealthy)
         changed = state.apply_health(unhealthy)
-        if not changed and not self._publish_pending:
+        # driver.publish_pending: the boot-time publication queue gave
+        # up after its bounded retries (driver.py _queue_publish) — the
+        # periodic reconcile here owns the republish from then on
+        if not changed and not self._publish_pending \
+                and not getattr(self.driver, "publish_pending", False):
             return False
         for idx, reason in sorted(unhealthy.items()):
             if before.get(idx) != reason:

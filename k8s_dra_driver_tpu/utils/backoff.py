@@ -12,13 +12,16 @@ from typing import Callable
 class Backoff:
     """Mirrors the knobs of the reference's readiness backoff
     (reference cmd/nvidia-dra-plugin/sharing.go:290-296: duration 1s,
-    factor 2, jitter 1, steps 4, cap 10s)."""
+    factor 2, jitter 1, steps 4, cap 10s), plus an overall
+    ``deadline_s`` wall-clock bound (client-go wait.Backoff has only
+    Steps; retry paths here must be boundable both ways)."""
 
     duration_s: float = 1.0
     factor: float = 2.0
     jitter: float = 1.0
     steps: int = 4
     cap_s: float = 10.0
+    deadline_s: float | None = None
 
     def delays(self) -> list[float]:
         out, d = [], self.duration_s
@@ -29,11 +32,20 @@ class Backoff:
         return out
 
     def poll(self, fn: Callable[[], bool],
-             sleep: Callable[[float], None] = time.sleep) -> bool:
-        """Run ``fn`` until it returns True or steps are exhausted."""
+             sleep: Callable[[float], None] = time.sleep,
+             clock: Callable[[], float] = time.monotonic) -> bool:
+        """Run ``fn`` until it returns True, steps are exhausted, or
+        ``deadline_s`` of wall clock has elapsed — whichever bound hits
+        first.  Sleeps never overshoot the deadline."""
+        start = clock()
         if fn():
             return True
         for delay in self.delays():
+            if self.deadline_s is not None:
+                remaining = self.deadline_s - (clock() - start)
+                if remaining <= 0:
+                    return False
+                delay = min(delay, remaining)
             sleep(delay)
             if fn():
                 return True

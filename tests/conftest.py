@@ -39,6 +39,7 @@ SLOW_MODULES = {
     "test_model_checkpoint",     # train/restore trajectories
     "test_oop_plugin",           # real plugin subprocesses
     "test_oop_gang",             # 4 plugin binaries + controller + jax
+    "test_chaos_oop",            # real plugin subprocesses + crashes
     "test_bench_smoke",          # drives the bench beds end-to-end
     "test_multihost_train",      # 2 jax.distributed processes training
     "test_serving",              # per-prompt-length prefill compiles

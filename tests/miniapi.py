@@ -5,12 +5,41 @@ paths, JSON CRUD, resourceVersion bump-on-write, status subresources,
 streaming chunked watches.  Used by the REST-client tests and by the
 out-of-process plugin bed (a real plugin subprocess pointed at this
 server through a kubeconfig).
+
+Fault injection: ``POST /faults`` installs a ``FaultPlan``
+(cluster/faults.py JSON schema) that every subsequent request is
+gated through, so subprocess gangs see scripted 429/5xx/conflict
+storms, latency, and connection drops at the REAL wire level.
+``DELETE /faults`` disarms; ``GET /faults`` returns the injection log.
 """
 
 import json
+import socket
+import sys
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
+
+from k8s_dra_driver_tpu.cluster.faults import FaultPlan
+
+# wire plural -> ClusterClient kind, so fault rules match the same
+# kind names in-process and over the wire
+KIND_BY_PLURAL = {
+    "resourceslices": "ResourceSlice", "resourceclaims": "ResourceClaim",
+    "deviceclasses": "DeviceClass", "nodes": "Node", "pods": "Pod",
+    "deployments": "Deployment",
+}
+
+
+class _QuietThreadingHTTPServer(ThreadingHTTPServer):
+    """Injected connection drops make handler teardown raise; keep the
+    test output free of those expected tracebacks."""
+
+    def handle_error(self, request, client_address):
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (ConnectionError, OSError, ValueError)):
+            return
+        super().handle_error(request, client_address)
 
 
 class MiniAPIServer:
@@ -27,6 +56,7 @@ class MiniAPIServer:
         # path-key -> object dict
         self.objects: dict[str, dict] = {}
         self.watchers: list = []  # (plural, wfile, event)
+        self.fault_plan: FaultPlan | None = None
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -35,13 +65,74 @@ class MiniAPIServer:
             def log_message(self, *a):
                 pass
 
-            def _send_json(self, obj, code=200):
+            def _send_json(self, obj, code=200, headers=None):
                 body = json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(body)
+
+            def _drop_connection(self):
+                try:
+                    self.connection.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self.connection.close()
+
+            def _fault_gate(self, verb, plural, name) -> bool:
+                """Consult the installed plan; True = the request was
+                consumed by an injected outcome."""
+                plan = server.fault_plan
+                if plan is None:
+                    return False
+                kind = KIND_BY_PLURAL.get(plural, plural)
+                decision = plan.decide(verb, kind, name)
+                if decision is None:
+                    return False
+                if decision.latency_s > 0:
+                    threading.Event().wait(decision.latency_s)
+                err = decision.error
+                if not err:
+                    return False           # latency-only rule
+                if err in ("drop", "crash"):  # crash is meaningless
+                    self._drop_connection()   # server-side: treat as drop
+                elif err == "conflict":
+                    self._send_json({"reason": "Conflict",
+                                     "message": "injected conflict"}, 409)
+                elif err == "notfound":
+                    self._send_json({"reason": "NotFound",
+                                     "message": "injected not-found"}, 404)
+                else:
+                    headers = {}
+                    if decision.retry_after_s is not None:
+                        headers["Retry-After"] = str(decision.retry_after_s)
+                    self._send_json(
+                        {"reason": "InjectedFault",
+                         "message": f"injected HTTP {err}"},
+                        int(err), headers=headers)
+                return True
+
+            def _handle_faults_admin(self, method, body=None) -> bool:
+                """The /faults admin surface; True = handled."""
+                if urlparse(self.path).path != "/faults":
+                    return False
+                if method == "POST":
+                    server.fault_plan = FaultPlan.from_json(body)
+                    self._send_json({"ok": True,
+                                     "rules": len(server.fault_plan.rules)})
+                elif method == "DELETE":
+                    server.fault_plan = None
+                    self._send_json({"ok": True})
+                else:
+                    plan = server.fault_plan
+                    self._send_json({
+                        "installed": plan is not None,
+                        "log": [list(entry) for entry in plan.log]
+                        if plan else []})
+                return True
 
             def _collection(self, path):
                 # /apis/group/version/[namespaces/ns/]plural[/name[/sub]]
@@ -61,11 +152,18 @@ class MiniAPIServer:
 
             def do_GET(self):
                 server.last_auth = self.headers.get("Authorization", "")
+                if self._handle_faults_admin("GET"):
+                    return
                 url = urlparse(self.path)
                 q = parse_qs(url.query)
                 plural, ns, name, _sub = self._collection(url.path)
                 if q.get("watch") == ["true"]:
+                    if self._fault_gate("watch", plural, ""):
+                        return
                     return self._serve_watch(plural)
+                if self._fault_gate("get" if name else "list",
+                                    plural, name):
+                    return
                 with server._lock:
                     if name:
                         obj = server.objects.get(f"{plural}/{ns}/{name}")
@@ -108,9 +206,13 @@ class MiniAPIServer:
             def do_POST(self):
                 n = int(self.headers.get("Content-Length", 0))
                 obj = json.loads(self.rfile.read(n))
+                if self._handle_faults_admin("POST", obj):
+                    return
                 url = urlparse(self.path)
                 plural, ns, _, _sub = self._collection(url.path)
                 name = obj["metadata"]["name"]
+                if self._fault_gate("create", plural, name):
+                    return
                 key = f"{plural}/{ns}/{name}"
                 with server._lock:
                     if key in server.objects:
@@ -134,6 +236,10 @@ class MiniAPIServer:
                 obj = json.loads(self.rfile.read(n))
                 url = urlparse(self.path)
                 plural, ns, name, sub = self._collection(url.path)
+                # subresource writes match rules as "<name>/status"
+                if self._fault_gate("update", plural,
+                                    f"{name}/{sub}" if sub else name):
+                    return
                 key = f"{plural}/{ns}/{name}"
                 with server._lock:
                     current = server.objects.get(key)
@@ -160,8 +266,12 @@ class MiniAPIServer:
                 return self._send_json(obj)
 
             def do_DELETE(self):
+                if self._handle_faults_admin("DELETE"):
+                    return
                 url = urlparse(self.path)
                 plural, ns, name, _sub = self._collection(url.path)
+                if self._fault_gate("delete", plural, name):
+                    return
                 key = f"{plural}/{ns}/{name}"
                 with server._lock:
                     obj = server.objects.pop(key, None)
@@ -170,7 +280,7 @@ class MiniAPIServer:
                 server.notify(plural, "DELETED", obj)
                 return self._send_json({"status": "Success"})
 
-        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.httpd = _QuietThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.url = (f"http://{self.httpd.server_address[0]}:"
                     f"{self.httpd.server_address[1]}")
         self._thread = threading.Thread(
@@ -188,6 +298,11 @@ class MiniAPIServer:
                     .encode())
             except OSError:
                 done.set()
+
+    def set_fault_plan(self, plan: FaultPlan | None):
+        """In-process twin of ``POST /faults`` (same plan object, so
+        the caller can assert on ``plan.log`` afterwards)."""
+        self.fault_plan = plan
 
     def drop_watchers(self):
         """Kill all live watch connections (API-server restart analog)."""

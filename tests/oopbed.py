@@ -129,9 +129,16 @@ class OOPBed:
                  node_name: str = "oop-node", verbosity: int = 1,
                  topos: dict[str, dict] | None = None,
                  with_controller: bool = False,
-                 plugin_env: dict[str, str] | None = None):
+                 plugin_env: dict[str, str] | None = None,
+                 plugin_fault_plan: dict | None = None):
         self.tmp = Path(tmp_path)
         self.plugin_env = dict(plugin_env or {})
+        if plugin_fault_plan is not None:
+            # scripted faults INSIDE the plugin binaries: API-call
+            # errors and crash windows (cluster/faults.py crashpoints)
+            plan_path = self.tmp / "fault_plan.json"
+            plan_path.write_text(json.dumps(plugin_fault_plan))
+            self.plugin_env["TPU_DRA_FAULT_PLAN"] = str(plan_path)
         if topos is None:
             topos = {node_name: dict(topo or {"generation": "v5e",
                                               "num_chips": 4})}
@@ -337,6 +344,29 @@ class OOPBed:
             time.sleep(0.05)
         raise TimeoutError(f"restarted plugin {name} never came up:\n"
                            + p.log_path.read_text()[-2000:])
+
+    # -- fault administration --------------------------------------------
+
+    def post_faults(self, plan: dict | None) -> None:
+        """Install (or, with None, clear) a wire-level fault plan on
+        the API server through its real ``/faults`` admin endpoint —
+        every subprocess in the gang sees the injected failures."""
+        import urllib.request
+        if plan is None:
+            req = urllib.request.Request(self.api.url + "/faults",
+                                         method="DELETE")
+        else:
+            req = urllib.request.Request(
+                self.api.url + "/faults", method="POST",
+                data=json.dumps(plan).encode(),
+                headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert json.loads(resp.read()).get("ok")
+
+    def clear_plugin_faults(self, node: str | None = None) -> None:
+        """Disarm the per-process plan so the NEXT plugin (re)start
+        comes up clean (the env file is read at boot)."""
+        self.plugin_env.pop("TPU_DRA_FAULT_PLAN", None)
 
     # -- the kubelet role ------------------------------------------------
 

@@ -1,0 +1,134 @@
+"""Chaos scenarios across REAL process boundaries.
+
+The in-process chaos tier (test_faults.py) proves the hardened paths;
+this module proves them where the reference's bugs would actually
+bite — real plugin binaries, a real HTTP API server, real crashes:
+
+- apiserver unreachable while the plugin binary boots (its own
+  FaultyClusterClient drops the publisher's calls) — the process stays
+  up and publishes once the outage ends;
+- a 429 storm injected at the WIRE (the miniapi ``POST /faults``
+  endpoint) while a prepare is in flight — the binary's REST client
+  absorbs it;
+- SIGKILL-equivalent crash (``os._exit``) scripted INSIDE the prepare
+  checkpoint window — the restarted process recovers idempotently from
+  its checkpoint;
+- a torn checkpoint on disk at restart — the previous generation
+  boots the plugin instead of bricking it.
+"""
+
+import json
+import urllib.request
+
+import grpc
+import pytest
+
+from k8s_dra_driver_tpu.api import resource
+from k8s_dra_driver_tpu.cluster.faults import CRASH_CHECKPOINT_SAVED
+
+from oopbed import OOPBed
+
+pytestmark = pytest.mark.faults
+
+
+def _claim(name):
+    return resource.ResourceClaim(
+        metadata=resource.ObjectMeta(name=name, namespace="default"),
+        spec=resource.ResourceClaimSpec(devices=resource.DeviceClaim(
+            requests=[resource.DeviceRequest(
+                name="r0", device_class_name="tpu.google.com", count=1)])))
+
+
+def test_plugin_boot_survives_apiserver_outage(tmp_path):
+    """The binary's first publications fail (scripted connection
+    drops); the process must come up anyway and publish from its
+    bounded retry queue — ``_await_ready`` inside the constructor IS
+    the assertion that publication eventually landed."""
+    bed = OOPBed(tmp_path, plugin_fault_plan={"rules": [
+        {"verb": "*", "kind": "ResourceSlice", "times": 2,
+         "error": "drop"}]})
+    try:
+        slices = bed.client.list("ResourceSlice")
+        assert slices, "plugin never published after the scripted outage"
+        # and the gRPC surface works end to end after recovery
+        c = bed.create_claim(_claim("chaos-boot"))
+        assert bed.run_pod(c).visible_chips
+        bed.teardown_claim(c)
+    finally:
+        bed.shutdown()
+
+
+def test_wire_level_429_storm_during_prepare(tmp_path):
+    """Throttling injected at the real HTTP layer mid-prepare: the
+    plugin's claim re-fetch sees genuine 429 responses with Retry-After
+    and still completes the prepare."""
+    from k8s_dra_driver_tpu.allocator import allocate_claim
+    bed = OOPBed(tmp_path)
+    try:
+        c = bed.create_claim(_claim("chaos-429"))
+        # allocate first so the only ResourceClaim GETs left are the
+        # plugin's own claim re-fetches — the storm hits the binary
+        allocate_claim(bed.client, c)
+        bed.post_faults({"rules": [
+            {"verb": "get", "kind": "ResourceClaim", "times": 2,
+             "error": "429", "retry_after_s": 0.05}]})
+        view = bed.run_pod(c)
+        assert view.visible_chips
+        log = json.loads(urllib.request.urlopen(
+            bed.api.url + "/faults", timeout=5).read())["log"]
+        injected = [e for e in log if e[3] == "429"]
+        assert len(injected) == 2, f"storm never hit the wire: {log}"
+        bed.post_faults(None)
+        bed.teardown_claim(c)
+    finally:
+        bed.shutdown()
+
+
+def test_crash_inside_prepare_checkpoint_window(tmp_path):
+    """The acceptance crash window: the plugin dies right after the
+    prepare's checkpoint save, before answering kubelet.  The restarted
+    process must treat the same claim as already prepared (checkpoint
+    idempotency across a real crash) and tear it down cleanly."""
+    bed = OOPBed(tmp_path, plugin_fault_plan={"rules": [
+        # skip the boot-time save of the empty checkpoint; crash on the
+        # save the first prepare performs
+        {"verb": CRASH_CHECKPOINT_SAVED, "skip": 1, "times": 1,
+         "error": "crash"}]})
+    try:
+        c = bed.create_claim(_claim("chaos-crash"))
+        with pytest.raises(grpc.RpcError):
+            bed.run_pod(c)                 # process dies mid-call
+        assert bed.plugins[bed.node].proc.wait(10) == 86  # scripted exit
+        bed.clear_plugin_faults()          # fresh process boots clean
+        bed.restart_plugin()
+        view = bed.run_pod(c)              # idempotent re-prepare
+        assert view.visible_chips
+        bed.teardown_claim(c)
+        # the chip is genuinely free again after the crash recovery
+        c2 = bed.create_claim(_claim("chaos-after-crash"))
+        assert bed.run_pod(c2).visible_chips
+        bed.teardown_claim(c2)
+    finally:
+        bed.shutdown()
+
+
+def test_torn_checkpoint_on_restart(tmp_path):
+    """A half-written checkpoint.json greets the restarting plugin; it
+    must boot from the previous generation instead of refusing to
+    start, and keep serving prepares."""
+    bed = OOPBed(tmp_path)
+    try:
+        c = bed.create_claim(_claim("chaos-torn"))
+        v1 = bed.run_pod(c)
+        ckpt = bed.plugins[bed.node].plugin_root / "checkpoint.json"
+        raw = ckpt.read_text()
+        bed.plugins[bed.node].proc.kill()
+        bed.plugins[bed.node].proc.wait(10)
+        ckpt.write_text(raw[:len(raw) // 2])   # torn write
+        bed.restart_plugin()                   # must not crash-loop
+        # previous generation predates the prepare: re-prepare succeeds
+        v2 = bed.run_pod(c)
+        assert v2.visible_chips == v1.visible_chips
+        bed.teardown_claim(c)
+    finally:
+        bed.shutdown()

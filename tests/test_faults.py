@@ -1,0 +1,627 @@
+"""Deterministic fault injection + control-plane hardening tests.
+
+The chaos tier the reference entirely lacks (its resilience story —
+checkpoint.go, device_state.go:94-190 — is exercised only by hand on
+kind clusters).  A seeded ``FaultPlan`` provokes apiserver outages,
+429/conflict storms, dropped connections, and torn checkpoints on
+demand, and these tests pin both halves of the contract: the injector
+replays identically, and the hardened client/driver paths survive what
+it throws — with every retry loop bounded by steps or a deadline.
+
+Run standalone with ``pytest -m faults``.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from k8s_dra_driver_tpu.api import resource
+from k8s_dra_driver_tpu.cluster import (ApiServerError, ApiUnavailableError,
+                                        ConflictError, FakeCluster,
+                                        FaultPlan, FaultRule,
+                                        FaultyClusterClient, NotFoundError)
+from k8s_dra_driver_tpu.cluster.rest import RestClusterClient
+from k8s_dra_driver_tpu.discovery import FakeHost
+from k8s_dra_driver_tpu.plugin import CheckpointManager, ChecksumError
+from k8s_dra_driver_tpu.devicemodel import PreparedClaim
+from k8s_dra_driver_tpu.utils.backoff import Backoff
+
+from miniapi import MiniAPIServer
+from testbed import E2EBed
+
+pytestmark = pytest.mark.faults
+
+
+def _slice(name="s1", node="n1"):
+    return resource.ResourceSlice(
+        metadata=resource.ObjectMeta(name=name),
+        driver="tpu.google.com",
+        pool=resource.ResourcePool(name="pool-a", generation=1),
+        node_name=node,
+        devices=[resource.Device(name="chip-0",
+                                 attributes={"type": "chip", "index": 0})])
+
+
+def _fast_backoff(**kw):
+    kw.setdefault("duration_s", 0.01)
+    kw.setdefault("factor", 1.5)
+    kw.setdefault("jitter", 0)
+    kw.setdefault("steps", 4)
+    kw.setdefault("cap_s", 0.05)
+    kw.setdefault("deadline_s", 10.0)
+    return Backoff(**kw)
+
+
+@pytest.fixture()
+def api():
+    server = MiniAPIServer()
+    server.start()
+    yield server
+    server.stop()
+
+
+@pytest.fixture()
+def client(api):
+    c = RestClusterClient(api.url, auth={}, qps=0, burst=1,
+                          retry_backoff=_fast_backoff())
+    yield c
+    c.close()
+
+
+# --------------------------------------------------------------------------
+# Backoff bounds (satellite: deadline_s)
+# --------------------------------------------------------------------------
+
+class TestBackoffBounds:
+    def test_poll_bounded_by_steps(self):
+        calls = []
+        b = Backoff(duration_s=0.001, jitter=0, steps=3)
+        assert not b.poll(lambda: calls.append(1) and False,
+                          sleep=lambda s: None)
+        assert len(calls) == 4          # initial try + one per step
+
+    def test_poll_bounded_by_deadline(self):
+        clock = [0.0]
+        sleeps = []
+
+        def sleep(s):
+            sleeps.append(s)
+            clock[0] += s
+
+        b = Backoff(duration_s=1.0, factor=1.0, jitter=0, steps=1000,
+                    cap_s=1.0, deadline_s=3.5)
+        assert not b.poll(lambda: False, sleep=sleep,
+                          clock=lambda: clock[0])
+        # the deadline cut the loop long before 1000 steps, and no
+        # sleep overshot the remaining budget
+        assert len(sleeps) == 4 and sum(sleeps) <= 3.5 + 1e-9
+
+    def test_poll_succeeds_within_bounds(self):
+        state = {"n": 0}
+
+        def fn():
+            state["n"] += 1
+            return state["n"] >= 3
+
+        b = Backoff(duration_s=0.001, jitter=0, steps=5)
+        assert b.poll(fn, sleep=lambda s: None)
+        assert state["n"] == 3
+
+
+# --------------------------------------------------------------------------
+# the FaultPlan itself
+# --------------------------------------------------------------------------
+
+class TestFaultPlanDeterminism:
+    RULES = [
+        {"verb": "create", "kind": "ResourceSlice", "times": 2,
+         "error": "429", "retry_after_s": 0.01},
+        {"verb": "update", "kind": "*", "probability": 0.5, "times": -1,
+         "error": "conflict"},
+        {"verb": "get", "kind": "Node", "skip": 1, "times": 1,
+         "error": "drop"},
+    ]
+
+    def _run_script(self, seed):
+        """A fixed call sequence against a fresh plan + cluster;
+        returns (driver-visible outcomes, injection log)."""
+        plan = FaultPlan.from_json({"seed": seed, "rules": self.RULES})
+        client = FaultyClusterClient(FakeCluster(), plan,
+                                     sleep=lambda s: None)
+        outcomes = []
+
+        def step(fn):
+            try:
+                fn()
+                outcomes.append("ok")
+            except Exception as e:
+                outcomes.append(type(e).__name__)
+
+        from k8s_dra_driver_tpu.cluster.objects import Node
+        node = Node(metadata=resource.ObjectMeta(name="n1"))
+        step(lambda: client.create(node))
+        for i in range(4):
+            step(lambda: client.create(_slice(name=f"s{i}")))
+        for _ in range(6):
+            step(lambda: client.update(node))
+        for _ in range(3):
+            step(lambda: client.get("Node", "", "n1"))
+        step(lambda: client.list("ResourceSlice"))
+        return outcomes, list(plan.log)
+
+    def test_seeded_plan_replays_identically(self):
+        first = self._run_script(seed=7)
+        second = self._run_script(seed=7)
+        assert first == second
+        # and the probabilistic rule actually fired both ways, so the
+        # equality above is not vacuous
+        outcomes = first[0]
+        assert "ConflictError" in outcomes and "ok" in outcomes[5:11]
+
+    def test_different_seed_differs(self):
+        # seeds chosen so the 0.5-probability rule draws differently
+        assert self._run_script(seed=7)[1] != self._run_script(seed=8)[1]
+
+    def test_plan_json_roundtrip(self):
+        plan = FaultPlan.from_json({"seed": 3, "rules": self.RULES})
+        again = FaultPlan.from_json(json.dumps(plan.to_json()))
+        assert again.to_json() == plan.to_json()
+
+    def test_unknown_error_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault error"):
+            FaultRule(error="teapot")
+
+
+class TestFaultyClusterClient:
+    def _client(self, rules, seed=0):
+        plan = FaultPlan([FaultRule(**r) for r in rules], seed=seed)
+        return FaultyClusterClient(FakeCluster(), plan,
+                                   sleep=lambda s: None), plan
+
+    def test_error_mapping(self):
+        client, _ = self._client([
+            {"verb": "create", "error": "429", "retry_after_s": 2.0},
+            {"verb": "get", "error": "notfound"},
+            {"verb": "list", "error": "503"},
+            {"verb": "delete", "error": "drop"},
+        ])
+        with pytest.raises(ApiServerError) as exc:
+            client.create(_slice())
+        assert exc.value.status == 429 and exc.value.retry_after_s == 2.0
+        with pytest.raises(NotFoundError):
+            client.get("ResourceSlice", "", "s1")
+        with pytest.raises(ApiServerError) as exc:
+            client.list("ResourceSlice")
+        assert exc.value.status == 503
+        with pytest.raises(ApiUnavailableError):
+            client.delete("ResourceSlice", "", "s1")
+
+    def test_skip_and_times_window(self):
+        client, _ = self._client([
+            {"verb": "create", "kind": "ResourceSlice", "skip": 1,
+             "times": 2, "error": "500"},
+        ])
+        client.create(_slice(name="a"))          # skipped: passes
+        for name in ("b", "c"):
+            with pytest.raises(ApiServerError):
+                client.create(_slice(name=name))
+        client.create(_slice(name="d"))          # window exhausted
+        assert {s.metadata.name
+                for s in client.list("ResourceSlice")} == {"a", "d"}
+
+    def test_latency_injection(self):
+        slept = []
+        plan = FaultPlan([FaultRule(verb="get", latency_s=0.5, times=1)])
+        client = FaultyClusterClient(FakeCluster(), plan,
+                                     sleep=slept.append)
+        with pytest.raises(NotFoundError):   # from the empty backend
+            client.get("Node", "", "missing")
+        assert slept == [0.5]
+
+    def test_pass_through_preserves_backend(self):
+        client, plan = self._client([])
+        created = client.create(_slice())
+        assert client.get("ResourceSlice", "",
+                          "s1").metadata.name == created.metadata.name
+        assert [e[3] for e in plan.log] == ["pass", "pass"]
+
+
+# --------------------------------------------------------------------------
+# hardened REST client against wire-level injection (miniapi /faults)
+# --------------------------------------------------------------------------
+
+class TestRestRetries:
+    def test_get_retries_transient_500(self, api, client):
+        client.create(_slice())
+        plan = FaultPlan([FaultRule(verb="get", kind="ResourceSlice",
+                                    times=2, error="500")])
+        api.set_fault_plan(plan)
+        got = client.get("ResourceSlice", "", "s1")
+        assert got.metadata.name == "s1"
+        assert [e[3] for e in plan.log] == ["500", "500", "pass"]
+
+    def test_429_storm_during_publish(self, api, client):
+        """The acceptance scenario: a publish fans out list+create, the
+        server answers 429 with Retry-After, and publication still
+        lands."""
+        from k8s_dra_driver_tpu.plugin.publisher import (PoolSpec,
+                                                         ResourceSlicePublisher)
+        plan = FaultPlan([
+            FaultRule(verb="create", kind="ResourceSlice", times=2,
+                      error="429", retry_after_s=0.01),
+            FaultRule(verb="list", kind="ResourceSlice", times=1,
+                      error="429", retry_after_s=0.01),
+        ])
+        api.set_fault_plan(plan)
+        pub = ResourceSlicePublisher(client, "tpu.google.com",
+                                     owner_id="node-n1")
+        pub.publish([PoolSpec(name="n1", devices=[resource.Device(
+            name="chip-0", attributes={"type": "chip"})],
+            node_name="n1")])
+        published = client.list("ResourceSlice")
+        assert len(published) == 1
+        assert [e for e in plan.log if e[3] == "429"], "nothing injected"
+
+    def test_retries_are_bounded_by_steps(self, api, client):
+        client.create(_slice())
+        plan = FaultPlan([FaultRule(verb="get", times=-1, error="503")])
+        api.set_fault_plan(plan)
+        with pytest.raises(ApiServerError) as exc:
+            client.get("ResourceSlice", "", "s1")
+        assert exc.value.status == 503
+        # initial try + one per backoff step, not one request more
+        assert len(plan.log) == client.retry_backoff.steps + 1
+
+    def test_retries_are_bounded_by_deadline(self, api):
+        c = RestClusterClient(
+            api.url, auth={}, qps=0, burst=1,
+            retry_backoff=_fast_backoff(duration_s=0.2, steps=1000,
+                                        cap_s=0.2, deadline_s=0.3))
+        plan = FaultPlan([FaultRule(verb="list", times=-1, error="500")])
+        api.set_fault_plan(plan)
+        start = time.monotonic()
+        with pytest.raises(ApiServerError):
+            c.list("ResourceSlice")
+        assert time.monotonic() - start < 2.0
+        assert len(plan.log) < 10
+        c.close()
+
+    def test_retry_after_is_honored(self, api, client):
+        client.create(_slice())
+        plan = FaultPlan([FaultRule(verb="get", times=1, error="429",
+                                    retry_after_s=0.3)])
+        api.set_fault_plan(plan)
+        start = time.monotonic()
+        client.get("ResourceSlice", "", "s1")
+        # our own backoff steps are ~10ms; the wait came from the header
+        assert time.monotonic() - start >= 0.25
+
+    def test_post_does_not_retry_500(self, api, client):
+        plan = FaultPlan([FaultRule(verb="create", times=-1, error="500")])
+        api.set_fault_plan(plan)
+        with pytest.raises(ApiServerError):
+            client.create(_slice())
+        assert len(plan.log) == 1, "a 500 POST must not be re-sent"
+
+    def test_get_retries_dropped_connection(self, api, client):
+        client.create(_slice())
+        plan = FaultPlan([FaultRule(verb="get", times=2, error="drop")])
+        api.set_fault_plan(plan)
+        assert client.get("ResourceSlice", "", "s1").metadata.name == "s1"
+
+    def test_faults_admin_endpoint_over_the_wire(self, api, client):
+        """POST /faults installs, GET /faults exposes the log, DELETE
+        disarms — the path subprocess beds use."""
+        import urllib.request
+        plan_json = {"seed": 0, "rules": [
+            {"verb": "get", "kind": "ResourceSlice", "times": 1,
+             "error": "503"}]}
+        req = urllib.request.Request(
+            api.url + "/faults", method="POST",
+            data=json.dumps(plan_json).encode())
+        assert json.loads(urllib.request.urlopen(req).read())["ok"]
+        client.create(_slice())
+        client.get("ResourceSlice", "", "s1")     # 503 absorbed by retry
+        log = json.loads(urllib.request.urlopen(
+            api.url + "/faults").read())["log"]
+        assert ["get", "ResourceSlice", "s1", "503"] in log
+        req = urllib.request.Request(api.url + "/faults", method="DELETE")
+        assert json.loads(urllib.request.urlopen(req).read())["ok"]
+        assert api.fault_plan is None
+
+
+class TestConflictHandling:
+    def _make_claim(self, api):
+        api.objects["resourceclaims/ns1/c1"] = {
+            "metadata": {"name": "c1", "namespace": "ns1", "uid": "u-1",
+                         "resourceVersion": "3"},
+            "spec": {"devices": {"requests": [{"name": "tpu"}]}},
+        }
+
+    def _allocated(self, client):
+        claim = client.get("ResourceClaim", "ns1", "c1")
+        claim.status = resource.ResourceClaimStatus(
+            allocation=resource.AllocationResult(
+                results=[resource.DeviceRequestAllocationResult(
+                    request="tpu", driver="tpu.google.com",
+                    pool="n1", device="chip-0")]))
+        return claim
+
+    def test_conflict_storm_on_claim_update(self, api, client):
+        self._make_claim(api)
+        claim = self._allocated(client)
+        plan = FaultPlan([FaultRule(verb="update", kind="ResourceClaim",
+                                    name="c1", times=3, error="conflict")])
+        api.set_fault_plan(plan)
+        client.update(claim)
+        stored = api.objects["resourceclaims/ns1/c1"]
+        assert stored["status"]["allocation"]["results"][0]["device"] == \
+            "chip-0"
+
+    def test_conflict_storm_on_status_subresource(self, api, client):
+        """Satellite: a failure after the main PUT must not leave the
+        claim half-written — the status write retries with a fresh
+        resourceVersion."""
+        self._make_claim(api)
+        claim = self._allocated(client)
+        plan = FaultPlan([FaultRule(verb="update", kind="ResourceClaim",
+                                    name="c1/status", times=2,
+                                    error="conflict")])
+        api.set_fault_plan(plan)
+        client.update(claim)
+        stored = api.objects["resourceclaims/ns1/c1"]
+        assert stored["status"]["allocation"]["results"][0]["device"] == \
+            "chip-0"
+
+    def test_persistent_conflict_is_bounded(self, api, client):
+        self._make_claim(api)
+        claim = self._allocated(client)
+        plan = FaultPlan([FaultRule(verb="update", kind="ResourceClaim",
+                                    name="c1", times=-1,
+                                    error="conflict")])
+        api.set_fault_plan(plan)
+        with pytest.raises(ConflictError, match="still conflicting"):
+            client.update(claim)
+        injected = [e for e in plan.log if e[3] == "conflict"]
+        assert len(injected) == client.conflict_retries + 1
+
+    def test_persistent_status_conflict_surfaces_half_write(
+            self, api, client):
+        self._make_claim(api)
+        claim = self._allocated(client)
+        plan = FaultPlan([FaultRule(verb="update", kind="ResourceClaim",
+                                    name="c1/status", times=-1,
+                                    error="conflict")])
+        api.set_fault_plan(plan)
+        with pytest.raises(ApiServerError, match="half-written"):
+            client.update(claim)
+
+    def test_apply_does_not_mutate_caller(self, api, client):
+        """Satellite: a retried apply must not see a zeroed
+        resourceVersion planted into shared state by a previous try."""
+        client.create(_slice())
+        s2 = _slice()
+        s2.metadata.resource_version = 17
+        s2.devices[0].attributes["index"] = 9
+        client.apply(s2)
+        assert s2.metadata.resource_version == 17
+        assert client.get("ResourceSlice", "",
+                          "s1").devices[0].attributes["index"] == 9
+
+    def test_update_does_not_mutate_caller_on_conflict(self, api, client):
+        self._make_claim(api)
+        claim = self._allocated(client)
+        claim.metadata.resource_version = 3
+        plan = FaultPlan([FaultRule(verb="update", kind="ResourceClaim",
+                                    name="c1", times=2,
+                                    error="conflict")])
+        api.set_fault_plan(plan)
+        client.update(claim)
+        assert claim.metadata.resource_version == 3
+
+
+# --------------------------------------------------------------------------
+# driver-level outage behavior (in-process bed + fault plan)
+# --------------------------------------------------------------------------
+
+class TestDriverOutage:
+    def test_apiserver_outage_at_boot_queues_publication(self, tmp_path):
+        """Acceptance scenario: the apiserver is down when the plugin
+        boots.  Driver.start() must come up anyway (gRPC sockets live),
+        queue publication behind backoff, and publish once the outage
+        ends."""
+        plan = FaultPlan([
+            FaultRule(verb="*", kind="ResourceSlice", times=5,
+                      error="drop"),
+        ])
+        bed = E2EBed(tmp_path, [FakeHost()], with_controller=False,
+                     fault_plan=plan)
+        try:
+            driver = bed.drivers["tpu-host-0"]
+            assert driver.plugin_socket.exists()
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if bed.cluster.list("ResourceSlice") \
+                        and not driver.publish_pending:
+                    break
+                time.sleep(0.02)
+            assert bed.cluster.list("ResourceSlice"), \
+                "publication never recovered from the boot outage"
+            assert not driver.publish_pending
+            dropped = [e for e in plan.log if e[3] == "drop"]
+            assert len(dropped) == 5
+        finally:
+            bed.shutdown()
+
+    def test_publish_retry_is_bounded(self, tmp_path):
+        """A permanently-dead apiserver must not spin the retry thread
+        forever: the bounded backoff gives up (flag stays pending for
+        the health monitor's periodic reconcile)."""
+        from k8s_dra_driver_tpu.plugin import (DeviceState,
+                                               DeviceStateConfig, Driver)
+        plan = FaultPlan([FaultRule(times=-1, error="drop")])
+        backend = FakeHost().materialize(tmp_path / "host")
+        cluster = FakeCluster()
+        faulty = FaultyClusterClient(cluster, plan, sleep=lambda s: None)
+        state = DeviceState(backend, faulty, DeviceStateConfig(
+            plugin_root=str(tmp_path / "plugin"),
+            cdi_root=str(tmp_path / "cdi"), node_name="tpu-host-0"))
+        steps = 3
+        driver = Driver(state, faulty, plugin_dir=str(tmp_path / "plugin"),
+                        publish_backoff=Backoff(
+                            duration_s=0.01, jitter=0, steps=steps,
+                            cap_s=0.01, deadline_s=5.0))
+        driver.start()
+        try:
+            assert driver._publish_thread is not None
+            driver._publish_thread.join(timeout=10)
+            assert not driver._publish_thread.is_alive(), \
+                "publish retry thread never terminated"
+            assert driver.publish_pending
+            # publish opens with a ResourceSlice list: boot attempt +
+            # initial poll try + one per backoff step
+            attempts = [e for e in plan.log if e[0] == "list"]
+            assert len(attempts) == steps + 2
+        finally:
+            driver.shutdown()
+
+    def test_health_monitor_picks_up_pending_publication(self, tmp_path):
+        """After the bounded boot retry gives up, the periodic health
+        monitor owns the republish (the extended _publish_pending
+        pattern)."""
+        from k8s_dra_driver_tpu.plugin import (DeviceState,
+                                               DeviceStateConfig, Driver)
+        from k8s_dra_driver_tpu.plugin.health import HealthMonitor
+        plan = FaultPlan([FaultRule(times=-1, error="drop")])
+        backend = FakeHost().materialize(tmp_path / "host")
+        cluster = FakeCluster()
+        faulty = FaultyClusterClient(cluster, plan, sleep=lambda s: None)
+        state = DeviceState(backend, faulty, DeviceStateConfig(
+            plugin_root=str(tmp_path / "plugin"),
+            cdi_root=str(tmp_path / "cdi"), node_name="tpu-host-0"))
+        driver = Driver(state, faulty, plugin_dir=str(tmp_path / "plugin"),
+                        publish_backoff=Backoff(
+                            duration_s=0.001, jitter=0, steps=1,
+                            cap_s=0.001, deadline_s=5.0))
+        driver.start()
+        try:
+            driver._publish_thread.join(timeout=10)
+            assert driver.publish_pending
+            # outage "ends": stop injecting
+            plan.rules[0].times = 0
+            monitor = HealthMonitor(driver, backend, interval=0)
+            assert monitor.check_once(), \
+                "monitor ignored the pending publication"
+            assert not driver.publish_pending
+            assert cluster.list("ResourceSlice")
+        finally:
+            driver.shutdown()
+
+
+class TestWatchGapRelist:
+    def test_deletion_during_injected_watch_gap(self, api, client):
+        """Acceptance scenario: the watch connection is torn down by
+        the fault plan, the object vanishes during the gap, and the
+        reconnecting relist synthesizes exactly one DELETED."""
+        client.create(_slice(name="doomed"))
+        events = []
+        saw = threading.Event()
+        deleted = threading.Event()
+
+        def handler(etype, obj):
+            if obj.metadata.name == "doomed":
+                events.append(etype)
+                (saw if etype == "ADDED" else deleted).set()
+
+        unsub = client.watch("ResourceSlice", handler)
+        assert saw.wait(5)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not api.watchers:
+            time.sleep(0.02)
+        assert api.watchers, "watch stream never connected"
+        # script the gap: reconnect attempts get dropped at the wire
+        plan = FaultPlan([FaultRule(verb="watch", kind="ResourceSlice",
+                                    times=1, error="drop")])
+        api.set_fault_plan(plan)
+        api.drop_watchers()
+        with api._lock:
+            del api.objects["resourceslices//doomed"]
+        assert deleted.wait(15), f"no synthesized DELETED: {events}"
+        assert events.count("DELETED") == 1
+        unsub()
+
+
+# --------------------------------------------------------------------------
+# checkpoint corruption recovery (satellite: previous generation)
+# --------------------------------------------------------------------------
+
+class TestCheckpointRecovery:
+    def _prepared(self, uid):
+        return {uid: PreparedClaim(claim_uid=uid, claim_namespace="d",
+                                   claim_name=f"claim-{uid}")}
+
+    def test_truncated_file_falls_back_to_previous(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(self._prepared("u1"))
+        mgr.save({**self._prepared("u1"), **self._prepared("u2")})
+        raw = mgr.path.read_text()
+        mgr.path.write_text(raw[:len(raw) // 2])        # torn write
+        recovered = CheckpointManager(str(tmp_path)).load()
+        assert set(recovered) == {"u1"}                 # previous gen
+
+    def test_bad_checksum_falls_back_to_previous(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(self._prepared("u1"))
+        mgr.save(self._prepared("u2"))
+        data = json.loads(mgr.path.read_text())
+        data["v1"]["preparedClaims"]["evil"] = {"claimUid": "evil"}
+        mgr.path.write_text(json.dumps(data))           # checksum broken
+        recovered = CheckpointManager(str(tmp_path)).load()
+        assert set(recovered) == {"u1"}
+
+    def test_crash_between_tmp_write_and_replace(self, tmp_path):
+        """A crash after rotating current->prev but before tmp->current
+        leaves no checkpoint.json at all; the previous generation still
+        restores the node."""
+        import os
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(self._prepared("u1"))
+        # simulate the torn save: rotation happened, final rename didn't
+        os.replace(mgr.path, mgr.prev_path)
+        recovered = CheckpointManager(str(tmp_path)).load()
+        assert set(recovered) == {"u1"}
+
+    def test_both_generations_corrupt_raises(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(self._prepared("u1"))
+        mgr.save(self._prepared("u2"))
+        mgr.path.write_text("garbage")
+        mgr.prev_path.write_text("also garbage")
+        with pytest.raises(ChecksumError, match="no previous generation"):
+            CheckpointManager(str(tmp_path)).load()
+
+    def test_torn_checkpoint_on_device_state_restart(self, tmp_path):
+        """Acceptance scenario end-to-end: prepare, tear the
+        checkpoint, restart the node-side state machine — it boots from
+        the previous generation instead of refusing to start, and the
+        claim is re-preparable."""
+        from k8s_dra_driver_tpu.plugin import DeviceState, DeviceStateConfig
+        from helpers import make_allocated_claim
+        backend = FakeHost().materialize(tmp_path / "host")
+        cluster = FakeCluster()
+        cfg = DeviceStateConfig(plugin_root=str(tmp_path / "plugin"),
+                                cdi_root=str(tmp_path / "cdi"),
+                                node_name="tpu-host-0")
+        state = DeviceState(backend, cluster, cfg)
+        claim = make_allocated_claim("c1", [("r0", "chip-0")])
+        state.prepare(claim)
+        ckpt = state.checkpoints.path
+        ckpt.write_text(ckpt.read_text()[:40])          # torn
+        state2 = DeviceState(backend, cluster, cfg)     # must not raise
+        # previous generation predates the prepare: the claim is gone
+        # from memory but the node is alive and re-prepares cleanly
+        prepared = state2.prepare(claim)
+        assert prepared.devices[0].device_name == "chip-0"
+        state2.unprepare(claim.metadata.uid)

@@ -20,11 +20,13 @@ import grpc
 from k8s_dra_driver_tpu.api import resource
 from k8s_dra_driver_tpu.api.classes import standard_device_classes
 from k8s_dra_driver_tpu.allocator import allocate_claim
-from k8s_dra_driver_tpu.cluster import FakeCluster, Node
+from k8s_dra_driver_tpu.cluster import (FakeCluster, FaultPlan,
+                                        FaultyClusterClient, Node)
 from k8s_dra_driver_tpu.controller import SliceGangController
 from k8s_dra_driver_tpu.discovery import FakeHost
 from k8s_dra_driver_tpu.plugin import (DeviceState, DeviceStateConfig, Driver)
 from k8s_dra_driver_tpu.proto import DRAPluginStub, dra_pb2
+from k8s_dra_driver_tpu.utils.backoff import Backoff
 
 from helpers import start_fake_deployment_controller
 
@@ -79,9 +81,17 @@ def apply_cdi(cdi_root: Path, cdi_device_ids: list[str]) -> PodView:
 
 class E2EBed:
     def __init__(self, tmp_path: Path, hosts: list[FakeHost],
-                 with_controller: bool = True):
+                 with_controller: bool = True,
+                 fault_plan: FaultPlan | None = None):
         self.tmp = Path(tmp_path)
         self.cluster = FakeCluster()
+        # Driver/controller API calls route through the fault plan when
+        # one is given; the bed's own admin calls (node/class/claim
+        # setup below) always use the raw cluster so a scripted outage
+        # breaks the system under test, not the test harness.
+        self.fault_plan = fault_plan
+        self.client = (FaultyClusterClient(self.cluster, fault_plan)
+                       if fault_plan is not None else self.cluster)
         start_fake_deployment_controller(self.cluster)
         self.classes = standard_device_classes()
         for cls in self.classes.values():
@@ -90,7 +100,7 @@ class E2EBed:
         self.hosts: dict[str, FakeHost] = {}
         self.controller = None
         if with_controller:
-            self.controller = SliceGangController(self.cluster,
+            self.controller = SliceGangController(self.client,
                                                   retry_delay_s=0.01)
             self.controller.start()
         for host in hosts:
@@ -102,13 +112,16 @@ class E2EBed:
         the identically-configured stack."""
         name = host.hostname
         backend = host.materialize(self.tmp / "hosts" / name)
-        state = DeviceState(backend, self.cluster, DeviceStateConfig(
+        state = DeviceState(backend, self.client, DeviceStateConfig(
             plugin_root=str(self.tmp / "plugin" / name),
             cdi_root=str(self.tmp / "cdi" / name),
             node_name=name,
             coordinator_image="registry.local/tpu-dra-driver:test"))
-        driver = Driver(state, self.cluster,
-                        plugin_dir=str(self.tmp / "plugin" / name))
+        driver = Driver(state, self.client,
+                        plugin_dir=str(self.tmp / "plugin" / name),
+                        publish_backoff=Backoff(
+                            duration_s=0.01, factor=2.0, jitter=0,
+                            steps=10, cap_s=0.1, deadline_s=10.0))
         driver.start()
         self.drivers[name] = driver
         return driver
@@ -131,7 +144,7 @@ class E2EBed:
         slices, imex.go:308-326 analog; the new instance re-publishes)."""
         assert self.controller is not None
         self.controller.stop()
-        self.controller = SliceGangController(self.cluster,
+        self.controller = SliceGangController(self.client,
                                               retry_delay_s=0.01)
         self.controller.start()
 
