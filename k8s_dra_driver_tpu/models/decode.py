@@ -33,6 +33,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
+from ..utils import dispatch
 from ..utils.flags import env_flag
 from .quant import ein, take_rows
 from .transformer import (Params, TransformerConfig, _dense_mlp, _moe_mlp,
@@ -131,6 +132,28 @@ def _kernel_cached_attention(q, k_cache, v_cache, pos, t, cfg,
     return out.astype(q.dtype)
 
 
+def _use_kv_kernel(pos) -> bool:
+    """OPT-IN gate for the int8-cache pallas flash-read path
+    (``_kernel_cached_attention``), the KV twin of
+    ``models/quant.py:_use_kernel`` and under the same discipline:
+    default OFF, ``TPU_KV_KERNEL=1`` enables (``0``/``""``/``false``
+    disable, read at TRACE time — flipping it later does not retrace
+    cached executables; fresh process per setting, as
+    tools/bench_int8.py does).
+
+    The artifact that justifies the gate: the r05 idle-machine capture
+    records the flash-read path at **0.188x** the bf16 baseline at
+    154M (tools/int8_decode_v5e.json ``int8_kv8_kernel`` — 2.87
+    ms/token where the XLA dequant path runs 0.44), a catastrophic
+    regression, while XLA's fused int8 read wins every clean capture.
+    ``TPU_QUANT_KERNEL=1`` (the weight-kernel opt-in) deliberately
+    does NOT enable this path: the two kernels fail independently and
+    a user opting into one must not silently get the other's 5x
+    slowdown.  The kernel also takes one scalar q_offset, so per-row
+    positions (continuous batching) always use the XLA path."""
+    return env_flag("TPU_KV_KERNEL") and jnp.ndim(pos) == 0
+
+
 def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
                       k_scale=None, v_scale=None):
     """q [B,T,H,D] at absolute positions pos..pos+T-1 against the full
@@ -163,10 +186,7 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
     read beating it (the weight-quant lesson, models/quant.py
     _use_kernel).
     """
-    if (k_scale is not None and env_flag("TPU_KV_KERNEL")
-            and jnp.ndim(pos) == 0):
-        # the kernel takes one scalar q_offset; per-row positions
-        # (continuous batching) use the XLA path
+    if k_scale is not None and _use_kv_kernel(pos):
         return _kernel_cached_attention(q, k_cache, v_cache, pos, t,
                                         cfg, k_scale, v_scale)
     if k_scale is not None:
@@ -268,6 +288,7 @@ def forward_with_cache(params: Params, tokens: jax.Array,
                            v_scale=new_vs if quantized else None)
 
 
+@dispatch.counted("prefill")
 @functools.partial(jax.jit, static_argnames=("cfg", "first_chunk"))
 def _prefill_jit(params, tokens, cfg, cache, first_chunk):
     return forward_with_cache(params, tokens, cfg, cache,
@@ -287,6 +308,7 @@ def prefill(params: Params, tokens: jax.Array, cfg: TransformerConfig,
     return _prefill_jit(params, tokens, cfg, cache, first_chunk)
 
 
+@dispatch.counted("decode_step")
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
 def decode_step(params: Params, token: jax.Array, cfg: TransformerConfig,
                 cache: KVCache) -> tuple[jax.Array, KVCache]:
@@ -394,6 +416,7 @@ def _rows_forward(params: Params, tokens: jax.Array,
     return logits, cache
 
 
+@dispatch.counted("decode_step_rows")
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
 def decode_step_rows(params: Params, token: jax.Array,
                      cfg: TransformerConfig, cache: KVCache,
@@ -414,6 +437,7 @@ def decode_step_rows(params: Params, token: jax.Array,
     return logits[:, 0], cache
 
 
+@dispatch.counted("decode_window_rows")
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
 def decode_window_rows(params: Params, tokens: jax.Array,
                        cfg: TransformerConfig, cache: KVCache,
@@ -432,6 +456,7 @@ def decode_window_rows(params: Params, tokens: jax.Array,
     return logits, cache
 
 
+@dispatch.counted("draft_propose_rows")
 @functools.partial(jax.jit, static_argnames=("cfg", "k"),
                    donate_argnums=(3,))
 def draft_propose_rows(params: Params, last: jax.Array,
@@ -481,6 +506,7 @@ def select_next_tokens(logits, keys, temps, top_k: int = 0,
     return nxt, new_keys
 
 
+@dispatch.counted("prefill_adopt_rows")
 @functools.partial(jax.jit, static_argnames=("cfg", "max_seq", "top_k",
                                              "top_p"),
                    donate_argnums=(3,))
@@ -546,6 +572,7 @@ def adopt_one_slot(cache: KVCache, one: KVCache, slot) -> KVCache:
             d, s[0], slot, 0) for d, s in zip(dst, src)])
 
 
+@dispatch.counted("suffix_fill_adopt")
 @functools.partial(jax.jit, static_argnames=("cfg", "top_k", "top_p"),
                    donate_argnums=(4,))
 def suffix_fill_adopt(params: Params, entry: KVCache,
@@ -579,45 +606,85 @@ def suffix_fill_adopt(params: Params, entry: KVCache,
     return first[0], cache, carry[0], one
 
 
+@dispatch.counted("decode_fused_rows")
 @functools.partial(jax.jit, static_argnames=("cfg", "k", "top_k",
                                              "top_p"),
                    donate_argnums=(3,))
-def decode_chain_rows(params: Params, last: jax.Array,
+def decode_fused_rows(params: Params, last: jax.Array,
                       cfg: TransformerConfig, cache: KVCache,
                       pos_rows: jax.Array, k: int, keys: jax.Array,
-                      temps: jax.Array, top_k: int = 0,
+                      temps: jax.Array, budget: jax.Array,
+                      eos: jax.Array, top_k: int = 0,
                       top_p: float = 0.0
-                      ) -> tuple[jax.Array, KVCache, jax.Array]:
-    """``k`` consecutive per-row decode steps in ONE dispatch: a
-    ``lax.scan`` over the ``decode_step_rows`` body, so the host pays
-    one round-trip per k tokens-per-slot instead of per token — the
-    dispatch-amortization lever for continuous batching on
-    high-latency (tunneled/remote) backends, where per-step RTT
-    dominates the compiled step time ~300x (BENCH_r04 serving vs
-    decode probes).
+                      ) -> tuple[jax.Array, jax.Array, KVCache,
+                                 jax.Array]:
+    """The on-device generation block: up to ``k`` per-row decode
+    steps in ONE dispatch — a donated-buffer ``lax.while_loop`` that
+    performs sampling, KV-cache update, per-row EOS/length stop
+    detection, and the active-row mask entirely on device.  The host
+    pays one launch + one readback per BLOCK of up to ``k *
+    active_rows`` tokens instead of per token — the dispatch lever
+    for continuous batching on high-latency (tunneled/remote)
+    backends, where per-step RTT dominates the compiled step time
+    ~300x (BENCH_r05: 0.45 ms dispatch of every 0.80 ms wall step).
 
-    Greedy rows take argmax; sampled rows (``temps`` > 0) draw
-    through the same per-row filter/key-stream advance as the
-    engine's per-step ``_next_tokens`` (split, sample split[1], carry
-    split[0]; greedy rows leave their key untouched) — so a chained
-    drain emits byte-identical tokens to the step-at-a-time engine,
-    and the host just checks finish flags every k steps, discarding
-    any overshoot past eos/max_new (per-row continuations are
-    independent, so a discarded tail never affects the kept prefix).
-    Returns (tokens [B, k], cache, new keys)."""
-    def step(carry, _):
-        tok, cache, pos, keys = carry
-        logits, cache = _rows_forward(params, tok[:, None], cfg,
+    Per-row stop state rides as DATA: ``budget`` [B] is how many
+    tokens each row may still emit (0 marks an inactive slot — it is
+    frozen from step zero), ``eos`` [B] is each row's stop token (-1
+    = none).  A finished row freezes: its position stops advancing,
+    its ``last`` token and PRNG key stop updating, and its K/V write
+    lands harmlessly at its frozen (already-past-the-end, in-bounds)
+    slot, masked from every live query by position — so, unlike the
+    scan-based chain this replaces, no scratch-margin rows are ever
+    consumed past the finish line and the engine needs NO capacity
+    margin.  The loop exits as soon as every row is done, so a block
+    never pays compute for steps nobody needs.
+
+    Greedy rows take argmax; sampled rows draw through the shared
+    ``select_next_tokens`` merge (split, sample split[1], carry
+    split[0]) — byte-identical tokens to the step-at-a-time engine
+    by construction.
+
+    Returns ``(packed [B, k+1], rows_finished scalar, cache, keys)``:
+    ``packed[:, :k]`` is the token block (entries past a row's count
+    are padding), ``packed[:, k]`` each row's emitted count — ONE
+    int32 array so the host needs one transfer; the scalar
+    ``rows_finished`` is the readback the host syncs on (scalar
+    readback is the only reliable sync on remote-relay PJRT backends,
+    see ops/collectives.py)."""
+    b = last.shape[0]
+
+    def cond(carry):
+        j, done = carry[0], carry[1]
+        return (j < k) & ~jnp.all(done)
+
+    def body(carry):
+        j, done, last, cache, pos, keys, emitted, toks = carry
+        logits, cache = _rows_forward(params, last[:, None], cfg,
                                       cache, pos)
         nxt, new_keys = select_next_tokens(logits[:, 0], keys, temps,
                                            top_k, top_p)
-        return (nxt, cache, pos + 1, new_keys), nxt
-    (_, cache, _, keys), toks = jax.lax.scan(
-        step, (last, cache, jnp.asarray(pos_rows), keys), None,
-        length=k)
-    return toks.T, cache, keys
+        alive = ~done
+        toks = jax.lax.dynamic_update_slice(
+            toks, jnp.where(alive, nxt, 0)[:, None], (0, j))
+        emitted = jnp.where(alive, emitted + 1, emitted)
+        pos = jnp.where(alive, pos + 1, pos)
+        last = jnp.where(alive, nxt, last)
+        keys = jnp.where(alive[:, None], new_keys, keys)
+        done = done | (alive & (((eos >= 0) & (nxt == eos))
+                                | (emitted >= budget)))
+        return (j + 1, done, last, cache, pos, keys, emitted, toks)
+
+    (_, done, _, cache, _, keys, emitted, toks) = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), budget <= 0, last, cache,
+         jnp.asarray(pos_rows), keys, jnp.zeros((b,), jnp.int32),
+         jnp.zeros((b, k), jnp.int32)))
+    packed = jnp.concatenate([toks, emitted[:, None]], axis=1)
+    return packed, jnp.sum(done.astype(jnp.int32)), cache, keys
 
 
+@dispatch.counted("draft_sample_rows")
 @functools.partial(jax.jit, static_argnames=("cfg", "k", "top_k",
                                              "top_p"),
                    donate_argnums=(3,))
@@ -659,6 +726,7 @@ def draft_sample_rows(params: Params, last: jax.Array,
     return toks[:k].T, jnp.moveaxis(qs[:k], 0, 1), cache, keys
 
 
+@dispatch.counted("spec_accept_rows")
 @functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
 def spec_accept_rows(logits: jax.Array, proposals: jax.Array,
                      q_probs: jax.Array, keys: jax.Array,

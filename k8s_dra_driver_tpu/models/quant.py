@@ -48,6 +48,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils import jax_compat  # noqa: F401  (version shims)
 from ..utils.flags import env_flag
 
 

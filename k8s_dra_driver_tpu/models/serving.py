@@ -41,14 +41,20 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   distributed exactly as plain sampling of the target.  Rollback is
   just not advancing ``_pos`` (rejected rows stay position-masked
   and are overwritten by the next window).
-- **Chained decode** (``chain_steps=K``): K decode steps per
-  dispatch via a ``lax.scan`` over the per-row step
-  (``decode_chain_rows``), finish/refill handled host-side at chain
-  boundaries with overshoot discarded — identical outputs, one host
-  round-trip per K tokens-per-slot.  THE lever on high-RTT
-  (tunneled/remote) backends where dispatch dominates the compiled
-  step ~300x; per-phase wall clocks in ``stats()`` separate engine
-  host overhead from dispatch so artifacts record which is which.
+- **Fused on-device generation blocks** (``chain_steps=K``): up to K
+  decode steps per dispatch via a donated-buffer ``lax.while_loop``
+  (``decode_fused_rows``) that samples, updates the KV cache, and
+  detects per-row EOS/length stops ON DEVICE — finished rows freeze
+  (no overshoot writes, no scratch margin) and the block early-exits
+  when every row is done.  The host pays one launch + one packed
+  readback per block, synced on a scalar rows-finished count, and
+  refills freed slots while the next block is already running
+  (``_fused_step``) — identical outputs to the per-step engine.  THE
+  lever on high-RTT (tunneled/remote) backends where dispatch
+  dominates the compiled step ~300x; per-phase wall clocks in
+  ``stats()`` separate engine host overhead from dispatch, and the
+  hermetic dispatch counter (utils/dispatch.py) makes
+  dispatches-per-token a CI-pinned number.
 - **Automatic prefix caching** (``prefix_cache=N``): the last N
   fills' AND finishes' K/V rows are retained and a new request
   adopts its longest remembered prefix zero-copy, prefilling only
@@ -75,10 +81,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..utils import dispatch
 from . import decode as _decode
-from .decode import (KVCache, decode_chain_rows, decode_step_rows,
-                     decode_window_rows, draft_propose_rows,
-                     draft_sample_rows, init_cache, prefill_adopt_rows,
+from .decode import (KVCache, decode_step_rows, decode_window_rows,
+                     draft_propose_rows, draft_sample_rows, init_cache,
                      sample_token, spec_accept_rows)
 from .transformer import TransformerConfig
 
@@ -105,6 +111,7 @@ class Finished:
     n_prompt: int = 0
 
 
+@dispatch.counted("sample_one")
 @functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
 def _sample_one(logits, key, temperature, top_k: int, top_p: float):
     """Refill-path first-token draw as ONE compiled program (eager
@@ -113,6 +120,7 @@ def _sample_one(logits, key, temperature, top_k: int, top_p: float):
     return sample_token(logits, key, temperature, top_k, top_p)
 
 
+@dispatch.counted("next_tokens")
 @functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
 def _next_tokens(logits, keys, temps, top_k: int, top_p: float):
     """[B,V] logits + [B,2] per-slot keys + [B] temps -> (next [B],
@@ -121,6 +129,13 @@ def _next_tokens(logits, keys, temps, top_k: int, top_p: float):
     is the cost that dominates tunneled backends)."""
     return _decode.select_next_tokens(logits, keys, temps, top_k,
                                       top_p)
+
+
+def _overlap(a: np.ndarray, b: np.ndarray) -> int:
+    """Length of the common leading token run of two prompts."""
+    n = min(a.size, b.size)
+    neq = np.nonzero(a[:n] != b[:n])[0]
+    return int(neq[0]) if neq.size else n
 
 
 class PrefixCache:
@@ -161,13 +176,10 @@ class PrefixCache:
     def _touch(self, key: tuple) -> None:
         self._store[key] = self._store.pop(key)
 
-    def longest_prefix(self, prompt: np.ndarray
-                       ) -> tuple[int, KVCache | None]:
-        """(p, entry) with ``p`` the longest common prefix length
-        over all entries, capped at len(prompt)-1 so the last prompt
-        token is always re-prefilled (its logits seed generation).
-        Rows of the entry beyond ``p`` are junk for the new prompt
-        but are masked (pos=p) and overwritten by the suffix fill."""
+    def _best_match(self, prompt: np.ndarray) -> tuple[int, tuple]:
+        """(p, key) of the longest common prefix over all entries,
+        capped at len(prompt)-1 so the last prompt token is always
+        re-prefilled (its logits seed generation)."""
         toks = prompt.tolist()
         cap = len(toks) - 1
         best_p, best_key = 0, None
@@ -179,6 +191,21 @@ class PrefixCache:
                 p += 1
             if p > best_p:
                 best_p, best_key = p, key
+        return best_p, best_key
+
+    def peek(self, prompt: np.ndarray) -> int:
+        """Longest match length WITHOUT hit accounting or an LRU
+        touch — used by the fused refill round to decide scheduling
+        (defer vs adopt) before committing to an adoption."""
+        return self._best_match(prompt)[0]
+
+    def longest_prefix(self, prompt: np.ndarray
+                       ) -> tuple[int, KVCache | None]:
+        """(p, entry) for the longest remembered prefix; counts the
+        hit and refreshes the entry's LRU position.  Rows of the
+        entry beyond ``p`` are junk for the new prompt but are masked
+        (pos=p) and overwritten by the suffix fill."""
+        best_p, best_key = self._best_match(prompt)
         if best_key is None:
             return 0, None
         self.hits += 1
@@ -203,6 +230,7 @@ class PrefixCache:
         self._store.pop(tuple(tokens.tolist()), None)
 
 
+@dispatch.counted("extract_slot")
 @jax.jit
 def _extract_slot(cache: KVCache, slot, pos) -> KVCache:
     """Copy row ``slot`` of the engine cache out as a [1, S] cache
@@ -224,6 +252,7 @@ def _extract_slot(cache: KVCache, slot, pos) -> KVCache:
                  if cache.v_scale is not None else None))
 
 
+@dispatch.counted("adopt_slot")
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _adopt_slot(cache: KVCache, one: KVCache, slot) -> KVCache:
     """Copy a freshly-prefilled [1, S] cache into row ``slot`` of the
@@ -289,11 +318,13 @@ class ServingEngine:
                                     (slots, 1))
         self._spec_windows = 0
         self._spec_accepted = 0
-        # chain_steps=K runs K decode steps per dispatch
-        # (decode_chain_rows): finish/refill checks move to chain
-        # boundaries and overshoot past eos/max_new is discarded, so
-        # outputs stay identical while the per-step host RTT is paid
-        # once per K tokens-per-slot
+        # chain_steps=K runs up to K decode steps per dispatch through
+        # the fused on-device generation block (decode_fused_rows):
+        # per-row EOS/length stops are detected ON DEVICE (no
+        # overshoot, no scratch margin), the block early-exits when
+        # every row is done, and refills happen between blocks while
+        # the device still runs the current one — outputs stay
+        # identical while the per-step host RTT is paid once per block
         self.chain_steps = chain_steps
         self.prefill_chunk = prefill_chunk
         self.top_k = top_k
@@ -338,11 +369,11 @@ class ServingEngine:
         # a speculative window's first write is the last emitted
         # token's own row; only the draft_len proposal rows lie past
         # it, so that is the scratch margin the capacity guard
-        # reserves.  A chained drain similarly overshoots by up to
-        # chain_steps-1 discarded writes past the finish line.
+        # reserves.  The fused block (chain_steps > 1) needs NO
+        # margin: finished rows freeze on device and never write past
+        # the finish line (decode_fused_rows).
         margin = (self.draft_len
-                  if self.draft_params is not None
-                  else self.chain_steps - 1)
+                  if self.draft_params is not None else 0)
         if prompt.size + req.max_new + margin > self.max_seq:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new ({req.max_new})"
@@ -427,30 +458,15 @@ class ServingEngine:
         if self._prefix is not None:
             p, entry = self._prefix.longest_prefix(req.prompt)
             if p > 0:
+                # chunked-prefill / draft engines only: the plain and
+                # prefix-cached fused configurations route through
+                # _fill_fused_round (hits there take the one-launch
+                # suffix_fill_adopt path)
                 one = KVCache(k=entry.k, v=entry.v,
                               pos=jnp.int32(p),
                               k_scale=entry.k_scale,
                               v_scale=entry.v_scale)
                 start = p
-        if (start > 0 and self.prefill_chunk is None
-                and self.draft_params is None):
-            # fused HIT fill: suffix forward + slot adopt + first
-            # token in ONE launch (suffix_fill_adopt) — the same
-            # launch-amortization prefill_adopt_rows gives fresh
-            # fills, applied to the prefix-adoption path
-            first, self.cache, carry, one = _decode.suffix_fill_adopt(
-                self.params, one,
-                jnp.asarray(req.prompt[start:]), self.cfg,
-                self.cache, jnp.int32(slot),
-                jax.random.PRNGKey(req.seed),
-                jnp.float32(req.temperature), self.top_k, self.top_p)
-            self._prefix.insert(req.prompt, one)
-            if req.temperature > 0:
-                self._keys = self._keys.at[slot].set(carry)
-            self._temps[slot] = req.temperature
-            self._req[slot] = req
-            self._pos[slot] = req.prompt.size
-            return first
         if start == 0:
             one = init_cache(self.cfg, 1, self.max_seq)
         if self.prefill_chunk is None and start == 0:
@@ -586,11 +602,11 @@ class ServingEngine:
     # -- the step loop ---------------------------------------------------
 
     def step(self) -> list[Finished]:
-        """Refill free slots from the queue, run ONE batched decode
-        step (with a draft model: one speculative window; with
-        ``chain_steps`` > 1: one K-step chain) for every active slot,
-        and return newly finished requests.  No-op (empty list) when
-        idle."""
+        """Run ONE batched decode step (with a draft model: one
+        speculative window; with ``chain_steps`` > 1: one fused
+        on-device block with the refill overlapped) and refill free
+        slots from the queue, returning newly finished requests.
+        No-op (empty list) when idle."""
         t_step = time.perf_counter()
         fill0, dec0 = self._time_prefill, self._time_decode
         try:
@@ -602,6 +618,8 @@ class ServingEngine:
 
     def _step_inner(self) -> list[Finished]:
         finished: list[Finished] = []
+        if self.chain_steps > 1:
+            return self._fused_step(finished)
         self._refill(finished)
         active = [s for s in range(self.slots)
                   if self._req[s] is not None]
@@ -609,8 +627,6 @@ class ServingEngine:
             return finished
         if self.draft_params is not None:
             return self._spec_step(active, finished)
-        if self.chain_steps > 1:
-            return self._chain_step(active, finished)
         t_dec = time.perf_counter()
         tokens = jnp.asarray(self._last[:, None])
         logits, self.cache = decode_step_rows(
@@ -626,12 +642,72 @@ class ServingEngine:
             nxt = np.asarray(nxt_dev, np.int32)
         else:
             nxt = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
+        dispatch.record_readback("step_tokens")
         self._time_decode += time.perf_counter() - t_dec
         self._steps_total += 1
         for slot in active:
             self._pos[slot] += 1
             self._generated[slot].append(int(nxt[slot]))
             self._last[slot] = nxt[slot]
+            if self._done(slot):
+                self._finish_slot(slot, finished)
+        return finished
+
+    def _fused_step(self, finished: list[Finished]) -> list[Finished]:
+        """One fused on-device generation block (decode_fused_rows)
+        with the refill OVERLAPPED: the block for the slots active NOW
+        is dispatched first (asynchronously), then the host refills
+        slots freed by the PREVIOUS block — prompt uploads and fill
+        launches ride the wire while the device runs the block (the
+        double-buffered host transfer), and the device serializes
+        block → fills on the shared donated cache, so a fill can never
+        race the block.  Newly filled slots join the NEXT block;
+        per-row continuations are independent, so tokens are identical
+        to the per-step engine under any refill timing (pinned by
+        tests/test_serving.py).
+
+        Per-row stop state goes down WITH the block: ``budget`` (how
+        many tokens the row may still emit before its max_new or the
+        cache capacity line, exactly ``_done``'s bounds) and ``eos``
+        ride as data, rows freeze on device when they finish, and the
+        host reads back ONE packed [slots, k+1] array — tokens plus
+        per-row emitted counts — after syncing on the scalar
+        rows-finished count."""
+        active = [s for s in range(self.slots)
+                  if self._req[s] is not None]
+        if not active:
+            self._refill(finished)
+            return finished
+        k = self.chain_steps
+        t_dec = time.perf_counter()
+        budget = np.zeros(self.slots, np.int32)
+        eos = np.full(self.slots, -1, np.int32)
+        for slot in active:
+            req = self._req[slot]
+            budget[slot] = min(
+                req.max_new - len(self._generated[slot]),
+                self.max_seq - 1 - int(self._pos[slot]))
+            if req.eos_id is not None:
+                eos[slot] = req.eos_id
+        packed, rows_done, self.cache, self._keys = \
+            _decode.decode_fused_rows(
+                self.params, jnp.asarray(self._last), self.cfg,
+                self.cache, jnp.asarray(self._pos), k, self._keys,
+                jnp.asarray(self._temps), jnp.asarray(budget),
+                jnp.asarray(eos), self.top_k, self.top_p)
+        self._time_decode += time.perf_counter() - t_dec
+        self._refill(finished)          # overlaps the running block
+        t_wait = time.perf_counter()
+        int(rows_done)                  # scalar sync on the block
+        arr = np.asarray(packed, np.int32)
+        dispatch.record_readback("fused_block")
+        self._time_decode += time.perf_counter() - t_wait
+        self._steps_total += int(max(arr[slot, k] for slot in active))
+        for slot in active:
+            for j in range(int(arr[slot, k])):
+                self._pos[slot] += 1
+                self._generated[slot].append(int(arr[slot, j]))
+                self._last[slot] = arr[slot, j]
             if self._done(slot):
                 self._finish_slot(slot, finished)
         return finished
@@ -649,7 +725,7 @@ class ServingEngine:
         for slot in range(self.slots):
             if self._req[slot] is not None and self._done(slot):
                 self._finish_slot(slot, finished)
-        fused_ok = (self._prefix is None and self.prefill_chunk is None
+        fused_ok = (self.prefill_chunk is None
                     and self.draft_params is None)
         while self.queue and any(r is None for r in self._req):
             t_fill = time.perf_counter()
@@ -662,6 +738,7 @@ class ServingEngine:
             else:
                 firsts = np.asarray(jnp.stack(
                     [self._fill_dispatch(s, r) for s, r in batch]))
+                dispatch.record_readback("fill_round")
             self._time_prefill += time.perf_counter() - t_fill
             for (slot, _), first in zip(batch, firsts):
                 self._fill_finalize(slot, int(first))
@@ -669,24 +746,100 @@ class ServingEngine:
                     self._finish_slot(slot, finished)
 
     def _fill_fused_round(self, batch: list) -> np.ndarray:
-        """One round of fresh fills through ``prefill_adopt_rows``:
-        requests grouped by prompt length (static shapes), ONE
-        program launch per group, ONE readback for the whole round.
-        Each group is PADDED to the full slot count by repeating its
-        first row (duplicate scatter index, identical values —
+        """One refill round, fully fused, ONE readback: prefix-cache
+        HITS ride the fused suffix fill (``suffix_fill_adopt``, one
+        launch each) and fresh fills are grouped by prompt length
+        through ``prefill_adopt_rows`` (one launch per group) — so a
+        prefix-cached engine pays the same launch economics as the
+        plain fused path, with the reused prefix rows never
+        recomputed.  First tokens stay device-resident until the
+        round's single stacked readback; when a fused block is in
+        flight (``_fused_step``), every launch here overlaps it on the
+        wire and the device serializes block → fills on the shared
+        donated cache.  Outputs are identical to the per-fill path —
+        same flash prefill, scatter-adopt, and first-token key
+        schedule (PRNGKey(seed) built host-side accepts any Python
+        int)."""
+        by_slot: dict[int, jax.Array] = {}
+        fresh = batch
+        if self._prefix is not None:
+            fresh, kept, deferred = [], [], []
+            live: list[np.ndarray] = []   # prompts filling THIS round
+            for slot, req in batch:
+                cap = req.prompt.size - 1
+                best_live = max(
+                    (min(_overlap(req.prompt, pr), cap)
+                     for pr in live), default=0)
+                if best_live > self._prefix.peek(req.prompt):
+                    # a LONGER match is being filled right now by an
+                    # earlier request in this round (the system-prompt
+                    # pattern: shared prefixes arrive together) —
+                    # defer one round so this request adopts that fill
+                    # instead of recomputing the shared tokens.  The
+                    # first of an overlapping set is never deferred,
+                    # so every round makes progress; scheduling shifts
+                    # never change tokens (per-request outputs are
+                    # schedule-independent, pinned by the fuzz test).
+                    deferred.append(req)
+                    continue
+                live.append(req.prompt)
+                kept.append((slot, req))
+                p, entry = self._prefix.longest_prefix(req.prompt)
+                if p > 0:
+                    by_slot[slot] = self._fill_hit(slot, req, p, entry)
+                else:
+                    fresh.append((slot, req))
+            self.queue.extendleft(reversed(deferred))
+            batch[:] = kept               # the caller zips over batch
+        if fresh:
+            self._fill_fresh_groups(fresh, by_slot)
+            if self._prefix is not None:
+                # remember each fresh prompt's K/V for later hits: the
+                # freshly adopted slot rows ARE that K/V — extract
+                # copies them into fresh buffers (a launch, not a
+                # readback, so it also overlaps any in-flight block)
+                for slot, req in fresh:
+                    self._prefix.insert(req.prompt, _extract_slot(
+                        self.cache, jnp.int32(slot),
+                        int(req.prompt.size)))
+        firsts = np.asarray(jnp.stack([by_slot[s] for s, _ in batch]))
+        dispatch.record_readback("fill_round")
+        return firsts
+
+    def _fill_hit(self, slot: int, req: Request, p: int,
+                  entry: KVCache) -> jax.Array:
+        """Fused prefix-HIT fill: adopt ``p`` remembered rows
+        zero-copy, then suffix forward + slot adopt + first-token
+        draw in ONE launch (``suffix_fill_adopt``).  Returns the
+        first token as a DEVICE scalar so the round's readback
+        batches across fills."""
+        one = KVCache(k=entry.k, v=entry.v, pos=jnp.int32(p),
+                      k_scale=entry.k_scale, v_scale=entry.v_scale)
+        first, self.cache, carry, filled = _decode.suffix_fill_adopt(
+            self.params, one, jnp.asarray(req.prompt[p:]), self.cfg,
+            self.cache, jnp.int32(slot),
+            jax.random.PRNGKey(req.seed),
+            jnp.float32(req.temperature), self.top_k, self.top_p)
+        self._prefix.insert(req.prompt, filled)
+        if req.temperature > 0:
+            self._keys = self._keys.at[slot].set(carry)
+        self._req[slot] = req
+        self._pos[slot] = req.prompt.size
+        self._temps[slot] = req.temperature
+        return first
+
+    def _fill_fresh_groups(self, batch: list, by_slot: dict) -> None:
+        """Fresh fills grouped by prompt length through
+        ``prefill_adopt_rows``: ONE program launch per group.  Each
+        group is PADDED to the full slot count by repeating its first
+        row (duplicate scatter index, identical values —
         deterministic), so compilation keys only on the prompt
-        length, the same compile surface as per-request fills.  Only
-        the plain fresh-fill configuration routes here (prefix cache
-        / chunked prefill / draft engines keep the per-fill path,
-        whose extra work is per-request by nature); outputs are
-        identical — the fused program runs the same flash prefill,
-        scatter-adopt, and first-token key schedule, with base keys
-        built host-side (PRNGKey(seed) accepts any Python int the
-        unbatched path did)."""
+        length, the same compile surface as per-request fills.  First
+        tokens land in ``by_slot`` as device scalars for the round's
+        single readback."""
         groups: dict[int, list] = {}
         for slot, req in batch:
             groups.setdefault(req.prompt.size, []).append((slot, req))
-        outs = []
         for grp in groups.values():
             n, pad = len(grp), self.slots - len(grp)
             slots_v = jnp.asarray(
@@ -700,51 +853,16 @@ class ServingEngine:
             temps = jnp.asarray(
                 [r.temperature for _, r in grp] + [0.0] * pad,
                 jnp.float32)
-            first, self.cache, carry = prefill_adopt_rows(
+            first, self.cache, carry = _decode.prefill_adopt_rows(
                 self.params, prompts, self.cfg, self.cache, slots_v,
                 keys0, temps, self.max_seq, self.top_k, self.top_p)
             if any(r.temperature > 0 for _, r in grp):
                 self._keys = self._keys.at[slots_v[:n]].set(carry[:n])
-            for slot, req in grp:
+            for i, (slot, req) in enumerate(grp):
                 self._req[slot] = req
                 self._pos[slot] = req.prompt.size
                 self._temps[slot] = req.temperature
-            outs.append(first[:n])
-        firsts = np.asarray(jnp.concatenate(outs))
-        # concatenation follows group order; map back to batch order
-        order = [s for grp in groups.values() for s, _ in grp]
-        by_slot = dict(zip(order, firsts))
-        return np.asarray([by_slot[s] for s, _ in batch])
-
-    def _chain_step(self, active: list[int],
-                    finished: list[Finished]) -> list[Finished]:
-        """``chain_steps`` decode steps in ONE dispatch
-        (decode_chain_rows): the host reads back a [slots, K] token
-        block, then replays the per-token bookkeeping — appending,
-        finish checks, _pos advance — exactly as K plain steps would,
-        except refills wait for the chain boundary and tokens past a
-        row's finish line are discarded (identical outputs: per-row
-        continuations are independent of other rows' refill timing).
-        The capacity overshoot (up to K-1 discarded cache writes past
-        the finish line) is reserved by submit()'s scratch margin."""
-        k = self.chain_steps
-        t_dec = time.perf_counter()
-        toks_dev, self.cache, self._keys = decode_chain_rows(
-            self.params, jnp.asarray(self._last), self.cfg,
-            self.cache, jnp.asarray(self._pos), k, self._keys,
-            jnp.asarray(self._temps), self.top_k, self.top_p)
-        toks = np.asarray(toks_dev, np.int32)
-        self._time_decode += time.perf_counter() - t_dec
-        self._steps_total += k
-        for slot in active:
-            for j in range(k):
-                self._pos[slot] += 1
-                self._generated[slot].append(int(toks[slot, j]))
-                self._last[slot] = toks[slot, j]
-                if self._done(slot):
-                    self._finish_slot(slot, finished)
-                    break
-        return finished
+                by_slot[slot] = first[i]
 
     def _spec_step(self, active: list[int],
                    finished: list[Finished]) -> list[Finished]:
@@ -791,13 +909,22 @@ class ServingEngine:
             emit_dev, a_dev, self._keys = spec_accept_rows(
                 logits, proposals, q_probs, self._keys, temps,
                 self.top_k, self.top_p)
-            emit_all = np.asarray(emit_dev, np.int32)
-            a_all = np.asarray(a_dev, np.int32)
+            # ONE packed transfer for the window (emit block + accept
+            # counts), same packing trick as the fused block — the
+            # second per-window readback was a full RTT on tunneled
+            # backends
+            packed = np.asarray(jnp.concatenate(
+                [emit_dev, a_dev[:, None]], axis=1), np.int32)
+            emit_all, a_all = packed[:, :-1], packed[:, -1]
         else:
             # lean greedy-only path: no filtered-softmax or key
-            # bookkeeping; acceptance is a host-side prefix match
-            greedy = np.asarray(jnp.argmax(logits, axis=-1), np.int32)
-            props = np.asarray(proposals, np.int32)
+            # bookkeeping; acceptance is a host-side prefix match —
+            # target choices and proposals ride one packed transfer
+            packed = np.asarray(jnp.concatenate(
+                [jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                 proposals], axis=1), np.int32)
+            greedy, props = packed[:, :k + 1], packed[:, k + 1:]
+        dispatch.record_readback("spec_window")
         self._time_decode += time.perf_counter() - t_dec
         self._steps_total += 1
         self._spec_windows += 1

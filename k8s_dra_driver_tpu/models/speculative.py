@@ -36,6 +36,15 @@ TPU-first mechanics:
 
 The reference has no serving stack at all (SURVEY.md §2.3); this sits
 on models/decode.py beside the int8 serving path.
+
+Dispatch economics: this whole-generation loop is already ONE program
+launch (the same ``lax.while_loop`` fusion the serving engine's
+``decode_fused_rows`` block applies per-batch — docs/SERVING.md).
+The ENGINE's speculative path (models/serving.py ``_spec_step``) pays
+two launches + one packed readback per window and keeps the
+token-parity guarantee pinned here: greedy speculation == plain
+greedy bit-exactly on the f32 CPU suite (tests/test_speculative.py,
+tests/test_serving.py), whatever the dispatch packaging.
 """
 
 from __future__ import annotations

@@ -31,6 +31,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.ring_attention import attention_reference, ring_attention
 from ..parallel.mesh import BATCH_AXES, mesh_platform
+from ..utils import jax_compat  # noqa: F401  (version shims)
 from .quant import ein, take_rows
 
 Params = dict[str, Any]

@@ -1,8 +1,8 @@
 """TPU compute ops: ring/flash attention, collectives, benchmarks."""
 
 from .collectives import (allreduce_bandwidth, attention_grad_probe,
-                          attention_probe, decode_probe, matmul_tflops,
-                          serving_probe)
+                          attention_probe, decode_probe, dispatch_probe,
+                          matmul_tflops, serving_probe)
 from .flash_attention import (flash_attention, flash_block_attention,
                               merge_flash_stats)
 from .ring_attention import attention_reference, ring_attention
@@ -10,6 +10,6 @@ from .ulysses_attention import ulysses_attention
 
 __all__ = ["allreduce_bandwidth", "attention_grad_probe",
            "attention_probe", "attention_reference", "decode_probe",
-           "flash_attention", "flash_block_attention", "matmul_tflops",
-           "merge_flash_stats", "ring_attention", "serving_probe",
-           "ulysses_attention"]
+           "dispatch_probe", "flash_attention", "flash_block_attention",
+           "matmul_tflops", "merge_flash_stats", "ring_attention",
+           "serving_probe", "ulysses_attention"]

@@ -85,6 +85,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils import jax_compat  # noqa: F401  (version shims)
+
 _NEG_INF = -1e30
 
 # Minimum second-to-last-dim tiles (pallas_guide.md): bf16 wants 16

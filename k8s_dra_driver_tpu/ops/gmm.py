@@ -39,6 +39,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils import jax_compat  # noqa: F401  (version shims)
+
 
 def _round_up(n: int, k: int) -> int:
     return -(-n // k) * k

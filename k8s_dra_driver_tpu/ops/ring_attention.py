@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..parallel.mesh import mesh_platform
+from ..utils import jax_compat  # noqa: F401  (version shims)
 from .flash_attention import (_kv_heads, attention_block_grads,
                               attention_delta, flash_block_attention,
                               flash_block_grads, merge_flash_stats,

@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils import jax_compat  # noqa: F401  (version shims)
+
 
 def stack_stages(per_stage_params: list) -> object:
     """[S] list of identically-structured pytrees -> one pytree whose

@@ -122,6 +122,8 @@ def gang_psum(value: float) -> float:
     import numpy as np
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from ..utils import jax_compat  # noqa: F401  (version shims)
+
     devs = np.array(jax.devices())
     mesh = Mesh(devs, ("gang",))
     n_local = jax.local_device_count()

@@ -1,0 +1,132 @@
+"""Hermetic host-dispatch accounting for the serving/decode path.
+
+The serving engine's throughput ceiling on tunneled/remote backends is
+set by HOST DISPATCHES, not compute: BENCH_r05 measured 0.45 ms of
+host dispatch inside every 0.80 ms wall step, leaving the chained
+engine ~11x below the compiled decode ceiling on the same chip.  That
+number was only observable on live hardware — nothing hermetic counted
+how many programs the engine actually launches per generated token, so
+a dispatch regression (an accidental per-step readback, an un-fused
+fill) could land silently and surface one round later as a throughput
+drop on the official line.
+
+This module makes "dispatches per generated token" a CI-assertable
+number: every jitted launch site in models/decode.py and
+models/serving.py is wrapped with :func:`counted`, which increments a
+process-global counter per call.  Counting CALLS of the jitted
+callable is exactly counting program launches — each call hands XLA
+one executable invocation (the per-launch round-trip a tunneled
+backend pays) — and it works identically on the CPU mesh, so the
+fast tier pins the ratio between the per-step and fused engines
+(tests/test_decode.py) without touching hardware.
+
+Blocking device→host readbacks (``np.asarray``/``int()`` on device
+values) are recorded separately via :func:`record_readback`: they are
+the other per-step RTT and the fused engine's whole point is paying
+one of each per token BLOCK instead of per token.
+
+Scoping: the counter is process-global (the wrapped functions cannot
+know their caller).  Measurements use :func:`track`, which snapshots
+deltas, so interleaved engines in one process must not run
+concurrently during a tracked region — true of every probe and test
+today (the suite is single-threaded; serving_probe drains engines
+sequentially).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class DispatchCounter:
+    """Process-global launch/readback tallies, by label."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.dispatches = 0
+        self.readbacks = 0
+        self.by_label: dict[str, int] = {}
+
+    def record(self, label: str, n: int = 1) -> None:
+        with self._lock:
+            self.dispatches += n
+            self.by_label[label] = self.by_label.get(label, 0) + n
+
+    def record_readback(self, label: str) -> None:
+        with self._lock:
+            self.readbacks += 1
+            key = f"readback:{label}"
+            self.by_label[key] = self.by_label.get(key, 0) + 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"dispatches": self.dispatches,
+                    "readbacks": self.readbacks,
+                    "by_label": dict(self.by_label)}
+
+
+#: the process-global counter every wrapped launch site feeds
+COUNTER = DispatchCounter()
+
+
+class Tracked:
+    """Delta view filled in when a :func:`track` region closes."""
+
+    def __init__(self) -> None:
+        self.dispatches = 0
+        self.readbacks = 0
+        self.by_label: dict[str, int] = {}
+
+
+@contextlib.contextmanager
+def track():
+    """``with dispatch.track() as t: ...`` — ``t.dispatches`` /
+    ``t.readbacks`` / ``t.by_label`` hold the region's deltas."""
+    before = COUNTER.snapshot()
+    t = Tracked()
+    try:
+        yield t
+    finally:
+        after = COUNTER.snapshot()
+        t.dispatches = after["dispatches"] - before["dispatches"]
+        t.readbacks = after["readbacks"] - before["readbacks"]
+        t.by_label = {
+            k: v - before["by_label"].get(k, 0)
+            for k, v in after["by_label"].items()
+            if v - before["by_label"].get(k, 0)}
+
+
+class _Counted:
+    """Callable wrapper that counts launches and forwards everything
+    else (``_clear_cache``/``_cache_size`` on jitted functions keep
+    working; tests that monkeypatch the module attribute replace the
+    whole wrapper, which is fine — the count then follows the patch)."""
+
+    def __init__(self, label: str, fn) -> None:
+        self._label = label
+        self._fn = fn
+        self.__doc__ = getattr(fn, "__doc__", None)
+        self.__name__ = label
+
+    def __call__(self, *args, **kwargs):
+        COUNTER.record(self._label)
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def counted(label: str):
+    """Decorator: count each call of ``fn`` as one host dispatch."""
+    def wrap(fn):
+        return _Counted(label, fn)
+    return wrap
+
+
+def record(label: str, n: int = 1) -> None:
+    COUNTER.record(label, n)
+
+
+def record_readback(label: str) -> None:
+    COUNTER.record_readback(label)

@@ -1,0 +1,44 @@
+"""jax-version compat shims for the workload layer.
+
+The tree targets current jax names; some runtime images bake an older
+jax (0.4.37 here) where two of them are missing.  Importing this
+module installs both aliases exactly once, so the same source runs on
+either — without it, every pallas kernel and every shard_map caller
+fails at trace time on older images.  Imported by the jax-facing
+modules only: the control-plane binaries deliberately never import
+jax (bench.py's parent-process contract), and this module must not
+change that.
+
+- ``pltpu.CompilerParams``: renamed from ``TPUCompilerParams``; same
+  signature for every field used here (``dimension_semantics``).
+- ``jax.shard_map``: promoted from ``jax.experimental.shard_map``
+  with two kwarg renames — ``check_vma`` was ``check_rep``, and the
+  new ``axis_names`` (mesh axes to shard manually) is the complement
+  of the old ``auto`` set.
+"""
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+if not hasattr(pltpu, "CompilerParams"):      # pre-rename jax
+    pltpu.CompilerParams = pltpu.TPUCompilerParams
+
+if not hasattr(jax.lax, "pcast"):             # pre-varying-types jax
+    # pcast only adjusts replication/varying TRACKING; with the old
+    # shard_map's check_rep machinery (or check_rep=False) the values
+    # themselves are unchanged, so identity is the faithful shim
+    jax.lax.pcast = lambda x, axis_name=None, *, to=None: x
+
+if not hasattr(jax, "shard_map"):             # pre-promotion jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh=None, in_specs=None, out_specs=None,
+                  check_vma=True, axis_names=None, **kw):
+        if axis_names is not None and mesh is not None:
+            kw.setdefault("auto",
+                          frozenset(mesh.axis_names) - set(axis_names))
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=check_vma,
+                          **kw)
+
+    jax.shard_map = shard_map

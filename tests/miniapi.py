@@ -56,6 +56,10 @@ class MiniAPIServer:
         # path-key -> object dict
         self.objects: dict[str, dict] = {}
         self.watchers: list = []  # (plural, wfile, event)
+        # in-process event taps: fn(plural, etype, obj) called on
+        # every write — the zero-latency wakeup the oop bed's
+        # deployment controller uses instead of a poll interval
+        self.listeners: list = []
         self.fault_plan: FaultPlan | None = None
         server = self
 
@@ -289,6 +293,7 @@ class MiniAPIServer:
     def notify(self, plural, etype, obj):
         with self._lock:
             watchers = list(self.watchers)
+            listeners = list(self.listeners)
         for wplural, handler, done in watchers:
             if wplural != plural:
                 continue
@@ -298,6 +303,11 @@ class MiniAPIServer:
                     .encode())
             except OSError:
                 done.set()
+        for fn in listeners:
+            try:
+                fn(plural, etype, obj)
+            except Exception:
+                pass              # a broken tap must not fail a write
 
     def set_fault_plan(self, plan: FaultPlan | None):
         """In-process twin of ``POST /faults`` (same plan object, so

@@ -73,11 +73,28 @@ def _start_deployment_controller(server: MiniAPIServer,
     """Kubelet stand-in for coordinator pods: run the Deployment's
     rendered command in-process and mark it ready only if its
     readiness probe would pass (same contract as the fake-cluster
-    controller in helpers.py, over the REST server's store)."""
+    controller in helpers.py, over the REST server's store).
+
+    EVENT-DRIVEN: a server listener wakes the loop the instant a
+    Deployment write lands, so the claim→Running critical path pays
+    the coordinator's actual start time instead of a poll interval —
+    the old fixed 50 ms sleep stacked with the plugin's readiness
+    backoff to set the 75.5 ms coordinated-shared oop prepare floor
+    (VERDICT r05 weak #5).  A 0.5 s fallback wait covers writes that
+    raced the scan."""
+
+    wake = threading.Event()
+
+    def on_write(plural, _etype, _obj):
+        if plural == "deployments":
+            wake.set()
+
+    server.listeners.append(on_write)
 
     def loop():
         while not stop.is_set():
-            todo = []
+            wake.clear()          # before the scan: a write racing the
+            todo = []             # scan re-sets it and we rescan
             with server._lock:
                 for key, obj in server.objects.items():
                     if not key.startswith("deployments/"):
@@ -86,6 +103,7 @@ def _start_deployment_controller(server: MiniAPIServer,
                     ready = obj.get("status", {}).get("readyReplicas", 0)
                     if ready < replicas:
                         todo.append((key, obj, replicas))
+            progressed = False
             for key, obj, replicas in todo:
                 pod_spec = (obj.get("spec", {}).get("template", {})
                             .get("spec", {}))
@@ -99,7 +117,9 @@ def _start_deployment_controller(server: MiniAPIServer,
                     cur.setdefault("status", {})["readyReplicas"] = replicas
                     cur["metadata"]["resourceVersion"] = str(server._rv)
                 server.notify("deployments", "MODIFIED", cur)
-            stop.wait(0.05)
+                progressed = True
+            if not progressed:      # idle OR crash-looping: don't spin
+                wake.wait(0.5)
 
     t = threading.Thread(target=loop, daemon=True)
     t.start()

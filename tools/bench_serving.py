@@ -24,15 +24,23 @@ def main() -> None:
     enable_persistent_cache()
     import jax
 
-    from k8s_dra_driver_tpu.ops import decode_probe, serving_probe
+    from k8s_dra_driver_tpu.ops import (decode_probe, dispatch_probe,
+                                        serving_probe)
 
     rec = {
-        "what": ("continuous-batching engine throughput: chained drain "
-                 "(chain_steps=47, one dispatch per decode wave) with "
-                 "fused grouped prefill, vs the per-step drain and the "
-                 "compiled decode ceiling; per-phase wall clocks "
-                 "(prefill_s / decode_dispatch_s / host_s) separate "
-                 "engine overhead from tunnel dispatch RTT"),
+        "what": ("continuous-batching engine throughput: fused "
+                 "on-device generation blocks (chain_steps=47, one "
+                 "lax.while_loop dispatch per block with per-row "
+                 "on-device stops, models/decode.py "
+                 "decode_fused_rows) with fused grouped/suffix "
+                 "prefill and refill overlapped with the running "
+                 "block, vs the per-step drain and the compiled "
+                 "decode ceiling; per-phase wall clocks (prefill_s / "
+                 "decode_dispatch_s / host_s) separate engine "
+                 "overhead from tunnel dispatch RTT, and "
+                 "host_dispatches / dispatches_per_token record the "
+                 "hermetic dispatch counts (utils/dispatch.py) each "
+                 "drain actually paid"),
         "host": platform.node(),
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
@@ -41,6 +49,7 @@ def main() -> None:
             capture_output=True, text=True).stdout.strip(),
         "harness": "ops/collectives.py serving_probe / decode_probe",
         "recorded_unix": int(time.time()),
+        "dispatch_overhead": dispatch_probe(),
         "serving_chain47": serving_probe(chain_steps=47),
         "serving_chain47_prefix": serving_probe(
             chain_steps=47, prefix_cache=8, shared_prefix=64),
