@@ -60,6 +60,14 @@ TINY_SERVING_KWARGS = dict(slots=2, n_requests=4, n_layers=2,
                            d_model=128, heads=4, kv_heads=2, d_ff=256,
                            prompt_len=12, max_new=6, max_seq=64)
 
+#: hermetic shape for the fleet-gateway probe (same contract: the
+#: smoke tests pin exactly what bench streams on the CPU mesh)
+TINY_GATEWAY_KWARGS = dict(replicas=2, slots=2, n_requests=8,
+                           n_layers=2, d_model=128, heads=4,
+                           kv_heads=2, d_ff=256, prompt_len=12,
+                           max_new=6, max_seq=64, shared_prefix=8,
+                           prefix_cache=2)
+
 _WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", "630"))
 _DEADLINE = time.monotonic() + _WALL_BUDGET_S
 
@@ -602,6 +610,16 @@ def _tpu_probes():
             chain_steps=3, **TINY_SERVING_KWARGS))])
     yield "serving_chain", shaped(label, res, errs)
 
+    # fleet gateway (gateway/probe.py): offered-load sweep through a
+    # replica pool behind SLO admission + prefix-affinity routing —
+    # goodput, SLO attainment, and p50/p99 admission-queue wait at
+    # loads below and above the pool's self-calibrated capacity
+    from k8s_dra_driver_tpu.gateway import gateway_probe
+    label, res, errs = _retry_probe(
+        [("p2s4_r16", lambda: gateway_probe())] if on_accel else
+        [("tiny_p2", lambda: gateway_probe(**TINY_GATEWAY_KWARGS))])
+    yield "gateway", shaped(label, res, errs)
+
 
 def tpu_probe_stream() -> None:
     """Child-process entry: stream one JSON line per finished probe.
@@ -780,6 +798,9 @@ _PROBE_SCALARS = (
     ("serving_prefix", "serving_px_tok_s", "tokens_per_s"),
     ("serving_chain", "serving_chain_tok_s", "tokens_per_s"),
     ("serving_chain", "chain_disp_per_tok", "dispatches_per_token"),
+    ("gateway", "gw_goodput_rps", "goodput_rps"),
+    ("gateway", "gw_slo_att", "slo_attainment"),
+    ("gateway", "gw_p99_wait_ms", "p99_queue_wait_ms"),
     ("allreduce_cpu_mesh8", "cpu_mesh_gbps", "gbps"),
 )
 

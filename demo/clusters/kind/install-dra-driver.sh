@@ -8,6 +8,11 @@ set -euo pipefail
 REPO_ROOT="$(cd "$(dirname "$0")/../../.." && pwd)"
 IMAGE_REPO="${IMAGE_REPO:-tpu-dra-driver}"
 IMAGE_TAG="${IMAGE_TAG:-dev}"
+# Per-worker chip masking (nvkind analog): the gang cluster's fake
+# trees each carry a /faketpu/visible_chips file written by
+# create-cluster.sh, so VISIBLE_CHIPS=@/visible_chips masks every
+# worker by its own file.  Empty (default) = no masking.
+VISIBLE_CHIPS="${VISIBLE_CHIPS:-}"
 
 helm upgrade --install tpu-dra-driver \
   "$REPO_ROOT/deployments/helm/tpu-dra-driver" \
@@ -17,6 +22,7 @@ helm upgrade --install tpu-dra-driver \
   --set image.pullPolicy=Never \
   --set kubeletPlugin.driverRoot=/faketpu \
   --set kubeletPlugin.allowEnvFile=true \
+  --set kubeletPlugin.visibleChips="$VISIBLE_CHIPS" \
   --set "kubeletPlugin.nodeSelector=null" \
   --set "kubeletPlugin.tolerations=null"
 

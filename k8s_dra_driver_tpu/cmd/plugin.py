@@ -103,6 +103,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="enumeration backend: pure-Python sysfs parser, "
                         "the C++ shim, or auto (native with sysfs "
                         "fallback) [env DISCOVERY]")
+    p.add_argument("--visible-chips",
+                   default=env_default("VISIBLE_CHIPS", ""),
+                   help="mask discovery to these host-local chip "
+                        "indices: a comma list (e.g. 0,1) or @<file> "
+                        "carrying one, resolved under --driver-root "
+                        "(per-worker masking: the file rides each "
+                        "worker's host mount — the nvkind params-file "
+                        "analog); empty = all chips "
+                        "[env VISIBLE_CHIPS]")
     KubeClientConfig.add_flags(p)
     LoggingConfig.add_flags(p)
     return p
@@ -152,6 +161,18 @@ def build_backend(args: argparse.Namespace):
     return SysfsBackend(host_root=args.driver_root)
 
 
+def mask_backend(args: argparse.Namespace, backend):
+    """Apply the --visible-chips mask (nvkind per-worker partitioning
+    analog) around whatever backend discovery chose — including an
+    injected fake one, so masking composes with every test tier."""
+    from ..discovery import MaskedBackend, parse_visible_chips
+    visible = parse_visible_chips(args.visible_chips, args.driver_root)
+    if visible is None:
+        return backend
+    log.info("masking discovery to visible chips %s", sorted(visible))
+    return MaskedBackend(backend, visible)
+
+
 def run(args: argparse.Namespace, client=None, backend=None,
         ready_event: threading.Event | None = None,
         stop_event: threading.Event | None = None) -> int:
@@ -169,7 +190,7 @@ def run(args: argparse.Namespace, client=None, backend=None,
         Path(d).mkdir(parents=True, exist_ok=True)
 
     client = client or KubeClientConfig.build_client(args)
-    backend = backend or build_backend(args)
+    backend = mask_backend(args, backend or build_backend(args))
 
     # Deterministic fault injection (test/chaos tooling): a plan file
     # named by TPU_DRA_FAULT_PLAN scripts API-call failures and named

@@ -85,6 +85,21 @@ class CoordinatorClient:
         self._registered: dict | None = None
         self._last_heartbeat_ms: float = 0.0
 
+    @classmethod
+    def from_env(cls, environ: dict | None = None,
+                 **kw) -> "CoordinatorClient | None":
+        """Client for the claim this process was prepared with, or
+        None when the env carries no coordination dir (an exclusive,
+        non-coordinated claim — nothing to register with).  The
+        fleet gateway's replica leases (gateway/replica.py) build on
+        this to hold a sharing slot per serving replica; containerized
+        callers with CDI mounts should resolve the dir through
+        ``gateway.resolve_container_path`` first."""
+        env = environ if environ is not None else os.environ
+        if not env.get(ENV_COORDINATION_DIR):
+            return None
+        return cls(env[ENV_COORDINATION_DIR], **kw)
+
     # -- registration --------------------------------------------------
 
     @property

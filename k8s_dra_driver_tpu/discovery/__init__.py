@@ -5,6 +5,7 @@ from .topology import (GENERATIONS, GiB, GenerationSpec, ICICoord, MeshShape,
 from .types import (ChipInfo, DiscoveryBackend, HostTopology, SliceMembership)
 from .sysfs import SysfsBackend, host_origin, parse_bounds
 from .fake import FakeHost, StaticBackend, fake_slice_hosts
+from .mask import MaskedBackend, parse_visible_chips
 from .native import NativeBackend, NativeUnavailableError
 
 __all__ = [
@@ -12,5 +13,6 @@ __all__ = [
     "standard_slice_shapes", "ChipInfo", "DiscoveryBackend", "HostTopology",
     "SliceMembership", "SysfsBackend", "host_origin", "parse_bounds",
     "FakeHost", "StaticBackend", "fake_slice_hosts",
+    "MaskedBackend", "parse_visible_chips",
     "NativeBackend", "NativeUnavailableError",
 ]

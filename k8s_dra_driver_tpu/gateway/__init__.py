@@ -1,0 +1,23 @@
+"""Fleet gateway: SLO-aware admission, prefix-affinity routing, and
+health-driven drain over a pool of serving engines (docs/SERVING.md
+"The fleet gateway" section; AlpaServe OSDI'23 is the cross-replica
+scheduling argument, Orca OSDI'22 the within-engine one PR 2 built)."""
+
+from .admission import (AdmissionError, AdmissionQueue, GatewayRequest,
+                        FINISHED, REJECTED_DUPLICATE, REJECTED_FULL,
+                        REJECTED_INVALID, SHED_EXPIRED)
+from .frontend import FleetGateway
+from .probe import gateway_probe
+from .replica import (DraChipLease, EngineReplica, ReplicaManager,
+                      resolve_container_path)
+from .router import (LeastLoadedRouter, PrefixAffinityRouter,
+                     RoundRobinRouter, Router)
+
+__all__ = [
+    "AdmissionError", "AdmissionQueue", "DraChipLease", "EngineReplica",
+    "FINISHED", "FleetGateway", "GatewayRequest", "LeastLoadedRouter",
+    "PrefixAffinityRouter", "REJECTED_DUPLICATE", "REJECTED_FULL",
+    "REJECTED_INVALID", "ReplicaManager", "RoundRobinRouter", "Router",
+    "SHED_EXPIRED",
+    "gateway_probe", "resolve_container_path",
+]

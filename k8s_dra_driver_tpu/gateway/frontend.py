@@ -1,0 +1,260 @@
+"""The fleet gateway: one front door over a pool of serving engines.
+
+``FleetGateway`` is the pump that connects the pieces this package
+provides — bounded SLO admission (admission.py), prefix-affinity
+placement (router.py), and health-driven replica lifecycle
+(replica.py) — into the first subsystem where the driver's allocation
+decisions and the JAX serving stack are exercised by the same
+traffic: replicas hold DRA-prepared chips (sharing-slot leases), the
+control plane's chip-health signal drains them, and the admission
+queue absorbs what the pool cannot place yet.
+
+Invariants the hermetic suite pins (tests/test_gateway.py):
+
+- **Exactly-once, byte-equal.**  Every admitted request reaches
+  exactly one terminal status; finished tokens equal a single-engine
+  oracle's byte-for-byte — routing, refills, drains and requeues are
+  scheduling, never math (a requeued request re-runs from scratch on
+  its new replica: greedy/seeded sampling makes the rerun identical,
+  and its partial work on the dead replica was cancelled via the
+  engine's active-cancel hook, so nothing is emitted twice).
+- **No silent drops.**  Overload turns into explicit
+  ``rejected_full``/``shed_expired`` statuses and metrics, never
+  missing uids.
+- **Drain is observable.**  A replica kill surfaces as
+  ``tpu_gateway_drains_total``/``tpu_gateway_requeued_total``
+  advancing and second queue-wait samples for the victims.
+
+The pump is deliberately single-threaded and clock-injected: one
+``step()`` = shed, health-poll, dispatch, step-ready-replicas, account
+— bursty arrival tests and the bench probe drive it with real or fake
+clocks without concurrency nondeterminism.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..models.serving import Finished, Request
+from ..utils import dispatch
+from ..utils.metrics import GatewayMetrics
+from .admission import (DISPATCHED, FINISHED, QUEUED,
+                        REJECTED_INVALID, SHED_EXPIRED, AdmissionError,
+                        AdmissionQueue, GatewayRequest)
+from .replica import EngineReplica, ReplicaManager
+from .router import PrefixAffinityRouter, Router
+
+# metrics outcome labels
+_FINISHED_ATTAINED = "finished_attained"
+_FINISHED_LATE = "finished_late"
+
+
+class FleetGateway:
+    """SLO-aware admission + routing + drain over a replica pool."""
+
+    def __init__(self, manager: ReplicaManager, *,
+                 router: Router | None = None,
+                 queue_capacity: int = 64,
+                 metrics: GatewayMetrics | None = None,
+                 clock=time.monotonic,
+                 auto_replace: bool = True):
+        self.manager = manager
+        self.router = router or PrefixAffinityRouter()
+        self.queue = AdmissionQueue(queue_capacity)
+        self.metrics = metrics or GatewayMetrics()
+        self.clock = clock
+        self.auto_replace = auto_replace
+        #: uid -> terminal GatewayRequest (exactly-once bookkeeping)
+        self.outcomes: dict = {}
+        #: uid -> Finished (tokens) for completed requests
+        self.results: dict = {}
+        #: submit-time refusals (kept as records, uids may repeat)
+        self.refused: list[GatewayRequest] = []
+        #: per-replica dispatch attribution (utils/dispatch.py)
+        self.per_replica = dispatch.Aggregator()
+        self._steps = 0
+
+    # -- intake ----------------------------------------------------------
+
+    def submit(self, req: Request,
+               slo_s: float | None = None) -> GatewayRequest:
+        """Admit or refuse; ALWAYS returns the request's gateway
+        record with an explicit status (``queued`` or a terminal
+        rejection) — refusal is a return value here, not an exception,
+        because shedding under load is an outcome the caller must see,
+        not a bug."""
+        now = self.clock()
+        live = frozenset(
+            uid for r in self.manager.replicas for uid in r.in_flight)
+        try:
+            g = self.queue.offer(req, now, slo_s=slo_s, live_uids=live)
+        except AdmissionError as e:
+            g = GatewayRequest(request=req, arrival_s=now,
+                               deadline_s=now, status=e.status)
+            self.refused.append(g)
+            self.metrics.requests.labels(outcome=e.status).inc()
+            return g
+        # uid reuse after a terminal outcome starts a FRESH lifecycle:
+        # the old record is forgotten so the exactly-once guard in
+        # _terminal keeps catching gateway bugs (a uid terminating
+        # twice within ONE lifecycle), not client uid recycling
+        self.outcomes.pop(req.uid, None)
+        self.results.pop(req.uid, None)
+        self.metrics.queue_depth.set(len(self.queue))
+        return g
+
+    # -- the pump --------------------------------------------------------
+
+    def step(self) -> list[GatewayRequest]:
+        """One pump round; returns requests that reached a terminal
+        status this round (finished or shed)."""
+        now = self.clock()
+        done: list[GatewayRequest] = []
+        # 1. shed-on-expired BEFORE dispatch: a dead-on-arrival-at-
+        #    the-front request must never occupy a slot
+        for g in self.queue.shed_expired(now):
+            self._terminal(g, SHED_EXPIRED, done)
+        # 2. health verdicts -> drain (stop dispatch, cancel, requeue)
+        for replica in self.manager.poll_down():
+            self._drain(replica)
+        # 3. place what the pool can take; the rest stays queued
+        #    (router returns None at the pool's depth bound)
+        while len(self.queue):
+            g = self.queue.peek()
+            target = self.router.route(g.request.prompt,
+                                       self.manager.replicas)
+            if target is None:
+                break
+            g = self.queue.pop(now)
+            g.status = DISPATCHED
+            g.replica = target.name
+            g.dispatched_s = now
+            try:
+                target.enqueue(g)
+            except ValueError:
+                # the engine refused it (e.g. prompt + max_new exceeds
+                # the cache): no replica in a homogeneous pool can run
+                # it — an explicit terminal status, never a lost
+                # request or a crashed pump
+                self._terminal(g, REJECTED_INVALID, done)
+                continue
+            self.metrics.queue_wait_seconds.observe(now - g.arrival_s)
+        # 4. advance every busy ready replica, attributing its host
+        #    dispatches to its name
+        for replica in list(self.manager.replicas):
+            if not replica.ready or not replica.in_flight:
+                continue
+            with dispatch.track() as t:
+                finished = replica.step()
+            self.per_replica.add(replica.name, t)
+            self._account(replica, finished, done)
+        # 5. leases + gauges
+        self.manager.heartbeat()
+        self.metrics.queue_depth.set(len(self.queue))
+        for state, n in self.manager.counts().items():
+            self.metrics.replicas.labels(state=state).set(n)
+        self._steps += 1
+        return done
+
+    def run_until_idle(self, max_steps: int = 10_000
+                       ) -> list[GatewayRequest]:
+        """Pump until no request is queued or in flight; returns every
+        terminal record from these rounds."""
+        out: list[GatewayRequest] = []
+        for _ in range(max_steps):
+            out.extend(self.step())
+            if not len(self.queue) and not any(
+                    r.in_flight for r in self.manager.replicas):
+                return out
+        raise RuntimeError(f"gateway not idle after {max_steps} steps")
+
+    # -- internals -------------------------------------------------------
+
+    def _account(self, replica: EngineReplica, finished: list[Finished],
+                 done: list[GatewayRequest]) -> None:
+        now = self.clock()
+        tokens = replica.occupancy()["tokens"]
+        for uid, n in tokens.items():
+            g = replica.in_flight.get(uid)
+            if g is not None and g.first_token_s is None and n >= 1:
+                g.first_token_s = now
+                self.metrics.ttft_seconds.observe(now - g.arrival_s)
+        for f in finished:
+            g = replica.in_flight.pop(f.uid, None)
+            if g is None:
+                # an engine must never emit a uid the gateway did not
+                # place on it — surfacing it beats silent corruption
+                raise RuntimeError(
+                    f"replica {replica.name} finished unknown uid "
+                    f"{f.uid!r}")
+            if g.first_token_s is None:
+                g.first_token_s = now
+                self.metrics.ttft_seconds.observe(now - g.arrival_s)
+            g.finished_s = now
+            self.results[g.uid] = f
+            self._terminal(g, FINISHED, done)
+
+    def _terminal(self, g: GatewayRequest, status: str,
+                  done: list[GatewayRequest]) -> None:
+        if g.uid in self.outcomes:
+            raise RuntimeError(
+                f"uid {g.uid!r} reached a second terminal status "
+                f"({self.outcomes[g.uid].status} then {status})")
+        g.status = status
+        if status == FINISHED:
+            margin = g.deadline_s - g.finished_s
+            if margin == float("inf"):
+                outcome = _FINISHED_ATTAINED
+            else:
+                self.metrics.slo_margin_seconds.observe(margin)
+                outcome = (_FINISHED_ATTAINED if margin >= 0
+                           else _FINISHED_LATE)
+        else:
+            outcome = status
+        self.metrics.requests.labels(outcome=outcome).inc()
+        self.outcomes[g.uid] = g
+        done.append(g)
+
+    def _drain(self, replica: EngineReplica) -> None:
+        """Health-driven drain: the replica stops receiving dispatch
+        (state DEAD), its in-flight rows are pulled back through the
+        engine's active-cancel hook and requeued AT THE FRONT with
+        their deadlines unchanged, and (``auto_replace``) a cold
+        replacement joins the pool under a fresh name."""
+        self.metrics.drains.inc()
+        self.manager.mark_down(replica)
+        self.router.forget(replica.name)
+        victims = list(replica.in_flight.values())
+        replica.in_flight.clear()
+        for g in reversed(victims):     # appendleft x reversed = FIFO
+            try:
+                replica.cancel(g.uid)
+            except Exception:
+                # a truly dead engine cannot cancel; the requeue is
+                # what guarantees delivery either way
+                pass
+            self.queue.requeue(g)
+            self.metrics.requeued.inc()
+        if self.auto_replace:
+            self.manager.replace(replica)
+
+    # -- observability ---------------------------------------------------
+
+    def stats(self) -> dict:
+        by_status: dict[str, int] = {}
+        for g in self.outcomes.values():
+            by_status[g.status] = by_status.get(g.status, 0) + 1
+        for g in self.refused:
+            by_status[g.status] = by_status.get(g.status, 0) + 1
+        return {
+            "queued": len(self.queue),
+            "in_flight": sum(len(r.in_flight)
+                             for r in self.manager.replicas),
+            "steps": self._steps,
+            "outcomes": by_status,
+            "replicas": self.manager.counts(),
+            "per_replica_dispatches": self.per_replica.snapshot(),
+        }
+
+
+__all__ = ["FleetGateway"]

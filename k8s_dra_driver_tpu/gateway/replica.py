@@ -1,0 +1,247 @@
+"""Replica pool lifecycle: DRA-acquired engines + health-driven drain.
+
+A replica is one ``ServingEngine`` bound to one chip's worth of
+capacity.  This module owns the two facts about a replica the engine
+itself cannot know:
+
+- **Where its chip came from.**  On a real node the serving process
+  holds a prepared ResourceClaim: the DRA plugin injected the
+  coordination-dir mount and env at prepare time (plugin/sharing.py),
+  and :class:`DraChipLease` consumes exactly that contract — it
+  resolves ``TPU_COORDINATOR_DIR`` through the pod's mounts, registers
+  with the claim's coordinator daemon as one more sharing-slot client
+  (coordclient/client.py), heartbeats while the replica serves so the
+  daemon never evicts it as dead, and unregisters on drain.  Hermetic
+  pools pass ``lease=None`` and run on the virtual mesh; the lease
+  path is exercised against a real prepared claim in
+  tests/test_gateway.py.
+- **Whether it should keep receiving traffic.**  ``ReplicaManager``
+  folds two down-signals into one verdict per replica: the discovery
+  backend's chip-health view (the same ``health()`` dict
+  plugin/health.py polls — a replica whose chip index goes unhealthy
+  is down) and a scripted :class:`~..cluster.faults.FaultPlan`
+  (verb ``"health"``, kind ``"Replica"``, name = replica name), so
+  chaos tests kill replicas deterministically through the same code
+  path a real chip failure takes.  The gateway pump turns a down
+  verdict into drain: stop dispatch, active-cancel the in-flight rows,
+  requeue them, and route around the hole until a replacement is up.
+"""
+
+from __future__ import annotations
+
+import itertools
+from pathlib import Path
+from typing import Callable
+
+from ..coordclient.client import ENV_COORDINATION_DIR, CoordinatorClient
+
+READY = "ready"
+DRAINING = "draining"
+DEAD = "dead"
+
+
+def resolve_container_path(path: str, mounts: list[dict] | None
+                           ) -> str:
+    """Map a container path from prepared-claim env back to the host
+    path through the claim's CDI mounts — the serving process and the
+    coordinator daemon rendezvous on the HOST directory; only
+    containerized workloads see the container alias."""
+    for m in mounts or []:
+        cpath = m.get("containerPath", "")
+        if path == cpath or path.startswith(cpath.rstrip("/") + "/"):
+            return m["hostPath"] + path[len(cpath):]
+    return path
+
+
+class DraChipLease:
+    """One replica's hold on its prepared-claim sharing slot.
+
+    Built from the env/mounts a DRA prepare injected (testbed
+    ``PodView`` or a real pod's environment).  ``None`` coordination
+    dir (an exclusive, non-coordinated claim) degrades to a no-op
+    lease: the claim still pins the chip; there is just no daemon to
+    register with.
+    """
+
+    def __init__(self, env: dict[str, str],
+                 mounts: list[dict] | None = None, *,
+                 name: str | None = None, weight: float = 1.0):
+        self.env = dict(env)
+        self.chips = [int(x) for x in
+                      env.get("TPU_VISIBLE_CHIPS", "").split(",")
+                      if x != ""]
+        cdir = env.get(ENV_COORDINATION_DIR)
+        self.client: CoordinatorClient | None = None
+        if cdir:
+            self.client = CoordinatorClient(
+                Path(resolve_container_path(cdir, mounts)),
+                name=name, weight=weight)
+
+    def acquire(self, wait_ready_s: float = 0.0) -> None:
+        """Register as a sharing-slot client (and optionally wait for
+        the coordinator daemon) — after this the duty-cycle schedule
+        includes the replica."""
+        if self.client is None:
+            return
+        if wait_ready_s > 0:
+            self.client.wait_ready(timeout_s=wait_ready_s)
+        self.client.register()
+
+    def heartbeat(self) -> None:
+        """Called from the gateway pump: a serving replica must never
+        look SIGKILLed to the daemon's staleness eviction."""
+        if self.client is not None:
+            self.client.maybe_heartbeat()
+
+    def release(self) -> None:
+        if self.client is not None:
+            self.client.unregister()
+
+
+class EngineReplica:
+    """One named engine in the pool, with the router-facing surface
+    (`ready`/`occupancy`/`prefix_peek`/`depth_bound`) and the gateway
+    verbs (`enqueue`/`cancel`/`step`)."""
+
+    def __init__(self, name: str, engine, *,
+                 chip: int | None = None,
+                 lease: DraChipLease | None = None,
+                 depth_bound: int | None = None):
+        self.name = name
+        self.engine = engine
+        self.chip = chip if chip is not None else (
+            lease.chips[0] if lease and lease.chips else None)
+        self.lease = lease
+        self.state = READY
+        # router backpressure line: slots (being decoded) + this many
+        # queued-behind fills; beyond it the request stays in the
+        # admission queue where shedding is accounted
+        self.depth_bound = (depth_bound if depth_bound is not None
+                            else 2 * engine.slots)
+        # uids this replica currently owns (dispatch -> finish/cancel);
+        # THE drain worklist, kept gateway-side so a dead engine's
+        # internals are never needed to know what it owed
+        self.in_flight: dict = {}
+
+    @property
+    def ready(self) -> bool:
+        return self.state == READY
+
+    def occupancy(self) -> dict:
+        return self.engine.occupancy()
+
+    def prefix_peek(self, prompt) -> int:
+        return self.engine.prefix_peek(prompt)
+
+    def enqueue(self, g) -> None:
+        self.engine.enqueue(g.request)
+        self.in_flight[g.uid] = g
+
+    def cancel(self, uid) -> bool:
+        return self.engine.cancel(uid)
+
+    def step(self) -> list:
+        return self.engine.step()
+
+
+class ReplicaManager:
+    """Owns the pool: construction, health verdicts, replacement.
+
+    ``engine_factory(name)`` builds a fresh engine (hermetic pools
+    close over params/config; DRA pools run the prepare path first and
+    close over the resulting lease env).  ``health_source`` is any
+    zero-arg callable returning the unhealthy dict
+    (``{chip_index: reason}``) — a discovery backend's bound
+    ``health()`` or a test dict's ``.copy``.  ``fault_plan`` injects
+    scripted replica-down decisions through cluster/faults.py.
+    """
+
+    def __init__(self, engine_factory: Callable[[str], object],
+                 replicas: int = 2, *,
+                 health_source: Callable[[], dict] | None = None,
+                 fault_plan=None,
+                 chip_of: Callable[[str], int | None] | None = None,
+                 lease_factory: Callable[[str], DraChipLease | None]
+                 | None = None,
+                 depth_bound: int | None = None):
+        self.engine_factory = engine_factory
+        self.health_source = health_source
+        self.fault_plan = fault_plan
+        self.lease_factory = lease_factory
+        self.depth_bound = depth_bound
+        self._chip_of = chip_of or (lambda name: None)
+        self._gen = itertools.count()
+        self.replicas: list[EngineReplica] = [
+            self._spawn() for _ in range(replicas)]
+
+    def _spawn(self) -> EngineReplica:
+        name = f"r{next(self._gen)}"
+        lease = self.lease_factory(name) if self.lease_factory else None
+        if lease is not None:
+            lease.acquire()
+        return EngineReplica(
+            name, self.engine_factory(name),
+            chip=self._chip_of(name), lease=lease,
+            depth_bound=self.depth_bound)
+
+    @property
+    def ready_replicas(self) -> list[EngineReplica]:
+        return [r for r in self.replicas if r.ready]
+
+    def counts(self) -> dict:
+        out = {READY: 0, DRAINING: 0, DEAD: 0}
+        for r in self.replicas:
+            out[r.state] += 1
+        return out
+
+    # -- health verdicts -------------------------------------------------
+
+    def poll_down(self) -> list[EngineReplica]:
+        """Replicas newly judged down this poll (chip unhealthy or a
+        scripted fault fired).  Judging is separate from draining: the
+        gateway pump owns the requeue so the admission accounting
+        stays in one place."""
+        down: list[EngineReplica] = []
+        unhealthy = {}
+        if self.health_source is not None:
+            try:
+                unhealthy = self.health_source() or {}
+            except Exception:
+                # same contract as plugin/health.py: a failed probe
+                # keeps last state rather than mass-draining the pool
+                unhealthy = {}
+        for r in self.replicas:
+            if not r.ready:
+                continue
+            if r.chip is not None and r.chip in unhealthy:
+                down.append(r)
+                continue
+            if self.fault_plan is not None:
+                d = self.fault_plan.decide("health", "Replica", r.name)
+                if d is not None and d.error:
+                    down.append(r)
+        return down
+
+    # -- lifecycle -------------------------------------------------------
+
+    def mark_down(self, replica: EngineReplica) -> None:
+        replica.state = DEAD
+        if replica.lease is not None:
+            replica.lease.release()
+
+    def replace(self, replica: EngineReplica) -> EngineReplica:
+        """Stand up a replacement for a dead replica (fresh name —
+        its PrefixCache starts cold, so routing history must not
+        follow the old identity)."""
+        fresh = self._spawn()
+        self.replicas.append(fresh)
+        return fresh
+
+    def heartbeat(self) -> None:
+        for r in self.replicas:
+            if r.ready and r.lease is not None:
+                r.lease.heartbeat()
+
+
+__all__ = ["DEAD", "DRAINING", "READY", "DraChipLease",
+           "EngineReplica", "ReplicaManager", "resolve_container_path"]

@@ -1,0 +1,137 @@
+"""Request placement across serving replicas.
+
+Two policies, one interface (``route(prompt, replicas) -> replica or
+None``):
+
+- **Prefix affinity** (the default): send same-system-prompt traffic
+  to the replica whose ``PrefixCache`` already holds that prefix, so
+  the shared tokens are prefilled ONCE per pool instead of once per
+  replica.  Affinity is scored from two sources — the replica engine's
+  own ``prefix_peek`` (what its cache holds NOW) and a bounded memory
+  of prompts this router recently routed there (what its cache is
+  ABOUT to hold: a burst of shared-prefix requests arrives faster than
+  the first fill completes, and peek alone would scatter the burst
+  across the pool before any cache has the prefix — the same
+  arrives-together pattern the engine's same-round deferral handles
+  one layer down).  Requests with no meaningful affinity spill to the
+  least-loaded replica (queue depth = active + pending), ties broken
+  by replica order, so cold traffic still statistically multiplexes
+  across the pool (AlpaServe's argument for pooling at all).
+- **Round robin**: the affinity-blind baseline the CI gate compares
+  against (tests/test_gateway.py pins that affinity routing pays
+  strictly fewer prefill dispatches on a shared-prefix workload).
+
+Routers never overfill: a replica at its depth bound is not a
+candidate, and ``route`` returns None when every replica is at bound —
+backpressure stays IN the admission queue where shedding is
+accounted, instead of hiding in per-replica queues.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from ..models.serving import _overlap
+
+
+class Router:
+    """Interface: pick a replica for a prompt, or None to hold it."""
+
+    def route(self, prompt: np.ndarray, replicas: list):
+        raise NotImplementedError
+
+    def forget(self, name: str) -> None:
+        """Drop any routing state tied to a replica (drain path)."""
+
+
+def _depth(replica) -> int:
+    occ = replica.occupancy()
+    return occ["active"] + occ["pending"]
+
+
+def _under_bound(replica) -> bool:
+    occ = replica.occupancy()
+    return occ["active"] + occ["pending"] < replica.depth_bound
+
+
+class LeastLoadedRouter(Router):
+    """Pure least-queue-depth spill (also the affinity fallback)."""
+
+    def route(self, prompt, replicas):
+        ready = [r for r in replicas if r.ready and _under_bound(r)]
+        if not ready:
+            return None
+        return min(ready, key=lambda r: (_depth(r), r.name))
+
+
+class RoundRobinRouter(Router):
+    """Affinity-blind baseline: next ready replica in turn."""
+
+    def __init__(self):
+        self._i = 0
+
+    def route(self, prompt, replicas):
+        ready = [r for r in replicas if r.ready and _under_bound(r)]
+        if not ready:
+            return None
+        pick = ready[self._i % len(ready)]
+        self._i += 1
+        return pick
+
+
+class PrefixAffinityRouter(Router):
+    """Longest-prefix placement with least-depth spill.
+
+    ``min_affinity`` is the token floor below which a match is noise
+    (a handful of coincidentally-equal leading tokens must not defeat
+    load balancing); ``history`` bounds the per-replica routed-prompt
+    memory (each entry is one prompt array reference, so the memory
+    cost is pointers, not tokens).
+    """
+
+    def __init__(self, min_affinity: int = 4, history: int = 32):
+        if min_affinity < 1:
+            raise ValueError("min_affinity must be >= 1")
+        self.min_affinity = min_affinity
+        self.history = history
+        self._routed: dict[str, deque] = {}
+
+    def _affinity(self, prompt: np.ndarray, replica) -> int:
+        # the last prompt token is always re-prefilled (its logits
+        # seed generation), so cap matches the engine's own peek cap
+        cap = prompt.size - 1
+        score = min(int(replica.prefix_peek(prompt)), cap)
+        for past in self._routed.get(replica.name, ()):
+            score = max(score, min(_overlap(prompt, past), cap))
+        return score
+
+    def route(self, prompt, replicas):
+        prompt = np.asarray(prompt, np.int32)
+        ready = [r for r in replicas if r.ready and _under_bound(r)]
+        if not ready:
+            return None
+        scored = [(self._affinity(prompt, r), r) for r in ready]
+        best, _ = max(scored, key=lambda s: s[0])
+        if best >= self.min_affinity:
+            # deterministic among equals: deepest affinity, then
+            # least depth, then name order
+            pick = min((r for a, r in scored if a == best),
+                       key=lambda r: (_depth(r), r.name))
+        else:
+            pick = min(ready, key=lambda r: (_depth(r), r.name))
+        hist = self._routed.setdefault(pick.name,
+                                       deque(maxlen=self.history))
+        hist.append(prompt)
+        return pick
+
+    def forget(self, name: str) -> None:
+        """A drained replica's cache is gone with it; keeping its
+        routed history would keep steering its old traffic at a fresh
+        replica that holds none of those prefixes."""
+        self._routed.pop(name, None)
+
+
+__all__ = ["Router", "LeastLoadedRouter", "RoundRobinRouter",
+           "PrefixAffinityRouter"]

@@ -395,6 +395,44 @@ class ServingEngine:
     def pending(self) -> int:
         return len(self.queue)
 
+    # -- pool-facing API (gateway/) --------------------------------------
+    #
+    # The fleet gateway places requests across N engines; it needs
+    # exactly four verbs — enqueue, cancel, occupancy, prefix-peek —
+    # and nothing else from engine internals, so replicas stay
+    # substitutable (a remote engine behind an RPC stub implements the
+    # same four).
+
+    def enqueue(self, req: Request) -> None:
+        """Pool-facing name for :meth:`submit` (same contract: raises
+        on malformed/duplicate/oversized requests)."""
+        self.submit(req)
+
+    def occupancy(self) -> dict:
+        """Scheduling snapshot for a router: slot/queue depth plus
+        per-active-request generated-token counts (the gateway derives
+        time-to-first-token from a count going 0 -> >=1; uids absent
+        from ``tokens`` are still queued engine-side)."""
+        return {
+            "slots": self.slots,
+            "active": self.active,
+            "pending": self.pending,
+            "free_slots": self.slots - self.active,
+            "depth": self.active + self.pending,
+            "tokens": {r.uid: len(self._generated[s])
+                       for s, r in enumerate(self._req)
+                       if r is not None},
+        }
+
+    def prefix_peek(self, prompt) -> int:
+        """Longest prompt prefix this engine's PrefixCache already
+        holds, WITHOUT hit accounting or an LRU touch (scheduling
+        probe, not an adoption) — 0 when the cache is off.  The
+        prefix-affinity router calls this on every candidate replica."""
+        if self._prefix is None:
+            return 0
+        return self._prefix.peek(np.asarray(prompt, np.int32))
+
     def cancel(self, uid) -> bool:
         """Drop a request by uid — queued (removed before it ever
         runs) or active (its slot frees immediately; the next step

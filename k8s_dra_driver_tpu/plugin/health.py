@@ -48,6 +48,14 @@ class HealthMonitor:
         self._publish_pending = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # change subscribers: called with the full unhealthy dict on
+        # every transition, BEFORE the republish attempt, so node-local
+        # consumers (the fleet gateway's replica drain,
+        # gateway/replica.py) see a chip-down even when the apiserver
+        # is unreachable — their reaction is local, the republish is
+        # not.  Callbacks must not raise; one failing listener must
+        # not starve the republish or its siblings.
+        self.listeners: list = []
 
     # -- one observation ---------------------------------------------------
 
@@ -73,6 +81,12 @@ class HealthMonitor:
                 log.warning("chip %d unhealthy: %s", idx, reason)
         for idx in sorted(set(before) - set(unhealthy)):
             log.info("chip %d healthy again", idx)
+        if changed:
+            for listener in list(self.listeners):
+                try:
+                    listener(dict(unhealthy))
+                except Exception:
+                    log.exception("health listener failed")
         try:
             self.driver.metrics.unhealthy_chips.set(len(unhealthy))
             self.driver.publish_resources()

@@ -97,6 +97,32 @@ def track():
             if v - before["by_label"].get(k, 0)}
 
 
+class Aggregator:
+    """Accumulate :func:`track` deltas under coarse keys — how the
+    fleet gateway attributes the process-global counter to replicas:
+    each replica's engine step runs inside its own ``track()`` region
+    (the pump is single-threaded, the scoping contract above) and the
+    delta is folded in under that replica's name.  ``snapshot()``
+    mirrors DispatchCounter's shape per key, so per-replica numbers
+    read exactly like the global ones."""
+
+    def __init__(self) -> None:
+        self.by_key: dict[str, Tracked] = {}
+
+    def add(self, key: str, t: Tracked) -> None:
+        agg = self.by_key.setdefault(key, Tracked())
+        agg.dispatches += t.dispatches
+        agg.readbacks += t.readbacks
+        for label, n in t.by_label.items():
+            agg.by_label[label] = agg.by_label.get(label, 0) + n
+
+    def snapshot(self) -> dict[str, dict]:
+        return {k: {"dispatches": t.dispatches,
+                    "readbacks": t.readbacks,
+                    "by_label": dict(t.by_label)}
+                for k, t in self.by_key.items()}
+
+
 class _Counted:
     """Callable wrapper that counts launches and forwards everything
     else (``_clear_cache``/``_cache_size`` on jitted functions keep

@@ -44,3 +44,67 @@ class DriverMetrics:
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
+
+
+# Gateway latency scales are milliseconds-to-seconds (queue wait,
+# TTFT), not the driver's sub-ms prepare path — separate bucket ladder.
+_GATEWAY_BUCKETS = (.0005, .001, .005, .01, .025, .05, .1, .25, .5,
+                    1, 2.5, 5, 10, 30)
+
+# SLO margin (deadline - completion, seconds): negative = missed.
+# Buckets must span both signs so the histogram shows HOW badly a
+# deadline was blown, not just that it was.
+_SLO_MARGIN_BUCKETS = (-30.0, -5.0, -1.0, -.25, -.05, 0.0, .05, .25,
+                       1.0, 5.0, 30.0)
+
+
+class GatewayMetrics:
+    """Fleet-gateway observability (gateway/frontend.py).
+
+    Same dedicated-registry pattern as :class:`DriverMetrics` so
+    gateway tests stay hermetic; ``render()`` serves the same
+    exposition endpoint.  The histograms are the acceptance surface
+    for drain/requeue: a replica kill is observable as requeued_total
+    advancing and the requeued requests' queue-wait samples landing a
+    second time.
+    """
+
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        self.queue_depth = Gauge(
+            "tpu_gateway_queue_depth",
+            "Requests currently waiting in the admission queue",
+            registry=self.registry)
+        self.replicas = Gauge(
+            "tpu_gateway_replicas", "Replicas by lifecycle state",
+            ["state"], registry=self.registry)
+        self.queue_wait_seconds = Histogram(
+            "tpu_gateway_queue_wait_seconds",
+            "Admission-queue wait per dispatch (requeued requests "
+            "sample again on their re-dispatch)",
+            registry=self.registry, buckets=_GATEWAY_BUCKETS)
+        self.ttft_seconds = Histogram(
+            "tpu_gateway_ttft_seconds",
+            "Arrival to first generated token, per request",
+            registry=self.registry, buckets=_GATEWAY_BUCKETS)
+        self.slo_margin_seconds = Histogram(
+            "tpu_gateway_slo_margin_seconds",
+            "Deadline minus completion time per finished request "
+            "(negative = SLO missed)", registry=self.registry,
+            buckets=_SLO_MARGIN_BUCKETS)
+        self.requests = Counter(
+            "tpu_gateway_requests_total",
+            "Terminal request outcomes "
+            "(finished_attained/finished_late/shed/rejected)",
+            ["outcome"], registry=self.registry)
+        self.requeued = Counter(
+            "tpu_gateway_requeued_total",
+            "In-flight requests pulled back to the queue by a drain",
+            registry=self.registry)
+        self.drains = Counter(
+            "tpu_gateway_drains_total",
+            "Replica drains triggered by health/fault signals",
+            registry=self.registry)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -75,6 +75,34 @@ class TestPluginFlags:
                 ["--node-name", "n", "--device-classes", "podslice"]))
 
 
+class TestVisibleChipsFlag:
+    def test_default_is_unmasked(self):
+        args = _parse_plugin(["--node-name", "n"])
+        assert args.visible_chips == ""
+
+    def test_env_mirror(self, monkeypatch):
+        monkeypatch.setenv("VISIBLE_CHIPS", "0,1")
+        assert _parse_plugin([]).visible_chips == "0,1"
+
+    def test_mask_backend_wraps_discovery(self, tmp_path):
+        """--visible-chips filters what the plugin will publish — the
+        nvkind per-worker partitioning analog, composed around any
+        backend (here a fake tree), with @file resolved under the
+        driver root so each worker's host mount carries its own
+        mask."""
+        from k8s_dra_driver_tpu.discovery import FakeHost
+        backend = FakeHost(num_chips=4).materialize(tmp_path)
+        (tmp_path / "visible_chips").write_text("1,2\n")
+        args = _parse_plugin(["--node-name", "n",
+                              "--driver-root", str(tmp_path),
+                              "--visible-chips", "@/visible_chips"])
+        masked = plugin_cmd.mask_backend(args, backend)
+        assert [c.index for c in masked.enumerate().chips] == [1, 2]
+        # empty value: the backend passes through untouched
+        args = _parse_plugin(["--node-name", "n"])
+        assert plugin_cmd.mask_backend(args, backend) is backend
+
+
 class TestPluginRun:
     def test_end_to_end_with_fake_topology(self, tmp_path):
         """main-path smoke: fake topology file -> devices published,

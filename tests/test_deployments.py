@@ -53,7 +53,8 @@ def test_daemonset_mounts_kubelet_contract():
     # every flag the binary reads from env is wired
     for name in ("NODE_NAME", "PLUGIN_ROOT", "REGISTRAR_ROOT", "CDI_ROOT",
                  "DRIVER_ROOT", "DEVICE_CLASSES", "COORDINATOR_NAMESPACE",
-                 "HTTP_ENDPOINT", "KUBE_API_QPS", "KUBE_API_BURST"):
+                 "HTTP_ENDPOINT", "KUBE_API_QPS", "KUBE_API_BURST",
+                 "VISIBLE_CHIPS"):
         assert name in env, f"DaemonSet missing env {name}"
     host = {m["mountPath"]: m for m in ctr["volumeMounts"]}["/host"]
     assert host.get("readOnly") is True
@@ -102,6 +103,22 @@ def test_demo_scripts_are_valid_bash():
         out = subprocess.run(["bash", "-n", str(script)],
                              capture_output=True, text=True)
         assert out.returncode == 0, f"{script}: {out.stderr}"
+
+
+def test_visible_chips_knob_is_wired_end_to_end():
+    """The nvkind chip-masking analog (VERDICT missing #3): chart
+    value -> env -> plugin flag, with the kind gang scripts writing
+    per-worker mask files and the installer passing the @file form
+    through."""
+    values = yaml.safe_load(
+        (REPO / "deployments/helm/tpu-dra-driver/values.yaml")
+        .read_text())
+    assert values["kubeletPlugin"]["visibleChips"] == ""
+    create = (REPO / "demo/clusters/kind/create-cluster.sh").read_text()
+    assert "visible_chips" in create        # per-worker mask files
+    install = (REPO
+               / "demo/clusters/kind/install-dra-driver.sh").read_text()
+    assert "kubeletPlugin.visibleChips" in install
 
 
 def test_kind_config_enables_dra():

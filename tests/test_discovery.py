@@ -148,3 +148,51 @@ class TestMultiHostSlice:
         topo = SysfsBackend(host_root=str(tmp_path)).enumerate()
         assert topo.slice is None  # ...and is ignored
         assert len(topo.chips) == 4  # sysfs enumeration itself unaffected
+
+
+class TestVisibleChipMasking:
+    """MaskedBackend + parse_visible_chips: the nvkind per-worker
+    chip-partitioning analog (VERDICT missing #3) at the discovery
+    boundary."""
+
+    def test_enumerate_filters_to_the_mask(self, tmp_path):
+        from k8s_dra_driver_tpu.discovery import FakeHost, MaskedBackend
+        inner = FakeHost(num_chips=4).materialize(tmp_path)
+        topo = MaskedBackend(inner, frozenset({0, 2})).enumerate()
+        assert [c.index for c in topo.chips] == [0, 2]
+        # host identity rides through untouched
+        assert topo.hostname == inner.enumerate().hostname
+
+    def test_unknown_index_fails_fast(self, tmp_path):
+        from k8s_dra_driver_tpu.discovery import FakeHost, MaskedBackend
+        inner = FakeHost(num_chips=2).materialize(tmp_path)
+        with pytest.raises(ValueError, match=r"\[7\] not on this host"):
+            MaskedBackend(inner, frozenset({0, 7})).enumerate()
+        with pytest.raises(ValueError, match=">= 1 chip"):
+            MaskedBackend(inner, frozenset())
+
+    def test_health_only_reports_visible_chips(self, tmp_path):
+        from k8s_dra_driver_tpu.discovery import (FakeHost,
+                                                  MaskedBackend,
+                                                  StaticBackend)
+        topo = FakeHost(num_chips=4).materialize(tmp_path).enumerate()
+        inner = StaticBackend(topo)
+        masked = MaskedBackend(inner, frozenset({0, 1}))
+        # one visible chip fails, one masked-out chip fails
+        inner.unhealthy = {1: "thermal trip", 3: "thermal trip"}
+        unhealthy = masked.health(expected=frozenset({0, 1}))
+        assert set(unhealthy) == {1}   # chip 3 is not our problem
+
+    def test_parse_visible_chips_list_and_file(self, tmp_path):
+        from k8s_dra_driver_tpu.discovery import parse_visible_chips
+        assert parse_visible_chips("") is None
+        assert parse_visible_chips(" 0,2 ") == frozenset({0, 2})
+        # @file resolves under the driver root, the same host mount
+        # the sysfs tree rides (per-worker masking)
+        (tmp_path / "visible_chips").write_text("1,3\n")
+        assert parse_visible_chips("@/visible_chips",
+                                   str(tmp_path)) == frozenset({1, 3})
+        (tmp_path / "empty").write_text("\n")
+        assert parse_visible_chips("@/empty", str(tmp_path)) is None
+        with pytest.raises(ValueError, match="comma list"):
+            parse_visible_chips("0,x")

@@ -1,0 +1,567 @@
+"""Fleet gateway (k8s_dra_driver_tpu/gateway/): SLO-aware admission,
+prefix-affinity routing, and health-driven drain over ≥2 in-process
+replicas on the virtual CPU mesh.
+
+The acceptance invariants (ISSUE 3): under bursty arrivals with a
+replica killed mid-stream, every admitted request completes exactly
+once with tokens byte-equal to a single-engine oracle, expired
+requests are shed with an explicit status, and drain/requeue is
+observable in the gateway metrics histograms.  Routing is scheduling,
+never math.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.cluster.faults import FaultPlan
+from k8s_dra_driver_tpu.gateway import (DraChipLease, FleetGateway,
+                                        GatewayRequest,
+                                        LeastLoadedRouter,
+                                        PrefixAffinityRouter,
+                                        REJECTED_DUPLICATE,
+                                        REJECTED_FULL, ReplicaManager,
+                                        RoundRobinRouter, SHED_EXPIRED,
+                                        resolve_container_path)
+from k8s_dra_driver_tpu.gateway.admission import (AdmissionError,
+                                                  AdmissionQueue)
+from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                       greedy_generate, init_params)
+from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
+from k8s_dra_driver_tpu.utils import dispatch
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_head=8, d_ff=64, max_seq=48, n_kv_heads=2,
+                        dtype=jnp.float32)
+
+_PARAMS = None
+
+
+def params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+    return _PARAMS
+
+
+def prompt(seed, n):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab), np.int32)
+
+
+def oracle(pr, n_new):
+    """Single-engine reference: tokens the pool must reproduce."""
+    out = greedy_generate(params(), jnp.asarray(pr)[None, :], CFG,
+                          n_tokens=n_new)
+    return np.asarray(out[0], np.int32)
+
+
+def make_req(uid, seed, n_prompt, max_new):
+    return Request(uid=uid, prompt=prompt(seed, n_prompt),
+                   max_new=max_new)
+
+
+def pool(replicas=2, slots=2, prefix_cache=0, **kw):
+    return ReplicaManager(
+        lambda name: ServingEngine(params(), CFG, slots=slots,
+                                   prefix_cache=prefix_cache),
+        replicas=replicas, **kw)
+
+
+class Clock:
+    """Injected gateway clock for deterministic SLO tests."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- admission queue (pure host logic, no jax) ----------------------------
+
+class TestAdmissionQueue:
+    def test_reject_on_full_is_explicit(self):
+        q = AdmissionQueue(capacity=2)
+        q.offer(Request(uid="a", prompt=np.ones(3, np.int32),
+                        max_new=1), 0.0)
+        q.offer(Request(uid="b", prompt=np.ones(3, np.int32),
+                        max_new=1), 0.0)
+        with pytest.raises(AdmissionError) as e:
+            q.offer(Request(uid="c", prompt=np.ones(3, np.int32),
+                            max_new=1), 0.0)
+        assert e.value.status == REJECTED_FULL
+
+    def test_duplicate_uid_rejected_pool_wide(self):
+        q = AdmissionQueue(capacity=4)
+        q.offer(Request(uid="a", prompt=np.ones(3, np.int32),
+                        max_new=1), 0.0)
+        with pytest.raises(AdmissionError) as e:
+            q.offer(Request(uid="a", prompt=np.ones(3, np.int32),
+                            max_new=1), 0.0)
+        assert e.value.status == REJECTED_DUPLICATE
+        with pytest.raises(AdmissionError):
+            q.offer(Request(uid="x", prompt=np.ones(3, np.int32),
+                            max_new=1), 0.0,
+                    live_uids=frozenset({"x"}))
+
+    def test_shed_on_expired_never_silent(self):
+        q = AdmissionQueue(capacity=4)
+        q.offer(Request(uid="a", prompt=np.ones(3, np.int32),
+                        max_new=1), 0.0, slo_s=1.0)
+        q.offer(Request(uid="b", prompt=np.ones(3, np.int32),
+                        max_new=1), 0.0, slo_s=10.0)
+        shed = q.shed_expired(5.0)
+        assert [g.uid for g in shed] == ["a"]
+        assert all(g.status == SHED_EXPIRED for g in shed)
+        assert len(q) == 1 and q.peek().uid == "b"
+        # pop never hands out an expired request either
+        assert q.pop(100.0) is None
+
+    def test_requeue_goes_to_front_keeping_deadline(self):
+        q = AdmissionQueue(capacity=4)
+        g1 = q.offer(Request(uid="a", prompt=np.ones(3, np.int32),
+                             max_new=1), 0.0, slo_s=9.0)
+        q.offer(Request(uid="b", prompt=np.ones(3, np.int32),
+                        max_new=1), 1.0)
+        got = q.pop(2.0)
+        assert got is g1
+        q.requeue(g1)
+        assert q.peek().uid == "a"          # front, ahead of b
+        assert g1.deadline_s == 9.0         # no extra SLO budget
+        assert g1.requeues == 1
+
+
+# -- routers (stub replicas, no jax) --------------------------------------
+
+class StubReplica:
+    def __init__(self, name, depth=0, bound=4, peek=0):
+        self.name = name
+        self.ready = True
+        self.depth_bound = bound
+        self._depth = depth
+        self._peek = peek
+
+    def occupancy(self):
+        return {"active": self._depth, "pending": 0,
+                "free_slots": 0, "slots": 2,
+                "depth": self._depth, "tokens": {}}
+
+    def prefix_peek(self, prompt):
+        return self._peek
+
+
+class TestRouters:
+    def test_affinity_prefers_cached_prefix(self):
+        r0 = StubReplica("r0", depth=3, peek=8)   # busier but warm
+        r1 = StubReplica("r1", depth=0, peek=0)
+        router = PrefixAffinityRouter(min_affinity=4)
+        pick = router.route(np.arange(12, dtype=np.int32), [r0, r1])
+        assert pick is r0
+
+    def test_cold_traffic_spills_to_least_depth(self):
+        r0 = StubReplica("r0", depth=3)
+        r1 = StubReplica("r1", depth=1)
+        pick = PrefixAffinityRouter().route(
+            np.arange(12, dtype=np.int32), [r0, r1])
+        assert pick is r1
+
+    def test_routed_history_binds_a_burst_before_first_fill(self):
+        """The system-prompt burst: the second request must follow the
+        first even though no cache holds the prefix yet."""
+        r0 = StubReplica("r0")
+        r1 = StubReplica("r1")
+        router = PrefixAffinityRouter(min_affinity=4)
+        pr = np.arange(12, dtype=np.int32)
+        first = router.route(pr, [r0, r1])
+        second = router.route(pr.copy(), [r0, r1])
+        assert second is first
+
+    def test_forget_unbinds_a_drained_replica(self):
+        r0, r1 = StubReplica("r0"), StubReplica("r1")
+        router = PrefixAffinityRouter(min_affinity=4)
+        pr = np.arange(12, dtype=np.int32)
+        assert router.route(pr, [r0, r1]) is r0
+        router.forget("r0")
+        r0.ready = False
+        assert router.route(pr.copy(), [r0, r1]) is r1
+
+    def test_every_router_honors_the_depth_bound(self):
+        full = [StubReplica("r0", depth=4, bound=4),
+                StubReplica("r1", depth=4, bound=4)]
+        pr = np.arange(6, dtype=np.int32)
+        for router in (PrefixAffinityRouter(), RoundRobinRouter(),
+                       LeastLoadedRouter()):
+            assert router.route(pr, full) is None
+
+    def test_round_robin_alternates(self):
+        r0, r1 = StubReplica("r0"), StubReplica("r1")
+        router = RoundRobinRouter()
+        picks = [router.route(np.arange(4, dtype=np.int32),
+                              [r0, r1]).name for _ in range(4)]
+        assert picks == ["r0", "r1", "r0", "r1"]
+
+
+# -- engine pool-facing API -----------------------------------------------
+
+class TestEnginePoolAPI:
+    def test_occupancy_and_token_progress(self):
+        eng = ServingEngine(params(), CFG, slots=2)
+        eng.enqueue(Request(uid="a", prompt=prompt(1, 5), max_new=4))
+        eng.enqueue(Request(uid="b", prompt=prompt(2, 6), max_new=4))
+        eng.enqueue(Request(uid="c", prompt=prompt(3, 5), max_new=4))
+        occ = eng.occupancy()
+        assert occ == {"slots": 2, "active": 0, "pending": 3,
+                       "free_slots": 2, "depth": 3, "tokens": {}}
+        eng.step()
+        occ = eng.occupancy()
+        assert occ["active"] == 2 and occ["pending"] == 1
+        assert set(occ["tokens"]) == {"a", "b"}
+        assert all(n >= 1 for n in occ["tokens"].values())
+
+    def test_prefix_peek_without_hit_accounting(self):
+        eng = ServingEngine(params(), CFG, slots=2, prefix_cache=2)
+        pr = prompt(4, 8)
+        assert eng.prefix_peek(pr) == 0
+        eng.enqueue(Request(uid="a", prompt=pr, max_new=2))
+        eng.run()
+        hits_before = eng.stats()["prefix_hits_total"]
+        assert eng.prefix_peek(pr) >= pr.size - 1
+        assert eng.stats()["prefix_hits_total"] == hits_before
+        assert ServingEngine(params(), CFG,
+                             slots=2).prefix_peek(pr) == 0
+
+
+# -- the acceptance scenario ----------------------------------------------
+
+def _burst_reqs():
+    """Bursty mixed-length workload: three bursts, distinct uids,
+    two prompt-length classes (bounds compile count)."""
+    bursts, seed = [], 10
+    for b, size in enumerate((4, 3, 4)):
+        burst = []
+        for i in range(size):
+            seed += 1
+            burst.append(make_req(f"b{b}i{i}", seed,
+                                  5 + (i % 2) * 3, 3 + (i % 3)))
+        bursts.append(burst)
+    return bursts
+
+
+def test_kill_replica_mid_stream_exactly_once_byte_equal():
+    """THE acceptance test: 2 replicas, bursty arrivals, replica r0
+    killed by an injected fault after its first dispatch wave; every
+    admitted request finishes exactly once, byte-equal to the
+    single-engine oracle, and the drain/requeue is observable in the
+    metrics."""
+    plan = FaultPlan.from_json({"rules": [
+        # skip r0's first health poll (pre-dispatch), kill on the 2nd:
+        # its in-flight rows exist and must drain+requeue
+        {"verb": "health", "kind": "Replica", "name": "r0",
+         "skip": 1, "times": 1, "error": "drop"}]})
+    mgr = pool(replicas=2, fault_plan=plan)
+    gw = FleetGateway(mgr, queue_capacity=32)
+    bursts = _burst_reqs()
+    submitted = [r for burst in bursts for r in burst]
+    done = []
+    for burst in bursts:
+        for req in burst:
+            g = gw.submit(req, slo_s=120.0)
+            assert g.status == "queued"
+        done.extend(gw.step())
+    done.extend(gw.run_until_idle())
+
+    # exactly once: every admitted uid has ONE terminal record
+    assert len(gw.outcomes) == len(submitted)
+    assert {g.uid for g in done} == {r.uid for r in submitted}
+    assert all(g.status == "finished" for g in gw.outcomes.values())
+    # byte-equal to the single-engine oracle, through the kill
+    for req in submitted:
+        np.testing.assert_array_equal(
+            gw.results[req.uid].tokens,
+            oracle(req.prompt, req.max_new),
+            err_msg=f"{req.uid} diverged from the oracle")
+    # the kill actually happened and is observable
+    st = gw.stats()
+    assert st["replicas"]["dead"] == 1
+    assert st["replicas"]["ready"] == 2          # replacement arrived
+    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
+    assert requeued, "fault fired before anything was in flight"
+    text = gw.metrics.render().decode()
+    assert re.search(r"tpu_gateway_drains_total 1\.0", text)
+    m = re.search(r"tpu_gateway_requeued_total (\d+)\.0", text)
+    assert m and int(m.group(1)) == len(requeued)
+    # requeued requests waited twice -> extra queue-wait samples
+    m = re.search(r"tpu_gateway_queue_wait_seconds_count (\d+)\.0",
+                  text)
+    assert int(m.group(1)) == len(submitted) + len(requeued)
+    # every finished request has a TTFT sample
+    m = re.search(r"tpu_gateway_ttft_seconds_count (\d+)\.0", text)
+    assert int(m.group(1)) == len(submitted)
+
+
+def test_chip_health_signal_drains_the_mapped_replica():
+    """The plugin/health.py-shaped signal: a replica whose chip index
+    goes unhealthy is drained; replicas on healthy chips keep
+    serving."""
+    unhealthy: dict[int, str] = {}
+    mgr = ReplicaManager(
+        lambda name: ServingEngine(params(), CFG, slots=2),
+        replicas=2, health_source=lambda: unhealthy,
+        chip_of=lambda name: int(name[1:]))   # r0 -> chip 0
+    gw = FleetGateway(mgr, queue_capacity=8)
+    for i in range(4):
+        gw.submit(make_req(f"u{i}", 30 + i, 5, 4), slo_s=60.0)
+    gw.step()
+    unhealthy[0] = "device node vanished"
+    done = gw.run_until_idle()
+    assert {g.uid for g in done} == {f"u{i}" for i in range(4)}
+    assert gw.stats()["replicas"]["dead"] == 1
+    dead = [r for r in mgr.replicas if r.state == "dead"]
+    assert [r.chip for r in dead] == [0]
+    for i in range(4):
+        req = make_req(f"u{i}", 30 + i, 5, 4)
+        np.testing.assert_array_equal(
+            gw.results[f"u{i}"].tokens,
+            oracle(req.prompt, req.max_new))
+
+
+def test_shed_and_reject_under_overload_are_explicit():
+    """Overload semantics with an injected clock: the bounded queue
+    rejects at the door, waiting requests past their deadline shed
+    with SHED_EXPIRED, and both outcomes land in the metrics — no
+    silent drops."""
+    clock = Clock()
+    mgr = pool(replicas=1, slots=1)
+    gw = FleetGateway(mgr, queue_capacity=2, clock=clock)
+    records = [gw.submit(make_req(f"u{i}", 40 + i, 5, 3), slo_s=5.0)
+               for i in range(4)]
+    # capacity 2: the last two are rejected with an explicit status
+    assert [g.status for g in records[:2]] == ["queued", "queued"]
+    assert [g.status for g in records[2:]] == [REJECTED_FULL] * 2
+    # expire the queued ones before any dispatch
+    clock.advance(10.0)
+    done = gw.run_until_idle()
+    assert {g.status for g in done} == {SHED_EXPIRED}
+    assert sorted(g.uid for g in done) == ["u0", "u1"]
+    text = gw.metrics.render().decode()
+    assert 'outcome="rejected_full"} 2.0' in text
+    assert 'outcome="shed_expired"} 2.0' in text
+    st = gw.stats()["outcomes"]
+    assert st == {SHED_EXPIRED: 2, REJECTED_FULL: 2}
+
+
+def test_prefix_affinity_beats_round_robin_on_prefill_dispatches():
+    """FAST-TIER CI GATE (ISSUE 3 satellite): on a shared-prefix
+    workload, prefix-affinity routing pays strictly fewer fresh
+    full-prompt prefill dispatches than round-robin — the pool
+    computes a shared system prompt once, not once per replica
+    (utils/dispatch.py counters are the hermetic evidence)."""
+    rng = np.random.default_rng(0)
+    pre = rng.integers(0, CFG.vocab, 8).astype(np.int32)
+    protos = []
+    for i in range(6):
+        tail = rng.integers(0, CFG.vocab,
+                            4 + (i % 2)).astype(np.int32)
+        protos.append((f"u{i}", np.concatenate([pre, tail])))
+
+    def drain(router):
+        mgr = pool(replicas=2, prefix_cache=2,
+                   depth_bound=len(protos))
+        gw = FleetGateway(mgr, router=router, queue_capacity=16)
+        with dispatch.track() as t:
+            for uid, pr in protos:
+                gw.submit(Request(uid=uid, prompt=pr.copy(),
+                                  max_new=3))
+            gw.run_until_idle()
+        fresh = (t.by_label.get("prefill_adopt_rows", 0)
+                 + t.by_label.get("prefill", 0))
+        return fresh, t.dispatches, gw
+
+    fresh_aff, disp_aff, gw_aff = drain(PrefixAffinityRouter())
+    fresh_rr, disp_rr, gw_rr = drain(RoundRobinRouter())
+    assert fresh_aff < fresh_rr, (fresh_aff, fresh_rr)
+    # and the placement explains it: affinity kept the family together
+    aff_replicas = {g.replica for g in gw_aff.outcomes.values()}
+    rr_replicas = {g.replica for g in gw_rr.outcomes.values()}
+    assert len(aff_replicas) < len(rr_replicas)
+    # outputs identical either way (routing is never math)
+    for uid in gw_aff.results:
+        np.testing.assert_array_equal(gw_aff.results[uid].tokens,
+                                      gw_rr.results[uid].tokens)
+
+
+def test_unrunnable_request_rejected_invalid_not_lost():
+    """A request no engine can run (prompt + max_new exceeds the
+    cache) terminates with an explicit rejected_invalid — the pump
+    neither crashes nor loses it."""
+    from k8s_dra_driver_tpu.gateway import REJECTED_INVALID
+    mgr = pool(replicas=1)
+    gw = FleetGateway(mgr, queue_capacity=4)
+    gw.submit(Request(uid="big", prompt=prompt(80, 40), max_new=20))
+    gw.submit(make_req("ok", 81, 5, 3))
+    done = gw.run_until_idle()
+    by_uid = {g.uid: g.status for g in done}
+    assert by_uid == {"big": REJECTED_INVALID, "ok": "finished"}
+
+
+def test_uid_reuse_after_finish_starts_fresh_lifecycle():
+    """A finished uid may be resubmitted (clients recycle request
+    ids); a LIVE uid may not (it would make cancel/finish ambiguous
+    pool-wide)."""
+    mgr = pool(replicas=1)
+    gw = FleetGateway(mgr, queue_capacity=4)
+    gw.submit(make_req("u", 70, 5, 3))
+    gw.run_until_idle()
+    first = gw.results["u"].tokens.copy()
+    g = gw.submit(make_req("u", 70, 5, 3))
+    assert g.status == "queued"
+    gw.run_until_idle()
+    np.testing.assert_array_equal(gw.results["u"].tokens, first)
+    gw.submit(make_req("v", 71, 5, 3))
+    rec = gw.submit(make_req("v", 72, 5, 3))
+    assert rec.status == REJECTED_DUPLICATE
+    gw.run_until_idle()
+
+
+def test_per_replica_dispatch_attribution():
+    """utils/dispatch.py aggregation: the gateway attributes launch
+    counts to the replica that paid them, and the per-replica sum
+    matches the global delta over the drain."""
+    mgr = pool(replicas=2)
+    gw = FleetGateway(mgr, queue_capacity=8)
+    with dispatch.track() as t:
+        for i in range(4):
+            gw.submit(make_req(f"u{i}", 60 + i, 5, 3))
+        gw.run_until_idle()
+    per = gw.stats()["per_replica_dispatches"]
+    assert set(per) == {"r0", "r1"}
+    assert sum(v["dispatches"] for v in per.values()) == t.dispatches
+    assert sum(v["readbacks"] for v in per.values()) == t.readbacks
+
+
+# -- DRA lease path -------------------------------------------------------
+
+def test_replica_lease_through_real_dra_prepare(tmp_path):
+    """The control-plane tie-in: a coordinated-sharing claim prepared
+    through the in-process driver bed yields the env/mounts a serving
+    replica's lease consumes — the lease registers with the claim's
+    REAL coordinator daemon as a sharing-slot client, heartbeats, and
+    unregisters on drain."""
+    import json
+
+    from helpers import chip_config
+    from testbed import E2EBed
+
+    from k8s_dra_driver_tpu.api import resource
+    from k8s_dra_driver_tpu.discovery import FakeHost
+    from k8s_dra_driver_tpu.plugin import DeviceState
+
+    DeviceState._sleep = staticmethod(lambda s: None)
+    bed = E2EBed(tmp_path, [FakeHost(hostname="gw-host")],
+                 with_controller=False)
+    try:
+        claim = resource.ResourceClaim(
+            metadata=resource.ObjectMeta(name="gw-co",
+                                         namespace="default"),
+            spec=resource.ResourceClaimSpec(
+                devices=resource.DeviceClaim(
+                    requests=[resource.DeviceRequest(
+                        name="r0",
+                        device_class_name="tpu.google.com",
+                        count=1)],
+                    config=[resource.ClaimConfig(
+                        opaque=resource.OpaqueConfig(
+                            driver="tpu.google.com",
+                            parameters=chip_config(
+                                "Coordinated",
+                                coordinated={
+                                    "dutyCyclePercent": 50})))])))
+        claim = bed.create_claim(claim)
+        view = bed.run_pod(claim)
+        assert view.env["TPU_COORDINATOR_DIR"] == "/coordination"
+        host_dir = resolve_container_path("/coordination", view.mounts)
+        assert host_dir != "/coordination"
+        lease = DraChipLease(view.env, view.mounts, name="replica-a")
+        assert lease.chips == view.visible_chips
+        lease.acquire(wait_ready_s=5.0)
+        reg = json.loads(
+            (lease.client.dir / "ctl" / "replica-a.json").read_text())
+        assert reg["pid"] > 0
+        lease.heartbeat()           # inside the interval: no rewrite
+        lease.release()
+        assert not (lease.client.dir / "ctl" / "replica-a.json").exists()
+    finally:
+        bed.shutdown()
+
+
+def test_lease_without_coordination_dir_is_noop():
+    lease = DraChipLease({"TPU_VISIBLE_CHIPS": "2"})
+    assert lease.client is None and lease.chips == [2]
+    lease.acquire()
+    lease.heartbeat()
+    lease.release()
+
+
+def test_resolve_container_path():
+    mounts = [{"hostPath": "/tmp/x/coord", "containerPath":
+               "/coordination", "options": ["rw", "bind"]}]
+    assert resolve_container_path("/coordination", mounts) \
+        == "/tmp/x/coord"
+    assert resolve_container_path("/coordination/ready", mounts) \
+        == "/tmp/x/coord/ready"
+    assert resolve_container_path("/other", mounts) == "/other"
+
+
+def test_health_monitor_listener_feeds_the_gateway_signal():
+    """plugin/health.py -> gateway wiring: the monitor's listener
+    hook fires with the unhealthy dict on every transition, even when
+    the republish fails (the gateway's reaction is node-local)."""
+    from k8s_dra_driver_tpu.plugin.health import HealthMonitor
+
+    class Backend:
+        def __init__(self):
+            self.unhealthy = {}
+
+        def health(self, expected=None):
+            return dict(self.unhealthy)
+
+    class State:
+        class topology:
+            chips = ()
+        unhealthy: dict = {}
+
+        @staticmethod
+        def apply_health(u):
+            changed = State.unhealthy != u
+            State.unhealthy = dict(u)
+            return changed
+
+        allocatable: dict = {}
+
+    class Driver:
+        state = State()
+
+        class metrics:
+            class unhealthy_chips:
+                @staticmethod
+                def set(n):
+                    pass
+
+        @staticmethod
+        def publish_resources():
+            raise RuntimeError("apiserver down")
+
+    backend = Backend()
+    monitor = HealthMonitor(Driver(), backend, interval=0)
+    seen = []
+    monitor.listeners.append(lambda u: seen.append(u))
+    backend.unhealthy = {1: "thermal trip"}
+    monitor.check_once()            # republish fails; listener fired
+    assert seen == [{1: "thermal trip"}]
