@@ -85,6 +85,15 @@ class AdmissionQueue:
     drain victims re-enter at the FRONT (they already waited their
     turn once — pushing them behind the burst that arrived after them
     would double-charge the queue wait and starve them under load).
+
+    Capacity contract on requeue: drain victims re-enter WITHOUT a
+    capacity check — they were already admitted once, and bouncing
+    them at the door would turn a replica failure into a silent drop.
+    The queue may therefore transiently hold up to ``capacity`` plus
+    the dead replica's in-flight count; :meth:`offer` keeps rejecting
+    NEW traffic until the backlog drains back under the bound, which
+    is the intended degraded-mode behavior (admitted work outranks
+    new work).
     """
 
     def __init__(self, capacity: int):
@@ -156,7 +165,9 @@ class AdmissionQueue:
         """Drain path: an in-flight request returns to the FRONT of
         the queue (see class docstring) with its arrival time — and
         therefore its deadline — unchanged: a replica failure does not
-        grant a request more SLO budget."""
+        grant a request more SLO budget.  No capacity check — the
+        request was already admitted (see the class docstring's
+        capacity contract)."""
         g.status = QUEUED
         g.replica = None
         g.dispatched_s = None

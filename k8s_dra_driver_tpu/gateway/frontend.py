@@ -126,6 +126,15 @@ class FleetGateway:
             if target is None:
                 break
             g = self.queue.pop(now)
+            if g is None:
+                # the head expired AFTER this step's sweep — a drain
+                # victim phase 2 requeued past its deadline.  Shed it
+                # with the explicit status right now (never dispatch
+                # it dead, never crash the pump) and keep placing
+                # whatever live work sits behind it.
+                for expired in self.queue.shed_expired(now):
+                    self._terminal(expired, SHED_EXPIRED, done)
+                continue
             g.status = DISPATCHED
             g.replica = target.name
             g.dispatched_s = now

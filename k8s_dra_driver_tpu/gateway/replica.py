@@ -171,6 +171,13 @@ class ReplicaManager:
         self.depth_bound = depth_bound
         self._chip_of = chip_of or (lambda name: None)
         self._gen = itertools.count()
+        # last successful health observation; reused when a probe
+        # fails so a flaky transport neither mass-drains the pool
+        # nor masks chips already known bad
+        self._last_unhealthy: dict = {}
+        # dead replicas compacted out of the pool by replace(); keeps
+        # counts() monotone without growing the replica list forever
+        self._dead_removed = 0
         self.replicas: list[EngineReplica] = [
             self._spawn() for _ in range(replicas)]
 
@@ -192,6 +199,7 @@ class ReplicaManager:
         out = {READY: 0, DRAINING: 0, DEAD: 0}
         for r in self.replicas:
             out[r.state] += 1
+        out[DEAD] += self._dead_removed
         return out
 
     # -- health verdicts -------------------------------------------------
@@ -202,14 +210,16 @@ class ReplicaManager:
         gateway pump owns the requeue so the admission accounting
         stays in one place."""
         down: list[EngineReplica] = []
-        unhealthy = {}
+        unhealthy = self._last_unhealthy
         if self.health_source is not None:
             try:
                 unhealthy = self.health_source() or {}
+                self._last_unhealthy = unhealthy
             except Exception:
                 # same contract as plugin/health.py: a failed probe
-                # keeps last state rather than mass-draining the pool
-                unhealthy = {}
+                # keeps the LAST OBSERVED state — neither mass-
+                # draining the pool nor forgetting known-bad chips
+                pass
         for r in self.replicas:
             if not r.ready:
                 continue
@@ -232,7 +242,15 @@ class ReplicaManager:
     def replace(self, replica: EngineReplica) -> EngineReplica:
         """Stand up a replacement for a dead replica (fresh name —
         its PrefixCache starts cold, so routing history must not
-        follow the old identity)."""
+        follow the old identity).  The dead replica leaves the pool
+        list — it serves nothing, holds no lease, and owns no
+        in-flight work, so keeping it would only grow submit()'s
+        live-uid scan and step()'s iteration without bound over a
+        long-running gateway; ``counts()`` still reports it dead via
+        a compaction counter."""
+        if replica in self.replicas:
+            self.replicas.remove(replica)
+            self._dead_removed += 1
         fresh = self._spawn()
         self.replicas.append(fresh)
         return fresh

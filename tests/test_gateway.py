@@ -323,8 +323,12 @@ def test_chip_health_signal_drains_the_mapped_replica():
     done = gw.run_until_idle()
     assert {g.uid for g in done} == {f"u{i}" for i in range(4)}
     assert gw.stats()["replicas"]["dead"] == 1
-    dead = [r for r in mgr.replicas if r.state == "dead"]
-    assert [r.chip for r in dead] == [0]
+    # the dead replica was compacted out of the pool list (no
+    # unbounded growth over repeated drains); only live replicas
+    # remain, none of them on the bad chip
+    assert len(mgr.replicas) == 2
+    assert all(r.state != "dead" for r in mgr.replicas)
+    assert all(r.chip != 0 for r in mgr.replicas)
     for i in range(4):
         req = make_req(f"u{i}", 30 + i, 5, 4)
         np.testing.assert_array_equal(
@@ -355,6 +359,109 @@ def test_shed_and_reject_under_overload_are_explicit():
     assert 'outcome="shed_expired"} 2.0' in text
     st = gw.stats()["outcomes"]
     assert st == {SHED_EXPIRED: 2, REJECTED_FULL: 2}
+
+
+def test_drain_requeues_expired_victim_then_sheds_not_crashes():
+    """REGRESSION: a drained replica's in-flight request already past
+    its SLO deadline is requeued at the queue front by the drain; the
+    pump must shed it with the explicit status in the same step — not
+    dispatch it dead, and not crash on pop() returning None for the
+    expired head (the original bug: AttributeError killed the pump,
+    violating the no-silent-drop contract)."""
+    clock = Clock()
+    plan = FaultPlan.from_json({"rules": [
+        {"verb": "health", "kind": "Replica", "name": "r0",
+         "skip": 1, "times": 1, "error": "drop"}]})
+    mgr = pool(replicas=1, fault_plan=plan)
+    gw = FleetGateway(mgr, queue_capacity=4, clock=clock)
+    gw.submit(make_req("victim", 90, 5, 3), slo_s=1.0)
+    gw.step()                       # dispatched; fault poll skipped
+    assert mgr.replicas[0].in_flight
+    clock.advance(5.0)              # deadline blown while in flight
+    done = gw.step()                # fault fires -> drain -> requeue
+    assert [(g.uid, g.status) for g in done] \
+        == [("victim", SHED_EXPIRED)]
+    assert gw.outcomes["victim"].requeues == 1
+    assert gw.run_until_idle() == []        # pump alive and idle
+    text = gw.metrics.render().decode()
+    assert re.search(r"tpu_gateway_drains_total 1\.0", text)
+    assert re.search(r"tpu_gateway_requeued_total 1\.0", text)
+    assert 'outcome="shed_expired"} 1.0' in text
+
+
+def test_expired_requeue_does_not_block_live_work_behind_it():
+    """The expired drain victim at the queue front must not stall
+    dispatch of the non-expired requests queued behind it in the same
+    pump step."""
+    clock = Clock()
+    plan = FaultPlan.from_json({"rules": [
+        {"verb": "health", "kind": "Replica", "name": "r0",
+         "skip": 1, "times": 1, "error": "drop"}]})
+    mgr = pool(replicas=1, slots=1, depth_bound=1, fault_plan=plan)
+    gw = FleetGateway(mgr, queue_capacity=4, clock=clock)
+    gw.submit(make_req("victim", 91, 5, 3), slo_s=1.0)
+    gw.submit(make_req("survivor", 92, 5, 3), slo_s=60.0)
+    gw.step()           # victim in flight; survivor waits (depth 1)
+    clock.advance(5.0)  # victim's deadline blown, survivor's is not
+    gw.step()           # drain: victim shed, survivor dispatched
+    assert gw.outcomes["victim"].status == SHED_EXPIRED
+    live = [r for r in mgr.replicas if r.in_flight]
+    assert [list(r.in_flight) for r in live] == [["survivor"]]
+    done = gw.run_until_idle()
+    assert [g.uid for g in done] == ["survivor"]
+    assert gw.outcomes["survivor"].status == "finished"
+    req = make_req("survivor", 92, 5, 3)
+    np.testing.assert_array_equal(
+        gw.results["survivor"].tokens, oracle(req.prompt, req.max_new))
+
+
+class _StubEngine:
+    """poll_down/replace never touch the engine; slots feeds the
+    depth bound."""
+    slots = 2
+
+
+class TestReplicaManagerHealth:
+    def test_probe_failure_keeps_last_observed_state(self):
+        """A failing health_source reuses the LAST successful
+        observation (the plugin/health.py contract): known-bad chips
+        stay judged down, healthy replicas are not mass-drained."""
+        state = {"fail": False, "unhealthy": {}}
+
+        def probe():
+            if state["fail"]:
+                raise RuntimeError("probe transport down")
+            return dict(state["unhealthy"])
+
+        mgr = ReplicaManager(lambda name: _StubEngine(), replicas=2,
+                             health_source=probe,
+                             chip_of=lambda name: int(name[1:]))
+        assert mgr.poll_down() == []
+        state["unhealthy"] = {0: "thermal trip"}
+        assert [r.name for r in mgr.poll_down()] == ["r0"]
+        # probe now fails persistently: chip 0 stays presumed bad
+        # (r0 still judged down), r1 is NOT mass-drained
+        state["fail"] = True
+        assert [r.name for r in mgr.poll_down()] == ["r0"]
+        assert mgr.replicas[1].ready
+        # and recovery is observed once the probe works again
+        state["fail"] = False
+        state["unhealthy"] = {}
+        assert mgr.poll_down() == []
+
+    def test_replace_compacts_dead_replicas(self):
+        """replace() removes the dead replica from the pool list so
+        repeated drains do not grow it without bound; counts() keeps
+        reporting the cumulative dead total."""
+        mgr = ReplicaManager(lambda name: _StubEngine(), replicas=2)
+        for i in range(3):
+            victim = mgr.replicas[0]
+            mgr.mark_down(victim)
+            mgr.replace(victim)
+            assert victim not in mgr.replicas
+            assert len(mgr.replicas) == 2
+            assert mgr.counts() == {"ready": 2, "draining": 0,
+                                    "dead": i + 1}
 
 
 def test_prefix_affinity_beats_round_robin_on_prefill_dispatches():
