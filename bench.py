@@ -68,6 +68,15 @@ TINY_GATEWAY_KWARGS = dict(replicas=2, slots=2, n_requests=8,
                            max_new=6, max_seq=64, shared_prefix=8,
                            prefix_cache=2)
 
+#: hermetic shape for the supervisor recovery probe (same contract:
+#: test_bench_smoke pins exactly what bench streams) — dp=2/tp=2 over
+#: the 8-device virtual mesh, a scripted worker kill per checkpoint
+#: cadence, shrink to dp=1
+TINY_SUPERVISOR_KWARGS = dict(dp=2, tp=2, batch=4, seq_len=16,
+                              steps=6, cadences=(1, 4), kill_after=3,
+                              d_model=32, n_layers=2, heads=4,
+                              d_ff=64, vocab=64)
+
 _WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", "630"))
 _DEADLINE = time.monotonic() + _WALL_BUDGET_S
 
@@ -392,6 +401,42 @@ def _cpu_mesh_allreduce(n: int = 8, size_mb: float = 8.0,
     payload["note"] = ("8-virtual-device CPU mesh: validates the n>1 "
                        "collective path; host-memory rate, not "
                        "interconnect bandwidth")
+    return payload
+
+
+def _supervisor_recovery_probe(timeout_s: float = 300.0) -> dict:
+    """Elastic-gang recovery probe (parallel/probe.py) in a CPU-pinned
+    subprocess: supervisor MTTR (eviction→first post-resume step) and
+    steps-lost-since-checkpoint at two checkpoint cadences.  Always a
+    CPU-mesh run — recovery math (restore + recompile) is what is
+    being measured, and the dp-shrink scenario needs the 8-device
+    virtual mesh regardless of how many chips the tunnel shows."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    kwargs = json.dumps(TINY_SUPERVISOR_KWARGS)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.parallel.probe import recovery_probe\n"
+        f"print(json.dumps(recovery_probe(**json.loads({kwargs!r}))))\n")
+    env = cpu_jax_env(8)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = ("8-virtual-device CPU mesh; " +
+                       payload.get("note", ""))
     return payload
 
 
@@ -801,6 +846,8 @@ _PROBE_SCALARS = (
     ("gateway", "gw_goodput_rps", "goodput_rps"),
     ("gateway", "gw_slo_att", "slo_attainment"),
     ("gateway", "gw_p99_wait_ms", "p99_queue_wait_ms"),
+    ("supervisor_recovery", "sup_mttr_ms", "mttr_ms"),
+    ("supervisor_recovery", "sup_steps_lost", "steps_lost_worst"),
     ("allreduce_cpu_mesh8", "cpu_mesh_gbps", "gbps"),
 )
 
@@ -996,6 +1043,13 @@ def main() -> None:
                 cpu_mesh = {"error": f"{type(e).__name__}: {e}"}
         else:
             cpu_mesh = {"error": "skipped: wall budget"}
+        # 3b. Supervisor recovery probe (hermetic, CPU subprocess):
+        #     MTTR + steps-lost through the elastic gang supervisor.
+        if _remaining() > 120:
+            recovery = _supervisor_recovery_probe(
+                timeout_s=min(300.0, _remaining() - 60.0))
+        else:
+            recovery = {"error": "skipped: wall budget"}
         # 4. TPU probes — the only section that can meet a wedged
         #    tunnel; child process + deadline, partial results kept.
         if _remaining() > 55:
@@ -1003,6 +1057,7 @@ def main() -> None:
         else:
             compute = {"error": "skipped: wall budget"}
         compute["allreduce_cpu_mesh8"] = cpu_mesh
+        compute["supervisor_recovery"] = recovery
         detail["tpu"] = compute
         detail["baseline_note"] = (
             "FLOOR comparison, not like-for-like: the reference "

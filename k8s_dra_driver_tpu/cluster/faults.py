@@ -30,7 +30,10 @@ wins)::
        "times": 3,              # then affect this many (-1 = forever)
        "probability": 1.0,      # seeded coin flip per candidate call
        "error": "429",          # 429|500|502|503|conflict|notfound|
-                                #   drop|crash|"" (latency only)
+                                #   drop|crash|hang|"" (latency only;
+                                #   hang = stall latency_s then
+                                #   proceed — a deadline watchdog
+                                #   upstream turns it into an outcome)
        "retry_after_s": 0.05,   # Retry-After for 429/503 responses
        "latency_s": 0.0}]}      # injected delay before the outcome
 """
@@ -61,7 +64,16 @@ CRASH_EXIT_CODE = 86
 VERBS = ("create", "update", "get", "list", "delete", "watch")
 
 ERROR_KINDS = ("429", "500", "502", "503", "conflict", "notfound",
-               "drop", "crash", "")
+               "drop", "crash", "hang", "")
+
+# Gang-worker fault targets (parallel/supervisor.py): one decision per
+# (worker, step), verbs below, kind "Worker", name = the worker's gang
+# name.  ``error: "crash"`` kills the worker (in-band gang death, like
+# the survivors' failing psum); ``error: "hang"`` wedges it — the
+# worker stops progressing for ``latency_s`` while its peers block in
+# the collective, the injected analog of the wedged-tunnel failure.
+GANG_VERB = "gang"
+GANG_WORKER_KIND = "Worker"
 
 # Injection-log cap: plans live for one test scenario; a runaway loop
 # must not turn the log into the test's memory hog.
@@ -179,6 +191,14 @@ class FaultPlan:
         if err == "crash":
             log.warning("fault plan: crashing process at %s", context)
             os._exit(CRASH_EXIT_CODE)
+        if err == "hang":
+            # an injected STALL, not an error: the latency was already
+            # applied by the caller's gate, so at the client layer the
+            # call proceeds — the decision kind exists so supervised
+            # regions (and the injection log) can tell a scripted wedge
+            # from ordinary latency, and a deadline watchdog upstream
+            # is what turns it into an outcome (utils/watchdog.py)
+            return
         raise ApiServerError(f"injected HTTP {err}: {context}",
                              status=int(err),
                              retry_after_s=decision.retry_after_s)

@@ -19,11 +19,14 @@ stopped.  TPU-first specifics:
 
 from __future__ import annotations
 
+import logging
 from pathlib import Path
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
+
+log = logging.getLogger(__name__)
 
 
 class TrainCheckpointer:
@@ -57,9 +60,24 @@ class TrainCheckpointer:
         """Restore onto the shardings/dtypes of the provided targets
         (e.g. a freshly init + shard_params'd state on the NEW mesh);
         ``step=None`` picks the latest.  Returns (params, opt_state,
-        step)."""
-        step = self._mgr.latest_step() if step is None else step
-        if step is None:
+        step) — the step ACTUALLY restored.
+
+        Corruption fallback (the driver's own
+        plugin/checkpoint.py ``.prev`` discipline, applied to the
+        workload tier): when ``step=None`` and the latest generation
+        is torn on disk — a preemption mid-write, a truncated copy, an
+        eaten metadata file — the restore falls back through the
+        retained steps newest-first and loads the first readable one,
+        logging what was skipped.  A restarted pod degrades to its
+        last good generation instead of crash-looping on garbage.
+        An EXPLICIT ``step=`` request stays strict: the caller named a
+        generation, so silently handing back a different one would
+        corrupt whatever invariant made them name it.
+        """
+        explicit = step is not None
+        candidates = ([step] if explicit
+                      else sorted(self._mgr.all_steps(), reverse=True))
+        if not candidates or candidates == [None]:
             raise FileNotFoundError(
                 f"no checkpoint under {self.directory}")
 
@@ -69,11 +87,28 @@ class TrainCheckpointer:
                     x.shape, x.dtype,
                     sharding=getattr(x, "sharding", None)), tree)
 
-        out = self._mgr.restore(step, args=ocp.args.Composite(
+        args = ocp.args.Composite(
             params=ocp.args.StandardRestore(as_abstract(params_like)),
             opt_state=ocp.args.StandardRestore(
-                as_abstract(opt_state_like))))
-        return out["params"], out["opt_state"], step
+                as_abstract(opt_state_like)))
+        torn: list[str] = []
+        for s in candidates:
+            try:
+                out = self._mgr.restore(s, args=args)
+            except Exception as e:
+                if explicit:
+                    raise
+                torn.append(f"step {s}: {type(e).__name__}: {e}")
+                continue
+            if torn:
+                log.warning(
+                    "checkpoint generation(s) unreadable, fell back "
+                    "to step %d: %s", s, "; ".join(t[:200]
+                                                   for t in torn))
+            return out["params"], out["opt_state"], s
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {self.directory}: "
+            f"{'; '.join(torn)}")
 
     def restore_extra(self, step: int | None = None) -> dict:
         """The JSON sidecar saved with ``extra=``.

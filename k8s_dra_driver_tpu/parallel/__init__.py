@@ -4,4 +4,20 @@ from .mesh import (BATCH_AXES, MESH_AXES, MeshSpec, batch_sharding,
                    make_mesh, replicated, visible_chip_count)
 
 __all__ = ["BATCH_AXES", "MESH_AXES", "MeshSpec", "batch_sharding",
-           "make_mesh", "replicated", "visible_chip_count"]
+           "make_mesh", "replicated", "visible_chip_count",
+           "ElasticTrainJob", "GangSupervisor", "SupervisorError",
+           "SupervisorReport", "recovery_probe"]
+
+_LAZY = {"ElasticTrainJob": "supervisor", "GangSupervisor": "supervisor",
+         "SupervisorError": "supervisor", "SupervisorReport": "supervisor",
+         "recovery_probe": "probe"}
+
+
+def __getattr__(name):
+    # supervisor/probe pull in the models layer (orbax, optax) —
+    # loaded on demand so mesh-only consumers stay light
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(name)

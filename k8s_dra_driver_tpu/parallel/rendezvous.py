@@ -100,6 +100,18 @@ def initialize(spec: RendezvousSpec | None = None, *,
     ``host_override`` replaces the host part of the coordinator
     address — for test beds where gang worker hostnames exist only as
     Node objects, not resolvable DNS (every process is local).
+
+    ``TPU_RENDEZVOUS_BARRIER_TIMEOUT_S`` is ENFORCED here, not just
+    forwarded: ``initialization_timeout`` does not bound every wait
+    inside ``jax.distributed.initialize`` (a coordinator that never
+    comes up, or peers that never join the barrier, can block it
+    indefinitely on some jaxlib versions), so the whole call runs
+    under a watchdog deadline (utils/watchdog.py) and a miss raises
+    :class:`ContractError` with the spec echoed — the driver-injected
+    contract promised a gang by the deadline and the gang never
+    formed.  The stuck init thread is a daemon; a worker that hits
+    this is expected to exit (and be restarted or shrunk around by
+    its supervisor, parallel/supervisor.py).
     """
     spec = spec or spec_from_env()
     addr = spec.coordinator_address
@@ -107,11 +119,26 @@ def initialize(spec: RendezvousSpec | None = None, *,
         _, _, port = addr.rpartition(":")
         addr = f"{host_override}:{port}"
     import jax
-    jax.distributed.initialize(
-        coordinator_address=addr,
-        num_processes=spec.num_workers,
-        process_id=spec.worker_id,
-        initialization_timeout=spec.barrier_timeout_s)
+
+    from ..utils.watchdog import WatchdogTimeout, run_with_deadline
+
+    def _init():
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=spec.num_workers,
+            process_id=spec.worker_id,
+            initialization_timeout=spec.barrier_timeout_s)
+
+    try:
+        run_with_deadline(_init, float(spec.barrier_timeout_s),
+                          label="jax.distributed.initialize")
+    except WatchdogTimeout as e:
+        raise ContractError(
+            f"rendezvous barrier timed out after "
+            f"{spec.barrier_timeout_s}s: gang never formed at "
+            f"coordinator {addr} (spec: worker {spec.worker_id}/"
+            f"{spec.num_workers}, channel {spec.channel}, "
+            f"topology {spec.topology!r})") from e
     return spec
 
 

@@ -1,0 +1,508 @@
+"""Elastic gang supervisor: the training analog of the fleet
+gateway's drain/replace loop.
+
+The reference driver's value proposition is that an allocation
+survives contact with reality (IMEX domain teardown, reference
+cmd/nvidia-dra-plugin/nvlib.go cleanup paths).  Our serving side
+matches it — gateway/frontend.py drains a dead replica, requeues its
+in-flight work, and byte-matches the oracle — but until now the
+training side only *failed cleanly*: tests/test_multihost_train.py
+pins "kill worker 2 → in-band error, not a hang", and then the gang
+was simply gone.  This module closes the loop: it owns the train
+loop and RECOVERS it.
+
+Recovery state machine::
+
+    RUNNING ──(worker death / watchdog stall / health down)──▶ SUSPECT
+       ▲                                                          │
+       │                                    classify via heartbeat │
+       │                                    files (dead vs wedged) │
+       │                                                          ▼
+    RESUME ◀── restore latest checkpoint ◀── REFORM ◀────────── EVICT
+               generation onto the NEW         re-issue the gang
+               (smaller) mesh + replay         contract at dp//…
+               the data loader state           (shrink-to-fit)
+
+- **Detection** rides utils/watchdog.py: every train step runs under
+  a per-step deadline (first step per formation gets a compile
+  allowance), the completed-step signal is a scalar readback
+  (``float(loss)`` — the only reliable sync on the tunneled backend),
+  and each worker keeps a heartbeat file under the coordination dir
+  so a stall can be attributed: ``dead`` (tombstone), ``wedged``
+  (stale heartbeat, no tombstone), ``slow`` (metric only).
+- **Eviction/shrink**: victims' chips leave the device set and the
+  gang reforms at the largest power-of-two dp width that fits the
+  survivors and still divides the global batch (dp=4 → 2 on the
+  8-device virtual mesh).  An *unattributed* stall (every heartbeat
+  fresh) reforms at the SAME width — the chips are not provably gone,
+  so the gang restarts in place instead of shrinking on rumor.
+- **Resume** is the first real consumer of the sharding-aware restore
+  models/checkpoint.py promises: params/opt restore from the latest
+  *readable* generation directly onto the new mesh layout, and the
+  data-loader sidecar replays so no batch is skipped or
+  double-applied (a dp change is a placement change, not a math
+  change — pinned by tests/test_model_checkpoint.py).
+
+Down-signals mirror the gateway wiring (gateway/replica.py): a
+polled ``health_source`` or a pushed :meth:`GangSupervisor.on_health`
+(attachable to plugin/health.py's listener hook) maps unhealthy chip
+indices to the workers that own them; a scripted
+:class:`~..cluster.faults.FaultPlan` injects worker death
+(``error: "crash"``) and wedges (``error: "hang"``) through the same
+decision path (verb ``"gang"``, kind ``"Worker"``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+import time
+from pathlib import Path
+from typing import Callable
+
+from ..cluster import faults
+from ..utils import watchdog
+from ..utils.metrics import RecoveryMetrics
+from ..utils.watchdog import (HeartbeatMonitor, WatchdogTimeout,
+                              WorkerHeartbeat, run_with_deadline)
+from .mesh import MeshSpec, make_mesh
+
+log = logging.getLogger(__name__)
+
+# supervisor states (the contract FAILURE_SEMANTICS.md documents)
+RUNNING = "running"
+SUSPECT = "suspect"
+EVICT = "evict"
+REFORM = "reform"
+RESUME = "resume"
+FAILED = "failed"
+STATES = (RUNNING, SUSPECT, EVICT, REFORM, RESUME, FAILED)
+
+CONTRACT_FILENAME = "gang.json"
+
+
+class SupervisorError(RuntimeError):
+    """The gang cannot continue (no shrink left, or recovery budget
+    exhausted) — the caller's own supervisor owns the restart."""
+
+
+class GangDeath(RuntimeError):
+    """A worker died mid-step; surfaces in-band from the step itself
+    (the survivors' collective fails, never hangs — the invariant
+    tests/test_multihost_train.py pins)."""
+
+    def __init__(self, worker: str):
+        self.worker = worker
+        super().__init__(f"gang worker {worker} died mid-step")
+
+
+class _Aborted(Exception):
+    """Internal: a wedged simulated step released by the abort event;
+    its (discarded) watchdog thread exits without dispatching."""
+
+
+@dataclasses.dataclass
+class Recovery:
+    """One eviction→resume cycle, as recorded in the report."""
+
+    cause: str                   # "dead" | "wedged" | "health"
+    victims: list[str]
+    from_dp: int
+    to_dp: int
+    restored_step: int
+    steps_lost: int
+    mttr_s: float = -1.0         # eviction → first post-resume step
+
+
+@dataclasses.dataclass
+class SupervisorReport:
+    losses: list                 # (step, loss) per COMPLETED step
+    recoveries: list[Recovery]
+    transitions: list[str]
+    dp: int                      # final dp width
+    steps: int                   # total completed steps
+    contract: dict               # the last issued gang contract
+
+
+class ElasticTrainJob:
+    """The hermetic gang a supervisor runs: a dp×tp transformer train
+    step over the local (virtual) device set.
+
+    ``build(dp, exclude_chips)`` is the re-formation hook — the
+    in-process analog of re-running a gang prepare at a smaller world
+    size: victims' chips never reappear in the new mesh.  Real
+    multi-host deployments supply their own job with the same three
+    methods (``build`` / ``make_loader`` / ``batch``).
+    """
+
+    def __init__(self, cfg, tokens, *, batch: int, seq_len: int,
+                 tp: int = 2, loader_seed: int = 1):
+        self.cfg = cfg
+        self.tokens = tokens
+        self.batch = batch
+        self.seq_len = seq_len
+        self.tp = tp
+        self.loader_seed = loader_seed
+
+    def build(self, dp: int, exclude_chips=frozenset()):
+        """(mesh, train_step, init_state) over dp×tp devices, never
+        touching an excluded (evicted) chip."""
+        import jax
+
+        from ..models import make_train_step
+
+        devs = [d for d in jax.devices()
+                if d.id not in exclude_chips]
+        need = dp * self.tp
+        if len(devs) < need:
+            raise SupervisorError(
+                f"cannot form dp={dp} tp={self.tp}: need {need} "
+                f"devices, {len(devs)} survive eviction")
+        mesh = make_mesh(MeshSpec(dp=dp, tp=self.tp), devs[:need])
+        step_fn, init_state = make_train_step(self.cfg, mesh)
+        return mesh, step_fn, init_state
+
+    def make_loader(self):
+        from ..models.data import BatchLoader
+        return BatchLoader(self.tokens, batch=self.batch,
+                           seq_len=self.seq_len, seed=self.loader_seed)
+
+
+@dataclasses.dataclass
+class _Worker:
+    name: str
+    chips: tuple                 # device ids this dp row owns
+    hb: WorkerHeartbeat
+    alive: bool = True
+
+
+class GangSupervisor:
+    """Owns the train loop and recovers it (see module docstring).
+
+    ``step_deadline_s`` bounds every steady-state step;
+    ``first_step_deadline_s`` is the compile allowance for the first
+    ``warmup_steps`` steps of each formation (a reformed mesh
+    recompiles, and the donated-buffer step recompiles once more on
+    its second call when the committed placements land).  ``ckpt`` is
+    a models/checkpoint.py ``TrainCheckpointer``; a generation is
+    saved every ``checkpoint_every`` completed steps (plus generation
+    0 at start, so an early death never strands the gang without a
+    restore point) with the loader state as the ``extra`` sidecar.
+    """
+
+    def __init__(self, job, ckpt, *, coordination_dir: Path | str,
+                 dp: int, fault_plan: faults.FaultPlan | None = None,
+                 health_source: Callable[[], dict] | None = None,
+                 metrics: RecoveryMetrics | None = None,
+                 step_deadline_s: float = 30.0,
+                 first_step_deadline_s: float = 300.0,
+                 warmup_steps: int = 2,
+                 soft_deadline_s: float | None = None,
+                 checkpoint_every: int = 4,
+                 max_recoveries: int = 4,
+                 init_seed: int = 0):
+        self.job = job
+        self.ckpt = ckpt
+        self.dir = Path(coordination_dir)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.dp = dp
+        self.plan = fault_plan
+        self.health_source = health_source
+        self.metrics = metrics or RecoveryMetrics()
+        self.step_deadline_s = step_deadline_s
+        self.first_step_deadline_s = first_step_deadline_s
+        self.warmup_steps = warmup_steps
+        self.monitor = HeartbeatMonitor(
+            self.dir,
+            soft_s=(soft_deadline_s if soft_deadline_s is not None
+                    else step_deadline_s / 2),
+            hard_s=step_deadline_s)
+        self.checkpoint_every = checkpoint_every
+        self.max_recoveries = max_recoveries
+        self.init_seed = init_seed
+
+        self.state = RUNNING
+        self.transitions: list[str] = [RUNNING]
+        self.losses: list = []
+        self.recoveries: list[Recovery] = []
+        self.contract: dict = {}
+        self.slow_steps = 0
+        self._gen = 0                    # formation generation
+        self._dead_chips: set = set()
+        self._unhealthy: dict = {}
+        self._unhealthy_lock = threading.Lock()
+        # released on eviction so a simulated wedge (fault "hang")
+        # unblocks promptly instead of leaking a sleeping thread
+        self._abort = threading.Event()
+        self.workers: list[_Worker] = []
+        self._formation_steps = 0        # steps since the last reform
+
+    # -- down-signals (the gateway-mirroring surface) --------------------
+
+    def on_health(self, unhealthy: dict) -> None:
+        """plugin/health.py listener signature: the full unhealthy
+        dict on every transition.  Thread-safe; consumed at the next
+        loop iteration."""
+        with self._unhealthy_lock:
+            self._unhealthy = dict(unhealthy)
+
+    def attach(self, health_monitor) -> None:
+        """Subscribe to a plugin ``HealthMonitor`` — chip-down events
+        reach the supervisor even when the apiserver is unreachable,
+        exactly like the gateway's replica drain wiring."""
+        health_monitor.listeners.append(self.on_health)
+
+    def _poll_down(self):
+        """(victims, cause) from push/poll health plus tombstones an
+        external bed may have written.  Stale-heartbeat classification
+        stays OUT of this path: between steps the supervisor itself
+        owns the clock, and a wedge is only diagnosable while a step
+        is actually overdue (the watchdog path)."""
+        unhealthy = dict(self._unhealthy)
+        if self.health_source is not None:
+            try:
+                unhealthy.update(self.health_source() or {})
+            except Exception:
+                # plugin/health.py contract: a failed probe keeps the
+                # last observed state
+                log.exception("health source failed; keeping last")
+        victims, cause = [], None
+        for w in self.workers:
+            if not w.alive:
+                continue
+            if any(c in unhealthy for c in w.chips):
+                victims.append(w)
+                cause = "health"
+            elif self.monitor.classify(w.name) == watchdog.DEAD:
+                victims.append(w)
+                cause = cause or "dead"
+        return victims, cause
+
+    # -- formation -------------------------------------------------------
+
+    def _form(self, dp: int) -> None:
+        """(Re-)issue the gang contract at world size ``dp`` and stand
+        the mesh/step program up over the surviving chips."""
+        import numpy as np
+
+        self.dp = dp
+        self.mesh, self.step_fn, self.init_state = self.job.build(
+            dp, exclude_chips=frozenset(self._dead_chips))
+        grid = np.asarray(self.mesh.devices).reshape(dp, -1)
+        self.workers = []
+        for i in range(dp):
+            name = f"g{self._gen}w{i}"
+            chips = tuple(int(d.id) for d in grid[i])
+            w = _Worker(name, chips, WorkerHeartbeat(self.dir, name))
+            w.hb.beat(0, "formed")
+            self.workers.append(w)
+        self.contract = {
+            "generation": self._gen,
+            "num_workers": dp,
+            "dp": dp,
+            "world_devices": int(grid.size),
+            "workers": [w.name for w in self.workers],
+            "excluded_chips": sorted(self._dead_chips),
+        }
+        (self.dir / CONTRACT_FILENAME).write_text(
+            json.dumps(self.contract, indent=1))
+        self._gen += 1
+        self._formation_steps = 0
+        self.metrics.dp_width.set(dp)
+
+    # -- the supervised step ---------------------------------------------
+
+    def _one_step(self, step: int):
+        """One train step as the watchdog thread runs it.  Fault
+        decisions are consumed BEFORE the loader advances or buffers
+        are donated, so a failed step consumes no data and leaves the
+        restore path nothing to unwind."""
+        from ..models.data import as_global
+
+        alive = [w for w in self.workers if w.alive]
+        for w in alive:
+            if self.plan is None:
+                continue
+            d = self.plan.decide(faults.GANG_VERB,
+                                 faults.GANG_WORKER_KIND, w.name)
+            if d is None or not d.error:
+                continue
+            if d.error == "crash":
+                # in-band death: the worker tombstones (its teardown,
+                # or the bed that SIGKILLed it, records the exit) and
+                # the survivors' collective errors out
+                w.hb.tombstone(faults.CRASH_EXIT_CODE)
+                w.alive = False
+                raise GangDeath(w.name)
+            if d.error == "hang":
+                # injected wedge: THIS worker's heartbeat freezes while
+                # the survivors — blocked in the collective but with
+                # live heartbeat threads — keep beating a stuck step.
+                # The supervisor's watchdog fires and classification
+                # attributes the stall to the silent worker.
+                stall_until = time.monotonic() + (d.latency_s or 600.0)
+                while (time.monotonic() < stall_until
+                       and not self._abort.is_set()):
+                    for s in alive:
+                        if s is not w:
+                            s.hb.beat(step + 1, "collective")
+                    self._abort.wait(0.2)
+                raise _Aborted()
+        for w in alive:
+            w.hb.beat(step + 1, "begin")
+        tokens = as_global(next(self.loader), self.mesh)
+        self.params, self.opt, loss = self.step_fn(
+            self.params, self.opt, tokens)
+        # scalar readback: the only sync the wedged-tunnel backend
+        # cannot fake (block_until_ready returns early there)
+        loss = float(loss)
+        for w in alive:
+            w.hb.beat(step + 1, "end")
+        return loss
+
+    # -- recovery --------------------------------------------------------
+
+    def _classify_stall(self):
+        """Attribute an overdue step via heartbeat files.  Workers
+        with a tombstone are dead; workers silent past the hard
+        deadline are wedged; if every heartbeat is fresh the stall is
+        unattributed (empty victim list → same-size reform)."""
+        victims, cause = [], "wedged"
+        for w in self.workers:
+            if not w.alive:
+                continue
+            cls = self.monitor.classify(w.name)
+            if cls == watchdog.DEAD:
+                victims.append(w)
+                cause = "dead"
+            elif cls in (watchdog.WEDGED, watchdog.MISSING):
+                victims.append(w)
+        return victims, cause
+
+    def _shrunk_dp(self, n_victims: int) -> int:
+        """Largest power-of-two dp width that fits the survivors and
+        divides the global batch; 0 when nothing fits."""
+        dp = 1
+        while (dp * 2 <= self.dp - n_victims
+               and self.job.batch % (dp * 2) == 0):
+            dp *= 2
+        if self.dp - n_victims < 1 or self.job.batch % dp:
+            return 0
+        return dp
+
+    def _transition(self, state: str) -> None:
+        self.state = state
+        self.transitions.append(state)
+        self.metrics.set_state(state, STATES)
+
+    def _recover(self, victims: list[_Worker], cause: str) -> None:
+        t0 = time.perf_counter()
+        self._transition(EVICT)
+        self._abort.set()              # release any simulated wedge
+        if len(self.recoveries) >= self.max_recoveries:
+            self._transition(FAILED)
+            raise SupervisorError(
+                f"recovery budget exhausted ({self.max_recoveries}) "
+                f"on {cause}: {[w.name for w in victims]}")
+        for w in victims:
+            w.alive = False
+            self._dead_chips.update(w.chips)
+        self.metrics.restarts.labels(cause=cause).inc()
+        self.metrics.evicted_workers.inc(len(victims))
+        new_dp = self._shrunk_dp(len(victims)) if victims else self.dp
+        log.warning("evicting %s (%s): dp %d -> %d",
+                    [w.name for w in victims] or "nobody (unattributed"
+                    " stall; restart in place)", cause, self.dp, new_dp)
+        if new_dp < 1:
+            self._transition(FAILED)
+            raise SupervisorError(
+                f"gang unrecoverable: {len(victims)} victim(s) leave "
+                f"no dp width that divides batch {self.job.batch}")
+        from_dp = self.dp
+        self._transition(REFORM)
+        self._form(new_dp)
+        self._transition(RESUME)
+        params, opt = self.init_state(self._key())
+        self.params, self.opt, at = self.ckpt.restore(params, opt)
+        self.loader.load_state_dict(
+            self.ckpt.restore_extra(at) or {"epoch": 0, "step": 0})
+        lost = self._step - at
+        rec = Recovery(cause=cause, victims=[w.name for w in victims],
+                       from_dp=from_dp, to_dp=new_dp, restored_step=at,
+                       steps_lost=lost)
+        self.recoveries.append(rec)
+        self._pending = (rec, t0)
+        self._step = at
+        self.metrics.steps_lost.inc(lost)
+        self.metrics.steps_lost_last.set(lost)
+        self._abort.clear()
+        self._transition(RUNNING)
+        log.warning("resumed at step %d on dp=%d (%d step(s) to "
+                    "replay)", at, new_dp, lost)
+
+    def _key(self):
+        import jax
+        return jax.random.PRNGKey(self.init_seed)
+
+    # -- the loop --------------------------------------------------------
+
+    def run(self, total_steps: int) -> SupervisorReport:
+        self._form(self.dp)
+        self.loader = self.job.make_loader()
+        self.params, self.opt = self.init_state(self._key())
+        self.ckpt.save(0, self.params, self.opt,
+                       extra=self.loader.state_dict())
+        self._step = 0
+        self._pending = None
+        self.metrics.set_state(RUNNING, STATES)
+        while self._step < total_steps:
+            victims, cause = self._poll_down()
+            if victims:
+                self._transition(SUSPECT)
+                self._recover(victims, cause)
+                continue
+            warm = self._formation_steps >= self.warmup_steps
+            deadline = (self.step_deadline_s if warm
+                        else self.first_step_deadline_s)
+            t_start = time.perf_counter()
+            try:
+                loss = run_with_deadline(
+                    lambda: self._one_step(self._step), deadline,
+                    label=f"train step {self._step + 1} "
+                          f"(gen {self._gen - 1})")
+            except WatchdogTimeout:
+                self._transition(SUSPECT)
+                self._recover(*self._classify_stall())
+                continue
+            except GangDeath as e:
+                self._transition(SUSPECT)
+                victim = [w for w in self.workers
+                          if w.name == e.worker]
+                self._recover(victim, "dead")
+                continue
+            if (warm and time.perf_counter() - t_start
+                    >= self.monitor.soft_s):
+                self.slow_steps += 1     # progressing, just slow
+            self._formation_steps += 1
+            self._step += 1
+            self.losses.append((self._step, loss))
+            if self._pending is not None:
+                rec, t0 = self._pending
+                rec.mttr_s = time.perf_counter() - t0
+                self.metrics.recovery_seconds.observe(rec.mttr_s)
+                self._pending = None
+            if self._step % self.checkpoint_every == 0:
+                self.ckpt.save(self._step, self.params, self.opt,
+                               extra=self.loader.state_dict())
+        return SupervisorReport(
+            losses=self.losses, recoveries=self.recoveries,
+            transitions=self.transitions, dp=self.dp,
+            steps=self._step, contract=self.contract)
+
+
+__all__ = ["CONTRACT_FILENAME", "EVICT", "FAILED", "REFORM", "RESUME",
+           "RUNNING", "STATES", "SUSPECT", "ElasticTrainJob",
+           "GangDeath", "GangSupervisor", "Recovery",
+           "SupervisorError", "SupervisorReport"]

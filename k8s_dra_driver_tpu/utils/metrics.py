@@ -108,3 +108,62 @@ class GatewayMetrics:
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
+
+
+# Recovery wall time spans a checkpoint restore plus a train-step
+# recompile on the reformed mesh — seconds to minutes, not the
+# gateway's sub-second scale.
+_RECOVERY_BUCKETS = (.1, .5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
+
+
+class RecoveryMetrics:
+    """Elastic-gang training recovery observability
+    (parallel/supervisor.py) — the training-side twin of
+    :class:`GatewayMetrics`' drain counters.
+
+    The acceptance surface for a recovery: ``restarts_total`` advances
+    once per eviction→resume cycle (labeled by cause: dead / wedged /
+    health), ``steps_lost`` records the replay distance back to the
+    restored checkpoint generation, and ``recovery_seconds`` is MTTR —
+    eviction decision to the first *completed* post-resume step
+    (scalar readback included, so a wedged resume can't look fast).
+    """
+
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        self.restarts = Counter(
+            "tpu_train_restarts_total",
+            "Gang recoveries (eviction→resume cycles) by cause",
+            ["cause"], registry=self.registry)
+        self.evicted_workers = Counter(
+            "tpu_train_evicted_workers_total",
+            "Gang workers evicted across all recoveries",
+            registry=self.registry)
+        self.steps_lost = Counter(
+            "tpu_train_steps_lost_total",
+            "Completed-but-uncheckpointed steps replayed after "
+            "restores", registry=self.registry)
+        self.steps_lost_last = Gauge(
+            "tpu_train_steps_lost_last",
+            "Steps lost in the most recent recovery",
+            registry=self.registry)
+        self.recovery_seconds = Histogram(
+            "tpu_train_recovery_seconds",
+            "Eviction decision to first completed post-resume step",
+            registry=self.registry, buckets=_RECOVERY_BUCKETS)
+        self.dp_width = Gauge(
+            "tpu_train_dp_width",
+            "Current data-parallel width of the supervised gang",
+            registry=self.registry)
+        self.supervisor_state = Gauge(
+            "tpu_train_supervisor_state",
+            "1 on the supervisor's current state, 0 elsewhere",
+            ["state"], registry=self.registry)
+
+    def set_state(self, state: str, all_states) -> None:
+        for s in all_states:
+            self.supervisor_state.labels(state=s).set(
+                1.0 if s == state else 0.0)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)

@@ -78,6 +78,56 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(pytest.mark.slow)
 
 
+# -- fast-tier stall guard (@pytest.mark.timeout_s) -----------------------
+#
+# The supervisor/gateway tests deliberately inject hangs and rely on a
+# watchdog to convert them into outcomes; if a future regression lets
+# an injected hang ESCAPE the watchdog, the test must fail in seconds,
+# not eat the tier-1 870 s budget.  No plugin installs are allowed in
+# this image, so the guard is local: ``@pytest.mark.timeout_s(N)`` (or
+# a module-level ``pytestmark``) arms a SIGALRM-based timer around the
+# test call — the handler raises in the main thread, which unwinds
+# blocking pure-Python waits (sleep, Event.wait, communicate loops).
+# Off the main thread (or without SIGALRM) it degrades to a
+# threading.Timer that interrupts the main thread.  The deadline
+# bounds the test CALL only (not setup/teardown), and generous values
+# are fine — the point is "seconds to fail", not tight budgets.
+
+import _thread    # noqa: E402
+import signal     # noqa: E402
+import threading  # noqa: E402
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("timeout_s")
+    if marker is None:
+        yield
+        return
+    seconds = float(marker.args[0])
+    if (hasattr(signal, "SIGALRM")
+            and threading.current_thread() is threading.main_thread()):
+        def _stall(signum, frame):
+            raise TimeoutError(
+                f"stall guard: {item.nodeid} exceeded {seconds:g}s — "
+                "an injected hang escaped its watchdog")
+        old = signal.signal(signal.SIGALRM, _stall)
+        signal.setitimer(signal.ITIMER_REAL, seconds)
+        try:
+            yield
+        finally:
+            signal.setitimer(signal.ITIMER_REAL, 0)
+            signal.signal(signal.SIGALRM, old)
+    else:
+        timer = threading.Timer(seconds, _thread.interrupt_main)
+        timer.daemon = True
+        timer.start()
+        try:
+            yield
+        finally:
+            timer.cancel()
+
+
 @pytest.fixture
 def v5e_host(tmp_path):
     """A 4-chip v5e host backed by a materialized fake sysfs tree."""

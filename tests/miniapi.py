@@ -99,8 +99,8 @@ class MiniAPIServer:
                 if decision.latency_s > 0:
                     threading.Event().wait(decision.latency_s)
                 err = decision.error
-                if not err:
-                    return False           # latency-only rule
+                if not err or err == "hang":
+                    return False     # latency-only / stall-then-serve
                 if err in ("drop", "crash"):  # crash is meaningless
                     self._drop_connection()   # server-side: treat as drop
                 elif err == "conflict":

@@ -227,6 +227,28 @@ class TestFaultyClusterClient:
                           "s1").metadata.name == created.metadata.name
         assert [e[3] for e in plan.log] == ["pass", "pass"]
 
+    def test_hang_rule_stalls_then_proceeds(self):
+        """The ``hang`` kind (ISSUE 4): an injected STALL, not an
+        error.  At the client layer the call sleeps latency_s and
+        then succeeds — a deadline watchdog upstream is what turns
+        the stall into an outcome (utils/watchdog.py); the gang
+        supervisor consumes the same kind through verb "gang" / kind
+        "Worker" (tests/test_supervisor.py).  The decision is still
+        distinguishable in the injection log."""
+        slept = []
+        plan = FaultPlan([FaultRule(verb="create", error="hang",
+                                    latency_s=30.0, times=1)])
+        client = FaultyClusterClient(FakeCluster(), plan,
+                                     sleep=slept.append)
+        created = client.create(_slice())        # stalls, then lands
+        assert created.metadata.name == "s1"
+        assert slept == [30.0]
+        assert [e[3] for e in plan.log] == ["hang"]
+        # determinism: replaying the same plan yields the same log
+        replay = FaultPlan.from_json(plan.to_json())
+        replay.decide("create", "ResourceSlice", "s1")
+        assert replay.log == plan.log
+
 
 # --------------------------------------------------------------------------
 # hardened REST client against wire-level injection (miniapi /faults)

@@ -33,6 +33,12 @@ from k8s_dra_driver_tpu.models import (TransformerConfig,
 from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
 from k8s_dra_driver_tpu.utils import dispatch
 
+# Stall guard (tests/conftest.py): drain/requeue tests exercise
+# deliberate replica kills — a regression that turns one into a hang
+# must fail in seconds, not eat the tier-1 budget.  Generous bound:
+# the whole module runs ~27 s warm; no single test nears 180 s.
+pytestmark = pytest.mark.timeout_s(180)
+
 CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
                         d_head=8, d_ff=64, max_seq=48, n_kv_heads=2,
                         dtype=jnp.float32)

@@ -1,0 +1,375 @@
+"""Elastic gang supervisor: hang detection, checkpoint-resume, and
+shrink-to-fit recovery (parallel/supervisor.py + utils/watchdog.py).
+
+THE acceptance invariants (ISSUE 4): a mid-run worker kill AND an
+injected collective hang each end in a RESUMED run on a shrunken
+mesh — no hang, no manual restart — with the loss trajectory
+continuing from the restored checkpoint generation past the pre-kill
+best, and the recovery observable in metrics (restarts=1, steps lost
+≤ the checkpoint cadence).  The serving-side twin of these invariants
+is tests/test_gateway.py's drain/requeue suite.
+
+Every supervised test rides the fast-tier stall guard
+(``timeout_s``, tests/conftest.py): the tests deliberately inject
+hangs, so a regression that lets one escape the watchdog must cost
+seconds, not the tier budget.
+"""
+
+import json
+import socket
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.cluster.faults import FaultPlan, FaultRule
+from k8s_dra_driver_tpu.utils import watchdog
+from k8s_dra_driver_tpu.utils.watchdog import (HeartbeatMonitor,
+                                               WatchdogTimeout,
+                                               WorkerHeartbeat,
+                                               run_with_deadline)
+
+pytestmark = pytest.mark.timeout_s(300)
+
+REPO = Path(__file__).parent.parent
+
+
+# -- watchdog primitives (no jax) -----------------------------------------
+
+def test_run_with_deadline_returns_result_and_reraises():
+    assert run_with_deadline(lambda: 41 + 1, 5.0) == 42
+    with pytest.raises(ZeroDivisionError):
+        run_with_deadline(lambda: 1 / 0, 5.0)
+
+
+def test_run_with_deadline_times_out_and_releases_caller():
+    release = threading.Event()
+    t0 = time.monotonic()
+    with pytest.raises(WatchdogTimeout) as exc:
+        run_with_deadline(lambda: release.wait(60), 0.2,
+                          label="wedged region")
+    assert time.monotonic() - t0 < 5.0      # caller got control back
+    assert "wedged region" in str(exc.value)
+    release.set()                           # unstick the daemon thread
+
+
+def test_heartbeat_classification(tmp_path):
+    hb = WorkerHeartbeat(tmp_path, "w0")
+    mon = HeartbeatMonitor(tmp_path, soft_s=1.0, hard_s=3.0)
+    assert mon.classify("missing-worker") == watchdog.MISSING
+    hb.beat(7, "begin")
+    now = hb.path.stat().st_mtime  # close enough to the record's t
+    rec = mon.read("w0")
+    assert rec["step"] == 7 and rec["phase"] == "begin"
+    assert mon.classify("w0", now=rec["t"] + 0.5) == watchdog.OK
+    assert mon.classify("w0", now=rec["t"] + 2.0) == watchdog.SLOW
+    assert mon.classify("w0", now=rec["t"] + 4.0) == watchdog.WEDGED
+    hb.tombstone(86)
+    assert mon.classify("w0", now=now + 100) == watchdog.DEAD
+    with pytest.raises(ValueError):
+        HeartbeatMonitor(tmp_path, soft_s=3.0, hard_s=1.0)
+
+
+# -- the supervised gang ---------------------------------------------------
+
+def _cfg():
+    import jax.numpy as jnp
+
+    from k8s_dra_driver_tpu.models import TransformerConfig
+    return TransformerConfig(vocab=64, d_model=32, n_layers=2,
+                             n_heads=4, d_head=8, d_ff=64, max_seq=16,
+                             dtype=jnp.float32)
+
+
+def _job(batch=8, tp=2):
+    from k8s_dra_driver_tpu.parallel.supervisor import ElasticTrainJob
+    motif = np.random.default_rng(0).integers(0, 64, 32)
+    return ElasticTrainJob(_cfg(), np.tile(motif, 64), batch=batch,
+                           seq_len=16, tp=tp)
+
+
+def _supervisor(tmp_path, *, dp=4, plan=None, health_source=None,
+                checkpoint_every=2, batch=8, tp=2, **kw):
+    from k8s_dra_driver_tpu.models.checkpoint import TrainCheckpointer
+    from k8s_dra_driver_tpu.parallel.supervisor import GangSupervisor
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    sup = GangSupervisor(
+        _job(batch=batch, tp=tp), ckpt,
+        coordination_dir=tmp_path / "coord", dp=dp, fault_plan=plan,
+        health_source=health_source, checkpoint_every=checkpoint_every,
+        step_deadline_s=kw.pop("step_deadline_s", 30.0),
+        first_step_deadline_s=kw.pop("first_step_deadline_s", 240.0),
+        **kw)
+    return sup, ckpt
+
+
+@pytest.mark.faults
+def test_elastic_resume_after_worker_kill(tmp_path):
+    """THE kill-path acceptance test: a dp shard dies mid-run via the
+    fault plan; the supervisor evicts it, shrinks dp=4→2 on the
+    8-device mesh, resumes from the latest checkpoint generation, and
+    the loss trajectory continues past the pre-kill best."""
+    from k8s_dra_driver_tpu.parallel import supervisor as sv
+    plan = FaultPlan([FaultRule(verb="gang", kind="Worker",
+                                name="g0w2", skip=4, times=1,
+                                error="crash")])
+    sup, ckpt = _supervisor(tmp_path, dp=4, plan=plan,
+                            checkpoint_every=2)
+    report = sup.run(8)
+    ckpt.close()
+
+    # exactly one recovery: shrink dp=4→2, resume from generation 4
+    assert len(report.recoveries) == 1
+    rec = report.recoveries[0]
+    assert rec.cause == "dead"
+    assert rec.victims == ["g0w2"]
+    assert (rec.from_dp, rec.to_dp) == (4, 2)
+    assert rec.restored_step == 4
+    assert rec.steps_lost <= 2              # the checkpoint cadence
+    assert rec.mttr_s > 0
+    assert report.transitions == [
+        sv.RUNNING, sv.SUSPECT, sv.EVICT, sv.REFORM, sv.RESUME,
+        sv.RUNNING]
+
+    # every step completed exactly once; the trajectory CONTINUES —
+    # it ends below the best loss the gang reached before the kill
+    steps = [s for s, _ in report.losses]
+    assert steps == list(range(1, 9))
+    losses = [l for _, l in report.losses]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < min(losses[:4])
+
+    # the reformed contract was re-issued at the smaller world size,
+    # with the victim's chips excluded
+    contract = json.loads(
+        (tmp_path / "coord" / sv.CONTRACT_FILENAME).read_text())
+    assert contract["num_workers"] == 2
+    assert contract["generation"] == 1
+    assert set(contract["excluded_chips"]) == set(rec_chips(report))
+
+    # observable in metrics: restarts=1, steps_lost ≤ cadence
+    reg = sup.metrics.registry
+    assert reg.get_sample_value("tpu_train_restarts_total",
+                                {"cause": "dead"}) == 1
+    assert reg.get_sample_value("tpu_train_steps_lost_total") <= 2
+    assert reg.get_sample_value("tpu_train_recovery_seconds_count") == 1
+    assert reg.get_sample_value("tpu_train_dp_width") == 2
+    assert reg.get_sample_value("tpu_train_supervisor_state",
+                                {"state": sv.RUNNING}) == 1
+
+
+def rec_chips(report):
+    """The evicted worker's chips = the contract's excluded set; with
+    dp=4/tp=2 over devices 0-7, dp row 2 owns devices 4 and 5."""
+    assert report.recoveries[0].victims == ["g0w2"]
+    return {4, 5}
+
+
+@pytest.mark.faults
+def test_elastic_resume_after_injected_hang(tmp_path):
+    """THE hang-path acceptance test: an injected collective stall
+    (fault kind ``hang`` — the wedged-tunnel mode, not a crash) trips
+    the per-step watchdog; heartbeat files attribute the stall to the
+    silent worker; the gang shrinks and resumes.  No hang escapes:
+    the stall guard around this test would fail it in seconds."""
+    from k8s_dra_driver_tpu.parallel import supervisor as sv
+    plan = FaultPlan([FaultRule(verb="gang", kind="Worker",
+                                name="g0w1", skip=2, times=1,
+                                error="hang", latency_s=60.0)])
+    sup, ckpt = _supervisor(tmp_path, dp=4, plan=plan,
+                            checkpoint_every=2, step_deadline_s=2.0)
+    t0 = time.monotonic()
+    report = sup.run(6)
+    ckpt.close()
+    # detection cost ≈ one step deadline, not the 60 s injected stall
+    assert time.monotonic() - t0 < 60
+
+    assert len(report.recoveries) == 1
+    rec = report.recoveries[0]
+    assert rec.cause == "wedged"
+    assert rec.victims == ["g0w1"]          # attributed, not guessed
+    assert (rec.from_dp, rec.to_dp) == (4, 2)
+    assert rec.restored_step == 2
+    assert rec.steps_lost <= 2
+    assert sv.SUSPECT in report.transitions
+    steps = [s for s, _ in report.losses]
+    assert steps == list(range(1, 7))
+    losses = [l for _, l in report.losses]
+    assert losses[-1] < min(losses[:2])
+    reg = sup.metrics.registry
+    assert reg.get_sample_value("tpu_train_restarts_total",
+                                {"cause": "wedged"}) == 1
+
+
+@pytest.mark.faults
+def test_health_down_signal_evicts_like_the_gateway(tmp_path):
+    """plugin/health.py wiring, mirroring gateway/replica.py: a chip
+    going unhealthy in the polled health view evicts the worker that
+    owns it, same shrink/resume path as a death."""
+    calls = {"n": 0}
+
+    def health_source():
+        calls["n"] += 1
+        # chip 5 (dp row 2's second device) fails on the 4th poll
+        return {5: "pcie link down"} if calls["n"] >= 4 else {}
+
+    sup, ckpt = _supervisor(tmp_path, dp=4,
+                            health_source=health_source,
+                            checkpoint_every=2)
+    report = sup.run(6)
+    ckpt.close()
+    assert len(report.recoveries) == 1
+    rec = report.recoveries[0]
+    assert rec.cause == "health"
+    assert rec.victims == ["g0w2"]
+    assert rec.to_dp == 2
+    reg = sup.metrics.registry
+    assert reg.get_sample_value("tpu_train_restarts_total",
+                                {"cause": "health"}) == 1
+
+
+def test_attach_subscribes_to_health_monitor_listeners(tmp_path):
+    """``attach`` uses the same listener hook the gateway's drain
+    wiring uses: a pushed unhealthy dict lands in the supervisor's
+    next poll, apiserver reachable or not."""
+    from k8s_dra_driver_tpu.parallel.supervisor import GangSupervisor
+
+    class StubMonitor:
+        def __init__(self):
+            self.listeners = []
+
+    sup = GangSupervisor.__new__(GangSupervisor)   # wiring-only check
+    sup._unhealthy = {}
+    sup._unhealthy_lock = threading.Lock()
+    monitor = StubMonitor()
+    sup.attach(monitor)
+    assert monitor.listeners == [sup.on_health]
+    monitor.listeners[0]({3: "gone"})
+    assert sup._unhealthy == {3: "gone"}
+
+
+@pytest.mark.faults
+def test_unrecoverable_gang_fails_explicitly(tmp_path):
+    """Shrink-to-fit bottoms out: killing the gang below dp=1 raises
+    SupervisorError (state FAILED) instead of looping or hanging —
+    process-level restart belongs to the caller's supervisor."""
+    from k8s_dra_driver_tpu.parallel import supervisor as sv
+    plan = FaultPlan([
+        FaultRule(verb="gang", kind="Worker", name="g0w1", skip=1,
+                  times=1, error="crash"),
+        FaultRule(verb="gang", kind="Worker", name="g1w0", skip=1,
+                  times=1, error="crash"),
+    ])
+    sup, ckpt = _supervisor(tmp_path, dp=2, batch=4, plan=plan,
+                            checkpoint_every=2)
+    with pytest.raises(sv.SupervisorError, match="no dp width"):
+        sup.run(10)
+    ckpt.close()
+    assert sup.transitions[-1] == sv.FAILED
+    assert len(sup.recoveries) == 1         # the first one succeeded
+    assert sup.recoveries[0].to_dp == 1
+
+
+def test_shrink_rule_is_power_of_two_that_divides_batch(tmp_path):
+    from k8s_dra_driver_tpu.models.checkpoint import TrainCheckpointer
+    from k8s_dra_driver_tpu.parallel.supervisor import GangSupervisor
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    sup = GangSupervisor(_job(batch=8), ckpt,
+                         coordination_dir=tmp_path / "coord", dp=4)
+    assert sup._shrunk_dp(1) == 2           # 3 survivors → 2
+    assert sup._shrunk_dp(2) == 2
+    assert sup._shrunk_dp(3) == 1
+    assert sup._shrunk_dp(4) == 0           # nobody left
+    sup.dp = 1
+    assert sup._shrunk_dp(1) == 0
+    ckpt.close()
+
+
+# -- rendezvous barrier deadline (satellite) -------------------------------
+
+def test_rendezvous_barrier_timeout_is_enforced():
+    """TPU_RENDEZVOUS_BARRIER_TIMEOUT_S used to be parsed and carried
+    but never enforced: a gang member whose peers never join blocked
+    in jax.distributed.initialize indefinitely.  Now the init runs
+    under the watchdog and a miss raises ContractError with the spec
+    echoed.  (The worker exits via os._exit afterwards — interpreter
+    teardown of the wedged grpc runtime can abort — which is fine:
+    a worker hitting this is about to die anyway.)"""
+    from k8s_dra_driver_tpu.utils.cpuproc import cpu_jax_env
+    free = socket.socket()
+    free.bind(("127.0.0.1", 0))
+    port = free.getsockname()[1]
+    free.close()
+    code = f"""
+import os
+import jax
+jax.config.update('jax_platforms', 'cpu')
+from k8s_dra_driver_tpu.parallel import rendezvous as r
+spec = r.RendezvousSpec(coordinator_address='127.0.0.1:{port}',
+                        worker_id=0, num_workers=2,
+                        barrier_timeout_s=2)
+try:
+    r.initialize(spec)
+except r.ContractError as e:
+    print('CONTRACT_ERROR:', e, flush=True)
+    os._exit(3)
+os._exit(0)
+"""
+    t0 = time.monotonic()
+    res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         env=cpu_jax_env(1), capture_output=True,
+                         text=True, timeout=240)
+    elapsed = time.monotonic() - t0
+    assert res.returncode == 3, (res.returncode, res.stderr[-1000:])
+    assert elapsed < 120, "barrier timeout was not enforced"
+    # the spec is echoed so the operator sees WHAT never formed
+    assert "CONTRACT_ERROR:" in res.stdout
+    assert "worker 0/2" in res.stdout
+    assert f"127.0.0.1:{port}" in res.stdout
+
+
+# -- checkpoint corruption fallback (satellite) ----------------------------
+
+def test_torn_latest_generation_falls_back_to_previous(tmp_path):
+    """models/checkpoint.py grows the driver's own .prev discipline:
+    a truncated latest generation restores from the previous retained
+    step instead of raising; an explicit step= request stays strict;
+    every generation torn raises with the evidence."""
+    import jax
+
+    from k8s_dra_driver_tpu.models import make_train_step
+    from k8s_dra_driver_tpu.models.checkpoint import TrainCheckpointer
+    from k8s_dra_driver_tpu.parallel import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(dp=2, tp=2), jax.devices()[:4])
+    step, init_state = make_train_step(_cfg(), mesh)
+    params, opt = init_state(jax.random.PRNGKey(0))
+    ckpt = TrainCheckpointer(tmp_path / "ckpt", keep=3)
+    ckpt.save(1, params, opt, extra={"epoch": 0, "step": 1})
+    ckpt.save(2, params, opt, extra={"epoch": 0, "step": 2})
+
+    def truncate(step_no):
+        for p in (tmp_path / "ckpt" / str(step_no)).rglob("*"):
+            if p.is_file():
+                p.write_bytes(b"")
+
+    truncate(2)
+    p2, o2 = init_state(jax.random.PRNGKey(7))
+    restored_p, _, at = ckpt.restore(p2, o2)
+    assert at == 1                          # fell back, did not raise
+    np.testing.assert_array_equal(
+        np.asarray(restored_p["embed"]), np.asarray(params["embed"]))
+    # the sidecar follows the step actually restored
+    assert ckpt.restore_extra(at) == {"epoch": 0, "step": 1}
+    # explicit step= stays strict: the caller named the generation
+    with pytest.raises(Exception):
+        ckpt.restore(p2, o2, step=2)
+    # every generation torn → explicit failure with the evidence
+    truncate(1)
+    with pytest.raises(FileNotFoundError, match="no restorable"):
+        ckpt.restore(p2, o2)
+    ckpt.close()
