@@ -77,6 +77,15 @@ TINY_SUPERVISOR_KWARGS = dict(dp=2, tp=2, batch=4, seq_len=16,
                               d_model=32, n_layers=2, heads=4,
                               d_ff=64, vocab=64)
 
+#: hermetic shape for the fleet-reconciler probe (same contract:
+#: test_bench_smoke pins exactly what bench streams) — a dp=2/tp=2
+#: gang plus one serving replica over a 5-chip ledger, one scripted
+#: contention cycle (burst -> preempt -> serve -> calm -> regrow)
+TINY_FLEET_KWARGS = dict(tp=2, train_dp=2, batch=4, seq_len=16,
+                         n_requests=10, max_new=4, slots=2,
+                         d_model=32, n_layers=2, heads=4, d_ff=64,
+                         vocab=64)
+
 _WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", "630"))
 _DEADLINE = time.monotonic() + _WALL_BUDGET_S
 
@@ -422,6 +431,43 @@ def _supervisor_recovery_probe(timeout_s: float = 300.0) -> dict:
         + "import json\n"
         "from k8s_dra_driver_tpu.parallel.probe import recovery_probe\n"
         f"print(json.dumps(recovery_probe(**json.loads({kwargs!r}))))\n")
+    env = cpu_jax_env(8)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = ("8-virtual-device CPU mesh; " +
+                       payload.get("note", ""))
+    return payload
+
+
+def _fleet_probe(timeout_s: float = 300.0) -> dict:
+    """Fleet-reconciler probe (fleet/probe.py) in a CPU-pinned
+    subprocess: scale-up latency, preemption-to-serving MTTR, and
+    regrow-to-full-width time through one scripted contention cycle.
+    Always a CPU-mesh run — arbitration wall time (reform + restore +
+    recompile + spawn) is what is measured, and the preempt/regrow
+    scenario needs the 8-device virtual mesh regardless of how many
+    chips the tunnel shows."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    kwargs = json.dumps(TINY_FLEET_KWARGS)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.fleet.probe import fleet_probe\n"
+        f"print(json.dumps(fleet_probe(**json.loads({kwargs!r}))))\n")
     env = cpu_jax_env(8)
     try:
         res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
@@ -848,6 +894,9 @@ _PROBE_SCALARS = (
     ("gateway", "gw_p99_wait_ms", "p99_queue_wait_ms"),
     ("supervisor_recovery", "sup_mttr_ms", "mttr_ms"),
     ("supervisor_recovery", "sup_steps_lost", "steps_lost_worst"),
+    ("fleet", "fleet_scaleup_ms", "scaleup_ms"),
+    ("fleet", "fleet_preempt_ms", "preempt_ms"),
+    ("fleet", "fleet_regrow_ms", "regrow_ms"),
     ("allreduce_cpu_mesh8", "cpu_mesh_gbps", "gbps"),
 )
 
@@ -1050,6 +1099,14 @@ def main() -> None:
                 timeout_s=min(300.0, _remaining() - 60.0))
         else:
             recovery = {"error": "skipped: wall budget"}
+        # 3c. Fleet reconciler probe (hermetic, CPU subprocess): one
+        #     contention cycle — scale-up latency, preemption-to-
+        #     serving MTTR, regrow-to-full-width.
+        if _remaining() > 120:
+            fleet = _fleet_probe(
+                timeout_s=min(300.0, _remaining() - 60.0))
+        else:
+            fleet = {"error": "skipped: wall budget"}
         # 4. TPU probes — the only section that can meet a wedged
         #    tunnel; child process + deadline, partial results kept.
         if _remaining() > 55:
@@ -1058,6 +1115,7 @@ def main() -> None:
             compute = {"error": "skipped: wall budget"}
         compute["allreduce_cpu_mesh8"] = cpu_mesh
         compute["supervisor_recovery"] = recovery
+        compute["fleet"] = fleet
         detail["tpu"] = compute
         detail["baseline_note"] = (
             "FLOOR comparison, not like-for-like: the reference "

@@ -4,7 +4,8 @@ fault injection."""
 from .client import (ApiServerError, ApiUnavailableError, ClusterClient,
                      ConflictError, EVENT_ADDED, EVENT_DELETED,
                      EVENT_MODIFIED, FakeCluster, NotFoundError, match_labels)
-from .faults import FaultPlan, FaultRule, FaultyClusterClient
+from .faults import (FaultPlan, FaultRule, FaultyClusterClient,
+                     ScriptedChipHealth)
 from .objects import Deployment, Node, Pod
 
 __all__ = [
@@ -12,4 +13,5 @@ __all__ = [
     "ConflictError", "Deployment", "EVENT_ADDED", "EVENT_DELETED",
     "EVENT_MODIFIED", "FakeCluster", "FaultPlan", "FaultRule",
     "FaultyClusterClient", "Node", "NotFoundError", "Pod", "match_labels",
+    "ScriptedChipHealth",
 ]

@@ -30,10 +30,12 @@ wins)::
        "times": 3,              # then affect this many (-1 = forever)
        "probability": 1.0,      # seeded coin flip per candidate call
        "error": "429",          # 429|500|502|503|conflict|notfound|
-                                #   drop|crash|hang|"" (latency only;
-                                #   hang = stall latency_s then
+                                #   drop|crash|hang|heal|"" (latency
+                                #   only; hang = stall latency_s then
                                 #   proceed — a deadline watchdog
-                                #   upstream turns it into an outcome)
+                                #   upstream turns it into an outcome;
+                                #   heal = chip UP-signal, consumed by
+                                #   ScriptedChipHealth below)
        "retry_after_s": 0.05,   # Retry-After for 429/503 responses
        "latency_s": 0.0}]}      # injected delay before the outcome
 """
@@ -64,7 +66,7 @@ CRASH_EXIT_CODE = 86
 VERBS = ("create", "update", "get", "list", "delete", "watch")
 
 ERROR_KINDS = ("429", "500", "502", "503", "conflict", "notfound",
-               "drop", "crash", "hang", "")
+               "drop", "crash", "hang", "heal", "")
 
 # Gang-worker fault targets (parallel/supervisor.py): one decision per
 # (worker, step), verbs below, kind "Worker", name = the worker's gang
@@ -74,6 +76,16 @@ ERROR_KINDS = ("429", "500", "502", "503", "conflict", "notfound",
 # the collective, the injected analog of the wedged-tunnel failure.
 GANG_VERB = "gang"
 GANG_WORKER_KIND = "Worker"
+
+# Chip-health fault targets (fleet/supply.py, gateway/replica.py): one
+# decision per (chip, poll), verb "health", kind "Chip", name = the
+# decimal chip index.  A down-kind error (drop/5xx/crash) marks the
+# chip unhealthy until a rule with ``error: "heal"`` — the chip
+# UP-signal twin of the down/kill/hang kinds — clears it, so
+# heal-driven regrow is as injectable and deterministic as eviction.
+HEALTH_VERB = "health"
+CHIP_KIND = "Chip"
+HEAL = "heal"
 
 # Injection-log cap: plans live for one test scenario; a runaway loop
 # must not turn the log into the test's memory hog.
@@ -199,6 +211,10 @@ class FaultPlan:
             # from ordinary latency, and a deadline watchdog upstream
             # is what turns it into an outcome (utils/watchdog.py)
             return
+        if err == HEAL:
+            # a recovery SIGNAL, not an error: only ScriptedChipHealth
+            # consumes it; at the client layer the call proceeds
+            return
         raise ApiServerError(f"injected HTTP {err}: {context}",
                              status=int(err),
                              retry_after_s=decision.retry_after_s)
@@ -262,6 +278,48 @@ class FaultyClusterClient(ClusterClient):
         close = getattr(self.inner, "close", None)
         if close:
             close()
+
+
+class ScriptedChipHealth:
+    """A deterministic chip-health source scripted by a ``FaultPlan``.
+
+    Callable with the ``health_source`` signature the health-consuming
+    stack shares (gateway/replica.py ``ReplicaManager``,
+    parallel/supervisor.py ``GangSupervisor``, fleet/supply.py
+    ``ChipLedger``): zero args, returns ``{chip_index: reason}``.  Each
+    poll consults the plan once per chip in chip order (verb
+    ``HEALTH_VERB``, kind ``CHIP_KIND``, name = the decimal index), so
+    the decision sequence is a pure function of the poll sequence —
+    the same determinism contract the client-boundary injection has.
+
+    Decisions LATCH: a down-kind error (anything but ``heal``/empty)
+    marks the chip unhealthy with an injected reason until a ``heal``
+    decision — the chip up-signal — clears it.  One rule therefore
+    scripts a failure window (``skip`` polls healthy, then down), and a
+    second rule with ``error: "heal"`` scripts the recovery, which is
+    what makes heal-driven regrow (fleet/reconciler.py) injectable
+    instead of waiting on real hardware to flap.  ``base`` composes a
+    real backend's ``health()`` view under the scripted overrides.
+    """
+
+    def __init__(self, plan: FaultPlan, chips, base=None):
+        self.plan = plan
+        self.chips = [int(c) for c in chips]
+        self.base = base
+        self._down: dict[int, str] = {}
+
+    def __call__(self) -> dict[int, str]:
+        for chip in self.chips:
+            d = self.plan.decide(HEALTH_VERB, CHIP_KIND, str(chip))
+            if d is None or not d.error:
+                continue
+            if d.error == HEAL:
+                self._down.pop(chip, None)
+            else:
+                self._down[chip] = f"injected {d.error}"
+        out = dict(self.base() if self.base is not None else {})
+        out.update(self._down)
+        return out
 
 
 # --------------------------------------------------------------------------

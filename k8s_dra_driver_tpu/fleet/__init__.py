@@ -1,0 +1,32 @@
+"""Fleet reconciler: demand-driven autoscaling, gang regrow, and
+training/serving chip arbitration (docs/AUTOSCALING.md).
+
+One control loop above the subsystems the serving and training PRs
+built: demand from the gateway's metrics, supply from the chip
+ledger's health-and-ownership view, hysteresis policy in between, and
+actuation exclusively through existing machinery — replica
+scale-up/drain/retire and the gang supervisor's
+checkpoint-then-shrink / EXPAND-regrow ``request_width`` API.
+"""
+
+from .policy import (Action, DemandSignals, FleetPolicy, PolicyConfig,
+                     PREEMPT, REGROW, SCALE_DOWN, SCALE_UP)
+from .reconciler import FleetReconciler
+from .supply import ChipLedger, SupplyView
+
+__all__ = [
+    "Action", "ChipLedger", "DemandSignals", "FleetPolicy",
+    "FleetReconciler", "PolicyConfig", "SupplyView",
+    "PREEMPT", "REGROW", "SCALE_DOWN", "SCALE_UP",
+    "fleet_probe",
+]
+
+
+def __getattr__(name):
+    # the probe pulls in the models layer (jax, orbax) — loaded on
+    # demand so control-plane consumers stay light (the parallel/
+    # package's lazy pattern)
+    if name == "fleet_probe":
+        from .probe import fleet_probe
+        return fleet_probe
+    raise AttributeError(name)

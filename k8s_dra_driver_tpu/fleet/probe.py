@@ -1,0 +1,190 @@
+"""Fleet reconciler bench probe: arbitration latency as an artifact.
+
+The gateway probe (gateway/probe.py) measures the serving fleet under
+OVERLOAD and the recovery probe (parallel/probe.py) measures the
+training fleet under FAILURE; this measures the ARBITER between them:
+one scripted contention cycle — burst → preempt the gang → serve on
+the freed chips → calm → retire → regrow — through the real
+reconciler, recording what a capacity planner needs:
+
+- ``scaleup_ms``    — burst start → first replica scale-up actuated
+  (hysteresis + the preempt wait included: with no free chips, the
+  scale-up CANNOT fire before the gang gives ground);
+- ``preempt_ms``    — preempt request → first request FINISHED on the
+  replica standing on the freed chips (preemption-to-serving MTTR:
+  checkpoint, shrink reform, replica spawn, dispatch, decode);
+- ``regrow_ms``     — regrow request → first completed train step at
+  full width (EXPAND reform + restore + recompile included).
+
+Runs hermetically on the 8-device virtual CPU mesh and identically on
+a live chip; schema pinned by tests/test_bench_smoke.py.
+"""
+
+from __future__ import annotations
+
+import tempfile
+import time
+from pathlib import Path
+
+
+def fleet_probe(tp: int = 2, train_dp: int = 2, batch: int = 4,
+                seq_len: int = 16, n_requests: int = 10,
+                max_new: int = 4, slots: int = 2,
+                d_model: int = 32, n_layers: int = 2, heads: int = 4,
+                d_ff: int = 64, vocab: int = 64,
+                max_rounds: int = 600, slo_s: float = 300.0) -> dict:
+    """One contention cycle through gateway + supervisor + reconciler
+    (module docstring).  The ledger holds ``train_dp*tp`` gang chips
+    plus ONE serving chip, so the burst's scale-up has no free supply
+    and MUST preempt — the arbitration path is what is being timed.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..fleet import (ChipLedger, FleetPolicy, FleetReconciler,
+                         PolicyConfig)
+    from ..models import TransformerConfig, init_params
+    from ..models.checkpoint import TrainCheckpointer
+    from ..models.serving import Request, ServingEngine
+    from ..gateway import FleetGateway, ReplicaManager
+    from ..parallel.supervisor import ElasticTrainJob, GangSupervisor
+
+    cfg = TransformerConfig(
+        vocab=vocab, d_model=d_model, n_layers=n_layers, n_heads=heads,
+        d_head=d_model // heads, d_ff=d_ff, max_seq=max(seq_len, 32),
+        dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    motif = rng.integers(0, vocab, 32)
+
+    gang_chips = train_dp * tp
+    chips = list(range(gang_chips + 1))       # + one serving chip
+
+    with tempfile.TemporaryDirectory() as tmp:
+        job = ElasticTrainJob(cfg, np.tile(motif, 64), batch=batch,
+                              seq_len=seq_len, tp=tp)
+        ckpt = TrainCheckpointer(Path(tmp) / "ckpt")
+        sup = GangSupervisor(job, ckpt,
+                             coordination_dir=Path(tmp) / "coord",
+                             dp=train_dp, checkpoint_every=2,
+                             step_deadline_s=120.0,
+                             first_step_deadline_s=600.0)
+        mgr = ReplicaManager(
+            lambda name: ServingEngine(params, cfg, slots=slots),
+            replicas=1, chip_of=lambda name: chips[-1],
+            depth_bound=slots)
+        gw = FleetGateway(mgr, queue_capacity=4 * n_requests,
+                          auto_replace=False)
+        ledger = ChipLedger(chips)
+        policy = FleetPolicy(PolicyConfig(
+            queue_high=3, up_after=1, down_after=2, regrow_after=2,
+            min_replicas=1, max_replicas=3, min_train_dp=1,
+            arrival_low_rps=1e9))
+        rec = FleetReconciler(gw, sup, ledger=ledger, policy=policy)
+
+        sup.begin(10_000)                      # stopped by the probe
+        sup_live = True
+
+        def pump():
+            nonlocal sup_live
+            gw.step()
+            if sup_live:
+                sup_live = sup.step_once()
+            rec.tick()
+
+        def first_event(kind):
+            for t, k, info in rec.events:
+                if k == kind:
+                    return t, info
+            return None, None
+
+        # -- phase A: burst against a dry pool --------------------------
+        t_burst = time.monotonic()
+        for i in range(n_requests):
+            gw.submit(Request(
+                uid=f"f{i}",
+                prompt=rng.integers(0, vocab, 8).astype(np.int32),
+                max_new=max_new), slo_s=slo_s)
+        new_replica = None
+        t_served = None
+        rounds = 0
+        while rounds < max_rounds:
+            rounds += 1
+            pump()
+            if new_replica is None:
+                _, info = first_event("scale_up")
+                if info:
+                    new_replica = info["replica"]
+            if new_replica is not None and t_served is None:
+                if any(g.status == "finished"
+                       and g.replica == new_replica
+                       for g in gw.outcomes.values()):
+                    t_served = time.monotonic()
+            if (t_served is not None and not len(gw.queue)
+                    and not any(r.in_flight for r in mgr.replicas)):
+                break
+        t_up, _ = first_event("scale_up")
+        t_pre, _ = first_event("preempt")
+
+        # -- phase B: calm → retire → regrow ----------------------------
+        t_regrown = None
+        while rounds < max_rounds:
+            rounds += 1
+            pump()
+            t_rg, _ = first_event("regrow")
+            if (t_rg is not None and sup.dp == train_dp
+                    and sup.state == "running"
+                    and sup.losses
+                    and sup.recoveries
+                    and sup.recoveries[-1].cause == "expand"
+                    and sup._step > sup.recoveries[-1].restored_step):
+                t_regrown = time.monotonic()
+                break
+        t_rg, _ = first_event("regrow")
+
+        report = sup.report()
+        ckpt.close()
+
+    steps = [s for s, _ in report.losses]
+    exactly_once = steps == list(range(1, len(steps) + 1))
+    finished = sum(1 for g in gw.outcomes.values()
+                   if g.status == "finished")
+    causes = [r.cause for r in report.recoveries]
+    valid = (t_up is not None and t_pre is not None
+             and t_rg is not None and t_served is not None
+             and t_regrown is not None
+             and t_pre < t_up                 # preempt unblocked the up
+             and finished == n_requests and exactly_once
+             and causes == ["preempt", "expand"]
+             and all(r.steps_lost == 0 for r in report.recoveries)
+             and report.dp == train_dp)
+
+    def ms(a, b):
+        return round((b - a) * 1000, 1) if None not in (a, b) else -1.0
+
+    return {
+        "chips": len(chips),
+        "train_dp": train_dp,
+        "tp": tp,
+        "requests": n_requests,
+        "rounds": rounds,
+        "scaleup_ms": ms(t_burst, t_up),
+        "preempt_ms": ms(t_pre, t_served),
+        "regrow_ms": ms(t_rg, t_regrown),
+        "train_steps": report.steps,
+        "finished": finished,
+        "recovery_causes": causes,
+        "steps_lost": [r.steps_lost for r in report.recoveries],
+        "exactly_once": exactly_once,
+        "valid": valid,
+        "note": ("scripted contention cycle: burst -> "
+                 "checkpoint-then-shrink preempt -> serve on freed "
+                 "chips -> calm -> retire -> EXPAND regrow; "
+                 "preempt_ms is preemption-to-serving MTTR, regrow_ms "
+                 "is regrow-to-full-width (reform + restore + "
+                 "recompile included)"),
+    }
+
+
+__all__ = ["fleet_probe"]

@@ -41,12 +41,19 @@ from ..utils.metrics import GatewayMetrics
 from .admission import (DISPATCHED, FINISHED, QUEUED,
                         REJECTED_INVALID, SHED_EXPIRED, AdmissionError,
                         AdmissionQueue, GatewayRequest)
-from .replica import EngineReplica, ReplicaManager
+from .replica import DEAD, EngineReplica, ReplicaManager
 from .router import PrefixAffinityRouter, Router
 
 # metrics outcome labels
 _FINISHED_ATTAINED = "finished_attained"
 _FINISHED_LATE = "finished_late"
+
+# EWMA smoothing for the fleet-reconciler demand signals: heavy enough
+# that one quiet (or bursty) pump step cannot flip a scaling decision,
+# light enough that a sustained change shows within a few steps — the
+# hysteresis the fleet policy adds on top is the real damper.
+_RATE_ALPHA = 0.3
+_MARGIN_ALPHA = 0.3
 
 
 class FleetGateway:
@@ -73,6 +80,14 @@ class FleetGateway:
         #: per-replica dispatch attribution (utils/dispatch.py)
         self.per_replica = dispatch.Aggregator()
         self._steps = 0
+        #: demand signals for the fleet reconciler: arrival-rate EWMA
+        #: (updated once per pump step from the arrivals since the
+        #: last one) and the signed SLO-margin EWMA over finished
+        #: SLO-bearing requests (None until one finishes)
+        self.arrival_rate_rps = 0.0
+        self.slo_margin_ewma_s: float | None = None
+        self._arrivals = 0
+        self._rate_t = self.clock()
 
     # -- intake ----------------------------------------------------------
 
@@ -84,6 +99,7 @@ class FleetGateway:
         because shedding under load is an outcome the caller must see,
         not a bug."""
         now = self.clock()
+        self._arrivals += 1      # offered load counts refusals too
         live = frozenset(
             uid for r in self.manager.replicas for uid in r.in_flight)
         try:
@@ -110,6 +126,18 @@ class FleetGateway:
         status this round (finished or shed)."""
         now = self.clock()
         done: list[GatewayRequest] = []
+        # 0. demand accounting: fold the arrivals since the last step
+        #    into the rate EWMA (a zero-arrival step decays it, which
+        #    is what lets the reconciler see calm)
+        dt = now - self._rate_t
+        if dt > 0:
+            inst = self._arrivals / dt
+            self.arrival_rate_rps = (_RATE_ALPHA * inst
+                                     + (1 - _RATE_ALPHA)
+                                     * self.arrival_rate_rps)
+            self.metrics.arrival_rate.set(self.arrival_rate_rps)
+            self._arrivals = 0
+            self._rate_t = now
         # 1. shed-on-expired BEFORE dispatch: a dead-on-arrival-at-
         #    the-front request must never occupy a slot
         for g in self.queue.shed_expired(now):
@@ -148,10 +176,12 @@ class FleetGateway:
                 self._terminal(g, REJECTED_INVALID, done)
                 continue
             self.metrics.queue_wait_seconds.observe(now - g.arrival_s)
-        # 4. advance every busy ready replica, attributing its host
-        #    dispatches to its name
+        # 4. advance every busy live replica — READY or DRAINING: a
+        #    gracefully draining replica (scale-down) must finish its
+        #    in-flight rows even though routers no longer feed it —
+        #    attributing its host dispatches to its name
         for replica in list(self.manager.replicas):
-            if not replica.ready or not replica.in_flight:
+            if replica.state == DEAD or not replica.in_flight:
                 continue
             with dispatch.track() as t:
                 finished = replica.step()
@@ -216,6 +246,12 @@ class FleetGateway:
                 outcome = _FINISHED_ATTAINED
             else:
                 self.metrics.slo_margin_seconds.observe(margin)
+                prev = self.slo_margin_ewma_s
+                self.slo_margin_ewma_s = (
+                    margin if prev is None
+                    else _MARGIN_ALPHA * margin
+                    + (1 - _MARGIN_ALPHA) * prev)
+                self.metrics.slo_margin_ewma.set(self.slo_margin_ewma_s)
                 outcome = (_FINISHED_ATTAINED if margin >= 0
                            else _FINISHED_LATE)
         else:

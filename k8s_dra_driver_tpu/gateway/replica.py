@@ -38,6 +38,7 @@ from ..coordclient.client import ENV_COORDINATION_DIR, CoordinatorClient
 READY = "ready"
 DRAINING = "draining"
 DEAD = "dead"
+RETIRED = "retired"     # counts() key only: gracefully scaled down
 
 
 def resolve_container_path(path: str, mounts: list[dict] | None
@@ -178,6 +179,10 @@ class ReplicaManager:
         # dead replicas compacted out of the pool by replace(); keeps
         # counts() monotone without growing the replica list forever
         self._dead_removed = 0
+        # gracefully retired replicas (scale-down), same compaction
+        # idea but a separate count: a retire is a decision, not a
+        # failure, and the two must stay distinguishable in metrics
+        self._retired = 0
         self.replicas: list[EngineReplica] = [
             self._spawn() for _ in range(replicas)]
 
@@ -200,6 +205,7 @@ class ReplicaManager:
         for r in self.replicas:
             out[r.state] += 1
         out[DEAD] += self._dead_removed
+        out[RETIRED] = self._retired
         return out
 
     # -- health verdicts -------------------------------------------------
@@ -255,11 +261,50 @@ class ReplicaManager:
         self.replicas.append(fresh)
         return fresh
 
+    # -- external-controller verbs (fleet/reconciler.py) ------------------
+
+    def add_replica(self, chip: int | None = None) -> EngineReplica:
+        """Scale-up: one fresh replica joins the pool.  ``chip`` pins
+        the ledger chip an external arbiter allocated it (overriding
+        ``chip_of``) so the health mapping and the supply bookkeeping
+        agree on who sits where."""
+        fresh = self._spawn()
+        if chip is not None:
+            fresh.chip = chip
+        self.replicas.append(fresh)
+        return fresh
+
+    def begin_drain(self, replica: EngineReplica) -> None:
+        """Graceful scale-down, the planned twin of ``mark_down``: the
+        replica stops receiving dispatch (routers skip non-ready) but
+        its engine is HEALTHY, so in-flight work runs to completion on
+        it instead of being cancelled and requeued.  ``retire`` it
+        once ``in_flight`` empties."""
+        if replica.state == READY:
+            replica.state = DRAINING
+
+    def retire(self, replica: EngineReplica) -> None:
+        """Remove a replica from the pool: a finished graceful drain,
+        or a dead replica in a pool whose controller owns replacement
+        (``auto_replace=False``).  The lease is released so the
+        coordinator's sharing slot — and the ledger's chip — free up;
+        ``counts()`` keeps the cumulative dead/retired totals."""
+        if replica in self.replicas:
+            self.replicas.remove(replica)
+            if replica.state == DEAD:
+                self._dead_removed += 1
+            else:
+                self._retired += 1
+        if replica.state != DEAD and replica.lease is not None:
+            replica.lease.release()   # mark_down released dead leases
+
     def heartbeat(self) -> None:
         for r in self.replicas:
-            if r.ready and r.lease is not None:
+            # draining replicas still serve their in-flight rows —
+            # the daemon must not evict them as dead mid-request
+            if r.state != DEAD and r.lease is not None:
                 r.lease.heartbeat()
 
 
-__all__ = ["DEAD", "DRAINING", "READY", "DraChipLease",
+__all__ = ["DEAD", "DRAINING", "READY", "RETIRED", "DraChipLease",
            "EngineReplica", "ReplicaManager", "resolve_container_path"]

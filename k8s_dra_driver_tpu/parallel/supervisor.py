@@ -50,6 +50,23 @@ indices to the workers that own them; a scripted
 :class:`~..cluster.faults.FaultPlan` injects worker death
 (``error: "crash"``) and wedges (``error: "hang"``) through the same
 decision path (verb ``"gang"``, kind ``"Worker"``).
+
+External control (the fleet reconciler's surface, fleet/):
+
+- The loop is steppable: ``begin`` + ``step_once`` let one
+  single-threaded control loop interleave train steps with serving
+  work and reconcile ticks; ``run`` remains begin + drain.
+- :meth:`GangSupervisor.request_width` re-forms the gang at a
+  requested dp at the next step boundary, AFTER checkpointing the
+  current step — a controlled resize loses zero steps.  Shrinks ride
+  the same REFORM path an eviction takes (checkpoint-then-shrink
+  preemption); grows pass through the EXPAND transition, closing the
+  shrink-only gap (the reconciler's heal-driven regrow is its first
+  consumer).  :meth:`GangSupervisor.readmit` is the chip up-signal
+  twin of eviction: healed chips return to the buildable set.
+- ``listeners`` mirror plugin/health.py's hook: each state transition
+  calls ``listener(state, info)`` so external controllers observe
+  RUNNING→…→RESUME without polling.
 """
 
 from __future__ import annotations
@@ -71,14 +88,17 @@ from .mesh import MeshSpec, make_mesh
 
 log = logging.getLogger(__name__)
 
-# supervisor states (the contract FAILURE_SEMANTICS.md documents)
+# supervisor states (the contract FAILURE_SEMANTICS.md documents);
+# EXPAND marks an externally requested GROW re-formation — the only
+# transition the failure paths never emit
 RUNNING = "running"
 SUSPECT = "suspect"
 EVICT = "evict"
 REFORM = "reform"
+EXPAND = "expand"
 RESUME = "resume"
 FAILED = "failed"
-STATES = (RUNNING, SUSPECT, EVICT, REFORM, RESUME, FAILED)
+STATES = (RUNNING, SUSPECT, EVICT, REFORM, EXPAND, RESUME, FAILED)
 
 CONTRACT_FILENAME = "gang.json"
 
@@ -229,10 +249,21 @@ class GangSupervisor:
         self.recoveries: list[Recovery] = []
         self.contract: dict = {}
         self.slow_steps = 0
+        # state-transition subscribers, mirroring plugin/health.py's
+        # listener hook: called with (state, info) on every
+        # transition; must not raise — one failing listener must not
+        # starve its siblings or the recovery itself
+        self.listeners: list = []
         self._gen = 0                    # formation generation
         self._dead_chips: set = set()
         self._unhealthy: dict = {}
         self._unhealthy_lock = threading.Lock()
+        # externally requested dp width (request_width), consumed at
+        # the next step boundary by step_once
+        self._requested_dp: int | None = None
+        self._width_lock = threading.Lock()
+        self._step = 0
+        self._total_steps = 0
         # released on eviction so a simulated wedge (fault "hang")
         # unblocks promptly instead of leaking a sleeping thread
         self._abort = threading.Event()
@@ -253,6 +284,37 @@ class GangSupervisor:
         reach the supervisor even when the apiserver is unreachable,
         exactly like the gateway's replica drain wiring."""
         health_monitor.listeners.append(self.on_health)
+
+    def request_width(self, dp: int) -> None:
+        """Ask the gang to re-form at ``dp`` data-parallel rows at the
+        next step boundary (the fleet reconciler's resize verb):
+        checkpoint-then-shrink preemption when ``dp`` is smaller,
+        EXPAND regrow when larger.  Thread-safe; the latest request
+        wins.  Raises ``ValueError`` for a width no formation could
+        ever run (static infeasibility); a width that is merely
+        infeasible RIGHT NOW (chips vanished since the request) is
+        dropped at apply time with a warning instead of killing the
+        run."""
+        if dp < 1:
+            raise ValueError(f"dp must be >= 1, got {dp}")
+        if self.job.batch % dp:
+            raise ValueError(
+                f"dp {dp} does not divide global batch {self.job.batch}")
+        with self._width_lock:
+            self._requested_dp = dp
+
+    def readmit(self, chips) -> None:
+        """Chip up-signal, the heal twin of eviction: the caller (the
+        reconciler, forwarding the health stack's recovery) asserts
+        these chips are healthy again, and the supervisor stops
+        excluding them — they rejoin the buildable set at the next
+        (re)formation, which is what makes an EXPAND back to full
+        width possible after a health eviction."""
+        chips = set(chips)
+        with self._unhealthy_lock:
+            self._dead_chips -= chips
+            for c in chips:
+                self._unhealthy.pop(c, None)
 
     def _poll_down(self):
         """(victims, cause) from push/poll health plus tombstones an
@@ -284,12 +346,17 @@ class GangSupervisor:
 
     def _form(self, dp: int) -> None:
         """(Re-)issue the gang contract at world size ``dp`` and stand
-        the mesh/step program up over the surviving chips."""
+        the mesh/step program up over the surviving chips.  The build
+        runs BEFORE any state mutates, so a failed formation (not
+        enough healthy devices) leaves the current gang intact — the
+        property the apply-time resize fallback relies on."""
         import numpy as np
 
-        self.dp = dp
-        self.mesh, self.step_fn, self.init_state = self.job.build(
+        mesh, step_fn, init_state = self.job.build(
             dp, exclude_chips=frozenset(self._dead_chips))
+        self.dp = dp
+        self.mesh, self.step_fn, self.init_state = (mesh, step_fn,
+                                                    init_state)
         grid = np.asarray(self.mesh.devices).reshape(dp, -1)
         self.workers = []
         for i in range(dp):
@@ -396,12 +463,25 @@ class GangSupervisor:
         self.state = state
         self.transitions.append(state)
         self.metrics.set_state(state, STATES)
+        info = {"dp": self.dp, "step": self._step,
+                "generation": self._gen}
+        for listener in list(self.listeners):
+            try:
+                listener(state, info)
+            except Exception:
+                log.exception("supervisor state listener failed")
 
     def _recover(self, victims: list[_Worker], cause: str) -> None:
         t0 = time.perf_counter()
         self._transition(EVICT)
         self._abort.set()              # release any simulated wedge
-        if len(self.recoveries) >= self.max_recoveries:
+        # only FAILURE recoveries consume the budget: controlled
+        # resizes (preempt/expand, the reconciler's verbs) are
+        # decisions, and a long arbitration history must not strand a
+        # healthy gang in FAILED
+        failures = sum(1 for r in self.recoveries
+                       if r.cause in ("dead", "wedged", "health"))
+        if failures >= self.max_recoveries:
             self._transition(FAILED)
             raise SupervisorError(
                 f"recovery budget exhausted ({self.max_recoveries}) "
@@ -442,13 +522,64 @@ class GangSupervisor:
         log.warning("resumed at step %d on dp=%d (%d step(s) to "
                     "replay)", at, new_dp, lost)
 
+    def _resize(self, target: int) -> None:
+        """Apply an externally requested width change (request_width):
+        checkpoint the CURRENT step first — a controlled resize must
+        lose nothing — then re-form through the same REFORM path an
+        eviction takes.  Grows pass through EXPAND, the transition the
+        shrink-only failure paths never emit; restore onto the new
+        mesh layout rides the same sharding-aware elastic path a
+        recovery uses (a dp change is a placement change, not a math
+        change)."""
+        cause = "expand" if target > self.dp else "preempt"
+        t0 = time.perf_counter()
+        self.ckpt.save(self._step, self.params, self.opt,
+                       extra=self.loader.state_dict())
+        from_dp = self.dp
+        if cause == "expand":
+            self._transition(EXPAND)
+        self._transition(REFORM)
+        try:
+            self._form(target)
+        except SupervisorError as e:
+            # transiently infeasible (chips vanished between request
+            # and apply): keep training at the current width — _form
+            # mutated nothing, and the reconciler sees the unchanged
+            # dp gauge and may re-request when supply returns
+            log.warning("resize to dp=%d infeasible (%s); staying at "
+                        "dp=%d", target, e, from_dp)
+            self._transition(RUNNING)
+            return
+        self._transition(RESUME)
+        params, opt = self.init_state(self._key())
+        self.params, self.opt, at = self.ckpt.restore(params, opt)
+        self.loader.load_state_dict(
+            self.ckpt.restore_extra(at) or {"epoch": 0, "step": 0})
+        lost = self._step - at
+        rec = Recovery(cause=cause, victims=[], from_dp=from_dp,
+                       to_dp=target, restored_step=at, steps_lost=lost)
+        self.recoveries.append(rec)
+        self._pending = (rec, t0)
+        self._step = at
+        self.metrics.restarts.labels(cause=cause).inc()
+        self.metrics.steps_lost.inc(lost)
+        self.metrics.steps_lost_last.set(lost)
+        self._transition(RUNNING)
+        log.warning("resized gang dp %d -> %d (%s) at step %d",
+                    from_dp, target, cause, at)
+
     def _key(self):
         import jax
         return jax.random.PRNGKey(self.init_seed)
 
     # -- the loop --------------------------------------------------------
 
-    def run(self, total_steps: int) -> SupervisorReport:
+    def begin(self, total_steps: int) -> None:
+        """Form the gang and arm the loop.  Pair with ``step_once``
+        when an external single-threaded control loop (the fleet
+        reconciler's co-loop) interleaves train steps with serving
+        work and reconcile ticks; ``run`` is begin + drain."""
+        self._total_steps = total_steps
         self._form(self.dp)
         self.loader = self.job.make_loader()
         self.params, self.opt = self.init_state(self._key())
@@ -457,52 +588,75 @@ class GangSupervisor:
         self._step = 0
         self._pending = None
         self.metrics.set_state(RUNNING, STATES)
-        while self._step < total_steps:
-            victims, cause = self._poll_down()
-            if victims:
-                self._transition(SUSPECT)
-                self._recover(victims, cause)
-                continue
-            warm = self._formation_steps >= self.warmup_steps
-            deadline = (self.step_deadline_s if warm
-                        else self.first_step_deadline_s)
-            t_start = time.perf_counter()
-            try:
-                loss = run_with_deadline(
-                    lambda: self._one_step(self._step), deadline,
-                    label=f"train step {self._step + 1} "
-                          f"(gen {self._gen - 1})")
-            except WatchdogTimeout:
-                self._transition(SUSPECT)
-                self._recover(*self._classify_stall())
-                continue
-            except GangDeath as e:
-                self._transition(SUSPECT)
-                victim = [w for w in self.workers
-                          if w.name == e.worker]
-                self._recover(victim, "dead")
-                continue
-            if (warm and time.perf_counter() - t_start
-                    >= self.monitor.soft_s):
-                self.slow_steps += 1     # progressing, just slow
-            self._formation_steps += 1
-            self._step += 1
-            self.losses.append((self._step, loss))
-            if self._pending is not None:
-                rec, t0 = self._pending
-                rec.mttr_s = time.perf_counter() - t0
-                self.metrics.recovery_seconds.observe(rec.mttr_s)
-                self._pending = None
-            if self._step % self.checkpoint_every == 0:
-                self.ckpt.save(self._step, self.params, self.opt,
-                               extra=self.loader.state_dict())
+
+    def step_once(self) -> bool:
+        """Advance the supervised run by at most one unit of work —
+        one completed train step, one recovery, or one applied resize
+        — and return True while steps remain.  Raises SupervisorError
+        exactly like ``run`` when recovery bottoms out."""
+        if self._step >= self._total_steps:
+            return False
+        with self._width_lock:
+            target, self._requested_dp = self._requested_dp, None
+        if target is not None and target != self.dp:
+            self._resize(target)
+            return self._step < self._total_steps
+        victims, cause = self._poll_down()
+        if victims:
+            self._transition(SUSPECT)
+            self._recover(victims, cause)
+            return True
+        warm = self._formation_steps >= self.warmup_steps
+        deadline = (self.step_deadline_s if warm
+                    else self.first_step_deadline_s)
+        t_start = time.perf_counter()
+        try:
+            loss = run_with_deadline(
+                lambda: self._one_step(self._step), deadline,
+                label=f"train step {self._step + 1} "
+                      f"(gen {self._gen - 1})")
+        except WatchdogTimeout:
+            self._transition(SUSPECT)
+            self._recover(*self._classify_stall())
+            return True
+        except GangDeath as e:
+            self._transition(SUSPECT)
+            victim = [w for w in self.workers
+                      if w.name == e.worker]
+            self._recover(victim, "dead")
+            return True
+        if (warm and time.perf_counter() - t_start
+                >= self.monitor.soft_s):
+            self.slow_steps += 1     # progressing, just slow
+        self._formation_steps += 1
+        self._step += 1
+        self.losses.append((self._step, loss))
+        if self._pending is not None:
+            rec, t0 = self._pending
+            rec.mttr_s = time.perf_counter() - t0
+            self.metrics.recovery_seconds.observe(rec.mttr_s)
+            self._pending = None
+        if self._step % self.checkpoint_every == 0:
+            self.ckpt.save(self._step, self.params, self.opt,
+                           extra=self.loader.state_dict())
+        return self._step < self._total_steps
+
+    def report(self) -> SupervisorReport:
+        """The run's record so far — callable mid-run by an external
+        control loop as well as at the end."""
         return SupervisorReport(
             losses=self.losses, recoveries=self.recoveries,
             transitions=self.transitions, dp=self.dp,
             steps=self._step, contract=self.contract)
 
+    def run(self, total_steps: int) -> SupervisorReport:
+        self.begin(total_steps)
+        while self.step_once():
+            pass
+        return self.report()
 
-__all__ = ["CONTRACT_FILENAME", "EVICT", "FAILED", "REFORM", "RESUME",
-           "RUNNING", "STATES", "SUSPECT", "ElasticTrainJob",
+
+__all__ = ["CONTRACT_FILENAME", "EVICT", "EXPAND", "FAILED", "REFORM",
+           "RESUME", "RUNNING", "STATES", "SUSPECT", "ElasticTrainJob",
            "GangDeath", "GangSupervisor", "Recovery",
            "SupervisorError", "SupervisorReport"]

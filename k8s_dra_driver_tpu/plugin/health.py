@@ -51,12 +51,14 @@ class HealthMonitor:
         # change subscribers: called with the full unhealthy dict on
         # every transition, BEFORE the republish attempt, so node-local
         # consumers (the fleet gateway's replica drain,
-        # gateway/replica.py, and the elastic gang supervisor's worker
-        # eviction, parallel/supervisor.py GangSupervisor.attach) see
-        # a chip-down even when the apiserver is unreachable — their
-        # reaction is local, the republish is not.  Callbacks must not
-        # raise; one failing listener must not starve the republish or
-        # its siblings.
+        # gateway/replica.py; the elastic gang supervisor's worker
+        # eviction, parallel/supervisor.py GangSupervisor.attach; and
+        # the fleet reconciler's supply ledger, fleet/supply.py
+        # ChipLedger.on_health — its heal bookkeeping is what drives
+        # gang regrow) see a chip-down even when the apiserver is
+        # unreachable — their reaction is local, the republish is not.
+        # Callbacks must not raise; one failing listener must not
+        # starve the republish or its siblings.
         self.listeners: list = []
 
     # -- one observation ---------------------------------------------------

@@ -26,7 +26,7 @@ import traceback
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from .metrics import DriverMetrics
+from .metrics import DriverMetrics, render_all
 
 
 def _thread_stacks() -> str:
@@ -73,10 +73,19 @@ def _frame_chain(frame):
 
 
 class HTTPEndpoint:
+    """``metrics`` is any object with ``render() -> bytes``
+    (DriverMetrics in the binaries); ``extra_metrics`` appends further
+    registries to the same ``/metrics`` exposition — how a process
+    that also runs the fleet stack (gateway, gang supervisor,
+    reconciler) exports their state on the one scrape endpoint
+    (utils/metrics.py ``render_all``)."""
+
     def __init__(self, address: str, metrics: DriverMetrics,
-                 pprof_prefix: str = "/debug/pprof"):
+                 pprof_prefix: str = "/debug/pprof",
+                 extra_metrics=()):
         host, _, port = address.rpartition(":")
         self.metrics = metrics
+        self.extra_metrics = tuple(extra_metrics)
         self._profile_lock = threading.Lock()
         prefix = pprof_prefix.rstrip("/")
         endpoint = self
@@ -96,7 +105,8 @@ class HTTPEndpoint:
                 url = urlparse(self.path)
                 path = url.path.rstrip("/") or "/"
                 if path == "/metrics":
-                    self._send(endpoint.metrics.render(),
+                    self._send(render_all(endpoint.metrics,
+                                          *endpoint.extra_metrics),
                                "text/plain; version=0.0.4")
                 elif path == "/healthz":
                     self._send(b"ok", "text/plain")

@@ -105,6 +105,20 @@ class GatewayMetrics:
             "tpu_gateway_drains_total",
             "Replica drains triggered by health/fault signals",
             registry=self.registry)
+        # demand gauges the fleet reconciler ticks on
+        # (fleet/reconciler.py): arrival-rate EWMA over pump steps and
+        # the signed SLO-margin EWMA over finished SLO-bearing
+        # requests — the sustained-pressure signals, as opposed to the
+        # per-request histograms above
+        self.arrival_rate = Gauge(
+            "tpu_gateway_arrival_rate_rps",
+            "EWMA of the request arrival rate (admitted + refused), "
+            "updated once per pump step", registry=self.registry)
+        self.slo_margin_ewma = Gauge(
+            "tpu_gateway_slo_margin_ewma_seconds",
+            "EWMA of the signed SLO margin over finished SLO-bearing "
+            "requests (negative = sustained SLO pressure)",
+            registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -167,3 +181,55 @@ class RecoveryMetrics:
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
+
+
+class FleetMetrics:
+    """Fleet-reconciler observability (fleet/reconciler.py): the one
+    place the serving fleet's demand, the gang's width, and the chip
+    ledger meet.  Scale decisions are counters (a preempt or regrow
+    that does not advance ``tpu_fleet_scale_events_total`` did not
+    happen — the acceptance surface tests/test_fleet.py pins), the
+    ledger is gauges, and the hysteresis counters are exported so an
+    operator can see pressure BUILDING before the action fires."""
+
+    def __init__(self):
+        self.registry = CollectorRegistry()
+        self.ticks = Counter(
+            "tpu_fleet_ticks_total", "Reconcile ticks executed",
+            registry=self.registry)
+        self.scale_events = Counter(
+            "tpu_fleet_scale_events_total",
+            "Actuated reconcile decisions by action "
+            "(up/down/preempt/regrow)", ["action"],
+            registry=self.registry)
+        self.chips = Gauge(
+            "tpu_fleet_chips",
+            "Ledger chips by ownership class "
+            "(free/serving/training/unhealthy)", ["owner"],
+            registry=self.registry)
+        self.pressure_ticks = Gauge(
+            "tpu_fleet_pressure_ticks",
+            "Consecutive pressured ticks (scale-up/preempt hysteresis "
+            "counter)", registry=self.registry)
+        self.calm_ticks = Gauge(
+            "tpu_fleet_calm_ticks",
+            "Consecutive calm ticks (scale-down/regrow hysteresis "
+            "counter)", registry=self.registry)
+        self.gang_dp_target = Gauge(
+            "tpu_fleet_gang_dp_target",
+            "dp width the reconciler most recently requested from the "
+            "gang supervisor", registry=self.registry)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+def render_all(*metrics) -> bytes:
+    """One Prometheus text exposition over several dedicated
+    registries (the pattern every metrics class here uses for test
+    hermeticity).  Valid as long as no two registries share a metric
+    family name — guaranteed by the per-subsystem name prefixes
+    (tpu_dra_/tpu_gateway_/tpu_train_/tpu_fleet_).  This is what the
+    HTTP endpoint serves when a binary or testbed carries fleet state
+    next to the driver's own metrics (utils/httpendpoint.py)."""
+    return b"".join(m.render() for m in metrics)

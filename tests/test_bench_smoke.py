@@ -134,6 +134,36 @@ def test_probe_roster_pins_supervisor_scalars():
     assert keys["sup_steps_lost"] == "steps_lost_worst"
 
 
+def test_fleet_probe_tiny():
+    """The fleet-reconciler probe at the hermetic shape bench.py
+    streams (same kwargs object, so this pins what actually streams):
+    one full contention cycle lands — preempt, serve on freed chips,
+    regrow — with the latency scalars the compact line picks up and
+    the exactly-once invariants intact."""
+    from k8s_dra_driver_tpu.fleet.probe import fleet_probe
+    out = fleet_probe(**bench.TINY_FLEET_KWARGS)
+    assert out["valid"] is True
+    assert out["recovery_causes"] == ["preempt", "expand"]
+    assert out["steps_lost"] == [0, 0]
+    assert out["exactly_once"] is True
+    assert out["finished"] == bench.TINY_FLEET_KWARGS["n_requests"]
+    # the compact-line scalars (bench._PROBE_SCALARS picks these up)
+    for key in ("scaleup_ms", "preempt_ms", "regrow_ms"):
+        assert out[key] > 0, key
+
+
+def test_probe_roster_pins_fleet_scalars():
+    """Bench-line schema: the reconciler's judge-facing scalars
+    (scale-up latency, preemption-to-serving MTTR, regrow-to-full-
+    width) are IN the compact line roster."""
+    probes = [p for p, _, _ in bench._PROBE_SCALARS]
+    assert "fleet" in probes
+    keys = {k: f for _, k, f in bench._PROBE_SCALARS}
+    assert keys["fleet_scaleup_ms"] == "scaleup_ms"
+    assert keys["fleet_preempt_ms"] == "preempt_ms"
+    assert keys["fleet_regrow_ms"] == "regrow_ms"
+
+
 def test_probe_roster_pins_gateway_scalars():
     """Bench-line schema: the gateway sweep's judge-facing scalars
     (goodput, SLO attainment, stress p99 queue wait) are IN the

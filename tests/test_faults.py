@@ -647,3 +647,70 @@ class TestCheckpointRecovery:
         prepared = state2.prepare(claim)
         assert prepared.devices[0].device_name == "chip-0"
         state2.unprepare(claim.metadata.uid)
+
+
+# --------------------------------------------------------------------------
+# scripted chip health: the down/heal up-signal twin (fleet satellite)
+# --------------------------------------------------------------------------
+
+class TestScriptedChipHealth:
+    def test_down_latches_until_heal(self):
+        """The recovery verb: a down-kind decision marks the chip
+        unhealthy and LATCHES; the new ``heal`` kind — the chip
+        up-signal twin of the down/kill/hang kinds — clears it, so a
+        failure window plus recovery is two rules, deterministic in
+        poll counts."""
+        from k8s_dra_driver_tpu.cluster.faults import ScriptedChipHealth
+        plan = FaultPlan([
+            FaultRule(verb="health", kind="Chip", name="2", skip=1,
+                      times=1, error="drop"),
+            FaultRule(verb="health", kind="Chip", name="2", skip=2,
+                      times=1, error="heal"),
+        ])
+        src = ScriptedChipHealth(plan, chips=[1, 2])
+        assert src() == {}                       # poll 1: skipped
+        down = src()                             # poll 2: rule fires
+        assert set(down) == {2} and "drop" in down[2]
+        assert set(src()) == {2}                 # poll 3: latched
+        # poll 4 reaches the heal rule (its seen counts polls 1 and 3,
+        # the ones the down rule let fall through) -> chip recovers
+        assert src() == {}
+        assert src() == {}                       # stays healthy
+
+    def test_composes_with_base_source(self):
+        from k8s_dra_driver_tpu.cluster.faults import ScriptedChipHealth
+        plan = FaultPlan([FaultRule(verb="health", kind="Chip",
+                                    name="0", times=1, error="500")])
+        src = ScriptedChipHealth(plan, chips=[0],
+                                 base=lambda: {3: "real ecc"})
+        out = src()
+        assert set(out) == {0, 3}
+        assert out[3] == "real ecc"
+
+    def test_replay_is_deterministic(self):
+        """Same plan JSON, same poll sequence -> identical health
+        trajectories (the chaos suite's determinism contract extended
+        to the up-signal)."""
+        from k8s_dra_driver_tpu.cluster.faults import ScriptedChipHealth
+        spec = {"seed": 3, "rules": [
+            {"verb": "health", "kind": "Chip", "name": "1", "skip": 2,
+             "times": 1, "error": "drop"},
+            {"verb": "health", "kind": "Chip", "name": "1", "skip": 5,
+             "times": 1, "error": "heal"}]}
+
+        def trajectory():
+            src = ScriptedChipHealth(FaultPlan.from_json(spec),
+                                     chips=[0, 1])
+            return [sorted(src()) for _ in range(10)]
+
+        assert trajectory() == trajectory()
+
+    def test_heal_is_a_signal_not_an_error(self):
+        """raise_for treats ``heal`` like ``hang``: the call layer
+        passes through — only ScriptedChipHealth consumes it — and
+        the rule validates like any other kind."""
+        from k8s_dra_driver_tpu.cluster.faults import Decision
+        plan = FaultPlan()
+        plan.raise_for(Decision(error="heal"), "ctx")   # no raise
+        with pytest.raises(ValueError, match="unknown fault error"):
+            FaultRule(error="resurrect")

@@ -1,0 +1,653 @@
+"""Fleet reconciler (k8s_dra_driver_tpu/fleet/): demand-driven
+autoscaling, gang regrow, and training/serving chip arbitration.
+
+THE acceptance invariants (ISSUE 5): under a sustained SLO-violating
+burst the reconciler preempts the training gang
+(checkpoint-then-shrink dp=4→2 through the supervisor's REFORM path),
+adds a gateway replica on the freed chips, and SLO attainment
+recovers; when load subsides and the chips free, the gang regrows to
+dp=4 through the EXPAND transition and resumes from the latest
+checkpoint with zero steps lost and every loss step applied exactly
+once — all transitions visible in the fleet metrics.  The chaos twin
+(``-m faults``) drives the same cycle from a scripted replica kill +
+heal (cluster/faults.py ScriptedChipHealth) and pins exactly-once,
+byte-equal outputs through drain, requeue, preempt, and regrow.
+
+Every co-loop test rides the fast-tier stall guard (``timeout_s``,
+tests/conftest.py): the supervisor side deliberately re-forms meshes,
+and a regression that turns a reform into a hang must cost seconds.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.cluster.faults import (FaultPlan, FaultRule,
+                                               ScriptedChipHealth)
+from k8s_dra_driver_tpu.fleet import (ChipLedger, DemandSignals,
+                                      FleetPolicy, FleetReconciler,
+                                      PolicyConfig)
+from k8s_dra_driver_tpu.gateway import FleetGateway, ReplicaManager
+from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                       greedy_generate, init_params)
+from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
+
+pytestmark = pytest.mark.timeout_s(300)
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_head=8, d_ff=64, max_seq=48, n_kv_heads=2,
+                        dtype=jnp.float32)
+
+_PARAMS = None
+
+
+def params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+    return _PARAMS
+
+
+def prompt(seed, n):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab), np.int32)
+
+
+def oracle(pr, n_new):
+    out = greedy_generate(params(), jnp.asarray(pr)[None, :], CFG,
+                          n_tokens=n_new)
+    return np.asarray(out[0], np.int32)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- chip ledger (pure host logic, no jax) ---------------------------------
+
+class _R:
+    def __init__(self, name, chip, state="ready"):
+        self.name = name
+        self.chip = chip
+        self.state = state
+
+
+class _Mgr:
+    def __init__(self, replicas):
+        self.replicas = replicas
+
+
+class _W:
+    def __init__(self, chips, alive=True):
+        self.chips = chips
+        self.alive = alive
+
+
+class _Sup:
+    def __init__(self, workers):
+        self.workers = workers
+
+
+class TestChipLedger:
+    def test_sync_recomputes_ownership_each_call(self):
+        led = ChipLedger([0, 1, 2, 3, 4, 5])
+        led.sync(_Mgr([_R("r0", 4), _R("r1", 5, state="dead")]),
+                 _Sup([_W((0, 1)), _W((2, 3), alive=False)]))
+        v = led.view()
+        assert v.serving == (4,)            # dead r1 frees chip 5
+        assert v.training == (0, 1)         # evicted worker frees 2,3
+        assert set(v.free) == {2, 3, 5}
+
+    def test_health_keeps_last_state_and_heals_once(self):
+        state = {"fail": False, "unhealthy": {}}
+
+        def probe():
+            if state["fail"]:
+                raise RuntimeError("transport down")
+            return dict(state["unhealthy"])
+
+        led = ChipLedger([0, 1], health_source=probe)
+        state["unhealthy"] = {1: "ecc"}
+        led.observe_health()
+        assert led.current_unhealthy() == {1: "ecc"}
+        # probe failure keeps the last observation (plugin/health.py)
+        state["fail"] = True
+        led.observe_health()
+        assert led.current_unhealthy() == {1: "ecc"}
+        # recovery is queued for exactly ONE take_healed
+        state["fail"] = False
+        state["unhealthy"] = {}
+        led.observe_health()
+        assert led.take_healed() == {1}
+        assert led.take_healed() == set()
+
+    def test_serving_takes_from_tail_training_block_from_head(self):
+        led = ChipLedger([0, 1, 2, 3])
+        assert led.take_for_serving() == 3
+        assert led.take_for_serving() == 2  # pending claim sticks
+        led.unhealthy = {1: "down"}
+        assert led.take_for_serving() == 0
+        assert led.take_for_serving() is None
+
+    def test_from_backend_binds_the_discovery_health_stack(self,
+                                                           tmp_path):
+        """ChipLedger.from_backend: the ledger enumerates the same
+        chip set the driver publishes, polls the backend's real
+        sysfs-path health(), and catches vanished entries via the
+        boot-time expected set."""
+        import shutil
+
+        from k8s_dra_driver_tpu.discovery import FakeHost
+
+        backend = FakeHost(num_chips=4).materialize(tmp_path)
+        led = ChipLedger.from_backend(backend)
+        assert led.chips == [0, 1, 2, 3]
+        led.observe_health()
+        assert led.current_unhealthy() == {}
+        (tmp_path / "sys/class/accel/accel2/device/health").write_text(
+            "hbm uncorrectable ecc\n")
+        shutil.rmtree(tmp_path / "sys/class/accel/accel3")
+        (tmp_path / "dev/accel3").unlink()
+        led.observe_health()
+        assert set(led.current_unhealthy()) == {2, 3}
+        assert led.healthy_free() == [0, 1]
+
+    def test_contiguous_available_counts_gang_and_skips_unhealthy(self):
+        led = ChipLedger([0, 1, 2, 3, 4])
+        led.sync(_Mgr([_R("r0", 4)]), _Sup([_W((0, 1))]))
+        assert led.contiguous_available(4)      # gang 0,1 + free 2,3
+        assert not led.contiguous_available(5)  # 4 is serving-owned
+        led.unhealthy = {2: "ecc"}
+        assert not led.contiguous_available(4)  # hole in the block
+        assert led.view().largest_free_block == 1
+
+
+# -- policy hysteresis (pure host logic, no jax) ---------------------------
+
+def _led(free=0):
+    return ChipLedger(list(range(free)))
+
+
+class TestFleetPolicy:
+    def kw(self, **over):
+        kw = dict(replicas=2, idle_replicas=0, gang_dp=4, gang_tp=1)
+        kw.update(over)
+        return kw
+
+    def test_scale_up_needs_sustained_pressure(self):
+        pol = FleetPolicy(PolicyConfig(queue_high=4, up_after=2))
+        hot = DemandSignals(queue_depth=9)
+        assert pol.decide(hot, _led(free=2), **self.kw()) is None
+        act = pol.decide(hot, _led(free=2), **self.kw())
+        assert act is not None and act.kind == "scale_up"
+        # counter reset: the next pressured tick starts a new streak
+        assert pol.decide(hot, _led(free=2), **self.kw()) is None
+
+    def test_one_calm_tick_breaks_the_streak(self):
+        pol = FleetPolicy(PolicyConfig(queue_high=4, up_after=2))
+        hot = DemandSignals(queue_depth=9)
+        mid = DemandSignals(queue_depth=2, arrival_rate_rps=99.0)
+        assert pol.decide(hot, _led(free=1), **self.kw()) is None
+        assert pol.decide(mid, _led(free=1), **self.kw()) is None
+        assert pol.decide(hot, _led(free=1), **self.kw()) is None
+
+    def test_preempt_only_when_pool_is_dry(self):
+        pol = FleetPolicy(PolicyConfig(queue_high=4, up_after=1,
+                                       min_train_dp=2))
+        hot = DemandSignals(queue_depth=9)
+        act = pol.decide(hot, _led(free=1), **self.kw())
+        assert act.kind == "scale_up"       # free chip outranks preempt
+        act = pol.decide(hot, _led(free=0), **self.kw())
+        assert act.kind == "preempt" and act.dp == 2
+        # floored: a gang at min width has nothing left to give
+        assert pol.decide(hot, _led(free=0),
+                          **self.kw(gang_dp=2)) is None
+
+    def test_stale_margin_without_queue_is_not_pressure(self):
+        pol = FleetPolicy(PolicyConfig(queue_high=4, up_after=1))
+        stale = DemandSignals(queue_depth=0, arrival_rate_rps=0.0,
+                              slo_margin_ewma_s=-3.0)
+        assert not pol.pressured(stale)
+        assert pol.is_calm(stale)
+        live = DemandSignals(queue_depth=1, slo_margin_ewma_s=-3.0)
+        assert pol.pressured(live)
+
+    def test_calm_scales_down_then_regrows(self):
+        pol = FleetPolicy(PolicyConfig(queue_high=4, down_after=2,
+                                       regrow_after=2, min_replicas=1),
+                          train_target_dp=4)
+        calm = DemandSignals(queue_depth=0, arrival_rate_rps=0.0)
+        led = ChipLedger([0, 1, 2, 3, 4])
+        led.sync(_Mgr([_R("r0", 4)]), _Sup([_W((0, 1))]))
+        kw = self.kw(replicas=2, idle_replicas=1, gang_dp=2, gang_tp=1)
+        assert pol.decide(calm, led, **kw) is None
+        act = pol.decide(calm, led, **kw)
+        assert act.kind == "scale_down"     # retire before regrow
+        # the victim retired: at min_replicas the next calm streak
+        # goes to the gang
+        kw = self.kw(replicas=1, idle_replicas=0, gang_dp=2, gang_tp=1)
+        assert pol.decide(calm, led, **kw) is None
+        act = pol.decide(calm, led, **kw)
+        assert act.kind == "regrow" and act.dp == 4
+        # at target: nothing more to reclaim
+        assert pol.decide(calm, led,
+                          **self.kw(gang_dp=4, idle_replicas=0,
+                                    replicas=1)) is None
+
+    def test_regrow_respects_contiguity(self):
+        pol = FleetPolicy(PolicyConfig(regrow_after=1),
+                          train_target_dp=4)
+        led = ChipLedger([0, 1, 2, 3])
+        led.sync(_Mgr([_R("r0", 2)]), _Sup([_W((0, 1))]))
+        calm = DemandSignals()
+        # chips 0,1 gang + 3 free, but 2 is serving: no block of 4
+        assert pol.decide(calm, led, **self.kw(gang_dp=2)) is None
+
+
+# -- gateway demand signals ------------------------------------------------
+
+class _IdleManager:
+    replicas: list = []
+
+    def poll_down(self):
+        return []
+
+    def heartbeat(self):
+        pass
+
+    def counts(self):
+        return {}
+
+
+def test_arrival_rate_ewma_rises_and_decays():
+    clock = Clock()
+    gw = FleetGateway(_IdleManager(), queue_capacity=64, clock=clock)
+    for step in range(6):
+        for i in range(4):      # 4 arrivals per 1s step = 4 rps
+            gw.submit(Request(uid=f"s{step}i{i}",
+                              prompt=np.ones(4, np.int32), max_new=1))
+        clock.advance(1.0)
+        gw.step()
+    burst_rate = gw.arrival_rate_rps
+    assert burst_rate > 2.0
+    for _ in range(12):         # silence decays the EWMA toward zero
+        clock.advance(1.0)
+        gw.step()
+    assert gw.arrival_rate_rps < 0.5 < burst_rate
+    reg = gw.metrics.registry
+    assert reg.get_sample_value("tpu_gateway_arrival_rate_rps") \
+        == pytest.approx(gw.arrival_rate_rps)
+
+
+# -- reconciler actuation (stub subsystems, no jax) ------------------------
+
+class _StubEngine:
+    slots = 2
+
+
+class _ScriptSup:
+    """Supervisor stub: records the reconciler's verbs."""
+
+    def __init__(self, dp=2, tp=2):
+        self.dp = dp
+        self.job = type("J", (), {"tp": tp})()
+        self.workers = [_W(tuple(range(i * tp, (i + 1) * tp)))
+                        for i in range(dp)]
+        self.requested = []
+        self.readmitted = []
+        self.metrics = None
+
+    def request_width(self, dp):
+        self.requested.append(dp)
+
+    def readmit(self, chips):
+        self.readmitted.append(set(chips))
+
+
+class TestReconcilerActuation:
+    def rig(self, chips=(0, 1, 2, 3, 4, 5), health=None, **pol):
+        mgr = ReplicaManager(lambda name: _StubEngine(), replicas=2,
+                             chip_of=lambda name: 4 + int(name[1:]))
+        gw = FleetGateway(mgr, queue_capacity=64)
+        sup = _ScriptSup()
+        led = ChipLedger(list(chips), health_source=health)
+        cfg = PolicyConfig(**{**dict(queue_high=4, up_after=1,
+                                     down_after=1, regrow_after=1,
+                                     min_replicas=1), **pol})
+        rec = FleetReconciler(gw, sup, ledger=led,
+                              policy=FleetPolicy(cfg))
+        return mgr, gw, sup, led, rec
+
+    def depth(self, gw, n):
+        gw.metrics.queue_depth.set(n)
+
+    def test_pressure_spends_free_chips_before_preempting(self):
+        # chips: 0-3 gang, 4-5 replicas, 6 free -> the free chip goes
+        # first, and training is untouched
+        mgr, gw, sup, led, rec = self.rig(chips=(0, 1, 2, 3, 4, 5, 6))
+        self.depth(gw, 9)
+        assert rec.tick() == ["scale_up"]
+        assert mgr.replicas[-1].chip == 6
+        assert sup.requested == []
+        # chips: 0-3 gang, 4-5 replicas -> pool dry: preempt
+        mgr2, gw2, sup2, _, rec2 = self.rig(chips=(0, 1, 2, 3, 4, 5))
+        self.depth(gw2, 9)
+        assert rec2.tick() == ["preempt"]
+        assert sup2.requested == [1]
+
+    def test_heal_is_forwarded_exactly_once(self):
+        state = {"unhealthy": {3: "ecc"}}
+        mgr, gw, sup, led, rec = self.rig(
+            health=lambda: dict(state["unhealthy"]))
+        rec.tick()
+        assert sup.readmitted == []         # down, nothing healed yet
+        state["unhealthy"] = {}
+        rec.tick()
+        assert sup.readmitted == [{3}]
+        rec.tick()
+        assert sup.readmitted == [{3}]      # forwarded once, not per tick
+
+    def test_calm_drains_then_retires_then_regrows(self):
+        mgr, gw, sup, led, rec = self.rig()
+        sup.dp = 1
+        sup.workers = sup.workers[:1]
+        rec.policy.train_target_dp = 2
+        assert rec.tick() == ["scale_down"]
+        victim = [r for r in mgr.replicas if r.state == "draining"]
+        assert len(victim) == 1
+        # drain finished -> retired next tick, chip freed, and the
+        # SAME tick's policy pass can already regrow onto it
+        applied = rec.tick()
+        assert "retired" in applied
+        assert victim[0] not in mgr.replicas
+        assert mgr.counts()["retired"] == 1
+        assert "regrow" in applied or rec.tick() == ["regrow"]
+        assert sup.requested == [2]
+        reg = rec.metrics.registry
+        assert reg.get_sample_value("tpu_fleet_scale_events_total",
+                                    {"action": "down"}) == 1
+        assert reg.get_sample_value("tpu_fleet_scale_events_total",
+                                    {"action": "regrow"}) == 1
+
+    def test_dead_replicas_are_reaped_and_counted(self):
+        mgr, gw, sup, led, rec = self.rig()
+        victim = mgr.replicas[0]
+        mgr.mark_down(victim)
+        rec.tick()
+        assert victim not in mgr.replicas
+        assert mgr.counts()["dead"] == 1
+        assert any(k == "reap_dead" for _, k, _ in rec.events)
+
+
+# -- the acceptance scenario (real gateway + real supervisor) --------------
+
+def _train_rig(tmp_path, *, dp, tp, batch=8):
+    from k8s_dra_driver_tpu.models.checkpoint import TrainCheckpointer
+    from k8s_dra_driver_tpu.parallel.supervisor import (ElasticTrainJob,
+                                                        GangSupervisor)
+    motif = np.random.default_rng(0).integers(0, 64, 32)
+    job = ElasticTrainJob(CFG, np.tile(motif, 64), batch=batch,
+                          seq_len=16, tp=tp)
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    sup = GangSupervisor(job, ckpt, coordination_dir=tmp_path / "coord",
+                         dp=dp, checkpoint_every=2,
+                         step_deadline_s=120.0,
+                         first_step_deadline_s=600.0)
+    return sup, ckpt
+
+
+def _pump(gw, sup, rec, clock, *, dt=1.0, sup_live=True):
+    gw.step()
+    alive = sup.step_once() if sup_live else False
+    rec.tick()
+    clock.advance(dt)
+    return alive
+
+
+def test_acceptance_burst_preempts_then_calm_regrows(tmp_path):
+    """THE acceptance test: sustained SLO-violating burst → preempt
+    (checkpoint, shrink dp=4→2) → replica added on the freed chips →
+    SLO attainment recovers; calm → retire → regrow to dp=4, resumed
+    from the latest checkpoint, zero steps lost, every loss step
+    exactly once; all of it visible in fleet metrics."""
+    from k8s_dra_driver_tpu.parallel import supervisor as sv
+
+    clock = Clock()
+    sup, ckpt = _train_rig(tmp_path, dp=4, tp=1)
+    mgr = ReplicaManager(
+        lambda name: ServingEngine(params(), CFG, slots=2),
+        replicas=2, chip_of=lambda name: 4 + int(name[1:]),
+        depth_bound=2)
+    gw = FleetGateway(mgr, queue_capacity=64, clock=clock,
+                      auto_replace=False)
+    ledger = ChipLedger([0, 1, 2, 3, 4, 5])
+    policy = FleetPolicy(PolicyConfig(
+        queue_high=4, up_after=2, down_after=3, regrow_after=3,
+        min_replicas=2, max_replicas=3, min_train_dp=2,
+        arrival_low_rps=0.5))
+    rec = FleetReconciler(gw, sup, ledger=ledger, policy=policy,
+                          clock=clock)
+    assert rec.policy.train_target_dp == 4  # adopted at construction
+
+    sup.begin(10_000)
+    sup_live = True
+
+    # -- sustained SLO-violating burst: 16 requests, SLO 6 fake-
+    # seconds, service ~1 req/s with two replicas → the tail waits
+    # far past its deadline unless capacity grows
+    wave1 = [Request(uid=f"a{i}", prompt=prompt(100 + i, 5), max_new=3)
+             for i in range(16)]
+    for r in wave1:
+        gw.submit(r, slo_s=6.0)
+    for _ in range(60):
+        sup_live = _pump(gw, sup, rec, clock, sup_live=sup_live)
+        if not len(gw.queue) and not any(r.in_flight
+                                         for r in mgr.replicas):
+            break
+    # the burst actually violated the SLO: its tail shed at the
+    # deadline or finished late — explicit outcomes, never silence
+    violated = [g for g in gw.outcomes.values()
+                if g.uid.startswith("a")
+                and (g.status == "shed_expired"
+                     or (g.status == "finished"
+                         and g.finished_s > g.deadline_s))]
+    assert violated, "burst never violated the SLO"
+
+    # the arbitration happened: preempt 4→2 through REFORM with a
+    # checkpoint (zero steps lost), and the scale-up landed ON the
+    # freed chips
+    pre = [r for r in sup.recoveries if r.cause == "preempt"]
+    assert len(pre) == 1
+    assert (pre[0].from_dp, pre[0].to_dp) == (4, 2)
+    assert pre[0].steps_lost == 0
+    ups = [(t, i) for t, k, i in rec.events if k == "scale_up"]
+    pres = [t for t, k, i in rec.events if k == "preempt"]
+    assert len(ups) == 1 and len(pres) == 1
+    assert pres[0] < ups[0][0]              # preempt unblocked the up
+    assert ups[0][1]["chip"] in (2, 3)      # the gang's freed chips
+    new_name = ups[0][1]["replica"]
+    assert any(g.replica == new_name and g.status == "finished"
+               for g in gw.outcomes.values()), \
+        "the added replica never served"
+
+    # -- SLO attainment recovers: a post-scale-up wave under the SAME
+    # SLO all attains (3 replicas, no backlog)
+    wave2 = [Request(uid=f"b{i}", prompt=prompt(200 + i, 5), max_new=3)
+             for i in range(4)]
+    for r in wave2:
+        gw.submit(r, slo_s=6.0)
+    for _ in range(30):
+        sup_live = _pump(gw, sup, rec, clock, sup_live=sup_live)
+        if all(r.uid in gw.outcomes for r in wave2):
+            break
+    for r in wave2:
+        g = gw.outcomes[r.uid]
+        assert g.status == "finished"
+        assert g.finished_s <= g.deadline_s, f"{r.uid} missed post-up"
+
+    # -- calm: arrivals stop, the pool shrinks back, the gang regrows
+    for _ in range(60):
+        sup_live = _pump(gw, sup, rec, clock, sup_live=sup_live)
+        exp = [r for r in sup.recoveries if r.cause == "expand"]
+        if exp and sup.dp == 4 and sup.state == sv.RUNNING \
+                and sup._step > exp[0].restored_step:
+            break
+    exp = [r for r in sup.recoveries if r.cause == "expand"]
+    assert len(exp) == 1
+    assert (exp[0].from_dp, exp[0].to_dp) == (2, 4)
+    assert exp[0].steps_lost == 0           # checkpoint-then-resize
+    assert sv.EXPAND in sup.transitions     # the new transition fired
+    assert sup.dp == 4
+
+    # exactly-once training: every completed step appears once, in
+    # order, across preempt and regrow
+    steps = [s for s, _ in sup.losses]
+    assert steps == list(range(1, len(steps) + 1))
+    assert len(steps) >= 6
+    assert np.isfinite([l for _, l in sup.losses]).all()
+
+    # exactly-once serving: every admitted uid has one terminal record
+    assert len(gw.outcomes) == len(wave1) + len(wave2)
+
+    # all transitions visible in fleet metrics
+    freg = rec.metrics.registry
+    for action, n in (("up", 1), ("preempt", 1), ("regrow", 1)):
+        assert freg.get_sample_value("tpu_fleet_scale_events_total",
+                                     {"action": action}) == n, action
+    assert freg.get_sample_value("tpu_fleet_scale_events_total",
+                                 {"action": "down"}) >= 1
+    assert freg.get_sample_value("tpu_fleet_chips",
+                                 {"owner": "training"}) == 4
+    sreg = sup.metrics.registry
+    assert sreg.get_sample_value("tpu_train_restarts_total",
+                                 {"cause": "preempt"}) == 1
+    assert sreg.get_sample_value("tpu_train_restarts_total",
+                                 {"cause": "expand"}) == 1
+    assert sreg.get_sample_value("tpu_train_dp_width") == 4
+    ckpt.close()
+
+
+# -- the chaos twin: scripted kill + heal through the same loop ------------
+
+@pytest.mark.faults
+def test_chaos_kill_burst_preempt_then_heal_regrow(tmp_path):
+    """ISSUE 5 satellite: a killed replica plus a burst forces
+    preempt; calm plus a scripted HEAL (the new up-signal fault verb)
+    forces regrow — with exactly-once, byte-equal outputs end to end
+    (drain victims rerun identically on their new replica) and the
+    checkpoint-resume invariants on the training side."""
+    clock = Clock()
+    sup, ckpt = _train_rig(tmp_path, dp=2, tp=2)
+    plan = FaultPlan([
+        # chip 4 (replica r0) dies on the ledger's 3rd poll ...
+        FaultRule(verb="health", kind="Chip", name="4", skip=2,
+                  times=1, error="drop"),
+        # ... and heals ~16 polls later, well after the preempt
+        FaultRule(verb="health", kind="Chip", name="4", skip=16,
+                  times=1, error="heal"),
+    ])
+    scripted = ScriptedChipHealth(plan, chips=[4])
+    ledger = ChipLedger([0, 1, 2, 3, 4, 5], health_source=scripted)
+    # ONE health observation: the pump's drain verdicts read the
+    # ledger's view, so gateway and reconciler can never disagree
+    mgr = ReplicaManager(
+        lambda name: ServingEngine(params(), CFG, slots=2),
+        replicas=2, chip_of=lambda name: 4 + int(name[1:]),
+        health_source=ledger.current_unhealthy, depth_bound=2)
+    gw = FleetGateway(mgr, queue_capacity=64, clock=clock,
+                      auto_replace=False)
+    policy = FleetPolicy(PolicyConfig(
+        queue_high=3, up_after=2, down_after=3, regrow_after=3,
+        min_replicas=1, max_replicas=2, min_train_dp=1,
+        arrival_low_rps=0.5))
+    rec = FleetReconciler(gw, sup, ledger=ledger, policy=policy,
+                          clock=clock)
+    sup.begin(10_000)
+    sup_live = True
+
+    # paced arrivals (2/round for 7 rounds): with both replicas alive
+    # the queue stays under the pressure line — it is the ROUND-3 kill
+    # that halves capacity and forces the preempt, not the burst alone
+    reqs = [Request(uid=f"c{i}", prompt=prompt(300 + i, 5 + (i % 2)),
+                    max_new=3 + (i % 2)) for i in range(14)]
+    for rnd in range(80):
+        for r in reqs[2 * rnd:2 * rnd + 2]:
+            gw.submit(r)                    # no SLO: all must finish
+        sup_live = _pump(gw, sup, rec, clock, sup_live=sup_live)
+        exp = [r for r in sup.recoveries if r.cause == "expand"]
+        if exp and sup.dp == 2 and not len(gw.queue) \
+                and not any(r.in_flight for r in mgr.replicas) \
+                and sup._step > exp[0].restored_step:
+            break
+
+    # the kill happened and was handled: drain + requeue observable,
+    # dead replica reaped by the reconciler, not auto-replaced
+    text = gw.metrics.render().decode()
+    assert "tpu_gateway_drains_total 1.0" in text
+    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
+    assert requeued, "fault fired before anything was in flight"
+    assert any(k == "reap_dead" for _, k, _ in rec.events)
+
+    # exactly-once, byte-equal: every request finished once, tokens
+    # equal the single-engine oracle through kill/requeue/preempt
+    assert len(gw.outcomes) == len(reqs)
+    for r in reqs:
+        assert gw.outcomes[r.uid].status == "finished"
+        np.testing.assert_array_equal(
+            gw.results[r.uid].tokens, oracle(r.prompt, r.max_new),
+            err_msg=f"{r.uid} diverged from the oracle")
+
+    # arbitration: preempt 2→1 while chip 4 was down, EXPAND back to
+    # 2 after the scripted heal freed supply again
+    causes = [r.cause for r in sup.recoveries]
+    assert causes == ["preempt", "expand"], causes
+    assert [(r.from_dp, r.to_dp) for r in sup.recoveries] \
+        == [(2, 1), (1, 2)]
+    assert all(r.steps_lost == 0 for r in sup.recoveries)
+    steps = [s for s, _ in sup.losses]
+    assert steps == list(range(1, len(steps) + 1))
+    # the heal was forwarded (the up-signal satellite end to end)
+    assert any(k == "readmit" and i.get("chips") == [4]
+               for _, k, i in rec.events)
+    freg = rec.metrics.registry
+    assert freg.get_sample_value("tpu_fleet_scale_events_total",
+                                 {"action": "preempt"}) == 1
+    assert freg.get_sample_value("tpu_fleet_scale_events_total",
+                                 {"action": "regrow"}) == 1
+    ckpt.close()
+
+
+# -- combined exposition ---------------------------------------------------
+
+def test_serve_metrics_combines_fleet_registries():
+    """fleet/reconciler.py serve_metrics: one /metrics serves the
+    reconciler + gateway + supervisor registries (the httpendpoint
+    extra_metrics satellite, exercised over real HTTP)."""
+    from urllib.request import urlopen
+
+    from k8s_dra_driver_tpu.utils.metrics import RecoveryMetrics
+
+    class _SupStub:
+        dp = 2
+        metrics = RecoveryMetrics()
+
+    gw = FleetGateway(_IdleManager(), queue_capacity=4)
+    rec = FleetReconciler(gw, _SupStub(), ledger=ChipLedger([0, 1]))
+    endpoint = rec.serve_metrics("127.0.0.1:0")
+    try:
+        body = urlopen(f"http://{endpoint.address}/metrics",
+                       timeout=5).read().decode()
+    finally:
+        endpoint.stop()
+    for family in ("tpu_fleet_ticks_total",
+                   "tpu_gateway_queue_depth",
+                   "tpu_train_dp_width"):
+        assert f"# TYPE {family}" in body, family

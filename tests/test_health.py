@@ -231,3 +231,72 @@ def test_gang_podslice_prepare_refused_on_unhealthy_chip(tmp_path):
         assert prepared.devices
     finally:
         driver.shutdown()
+
+
+class TestFleetExposition:
+    """Fleet-state Prometheus exposition (ISSUE 5 satellite): the
+    gateway, supervisor, and reconciler registries render through one
+    text exposition (utils/metrics.py render_all) that the HTTP
+    endpoint serves next to the driver's own metrics — pinned here so
+    the format cannot drift out from under scrapers."""
+
+    def _metrics(self):
+        from k8s_dra_driver_tpu.utils.metrics import (FleetMetrics,
+                                                      GatewayMetrics,
+                                                      RecoveryMetrics)
+        gw, rec, fl = GatewayMetrics(), RecoveryMetrics(), FleetMetrics()
+        gw.queue_depth.set(3)
+        gw.arrival_rate.set(2.5)
+        gw.slo_margin_ewma.set(-0.75)
+        rec.dp_width.set(2)
+        rec.restarts.labels(cause="preempt").inc()
+        fl.ticks.inc()
+        fl.scale_events.labels(action="regrow").inc()
+        fl.chips.labels(owner="free").set(2)
+        return gw, rec, fl
+
+    def test_render_all_is_one_valid_exposition(self):
+        from k8s_dra_driver_tpu.utils.metrics import render_all
+        text = render_all(*self._metrics()).decode()
+        # every family appears exactly once, with HELP + TYPE lines
+        # (concatenation stays valid because the per-subsystem name
+        # prefixes cannot collide)
+        for family, kind in (
+                ("tpu_gateway_queue_depth", "gauge"),
+                ("tpu_gateway_arrival_rate_rps", "gauge"),
+                ("tpu_gateway_slo_margin_ewma_seconds", "gauge"),
+                ("tpu_train_dp_width", "gauge"),
+                ("tpu_train_restarts_total", "counter"),
+                ("tpu_fleet_ticks_total", "counter"),
+                ("tpu_fleet_scale_events_total", "counter"),
+                ("tpu_fleet_chips", "gauge")):
+            assert text.count(f"# TYPE {family} {kind}\n") == 1, family
+            assert f"# HELP {family} " in text, family
+        assert "tpu_gateway_queue_depth 3.0" in text
+        assert "tpu_gateway_slo_margin_ewma_seconds -0.75" in text
+        assert 'tpu_train_restarts_total{cause="preempt"} 1.0' in text
+        assert 'tpu_fleet_scale_events_total{action="regrow"} 1.0' \
+            in text
+        assert 'tpu_fleet_chips{owner="free"} 2.0' in text
+
+    def test_http_endpoint_serves_combined_registries(self):
+        """utils/httpendpoint.py extra_metrics: one /metrics scrape
+        carries driver + fleet families (real HTTP round-trip)."""
+        from urllib.request import urlopen
+
+        from k8s_dra_driver_tpu.utils.httpendpoint import HTTPEndpoint
+        from k8s_dra_driver_tpu.utils.metrics import DriverMetrics
+
+        endpoint = HTTPEndpoint("127.0.0.1:0", DriverMetrics(),
+                                extra_metrics=self._metrics())
+        endpoint.start()
+        try:
+            body = urlopen(f"http://{endpoint.address}/metrics",
+                           timeout=5).read().decode()
+        finally:
+            endpoint.stop()
+        for family in ("tpu_dra_prepared_claims",
+                       "tpu_gateway_arrival_rate_rps",
+                       "tpu_train_supervisor_state",
+                       "tpu_fleet_ticks_total"):
+            assert f"# TYPE {family}" in body, family

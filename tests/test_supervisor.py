@@ -373,3 +373,103 @@ def test_torn_latest_generation_falls_back_to_previous(tmp_path):
     with pytest.raises(FileNotFoundError, match="no restorable"):
         ckpt.restore(p2, o2)
     ckpt.close()
+
+
+# -- state-transition listeners (fleet satellite) --------------------------
+
+def test_transition_listeners_fire_without_polling():
+    """The plugin/health.py-mirroring hook: every _transition calls
+    each listener with (state, info); a raising listener must not
+    starve its siblings or the transition itself."""
+    from k8s_dra_driver_tpu.parallel import supervisor as sv
+    from k8s_dra_driver_tpu.parallel.supervisor import GangSupervisor
+    from k8s_dra_driver_tpu.utils.metrics import RecoveryMetrics
+
+    sup = GangSupervisor.__new__(GangSupervisor)   # wiring-only check
+    sup.state = sv.RUNNING
+    sup.transitions = [sv.RUNNING]
+    sup.metrics = RecoveryMetrics()
+    sup.dp, sup._step, sup._gen = 4, 7, 1
+    seen, seen2 = [], []
+    sup.listeners = [
+        lambda s, info: seen.append((s, info["dp"], info["step"])),
+        lambda s, info: 1 / 0,
+        lambda s, info: seen2.append(s),
+    ]
+    sup._transition(sv.SUSPECT)
+    sup._transition(sv.REFORM)
+    assert seen == [(sv.SUSPECT, 4, 7), (sv.REFORM, 4, 7)]
+    assert seen2 == [sv.SUSPECT, sv.REFORM]
+    assert sup.transitions == [sv.RUNNING, sv.SUSPECT, sv.REFORM]
+    assert sup.state == sv.REFORM
+
+
+# -- external resize API (fleet reconciler surface) ------------------------
+
+def test_request_width_validates_statically(tmp_path):
+    sup, ckpt = _supervisor(tmp_path, dp=4, batch=8)
+    with pytest.raises(ValueError, match="dp must be"):
+        sup.request_width(0)
+    with pytest.raises(ValueError, match="does not divide"):
+        sup.request_width(3)                # batch 8 % 3 != 0
+    sup.request_width(2)
+    sup.request_width(4)                    # latest request wins
+    assert sup._requested_dp == 4
+    ckpt.close()
+
+
+def test_readmit_is_the_heal_twin_of_eviction(tmp_path):
+    sup, ckpt = _supervisor(tmp_path, dp=4)
+    sup._dead_chips = {4, 5}
+    sup._unhealthy = {4: "pcie link down"}
+    sup.readmit([4])
+    assert sup._dead_chips == {5}
+    assert sup._unhealthy == {}
+    ckpt.close()
+
+
+def test_external_resize_preempt_then_expand(tmp_path):
+    """request_width end-to-end on the real mesh: checkpoint-then-
+    shrink at a step boundary loses zero steps; the grow back passes
+    through EXPAND (the transition no failure path emits) and restores
+    onto the wider mesh; every loss step lands exactly once across
+    both resizes; an infeasible width is dropped, not fatal."""
+    from k8s_dra_driver_tpu.parallel import supervisor as sv
+    sup, ckpt = _supervisor(tmp_path, dp=4, checkpoint_every=2)
+    sup.begin(8)
+    while sup._step < 2:
+        sup.step_once()
+    sup.request_width(2)
+    sup.step_once()                         # applies the preempt
+    assert sup.dp == 2
+    assert sup.transitions[-4:] == [
+        sv.RUNNING, sv.REFORM, sv.RESUME, sv.RUNNING]
+    while sup._step < 4:
+        sup.step_once()
+    sup.request_width(4)
+    sup.step_once()                         # applies the expand
+    assert sup.dp == 4
+    assert sv.EXPAND in sup.transitions
+    # transiently infeasible (dp=8 x tp=2 > 8 devices): dropped with
+    # the gang intact, not FAILED
+    sup.request_width(8)
+    sup.step_once()
+    assert sup.dp == 4
+    assert sup.state == sv.RUNNING
+    while sup.step_once():
+        pass
+    report = sup.report()
+    ckpt.close()
+    assert [r.cause for r in report.recoveries] == ["preempt", "expand"]
+    assert [(r.from_dp, r.to_dp) for r in report.recoveries] \
+        == [(4, 2), (2, 4)]
+    assert all(r.steps_lost == 0 for r in report.recoveries)
+    assert [s for s, _ in report.losses] == list(range(1, 9))
+    losses = [l for _, l in report.losses]
+    assert np.isfinite(losses).all()
+    reg = sup.metrics.registry
+    assert reg.get_sample_value("tpu_train_restarts_total",
+                                {"cause": "preempt"}) == 1
+    assert reg.get_sample_value("tpu_train_restarts_total",
+                                {"cause": "expand"}) == 1
+    assert reg.get_sample_value("tpu_train_dp_width") == 4
