@@ -68,6 +68,15 @@ TINY_GATEWAY_KWARGS = dict(replicas=2, slots=2, n_requests=8,
                            max_new=6, max_seq=64, shared_prefix=8,
                            prefix_cache=2)
 
+#: hermetic shape for the disaggregated-serving probe (same contract:
+#: the smoke tests pin exactly what bench streams) — 1 prefill + 1
+#: decode replica vs the same two engines unified, overload at 4x
+TINY_DISAGG_KWARGS = dict(prefill_replicas=1, decode_replicas=1,
+                          slots=2, n_requests=8, n_layers=2,
+                          d_model=128, heads=4, kv_heads=2, d_ff=256,
+                          prompt_len=12, max_new=6, max_seq=64,
+                          shared_prefix=8, prefix_cache=2)
+
 #: hermetic shape for the supervisor recovery probe (same contract:
 #: test_bench_smoke pins exactly what bench streams) — dp=2/tp=2 over
 #: the 8-device virtual mesh, a scripted worker kill per checkpoint
@@ -711,6 +720,16 @@ def _tpu_probes():
         [("tiny_p2", lambda: gateway_probe(**TINY_GATEWAY_KWARGS))])
     yield "gateway", shaped(label, res, errs)
 
+    # disaggregated prefill/decode (serving_disagg/): the same engines
+    # unified vs role-split behind the fleet prefix index, overloaded
+    # at 4x calibrated capacity — p99 TTFT both ways, the win ratio,
+    # and per-migration KV reshard-on-transfer cost
+    from k8s_dra_driver_tpu.serving_disagg import disagg_probe
+    label, res, errs = _retry_probe(
+        [("p1d2_r24", lambda: disagg_probe())] if on_accel else
+        [("tiny_p1d1", lambda: disagg_probe(**TINY_DISAGG_KWARGS))])
+    yield "serving_disagg", shaped(label, res, errs)
+
 
 def tpu_probe_stream() -> None:
     """Child-process entry: stream one JSON line per finished probe.
@@ -892,6 +911,9 @@ _PROBE_SCALARS = (
     ("gateway", "gw_goodput_rps", "goodput_rps"),
     ("gateway", "gw_slo_att", "slo_attainment"),
     ("gateway", "gw_p99_wait_ms", "p99_queue_wait_ms"),
+    ("serving_disagg", "disagg_ttft_ms", "ttft_p99_ms"),
+    ("serving_disagg", "disagg_ttft_win_x", "ttft_win_x"),
+    ("serving_disagg", "disagg_kv_migrate_ms", "kv_migrate_ms"),
     ("supervisor_recovery", "sup_mttr_ms", "mttr_ms"),
     ("supervisor_recovery", "sup_steps_lost", "steps_lost_worst"),
     ("fleet", "fleet_scaleup_ms", "scaleup_ms"),

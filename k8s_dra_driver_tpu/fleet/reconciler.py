@@ -166,23 +166,32 @@ class FleetReconciler:
             chip = self.ledger.take_for_serving()
             if chip is None:            # raced away since decide()
                 return []
+            # role-aware growth: add_replica defaults to the
+            # manager's default_scale_role — decode in a
+            # disaggregated pool (capacity lives there), unified
+            # otherwise
             fresh = mgr.add_replica(chip=chip)
             self.metrics.scale_events.labels(action="up").inc()
-            self._event(now, SCALE_UP, replica=fresh.name, chip=chip)
-            log.info("fleet: scale-up %s onto chip %d",
-                     fresh.name, chip)
+            self._event(now, SCALE_UP, replica=fresh.name, chip=chip,
+                        role=fresh.role)
+            log.info("fleet: scale-up %s (%s) onto chip %d",
+                     fresh.name, fresh.role, chip)
             return [SCALE_UP]
         if action.kind == SCALE_DOWN:
             idle = [r for r in mgr.replicas
                     if r.ready and not r.in_flight]
-            if not idle:
-                return []
-            victim = idle[-1]           # newest idle: old caches stay
-            mgr.begin_drain(victim)
-            self._event(now, SCALE_DOWN, replica=victim.name,
-                        chip=victim.chip)
-            log.info("fleet: draining %s for scale-down", victim.name)
-            return [SCALE_DOWN]
+            # newest idle first (old caches stay); begin_drain may
+            # refuse a victim on role grounds (the last prefill
+            # replica), so walk the candidates until one accepts
+            for victim in reversed(idle):
+                if not mgr.begin_drain(victim):
+                    continue
+                self._event(now, SCALE_DOWN, replica=victim.name,
+                            chip=victim.chip, role=victim.role)
+                log.info("fleet: draining %s for scale-down",
+                         victim.name)
+                return [SCALE_DOWN]
+            return []
         if action.kind in (PREEMPT, REGROW):
             if self.supervisor is None:
                 return []

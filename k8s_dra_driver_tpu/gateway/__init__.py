@@ -9,6 +9,7 @@ from .admission import (AdmissionError, AdmissionQueue, GatewayRequest,
 from .frontend import FleetGateway
 from .probe import gateway_probe
 from .replica import (DraChipLease, EngineReplica, ReplicaManager,
+                      ROLE_DECODE, ROLE_PREFILL, ROLE_UNIFIED,
                       resolve_container_path)
 from .router import (LeastLoadedRouter, PrefixAffinityRouter,
                      RoundRobinRouter, Router)
@@ -17,7 +18,8 @@ __all__ = [
     "AdmissionError", "AdmissionQueue", "DraChipLease", "EngineReplica",
     "FINISHED", "FleetGateway", "GatewayRequest", "LeastLoadedRouter",
     "PrefixAffinityRouter", "REJECTED_DUPLICATE", "REJECTED_FULL",
-    "REJECTED_INVALID", "ReplicaManager", "RoundRobinRouter", "Router",
+    "REJECTED_INVALID", "ROLE_DECODE", "ROLE_PREFILL", "ROLE_UNIFIED",
+    "ReplicaManager", "RoundRobinRouter", "Router",
     "SHED_EXPIRED",
     "gateway_probe", "resolve_container_path",
 ]

@@ -80,6 +80,10 @@ class FleetGateway:
         #: per-replica dispatch attribution (utils/dispatch.py)
         self.per_replica = dispatch.Aggregator()
         self._steps = 0
+        # last-seen per-replica prefix counters, for the delta fold
+        # into the fleet-wide prefix metrics (replica names are never
+        # reused, so pruning to live names cannot alias)
+        self._prefix_seen: dict[str, tuple] = {}
         #: demand signals for the fleet reconciler: arrival-rate EWMA
         #: (updated once per pump step from the arrivals since the
         #: last one) and the signed SLO-margin EWMA over finished
@@ -187,11 +191,16 @@ class FleetGateway:
                 finished = replica.step()
             self.per_replica.add(replica.name, t)
             self._account(replica, finished, done)
-        # 5. leases + gauges
+        # 5. leases + gauges + engine-level observability (prefix
+        #    effectiveness, KV migration) folded into the registry
         self.manager.heartbeat()
         self.metrics.queue_depth.set(len(self.queue))
-        for state, n in self.manager.counts().items():
+        counts = self.manager.counts()
+        for role, n in counts.pop("roles", {}).items():
+            self.metrics.replica_roles.labels(role=role).set(n)
+        for state, n in counts.items():
             self.metrics.replicas.labels(state=state).set(n)
+        self._scrape_engine_stats()
         self._steps += 1
         return done
 
@@ -259,6 +268,41 @@ class FleetGateway:
         self.metrics.requests.labels(outcome=outcome).inc()
         self.outcomes[g.uid] = g
         done.append(g)
+
+    def _scrape_engine_stats(self) -> None:
+        """Fold per-engine prefix-cache counters (hits/misses/bytes
+        reused) and the pool's KV-migration events into the gateway
+        registry as deltas — engine counters are lifetime totals, the
+        registry wants monotone fleet-wide counters, and replicas come
+        and go.  Runs at the end of every pump step, AFTER the
+        replicas stepped, so a retiring replica's last deltas are
+        never lost."""
+        live: dict[str, tuple] = {}
+        for r in self.manager.replicas:
+            stats = getattr(r.engine, "stats", None)
+            if stats is None:
+                continue
+            st = stats()
+            if "prefix_hits_total" not in st:
+                continue
+            cur = (st["prefix_hits_total"],
+                   st["prefix_misses_total"],
+                   st["prefix_bytes_reused_total"])
+            prev = self._prefix_seen.get(r.name, (0, 0, 0))
+            if cur[0] > prev[0]:
+                self.metrics.prefix_hits.inc(cur[0] - prev[0])
+            if cur[1] > prev[1]:
+                self.metrics.prefix_misses.inc(cur[1] - prev[1])
+            if cur[2] > prev[2]:
+                self.metrics.prefix_bytes_reused.inc(cur[2] - prev[2])
+            live[r.name] = cur
+        self._prefix_seen = live
+        drain = getattr(self.manager, "drain_migration_events", None)
+        if drain is not None:
+            for wall_s, nbytes in drain():
+                self.metrics.kv_migrations.inc()
+                self.metrics.kv_bytes_moved.inc(nbytes)
+                self.metrics.kv_migrate_seconds.observe(wall_s)
 
     def _drain(self, replica: EngineReplica) -> None:
         """Health-driven drain: the replica stops receiving dispatch

@@ -40,6 +40,15 @@ DRAINING = "draining"
 DEAD = "dead"
 RETIRED = "retired"     # counts() key only: gracefully scaled down
 
+# Replica roles (serving_disagg/): a unified replica prefills AND
+# decodes (every pool before the disaggregated one); a prefill replica
+# only computes prompt K/V and exports blocks; a decode replica adopts
+# blocks and generates (it can still prefill locally — the fallback
+# when prefill capacity is gone).
+ROLE_UNIFIED = "unified"
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+
 
 def resolve_container_path(path: str, mounts: list[dict] | None
                            ) -> str:
@@ -107,9 +116,14 @@ class EngineReplica:
     def __init__(self, name: str, engine, *,
                  chip: int | None = None,
                  lease: DraChipLease | None = None,
-                 depth_bound: int | None = None):
+                 depth_bound: int | None = None,
+                 role: str = ROLE_UNIFIED):
         self.name = name
         self.engine = engine
+        # routing/arbitration dimension, not a state: roles never
+        # change over a replica's lifetime (a replacement spawns with
+        # the dead replica's role)
+        self.role = role
         self.chip = chip if chip is not None else (
             lease.chips[0] if lease and lease.chips else None)
         self.lease = lease
@@ -172,6 +186,11 @@ class ReplicaManager:
         self.depth_bound = depth_bound
         self._chip_of = chip_of or (lambda name: None)
         self._gen = itertools.count()
+        # the role an external scale-up decision gets when it does not
+        # say (fleet/reconciler.py add_replica): unified pools grow
+        # unified; the disaggregated manager overrides this to decode,
+        # the capacity-bearing role
+        self.default_scale_role = ROLE_UNIFIED
         # last successful health observation; reused when a probe
         # fails so a flaky transport neither mass-drains the pool
         # nor masks chips already known bad
@@ -186,7 +205,7 @@ class ReplicaManager:
         self.replicas: list[EngineReplica] = [
             self._spawn() for _ in range(replicas)]
 
-    def _spawn(self) -> EngineReplica:
+    def _spawn(self, role: str = ROLE_UNIFIED) -> EngineReplica:
         name = f"r{next(self._gen)}"
         lease = self.lease_factory(name) if self.lease_factory else None
         if lease is not None:
@@ -194,7 +213,7 @@ class ReplicaManager:
         return EngineReplica(
             name, self.engine_factory(name),
             chip=self._chip_of(name), lease=lease,
-            depth_bound=self.depth_bound)
+            depth_bound=self.depth_bound, role=role)
 
     @property
     def ready_replicas(self) -> list[EngineReplica]:
@@ -202,10 +221,19 @@ class ReplicaManager:
 
     def counts(self) -> dict:
         out = {READY: 0, DRAINING: 0, DEAD: 0}
+        roles: dict[str, int] = {}
         for r in self.replicas:
             out[r.state] += 1
+            if r.state != DEAD:
+                roles[r.role] = roles.get(r.role, 0) + 1
         out[DEAD] += self._dead_removed
         out[RETIRED] = self._retired
+        # LIVE-replica role breakdown rides along so the gateway's
+        # role gauge and the reconciler's arbitration see the same
+        # view (a nested dict: the state keys stay flat for the
+        # replicas-by-state gauge; dead replicas serve nothing and
+        # must not pad a role's apparent capacity)
+        out["roles"] = roles
         return out
 
     # -- health verdicts -------------------------------------------------
@@ -257,31 +285,47 @@ class ReplicaManager:
         if replica in self.replicas:
             self.replicas.remove(replica)
             self._dead_removed += 1
-        fresh = self._spawn()
+        fresh = self._spawn(replica.role)
         self.replicas.append(fresh)
         return fresh
 
     # -- external-controller verbs (fleet/reconciler.py) ------------------
 
-    def add_replica(self, chip: int | None = None) -> EngineReplica:
+    def add_replica(self, chip: int | None = None,
+                    role: str | None = None) -> EngineReplica:
         """Scale-up: one fresh replica joins the pool.  ``chip`` pins
         the ledger chip an external arbiter allocated it (overriding
         ``chip_of``) so the health mapping and the supply bookkeeping
-        agree on who sits where."""
-        fresh = self._spawn()
+        agree on who sits where; ``role`` defaults to
+        ``default_scale_role`` (decode in a disaggregated pool —
+        capacity lives there)."""
+        fresh = self._spawn(role or self.default_scale_role)
         if chip is not None:
             fresh.chip = chip
         self.replicas.append(fresh)
         return fresh
 
-    def begin_drain(self, replica: EngineReplica) -> None:
+    def begin_drain(self, replica: EngineReplica) -> bool:
         """Graceful scale-down, the planned twin of ``mark_down``: the
         replica stops receiving dispatch (routers skip non-ready) but
         its engine is HEALTHY, so in-flight work runs to completion on
         it instead of being cancelled and requeued.  ``retire`` it
-        once ``in_flight`` empties."""
-        if replica.state == READY:
-            replica.state = DRAINING
+        once ``in_flight`` empties.
+
+        Returns whether the drain started.  Role guard: the LAST ready
+        prefill replica is never drained by a decision — without it
+        every fill falls back to the decode side, which is exactly the
+        interference disaggregation exists to remove (a FAILURE may
+        still take it: ``mark_down`` is unconditional, and the router
+        falls back to decode-local prefill)."""
+        if replica.state != READY:
+            return False
+        if replica.role == ROLE_PREFILL and not any(
+                r is not replica and r.role == ROLE_PREFILL
+                and r.ready for r in self.replicas):
+            return False
+        replica.state = DRAINING
+        return True
 
     def retire(self, replica: EngineReplica) -> None:
         """Remove a replica from the pool: a finished graceful drain,
@@ -306,5 +350,6 @@ class ReplicaManager:
                 r.lease.heartbeat()
 
 
-__all__ = ["DEAD", "DRAINING", "READY", "RETIRED", "DraChipLease",
+__all__ = ["DEAD", "DRAINING", "READY", "RETIRED", "ROLE_DECODE",
+           "ROLE_PREFILL", "ROLE_UNIFIED", "DraChipLease",
            "EngineReplica", "ReplicaManager", "resolve_container_path"]

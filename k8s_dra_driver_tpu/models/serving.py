@@ -63,6 +63,19 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   (``PrefixCache``).  Finish-time capture is what makes multi-turn
   chat cheap: a follow-up prompt (prompt + generated + new text)
   adopts the whole previous conversation's K/V.
+- **KV export/adopt** (``prefill_export``/``adopt_block``): the
+  prefill half of a disaggregated pool (serving_disagg/) fills a
+  prompt on a standalone [1, S] cache and exports it as a
+  :class:`KVBlock` — prompt K/V, the first generated token (its
+  logits ARE the fill's output), and the carried sampling key — and
+  a decode engine adopts the block into a free slot via the same
+  ``adopt_one_slot`` scatter the local fills use, continuing exactly
+  where a local fill would have: byte-equal by construction, with
+  zero prefill recompute on the decode side (DistServe/Splitwise
+  role splitting, the TTFT/TPOT interference fix).  Reuse-path
+  suffix launches carry their own ``prefill_suffix`` dispatch label
+  so "no full-prefill recompute on an index hit" is a CI-pinnable
+  launch count.
 
 No reference analog (SURVEY.md §2.3 — the reference has no serving
 stack at all); beyond-parity workload tier alongside speculative
@@ -109,6 +122,29 @@ class Finished:
     # prompt length, so consumers (stream()) can split generated
     # tokens out of ``tokens`` without re-holding the Request
     n_prompt: int = 0
+
+
+@dataclasses.dataclass
+class KVBlock:
+    """One prefilled prompt's exportable K/V state — the unit of
+    prefill→decode handoff in the disaggregated pool (serving_disagg/).
+
+    ``kv`` is the [1, S] cache holding the prompt's K/V (``pos`` =
+    prompt length), ``first`` the first generated token (prefill
+    produces it: its logits are the fill's output), ``carry_key`` the
+    carried per-request PRNG key for temperature>0 requests (the exact
+    ``_fill_dispatch`` schedule: split before the first token, carry
+    the other half), so a decode engine that adopts the block
+    continues EXACTLY where a local fill would have left off —
+    byte-equal by construction.  ``reused_tokens`` counts prompt
+    tokens adopted from the exporter's prefix cache instead of
+    computed (the fleet-index zero-recompute evidence)."""
+
+    request: Request
+    kv: KVCache
+    first: int
+    carry_key: Any = None           # [2] PRNG key, device-resident
+    reused_tokens: int = 0
 
 
 @dispatch.counted("sample_one")
@@ -171,7 +207,27 @@ class PrefixCache:
         # dict insertion order IS the LRU order (oldest first)
         self._store: dict[tuple, KVCache] = {}
         self.hits = 0
+        self.misses = 0
         self.tokens_reused = 0
+        # bytes of K/V adopted instead of recomputed: tokens_reused x
+        # the per-token row cost, measured once from a real entry so
+        # int8 caches report int8 bytes (utils/metrics.py surfaces
+        # this fleet-wide as tpu_gateway_prefix_bytes_reused_total)
+        self.bytes_reused = 0
+        self.bytes_per_token = 0
+        #: ``listener(event, key)`` with event in {"insert", "evict",
+        #: "drop"} — how the fleet prefix index (serving_disagg/
+        #: index.py) mirrors which prefixes this engine holds.  A
+        #: raising listener is isolated: observability must never
+        #: break a fill.
+        self.listeners: list = []
+
+    def _notify(self, event: str, key: tuple) -> None:
+        for cb in self.listeners:
+            try:
+                cb(event, key)
+            except Exception:
+                pass
 
     def _touch(self, key: tuple) -> None:
         self._store[key] = self._store.pop(key)
@@ -207,27 +263,51 @@ class PrefixCache:
         (pos=p) and overwritten by the suffix fill."""
         best_p, best_key = self._best_match(prompt)
         if best_key is None:
+            self.misses += 1
             return 0, None
         self.hits += 1
         self.tokens_reused += best_p
+        self.bytes_reused += best_p * self.bytes_per_token
         self._touch(best_key)
         return best_p, self._store[best_key]
+
+    def entry(self, tokens: np.ndarray) -> KVCache | None:
+        """The remembered entry for EXACTLY ``tokens`` (or None) —
+        the fleet-index fetch path (serving_disagg/).  Refreshes the
+        LRU position (a remote fetch is a use) but does NOT count a
+        hit: reuse is accounted where the tokens are adopted, not
+        where they are stored."""
+        key = tuple(np.asarray(tokens).tolist())
+        if key not in self._store:
+            return None
+        self._touch(key)
+        return self._store[key]
 
     def insert(self, tokens: np.ndarray, filled: KVCache) -> None:
         """Remember a [1, S] cache whose first ``len(tokens)`` rows
         are the K/V of ``tokens`` (``pos == len(tokens)``).  Two kinds
         of entries arrive here: fill-time full-prompt caches and
         finish-time conversation captures (prompt + generated)."""
+        if not self.bytes_per_token:
+            arrs = (filled.k + filled.v + (filled.k_scale or [])
+                    + (filled.v_scale or []))
+            self.bytes_per_token = (sum(a.nbytes for a in arrs)
+                                    // filled.k[0].shape[1])
         key = tuple(tokens.tolist())
         self._store.pop(key, None)            # re-insert = most recent
         self._store[key] = filled
+        self._notify("insert", key)
         while len(self._store) > self.entries:
-            self._store.pop(next(iter(self._store)))
+            evicted = next(iter(self._store))
+            self._store.pop(evicted)
+            self._notify("evict", evicted)
 
     def drop(self, tokens: np.ndarray) -> None:
         """Forget an entry (no-op if absent) — used when a finish
         capture strictly dominates its fill-time prompt entry."""
-        self._store.pop(tuple(tokens.tolist()), None)
+        key = tuple(tokens.tolist())
+        if self._store.pop(key, None) is not None:
+            self._notify("drop", key)
 
 
 @dispatch.counted("extract_slot")
@@ -250,6 +330,17 @@ def _extract_slot(cache: KVCache, slot, pos) -> KVCache:
                  if cache.k_scale is not None else None),
         v_scale=(take(cache.v_scale)
                  if cache.v_scale is not None else None))
+
+
+#: the reuse-path suffix continuation of a prefix-adopted fill under
+#: its OWN dispatch label: "prefill" counts fresh prompt compute,
+#: "prefill_suffix" counts suffix-only compute after zero-copy prefix
+#: adoption — the split that lets CI pin "no full-prefill recompute on
+#: an index hit" as a launch count (tests/test_disagg.py).  Wraps the
+#: UNDERLYING jit (not the counted wrapper) so one launch is never
+#: tallied under both labels.
+_prefill_suffix_jit = dispatch.counted("prefill_suffix")(
+    _decode._prefill_jit._fn)
 
 
 @dispatch.counted("adopt_slot")
@@ -356,10 +447,18 @@ class ServingEngine:
         self._cancelled = 0
         self._tokens_total = 0
         self._steps_total = 0
+        # disaggregated-pool counters: blocks exported (prefill role)
+        # and adopted (decode role) — serving_disagg/pool.py
+        self._exports = 0
+        self._adoptions = 0
 
     # -- request intake --------------------------------------------------
 
-    def submit(self, req: Request) -> None:
+    def _check_request(self, req: Request) -> Request:
+        """Shape/capacity validation shared by :meth:`submit` and the
+        disaggregated entry points (``prefill_export``/
+        ``adopt_block``); returns the request with its prompt
+        normalized to int32."""
         prompt = np.asarray(req.prompt, np.int32)
         if prompt.ndim != 1 or prompt.size == 0:
             raise ValueError("prompt must be a non-empty 1-D array")
@@ -380,12 +479,16 @@ class ServingEngine:
                 + (f" + scratch margin ({margin})" if margin
                    else "")
                 + f" exceeds the {self.max_seq}-slot cache")
+        return dataclasses.replace(req, prompt=prompt)
+
+    def submit(self, req: Request) -> None:
+        req = self._check_request(req)
         if any(r.uid == req.uid for r in self.queue) or any(
                 r is not None and r.uid == req.uid for r in self._req):
             # uid is the cancel/finished-stream handle; a duplicate
             # would make cancel() ambiguous
             raise ValueError(f"uid {req.uid!r} already in flight")
-        self.queue.append(dataclasses.replace(req, prompt=prompt))
+        self.queue.append(req)
 
     @property
     def active(self) -> int:
@@ -433,6 +536,123 @@ class ServingEngine:
             return 0
         return self._prefix.peek(np.asarray(prompt, np.int32))
 
+    # -- disaggregated prefill/decode (serving_disagg/) ------------------
+    #
+    # The role-splitting surface: a PREFILL engine computes prompt K/V
+    # and exports it as a KVBlock; a DECODE engine adopts the block
+    # into a free slot and generates.  Both verbs reuse the exact
+    # machinery the unified fills use (_prefill_jit chunks,
+    # adopt_one_slot scatter, the _fill_dispatch key schedule), so a
+    # request split across two engines is byte-equal to one engine
+    # running it end to end (pinned in tests/test_disagg.py).
+
+    def prefill_export(self, req: Request) -> KVBlock:
+        """Prefill ``req`` on a standalone [1, S] cache and return the
+        exportable :class:`KVBlock` WITHOUT occupying a decode slot.
+
+        Prefix-cache hits adopt remembered rows zero-copy and compute
+        only the suffix — those launches carry the ``prefill_suffix``
+        dispatch label, so an index-hit fill is CI-pinnable as "no
+        fresh-prefill launch".  The first token is drawn with the
+        exact ``_fill_dispatch`` key schedule and resolved here (one
+        readback per export: the first token IS the TTFT-critical
+        output of the prefill role)."""
+        req = self._check_request(req)
+        t0 = time.perf_counter()
+        start = 0
+        if self._prefix is not None:
+            p, hit = self._prefix.longest_prefix(req.prompt)
+            if p > 0:
+                start = p
+                one = KVCache(k=hit.k, v=hit.v, pos=jnp.int32(p),
+                              k_scale=hit.k_scale,
+                              v_scale=hit.v_scale)
+        if start == 0:
+            one = init_cache(self.cfg, 1, self.max_seq)
+        # whole-prompt or chunked, same programs either way; a hit's
+        # suffix rides the masked path under the prefill_suffix label
+        fill = (_prefill_suffix_jit if start > 0
+                else _decode._prefill_jit)
+        c = self.prefill_chunk or req.prompt.size
+        for off in range(start, req.prompt.size, c):
+            logits, one = fill(self.params,
+                               req.prompt[None, off:off + c],
+                               self.cfg, one, off == 0)
+        if self._prefix is not None:
+            self._prefix.insert(req.prompt, one)
+        carry = None
+        if req.temperature > 0:
+            key, sub = jax.random.split(jax.random.PRNGKey(req.seed))
+            first = _sample_one(logits[0, -1], sub,
+                                jnp.float32(req.temperature),
+                                self.top_k, self.top_p)
+            carry = key
+        else:
+            first = jnp.argmax(logits[0, -1])
+        first = int(first)
+        dispatch.record_readback("prefill_export")
+        self._exports += 1
+        self._time_prefill += time.perf_counter() - t0
+        return KVBlock(request=req, kv=one, first=first,
+                       carry_key=carry, reused_tokens=start)
+
+    def adopt_block(self, block: KVBlock) -> int:
+        """Adopt an exported prefill block into a free slot; returns
+        the slot index.  Raises RuntimeError when no slot is free
+        (callers gate on ``occupancy``) and ValueError on a duplicate
+        uid or a request this engine cannot hold — the decode twin of
+        :meth:`prefill_export`; the slot continues from the block's
+        first token exactly as if this engine had filled it."""
+        if self.draft_params is not None:
+            # the block carries target K/V only; a speculative engine
+            # would propose from an empty draft cache
+            raise ValueError("draft engines cannot adopt KV blocks")
+        req = self._check_request(block.request)
+        if any(r.uid == req.uid for r in self.queue) or any(
+                r is not None and r.uid == req.uid for r in self._req):
+            raise ValueError(f"uid {req.uid!r} already in flight")
+        slot = next((s for s in range(self.slots)
+                     if self._req[s] is None), None)
+        if slot is None:
+            raise RuntimeError("no free decode slot to adopt into")
+        t0 = time.perf_counter()
+        self.cache = _adopt_slot(self.cache, block.kv,
+                                 jnp.int32(slot))
+        if self._prefix is not None:
+            # the migrated prompt K/V is now a local asset: later
+            # same-prefix traffic hits HERE without another transfer
+            self._prefix.insert(req.prompt, block.kv)
+        self._req[slot] = req
+        self._pos[slot] = req.prompt.size
+        self._temps[slot] = req.temperature
+        if req.temperature > 0:
+            if block.carry_key is None:
+                raise ValueError("sampled block without a carried key")
+            self._keys = self._keys.at[slot].set(
+                jnp.asarray(block.carry_key))
+        self._fill_finalize(slot, block.first)
+        self._adoptions += 1
+        self._time_prefill += time.perf_counter() - t0
+        return slot
+
+    def export_prefix(self, tokens) -> KVCache | None:
+        """The fleet-index fetch: the remembered [1, S] entry for
+        EXACTLY ``tokens``, or None when this engine no longer holds
+        it (LRU eviction races the index's view — callers fall back
+        to computing).  No hit accounting: reuse is counted where the
+        tokens are adopted."""
+        if self._prefix is None:
+            return None
+        return self._prefix.entry(np.asarray(tokens, np.int32))
+
+    def import_prefix(self, tokens, entry: KVCache) -> None:
+        """Adopt a migrated prefix entry into the local PrefixCache so
+        the next fill of a ``tokens``-prefixed prompt hits locally —
+        the receiving half of a fleet-index fetch."""
+        if self._prefix is None:
+            raise ValueError("prefix cache is off on this engine")
+        self._prefix.insert(np.asarray(tokens, np.int32), entry)
+
     def cancel(self, uid) -> bool:
         """Drop a request by uid — queued (removed before it ever
         runs) or active (its slot frees immediately; the next step
@@ -473,7 +693,12 @@ class ServingEngine:
         out["time_host_s"] = round(self._time_host, 4)
         if self._prefix is not None:
             out["prefix_hits_total"] = self._prefix.hits
+            out["prefix_misses_total"] = self._prefix.misses
             out["prefix_tokens_reused_total"] = self._prefix.tokens_reused
+            out["prefix_bytes_reused_total"] = self._prefix.bytes_reused
+        if self._exports or self._adoptions:
+            out["kv_exports_total"] = self._exports
+            out["kv_adoptions_total"] = self._adoptions
         if self.draft_params is not None:
             out["speculative_windows_total"] = self._spec_windows
             out["speculative_accepted_total"] = self._spec_accepted
@@ -1048,4 +1273,5 @@ class ServingEngine:
         raise RuntimeError(f"not drained after {max_steps} steps")
 
 
-__all__ = ["Request", "Finished", "ServingEngine"]
+__all__ = ["Finished", "KVBlock", "PrefixCache", "Request",
+           "ServingEngine"]

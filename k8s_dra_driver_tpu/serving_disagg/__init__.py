@@ -1,0 +1,33 @@
+"""Disaggregated prefill/decode serving with a fleet-wide KV/prefix
+index (docs/SERVING.md "Disaggregated prefill/decode").
+
+DistServe (OSDI'24) / Splitwise-style phase splitting behind the
+EXISTING fleet gateway: dedicated prefill replicas turn arrivals into
+exported KV blocks (models/serving.py ``prefill_export``), decode
+replicas adopt them by reshard-on-transfer (migrate.py, the
+SNIPPETS.md shard/gather-fn pattern) and generate, and the fleet
+prefix index (index.py) makes any replica's cached prefix feed any
+fill — prefix reuse stops being a per-engine, per-route accident and
+becomes a pool asset.  Byte-equal to the unified pool by construction;
+the probe records the TTFT win the split buys under overload.
+"""
+
+from .index import FleetPrefixIndex
+from .migrate import KVMigrator, make_kv_shard_and_gather_fns
+from .pool import DisaggReplicaManager, PrefillReplica
+from .router import DisaggRouter
+
+__all__ = [
+    "DisaggReplicaManager", "DisaggRouter", "FleetPrefixIndex",
+    "KVMigrator", "PrefillReplica", "disagg_probe",
+    "make_kv_shard_and_gather_fns",
+]
+
+
+def __getattr__(name):
+    # the probe pulls in the models layer — loaded on demand so
+    # importing the pool types stays light (the fleet/ lazy pattern)
+    if name == "disagg_probe":
+        from .probe import disagg_probe
+        return disagg_probe
+    raise AttributeError(name)

@@ -1,0 +1,86 @@
+"""Fleet-level prefix index: which replica holds which prompt prefix.
+
+Before this, prefix reuse stopped at one replica: the gateway's
+affinity router could steer same-prefix traffic AT a warm replica, but
+a prefix cached on replica A was recomputed from scratch the moment
+load spilled a request to replica B.  The index makes cached K/V a
+fleet asset — it mirrors every pool engine's ``PrefixCache`` contents
+(via the cache's listener hook, so the mirror can never drift from
+the store it mirrors) and answers the one question the disaggregated
+pool asks: *who holds the longest prefix of this prompt, and under
+which exact key can it be fetched?*  A hit on another replica turns
+into a KV migration (migrate.py) + a local ``import_prefix``, after
+which the fill pays only the suffix — the vLLM automatic-prefix-cache
+idea lifted from one engine to the pool, with DistServe's observation
+that prefill work is exactly the part worth deduplicating fleet-wide.
+
+The index stores KEYS ONLY (token tuples), never K/V: entries stay
+resident on the replica that computed them until someone fetches, so
+index memory is prompts, not caches, and an eviction on the owner
+(mirrored here via the listener) simply makes the next lookup miss —
+callers treat a failed fetch as a miss and compute (exactly-once is
+never at stake; the index is pure optimization).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class FleetPrefixIndex:
+    """prefix keys → holding replica, across the pool.
+
+    ``attach(name, cache)`` wires one engine's PrefixCache: current
+    contents are seeded and the cache's listeners keep the mirror
+    synchronized (insert adds, evict/drop removes).  ``drop_replica``
+    forgets everything a drained/retired replica held — its cache
+    died with it.
+    """
+
+    def __init__(self):
+        self._held: dict[str, set[tuple]] = {}
+
+    def attach(self, name: str, cache) -> None:
+        self._held[name] = set(cache._store.keys())
+        cache.listeners.append(
+            lambda event, key, name=name: self._on(name, event, key))
+
+    def _on(self, name: str, event: str, key: tuple) -> None:
+        held = self._held.get(name)
+        if held is None:        # replica already dropped; stale cb
+            return
+        if event == "insert":
+            held.add(key)
+        else:                   # evict / drop
+            held.discard(key)
+
+    def drop_replica(self, name: str) -> None:
+        self._held.pop(name, None)
+
+    def lookup(self, prompt) -> tuple[int, str | None, tuple | None]:
+        """(p, replica, key): the longest common prefix of ``prompt``
+        over every held key, capped at ``len(prompt) - 1`` (the last
+        token is always re-prefilled — its logits seed generation,
+        the engines' own cap).  Ties break by replica name then key
+        order, so placement is deterministic.  (0, None, None) on a
+        fleet-wide miss."""
+        toks = np.asarray(prompt).tolist()
+        cap = len(toks) - 1
+        best_p, best_name, best_key = 0, None, None
+        for name in sorted(self._held):
+            for key in self._held[name]:
+                p = 0
+                for a, b in zip(key, toks[:cap]):
+                    if a != b:
+                        break
+                    p += 1
+                if p > best_p:
+                    best_p, best_name, best_key = p, name, key
+        return best_p, best_name, best_key
+
+    def holders(self) -> dict[str, int]:
+        """Entries per replica (observability/tests)."""
+        return {name: len(keys) for name, keys in self._held.items()}
+
+
+__all__ = ["FleetPrefixIndex"]

@@ -1,0 +1,122 @@
+"""KV migration: reshard-on-transfer between replica meshes.
+
+The physical half of disaggregated serving — a prompt's K/V computed
+on a prefill replica must land on the decode replica's devices in the
+decode replica's layout WITHOUT recomputing a single token.  The
+mechanism is the shard/gather-fn pattern (SNIPPETS.md
+``make_shard_and_gather_fns``: a pytree of per-leaf functions built
+from partition specs, shard = place onto the destination sharding,
+gather = pull to host), applied to the [1, S] ``KVCache`` pytree the
+engines already exchange for prefix adoption: every leaf is
+``device_put`` onto the destination sharding (same-device
+destinations still copy into fresh buffers — the engine-cache
+aliasing rules around donation require it, exactly like
+``_extract_slot``), and ``pos`` rides along untouched.
+
+Costs are recorded per event — wall seconds and FULL-BUFFER bytes
+(static shapes move the whole [1, max_seq] allocation, not just the
+``pos`` valid rows; that is the honest transfer size and the reason
+blocks, not tokens, are the migration unit).  The gateway folds the
+events into ``tpu_gateway_kv_migrations_total`` /
+``_kv_bytes_moved_total`` / ``_kv_migrate_seconds``
+(gateway/frontend.py), and the bench probe reports the per-migration
+mean as ``kv_migrate_ms``.
+
+Sync discipline: the migrated leaves are blocked on before the event
+is recorded — on the tunneled TPU backend ``device_put`` returns
+early, and an unblocked timing would record the enqueue, not the
+transfer (the ops/collectives.py scalar-readback lesson applied to
+transfers).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+
+from ..models.decode import KVCache
+
+
+def make_kv_shard_and_gather_fns(dest=None):
+    """(shard_fn, gather_fn) for KVCache leaves, the SNIPPETS.md
+    pattern at our scale: ``dest`` is a ``jax.Device`` or a
+    ``Sharding`` (None = the default device).  shard places a leaf
+    onto the destination — a cross-device reshard when source and
+    destination differ, a fresh-buffer copy when they match; gather
+    pulls a leaf to host (the escape hatch for destinations jax
+    cannot transfer to directly)."""
+    def shard_fn(leaf):
+        if dest is None:
+            # fresh buffers on the default device: device_put with no
+            # placement would alias same-device inputs
+            return jax.device_put(jax.device_get(leaf))
+        return jax.device_put(leaf, dest)
+
+    def gather_fn(leaf):
+        return jax.device_get(leaf)
+
+    return shard_fn, gather_fn
+
+
+class KVMigrator:
+    """Moves [1, S] KV entries/blocks between replicas, with
+    accounting.  One instance per pool: the counters are the pool's
+    migration ledger and ``take_events`` drains per-event samples for
+    the metrics fold (exactly-once, the ChipLedger ``take_healed``
+    idiom)."""
+
+    def __init__(self, clock=time.perf_counter):
+        self.clock = clock
+        self.migrations = 0
+        self.bytes_moved = 0
+        self.tokens_moved = 0
+        self.wall_s = 0.0
+        self._events: list[tuple[float, int]] = []
+
+    def migrate_entry(self, entry: KVCache, dest=None) -> KVCache:
+        """Reshard one [1, S] cache onto ``dest`` and return the
+        migrated copy; the source entry is untouched (its owner keeps
+        serving hits from it)."""
+        t0 = self.clock()
+        shard_fn, _ = make_kv_shard_and_gather_fns(dest)
+        leaves, treedef = jax.tree_util.tree_flatten(entry)
+        moved = [shard_fn(leaf) for leaf in leaves]
+        jax.block_until_ready(moved)
+        out = jax.tree_util.tree_unflatten(treedef, moved)
+        nbytes = sum(getattr(leaf, "nbytes", 0) for leaf in leaves)
+        wall = self.clock() - t0
+        self.migrations += 1
+        self.bytes_moved += nbytes
+        self.tokens_moved += int(jax.device_get(entry.pos))
+        self.wall_s += wall
+        self._events.append((wall, nbytes))
+        return out
+
+    def migrate_block(self, block, dest=None):
+        """Reshard a :class:`~...models.serving.KVBlock` — the KV
+        entry plus the carried sampling key (a [2] leaf that must land
+        on the same devices as the cache it steers)."""
+        import dataclasses
+
+        kv = self.migrate_entry(block.kv, dest)
+        carry = block.carry_key
+        if carry is not None:
+            shard_fn, _ = make_kv_shard_and_gather_fns(dest)
+            carry = shard_fn(carry)
+        return dataclasses.replace(block, kv=kv, carry_key=carry)
+
+    def take_events(self) -> list[tuple[float, int]]:
+        """Per-migration (wall_s, bytes) samples since the last call —
+        consumed, so each lands in the metrics exactly once."""
+        events, self._events = self._events, []
+        return events
+
+    def stats(self) -> dict:
+        return {"migrations": self.migrations,
+                "bytes_moved": self.bytes_moved,
+                "tokens_moved": self.tokens_moved,
+                "wall_s": round(self.wall_s, 6)}
+
+
+__all__ = ["KVMigrator", "make_kv_shard_and_gather_fns"]

@@ -105,6 +105,42 @@ class GatewayMetrics:
             "tpu_gateway_drains_total",
             "Replica drains triggered by health/fault signals",
             registry=self.registry)
+        # prefix-cache effectiveness, fleet-wide (ISSUE 6 satellite):
+        # the engines' per-cache hit/miss/bytes counters folded into
+        # one registry as deltas per pump step
+        # (gateway/frontend.py _scrape_engine_stats) — before this,
+        # adoption was invisible outside dispatch counts
+        self.prefix_hits = Counter(
+            "tpu_gateway_prefix_hits_total",
+            "Prefix-cache hits across all pool engines",
+            registry=self.registry)
+        self.prefix_misses = Counter(
+            "tpu_gateway_prefix_misses_total",
+            "Prefix-cache misses across all pool engines",
+            registry=self.registry)
+        self.prefix_bytes_reused = Counter(
+            "tpu_gateway_prefix_bytes_reused_total",
+            "K/V bytes adopted from prefix caches instead of "
+            "recomputed, across all pool engines",
+            registry=self.registry)
+        # disaggregated-pool KV migration (serving_disagg/): every
+        # prefill->decode handoff and index fetch is one migration
+        self.kv_migrations = Counter(
+            "tpu_gateway_kv_migrations_total",
+            "KV blocks/prefix entries moved between replicas "
+            "(reshard-on-transfer)", registry=self.registry)
+        self.kv_bytes_moved = Counter(
+            "tpu_gateway_kv_bytes_moved_total",
+            "Bytes of K/V cache moved between replicas",
+            registry=self.registry)
+        self.kv_migrate_seconds = Histogram(
+            "tpu_gateway_kv_migrate_seconds",
+            "Wall time per KV migration (gather + reshard + adopt)",
+            registry=self.registry, buckets=_GATEWAY_BUCKETS)
+        self.replica_roles = Gauge(
+            "tpu_gateway_replica_role",
+            "Live replicas by role (unified/prefill/decode)",
+            ["role"], registry=self.registry)
         # demand gauges the fleet reconciler ticks on
         # (fleet/reconciler.py): arrival-rate EWMA over pump steps and
         # the signed SLO-margin EWMA over finished SLO-bearing

@@ -1,0 +1,489 @@
+"""Disaggregated prefill/decode serving (k8s_dra_driver_tpu/
+serving_disagg/): KV export/adopt byte-equality, reshard-on-transfer
+migration, the fleet prefix index, and the two-role pool behind the
+existing gateway.
+
+The acceptance invariants (ISSUE 6): a 1-prefill + 2-decode pool under
+bursty greedy+sampled arrivals finishes every admitted request exactly
+once with tokens byte-equal to the single-engine oracle, KV arrives on
+the decode side by migration with ZERO prefill launches on decode
+replicas (utils/dispatch.py attribution is the hermetic evidence), an
+index hit on another replica's cached prefix pays only the suffix
+(the ``prefill_suffix`` dispatch label pins zero full-prefill
+recompute), and a prefill replica killed mid-KV-transfer degrades to
+decode-local prefill — exactly once, byte-equal.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.cluster.faults import FaultPlan
+from k8s_dra_driver_tpu.gateway import FleetGateway, ReplicaManager
+from k8s_dra_driver_tpu.gateway.replica import (ROLE_DECODE,
+                                                ROLE_PREFILL,
+                                                ROLE_UNIFIED)
+from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                       greedy_generate, init_params)
+from k8s_dra_driver_tpu.models.serving import (Request, ServingEngine)
+from k8s_dra_driver_tpu.serving_disagg import (DisaggReplicaManager,
+                                               DisaggRouter,
+                                               FleetPrefixIndex,
+                                               KVMigrator,
+                                               PrefillReplica)
+from k8s_dra_driver_tpu.utils import dispatch
+
+# Stall guard (tests/conftest.py, the gateway/supervisor precedent):
+# the chaos twin deliberately kills a replica mid-transfer — a
+# regression that turns the drain into a hang must fail in seconds,
+# not eat the tier-1 budget.  Generous: the module runs well under
+# 300 s warm.
+pytestmark = pytest.mark.timeout_s(300)
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_head=8, d_ff=64, max_seq=48, n_kv_heads=2,
+                        dtype=jnp.float32)
+
+_PARAMS = None
+
+
+def params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+    return _PARAMS
+
+
+def prompt(seed, n):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab), np.int32)
+
+
+def greedy_oracle(pr, n_new):
+    out = greedy_generate(params(), jnp.asarray(pr)[None, :], CFG,
+                          n_tokens=n_new)
+    return np.asarray(out[0], np.int32)
+
+
+def engine(name=None, slots=2, prefix_cache=2, **kw):
+    return ServingEngine(params(), CFG, slots=slots,
+                         prefix_cache=prefix_cache, **kw)
+
+
+def oracle_tokens(req: Request, **engine_kw) -> np.ndarray:
+    """Single-engine reference for any request (greedy or sampled):
+    what the pool must reproduce byte-for-byte.  ``engine_kw`` must
+    match the pool engines' sampling shape (top_k/top_p are
+    engine-level program parameters)."""
+    eng = ServingEngine(params(), CFG, slots=1, **engine_kw)
+    eng.submit(Request(uid=req.uid, prompt=req.prompt.copy(),
+                       max_new=req.max_new, eos_id=req.eos_id,
+                       temperature=req.temperature, seed=req.seed))
+    return eng.run()[0].tokens
+
+
+def disagg_pool(prefill=1, decode=2, slots=2, prefix_cache=2, **kw):
+    mgr = DisaggReplicaManager(
+        lambda name: engine(name, slots=slots,
+                            prefix_cache=prefix_cache),
+        prefill_replicas=prefill, decode_replicas=decode,
+        depth_bound=slots, **kw)
+    return mgr
+
+
+# -- the KV migration primitive (models/serving.py) ------------------------
+
+class TestKVBlock:
+    def test_export_adopt_byte_equal_greedy(self):
+        pr = prompt(1, 7)
+        blk = engine().prefill_export(
+            Request(uid="g", prompt=pr, max_new=5))
+        assert int(blk.kv.pos) == pr.size
+        dec = engine()
+        dec.adopt_block(blk)
+        out = dec.run()
+        np.testing.assert_array_equal(out[0].tokens,
+                                      greedy_oracle(pr, 5))
+
+    def test_export_adopt_byte_equal_sampled(self):
+        pr = prompt(2, 7)
+        req = Request(uid="s", prompt=pr, max_new=6,
+                      temperature=0.8, seed=13)
+        ref = oracle_tokens(req, top_k=8)
+        blk = ServingEngine(params(), CFG, slots=2, top_k=8,
+                            prefix_cache=2).prefill_export(req)
+        assert blk.carry_key is not None
+        dec = ServingEngine(params(), CFG, slots=2, top_k=8)
+        dec.adopt_block(blk)
+        np.testing.assert_array_equal(dec.run()[0].tokens, ref)
+
+    def test_adopt_refuses_duplicates_and_overflow(self):
+        pr = prompt(3, 5)
+        src = engine()
+        blk = src.prefill_export(Request(uid="a", prompt=pr,
+                                         max_new=2))
+        dec = engine(slots=1)
+        dec.adopt_block(blk)
+        with pytest.raises(ValueError, match="already in flight"):
+            dec.adopt_block(blk)
+        blk_b = src.prefill_export(Request(uid="b", prompt=pr,
+                                           max_new=2))
+        with pytest.raises(RuntimeError, match="no free"):
+            dec.adopt_block(blk_b)      # the only slot is taken
+
+    def test_max_new_one_finishes_at_adoption(self):
+        """A request whose first (prefill-produced) token already
+        completes it must finish on the decode engine's next step
+        without decoding anything."""
+        pr = prompt(4, 6)
+        blk = engine().prefill_export(
+            Request(uid="one", prompt=pr, max_new=1))
+        dec = engine()
+        dec.adopt_block(blk)
+        out = dec.run()
+        np.testing.assert_array_equal(out[0].tokens,
+                                      greedy_oracle(pr, 1))
+
+
+class TestMigrator:
+    def test_reshard_moves_devices_and_counts(self):
+        devs = jax.devices()
+        assert len(devs) >= 2, "conftest forces an 8-device CPU mesh"
+        src = engine()
+        blk = src.prefill_export(
+            Request(uid="m", prompt=prompt(5, 6), max_new=2))
+        mig = KVMigrator()
+        moved = mig.migrate_entry(blk.kv, devs[1])
+        assert list(moved.k[0].devices()) == [devs[1]]
+        np.testing.assert_array_equal(np.asarray(moved.k[0]),
+                                      np.asarray(blk.kv.k[0]))
+        assert int(moved.pos) == int(blk.kv.pos)
+        st = mig.stats()
+        assert st["migrations"] == 1
+        assert st["bytes_moved"] == sum(
+            leaf.nbytes for leaf in jax.tree_util.tree_leaves(blk.kv))
+        assert st["tokens_moved"] == 6
+        # events drain exactly once
+        assert len(mig.take_events()) == 1
+        assert mig.take_events() == []
+
+    def test_same_device_migration_is_a_fresh_copy(self):
+        src = engine()
+        blk = src.prefill_export(
+            Request(uid="c", prompt=prompt(6, 6), max_new=2))
+        moved = KVMigrator().migrate_entry(blk.kv, None)
+        assert moved.k[0] is not blk.kv.k[0]
+        np.testing.assert_array_equal(np.asarray(moved.v[1]),
+                                      np.asarray(blk.kv.v[1]))
+
+
+# -- the fleet prefix index ------------------------------------------------
+
+class TestFleetIndex:
+    def test_mirror_tracks_insert_evict_drop(self):
+        idx = FleetPrefixIndex()
+        eng = engine(prefix_cache=2)
+        idx.attach("r0", eng._prefix)
+        pra, prb, prc = prompt(7, 6), prompt(8, 6), prompt(9, 6)
+        for uid, pr in (("a", pra), ("b", prb)):
+            eng.submit(Request(uid=uid, prompt=pr, max_new=1))
+        eng.run()
+        assert idx.holders()["r0"] == 2
+        p, name, key = idx.lookup(pra)
+        assert name == "r0" and p == pra.size - 1
+        assert eng.export_prefix(key) is not None
+        # a third insert LRU-evicts the oldest; the mirror follows
+        eng.submit(Request(uid="c", prompt=prc, max_new=1))
+        eng.run()
+        assert idx.holders()["r0"] == 2
+        idx.drop_replica("r0")
+        assert idx.lookup(pra) == (0, None, None)
+
+    def test_lookup_longest_match_across_replicas(self):
+        idx = FleetPrefixIndex()
+        e0, e1 = engine(prefix_cache=2), engine(prefix_cache=2)
+        idx.attach("r0", e0._prefix)
+        idx.attach("r1", e1._prefix)
+        shared = prompt(10, 8)
+        e0.submit(Request(uid="s", prompt=shared[:5], max_new=1))
+        e0.run()
+        e1.submit(Request(uid="l", prompt=shared, max_new=1))
+        e1.run()
+        p, name, _ = idx.lookup(np.concatenate(
+            [shared, prompt(11, 3)]))
+        assert name == "r1" and p == shared.size
+
+
+def test_index_hit_migrates_prefix_zero_recompute():
+    """THE zero-recompute pin (dispatch counter): a prompt whose
+    prefix another replica already computed is filled with NO fresh
+    full-prefill launch — the prefix entry migrates through the fleet
+    index and only the suffix runs (the ``prefill_suffix`` label)."""
+    mgr = disagg_pool(prefill=2, decode=1)
+    p0, p1 = [r for r in mgr.replicas if r.role == ROLE_PREFILL]
+    pr = prompt(12, 8)
+    # p0 computes the prompt once (a fresh "prefill" launch)
+    with dispatch.track() as t0:
+        p0.engine.prefill_export(Request(uid="warm", prompt=pr,
+                                         max_new=2))
+    assert t0.by_label.get("prefill") == 1
+    # p1 fills the SAME prompt: the index fetch migrates p0's entry,
+    # the fill pays only the 1-token suffix — zero fresh prefill
+    with dispatch.track() as t1:
+        mgr._fetch_remote_prefix(p1, pr)
+        blk = p1.engine.prefill_export(Request(uid="hit", prompt=pr,
+                                               max_new=3))
+    assert t1.by_label.get("prefill", 0) == 0
+    assert t1.by_label.get("prefill_suffix") == 1
+    assert blk.reused_tokens == pr.size - 1
+    assert mgr.migration_stats()["migrations"] == 1
+    assert p1.engine.stats()["prefix_tokens_reused_total"] \
+        == pr.size - 1
+    # and the migrated-prefix fill is still byte-equal
+    dec = engine()
+    dec.adopt_block(blk)
+    np.testing.assert_array_equal(dec.run()[0].tokens,
+                                  greedy_oracle(pr, 3))
+
+
+def test_router_prefers_index_holder_then_falls_back():
+    idx = FleetPrefixIndex()
+    router = DisaggRouter(idx, min_affinity=4)
+
+    class Stub:
+        def __init__(self, name, role, depth=0):
+            self.name, self.role, self.ready = name, role, True
+            self.depth_bound, self._depth = 8, depth
+
+        def occupancy(self):
+            return {"active": self._depth, "pending": 0}
+
+    pa, pb = Stub("p0", ROLE_PREFILL, depth=3), Stub("p1", ROLE_PREFILL)
+    d0 = Stub("d0", ROLE_DECODE)
+    pr = prompt(13, 8)
+    idx._held["p0"] = {tuple(pr[:6].tolist())}
+    # busier holder still wins on affinity
+    assert router.route(pr, [pa, pb, d0]) is pa
+    # no prefill capacity -> decode fallback (local prefill)
+    pa.ready = pb.ready = False
+    assert router.route(pr, [pa, pb, d0]) is d0
+    d0.ready = False
+    assert router.route(pr, [pa, pb, d0]) is None
+    # forget drops the drained replica's index entries
+    router.forget("p0")
+    assert idx.lookup(pr) == (0, None, None)
+
+
+# -- the acceptance scenario ----------------------------------------------
+
+def _burst_reqs():
+    """Bursty mixed greedy/sampled workload, two prompt-length
+    classes (bounds compile count), distinct uids."""
+    bursts, seed = [], 20
+    for b, size in enumerate((4, 3, 4)):
+        burst = []
+        for i in range(size):
+            seed += 1
+            burst.append(Request(
+                uid=f"b{b}i{i}", prompt=prompt(seed, 5 + (i % 2) * 3),
+                max_new=3 + (i % 3),
+                temperature=0.7 if i % 3 == 2 else 0.0, seed=seed))
+        bursts.append(burst)
+    return bursts
+
+
+def test_two_role_pool_exactly_once_byte_equal_zero_decode_prefill():
+    """THE acceptance test: 1 prefill + 2 decode replicas behind the
+    existing gateway, bursty greedy+sampled arrivals; every admitted
+    request finishes exactly once, byte-equal to the single-engine
+    oracle; every prompt's KV reached decode by migration (counter ==
+    finished count) and decode replicas paid ZERO prefill launches —
+    prefill no longer steals decode steps by construction."""
+    mgr = disagg_pool(prefill=1, decode=2)
+    gw = FleetGateway(mgr, router=DisaggRouter(mgr.index),
+                      queue_capacity=32, auto_replace=False)
+    bursts = _burst_reqs()
+    submitted = [r for burst in bursts for r in burst]
+    oracles = {r.uid: oracle_tokens(r) for r in submitted}
+    done = []
+    for burst in bursts:
+        for req in burst:
+            assert gw.submit(req, slo_s=300.0).status == "queued"
+        done.extend(gw.step())
+    done.extend(gw.run_until_idle())
+
+    assert len(gw.outcomes) == len(submitted)
+    assert {g.uid for g in done} == {r.uid for r in submitted}
+    assert all(g.status == "finished" for g in gw.outcomes.values())
+    for req in submitted:
+        np.testing.assert_array_equal(
+            gw.results[req.uid].tokens, oracles[req.uid],
+            err_msg=f"{req.uid} diverged from the oracle")
+    # every request's KV moved prefill->decode exactly once
+    assert mgr.migration_stats()["migrations"] == len(submitted)
+    # the role split held: decode replicas launched NO prefill
+    # programs of any kind; the prefill replica decoded nothing
+    per = gw.stats()["per_replica_dispatches"]
+    for r in mgr.replicas:
+        labels = per.get(r.name, {}).get("by_label", {})
+        if r.role == ROLE_DECODE:
+            assert not any(lbl.startswith("prefill")
+                           for lbl in labels), (r.name, labels)
+        else:
+            assert not any(lbl.startswith("decode_")
+                           for lbl in labels), (r.name, labels)
+    # everything finished on a decode replica
+    assert {g.replica for g in gw.outcomes.values()} \
+        <= {r.name for r in mgr.replicas if r.role == ROLE_DECODE}
+    text = gw.metrics.render().decode()
+    m = re.search(r"tpu_gateway_kv_migrations_total (\d+)\.0", text)
+    assert m and int(m.group(1)) == len(submitted)
+    m = re.search(r"tpu_gateway_ttft_seconds_count (\d+)\.0", text)
+    assert m and int(m.group(1)) == len(submitted)
+    assert re.search(r'tpu_gateway_replica_role\{role="prefill"\} 1\.0',
+                     text)
+    assert re.search(r'tpu_gateway_replica_role\{role="decode"\} 2\.0',
+                     text)
+
+
+@pytest.mark.faults
+def test_prefill_replica_killed_mid_transfer_falls_back_local():
+    """Chaos twin: the only prefill replica dies via the FaultPlan
+    health verb AFTER exporting blocks but before every handoff —
+    un-adopted blocks die with it, the drain requeues the victims,
+    and the router falls back to decode-local prefill.  Exactly once,
+    byte-equal to the oracle, drain observable."""
+    plan = FaultPlan.from_json({"rules": [
+        # skip the pre-dispatch poll; kill on the 2nd: exports exist,
+        # handoffs are mid-flight
+        {"verb": "health", "kind": "Replica", "name": "p0",
+         "skip": 1, "times": 1, "error": "drop"}]})
+    mgr = disagg_pool(prefill=1, decode=2, fault_plan=plan)
+    gw = FleetGateway(mgr, router=DisaggRouter(mgr.index),
+                      queue_capacity=32, auto_replace=False)
+    bursts = _burst_reqs()
+    submitted = [r for burst in bursts for r in burst]
+    oracles = {r.uid: oracle_tokens(r) for r in submitted}
+    for burst in bursts:
+        for req in burst:
+            assert gw.submit(req, slo_s=300.0).status == "queued"
+        gw.step()
+    gw.run_until_idle()
+
+    assert len(gw.outcomes) == len(submitted)
+    assert all(g.status == "finished" for g in gw.outcomes.values())
+    for req in submitted:
+        np.testing.assert_array_equal(
+            gw.results[req.uid].tokens, oracles[req.uid],
+            err_msg=f"{req.uid} diverged through the kill")
+    st = gw.stats()
+    assert st["replicas"]["dead"] == 1
+    assert st["replicas"]["roles"] == {ROLE_DECODE: 2}
+    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
+    assert requeued, "fault fired before anything was in flight"
+    text = gw.metrics.render().decode()
+    assert re.search(r"tpu_gateway_drains_total 1\.0", text)
+    # the fallback actually happened: decode replicas prefilled
+    # locally after the prefill capacity vanished
+    per = gw.stats()["per_replica_dispatches"]
+    decode_prefills = sum(
+        n for r in mgr.replicas if r.role == ROLE_DECODE
+        for lbl, n in per.get(r.name, {}).get("by_label", {}).items()
+        if lbl.startswith("prefill"))
+    assert decode_prefills > 0
+    # and the dead replica's index entries are gone
+    assert "p0" not in mgr.index.holders()
+
+
+# -- role plumbing (ISSUE 6 satellites) ------------------------------------
+
+class _StubEngine:
+    slots = 2
+
+
+class TestRoles:
+    def test_counts_carry_roles(self):
+        mgr = disagg_pool(prefill=1, decode=2)
+        c = mgr.counts()
+        assert c["roles"] == {ROLE_PREFILL: 1, ROLE_DECODE: 2}
+        assert c["ready"] == 3
+        uni = ReplicaManager(lambda name: _StubEngine(), replicas=2)
+        assert uni.counts()["roles"] == {ROLE_UNIFIED: 2}
+
+    def test_begin_drain_refuses_last_prefill_replica(self):
+        mgr = disagg_pool(prefill=2, decode=1)
+        pf = [r for r in mgr.replicas if r.role == ROLE_PREFILL]
+        assert mgr.begin_drain(pf[0]) is True
+        # pf[1] is now the LAST ready prefill replica: refuse
+        assert mgr.begin_drain(pf[1]) is False
+        assert pf[1].ready
+        # decode replicas are always drainable by role
+        dec = next(r for r in mgr.replicas if r.role == ROLE_DECODE)
+        assert mgr.begin_drain(dec) is True
+
+    def test_replace_preserves_role(self):
+        mgr = disagg_pool(prefill=1, decode=1)
+        victim = next(r for r in mgr.replicas
+                      if r.role == ROLE_PREFILL)
+        mgr.mark_down(victim)
+        fresh = mgr.replace(victim)
+        assert fresh.role == ROLE_PREFILL
+        assert isinstance(fresh, PrefillReplica)
+
+    def test_scale_up_defaults_to_decode_role(self):
+        mgr = disagg_pool(prefill=1, decode=1)
+        assert mgr.add_replica().role == ROLE_DECODE
+        assert mgr.add_replica(role=ROLE_PREFILL).role == ROLE_PREFILL
+        uni = ReplicaManager(lambda name: _StubEngine(), replicas=1)
+        assert uni.add_replica().role == ROLE_UNIFIED
+
+    def test_reconciler_scale_down_skips_last_prefill(self):
+        """fleet/reconciler.py walks idle victims until begin_drain
+        accepts: with one idle prefill + one idle decode replica the
+        decode replica drains; with ONLY the prefill replica idle,
+        nothing does."""
+        from k8s_dra_driver_tpu.fleet import ChipLedger, FleetReconciler
+        from k8s_dra_driver_tpu.fleet.policy import SCALE_DOWN, Action
+
+        mgr = disagg_pool(prefill=1, decode=1)
+        gw = FleetGateway(mgr, router=DisaggRouter(mgr.index),
+                          queue_capacity=4, auto_replace=False)
+        rec = FleetReconciler(gw, None, ledger=ChipLedger([0, 1]))
+        assert rec._apply(Action(SCALE_DOWN), 0.0) == [SCALE_DOWN]
+        drained = [r for r in mgr.replicas if r.state == "draining"]
+        assert [r.role for r in drained] == [ROLE_DECODE]
+        # only the prefill replica remains idle+ready: refuse
+        assert rec._apply(Action(SCALE_DOWN), 1.0) == []
+        assert all(r.state != "draining" for r in mgr.replicas
+                   if r.role == ROLE_PREFILL)
+
+
+def test_prefix_observability_in_gateway_metrics():
+    """ISSUE 6 satellite: prefix hit/miss/bytes counters surface in
+    GatewayMetrics — a shared-prefix drain through a unified pool
+    shows hits AND misses AND reused bytes fleet-wide."""
+    rng = np.random.default_rng(0)
+    pre = rng.integers(0, CFG.vocab, 8).astype(np.int32)
+    mgr = ReplicaManager(
+        lambda name: engine(name, prefix_cache=2), replicas=1)
+    gw = FleetGateway(mgr, queue_capacity=16)
+    for i in range(4):
+        tail = rng.integers(0, CFG.vocab, 4).astype(np.int32)
+        gw.submit(Request(uid=f"u{i}",
+                          prompt=np.concatenate([pre, tail]),
+                          max_new=2))
+    gw.run_until_idle()
+    text = gw.metrics.render().decode()
+    hits = float(re.search(
+        r"tpu_gateway_prefix_hits_total (\d+)\.0", text).group(1))
+    misses = float(re.search(
+        r"tpu_gateway_prefix_misses_total (\d+)\.0", text).group(1))
+    reused = float(re.search(
+        r"tpu_gateway_prefix_bytes_reused_total (\d+)\.0",
+        text).group(1))
+    assert hits >= 3 and misses >= 1 and reused > 0
+    eng = mgr.replicas[0].engine
+    assert reused == eng.stats()["prefix_bytes_reused_total"]

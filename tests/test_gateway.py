@@ -467,7 +467,8 @@ class TestReplicaManagerHealth:
             assert victim not in mgr.replicas
             assert len(mgr.replicas) == 2
             assert mgr.counts() == {"ready": 2, "draining": 0,
-                                    "dead": i + 1, "retired": 0}
+                                    "dead": i + 1, "retired": 0,
+                                    "roles": {"unified": 2}}
 
 
 def test_prefix_affinity_beats_round_robin_on_prefill_dispatches():
