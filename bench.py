@@ -95,6 +95,19 @@ TINY_FLEET_KWARGS = dict(tp=2, train_dp=2, batch=4, seq_len=16,
                          d_model=32, n_layers=2, heads=4, d_ff=64,
                          vocab=64)
 
+#: control-plane ceiling probe (gateway/ctlprobe.py): NO-OP engines +
+#: open-loop trace replay, so the scalars isolate admission/routing
+#: decisions per second from model compute.  Always CPU-meaningful
+#: (the ceiling is host cost); this is the full recorded shape —
+#: tools/ctl_ceiling_cpu.json is its committed artifact — and the
+#: smoke tests pin the reduced TINY shape below.
+CTL_KWARGS = dict(pump_counts=(1, 2, 4), replicas=4, slots=8,
+                  n_requests=2048, trace_name="bursty",
+                  offered_x=20.0)
+TINY_CTL_KWARGS = dict(pump_counts=(1, 2), replicas=2, slots=4,
+                       n_requests=96, trace_name="bursty",
+                       offered_x=8.0)
+
 _WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", "630"))
 _DEADLINE = time.monotonic() + _WALL_BUDGET_S
 
@@ -492,6 +505,43 @@ def _fleet_probe(timeout_s: float = 300.0) -> dict:
         return {"error": f"unparseable output: {e}"}
     payload["note"] = ("8-virtual-device CPU mesh; " +
                        payload.get("note", ""))
+    return payload
+
+
+def _control_plane_probe(timeout_s: float = 240.0) -> dict:
+    """Control-plane ceiling probe (gateway/ctlprobe.py) in a
+    CPU-pinned subprocess: admissions/s + route decisions/s through
+    the sharded gateway over NO-OP engines under open-loop trace
+    replay, swept over pump counts.  Always CPU — the ceiling being
+    measured is host decision cost, deliberately isolated from any
+    accelerator."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    kwargs = json.dumps(CTL_KWARGS)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.gateway.ctlprobe import "
+        "control_plane_probe\n"
+        f"print(json.dumps(control_plane_probe("
+        f"**json.loads({kwargs!r}))))\n")
+    env = cpu_jax_env(1)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = "CPU-pinned subprocess; " + payload.get("note", "")
     return payload
 
 
@@ -919,6 +969,9 @@ _PROBE_SCALARS = (
     ("fleet", "fleet_scaleup_ms", "scaleup_ms"),
     ("fleet", "fleet_preempt_ms", "preempt_ms"),
     ("fleet", "fleet_regrow_ms", "regrow_ms"),
+    ("control_plane", "ctl_admissions_per_s", "admissions_per_s"),
+    ("control_plane", "ctl_routes_per_s", "routes_per_s"),
+    ("control_plane", "ctl_goodput_flat_x", "goodput_flat_x"),
     ("allreduce_cpu_mesh8", "cpu_mesh_gbps", "gbps"),
 )
 
@@ -1129,6 +1182,14 @@ def main() -> None:
                 timeout_s=min(300.0, _remaining() - 60.0))
         else:
             fleet = {"error": "skipped: wall budget"}
+        # 3d. Control-plane ceiling probe (hermetic, CPU subprocess):
+        #     admissions/s + routes/s over no-op engines under
+        #     open-loop trace replay, swept over pump counts.
+        if _remaining() > 90:
+            ctl = _control_plane_probe(
+                timeout_s=min(240.0, _remaining() - 45.0))
+        else:
+            ctl = {"error": "skipped: wall budget"}
         # 4. TPU probes — the only section that can meet a wedged
         #    tunnel; child process + deadline, partial results kept.
         if _remaining() > 55:
@@ -1138,6 +1199,7 @@ def main() -> None:
         compute["allreduce_cpu_mesh8"] = cpu_mesh
         compute["supervisor_recovery"] = recovery
         compute["fleet"] = fleet
+        compute["control_plane"] = ctl
         detail["tpu"] = compute
         detail["baseline_note"] = (
             "FLOOR comparison, not like-for-like: the reference "

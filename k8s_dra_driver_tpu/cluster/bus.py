@@ -1,0 +1,132 @@
+"""Deterministic in-process event bus for the serving control plane.
+
+The fleet's subsystems used to learn about each other by POLLING once
+per pump step: the gateway scraped every engine's prefix counters
+(O(replicas) per step), the replica manager re-polled health, and the
+reconciler re-read the metrics registry every tick.  This bus inverts
+that: producers PUBLISH (prefix hit, drain, demand update, reconciler
+tick) and consumers fold events at O(events) cost — the step cost of a
+quiet control plane no longer grows with pool size.
+
+Two design rules, both inherited from the miniapi listener pattern
+(tests/miniapi.py ``listeners`` — the zero-latency tap PR 2's oopbed
+deployment controller uses instead of a poll interval):
+
+- **No threads.**  ``publish`` only enqueues; ``pump()`` delivers
+  synchronously FIFO in the caller's thread.  Every owner (gateway
+  pump, sharded cycle, reconciler tick) pumps at a well-defined point
+  in its step, so event delivery interleaves with control logic
+  deterministically — ``-m faults`` chaos runs replay exactly.
+- **Seeded, not arbitrary, ordering.**  Delivery is strict FIFO by
+  publish order; where the control plane has a genuinely free choice
+  (which gateway pump dispatches first this cycle, which idle pump
+  steals first), it draws the order from this bus's seeded RNG via
+  :meth:`shuffle` — same seed, same schedule, same outcomes (pinned by
+  tests/test_control_plane.py's determinism test), while different
+  seeds exercise different interleavings for free.
+
+A raising subscriber is isolated (counted in ``errors``) — an
+observability consumer must never break the pump, same contract as
+``PrefixCache.listeners`` and the miniapi taps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import random
+from collections import deque
+from typing import Any, Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class Event:
+    """One published fact: a monotone sequence number (the total
+    order), a topic string, and an immutable-by-convention payload."""
+
+    seq: int
+    topic: str
+    payload: dict
+
+
+class EventBus:
+    """Seeded single-threaded pub/sub (module docstring).
+
+    ``journal`` keeps the last N delivered events — the determinism
+    tests' evidence that two same-seed runs delivered the same event
+    sequence, and a debugging trace for chaos failures.
+    """
+
+    def __init__(self, seed: int = 0, journal: int = 4096):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self._subs: dict[str, list[Callable[[Event], Any]]] = {}
+        self._q: deque[Event] = deque()
+        self._seq = itertools.count()
+        self.journal: deque[Event] = deque(maxlen=journal)
+        self.published_total = 0
+        self.delivered_total = 0
+        self.errors = 0
+
+    # -- wiring ----------------------------------------------------------
+
+    def subscribe(self, topic: str,
+                  fn: Callable[[Event], Any]) -> None:
+        """Register ``fn`` for ``topic``; delivery order among
+        subscribers is registration order (deterministic)."""
+        self._subs.setdefault(topic, []).append(fn)
+
+    # -- publish / deliver -----------------------------------------------
+
+    def publish(self, topic: str, **payload) -> Event:
+        """Enqueue one event; NOTHING is delivered here — the owner's
+        next :meth:`pump` delivers, so a publisher can never re-enter
+        a consumer mid-decision."""
+        ev = Event(next(self._seq), topic, payload)
+        self._q.append(ev)
+        self.published_total += 1
+        return ev
+
+    def pump(self, max_events: int = 100_000) -> int:
+        """Deliver queued events FIFO until the queue is empty (events
+        published BY subscribers during delivery are appended and
+        delivered in the same pump — cascades settle); returns the
+        number delivered.  ``max_events`` is a runaway-cascade
+        backstop, far above any real step's traffic."""
+        delivered = 0
+        while self._q and delivered < max_events:
+            ev = self._q.popleft()
+            self.journal.append(ev)
+            self.delivered_total += 1
+            delivered += 1
+            for fn in list(self._subs.get(ev.topic, ())):
+                try:
+                    fn(ev)
+                except Exception:
+                    # a broken tap must not fail the pump (miniapi
+                    # notify contract) — but it must be visible
+                    self.errors += 1
+        return delivered
+
+    # -- seeded scheduling -----------------------------------------------
+
+    def shuffle(self, items) -> list:
+        """A seeded permutation for genuinely-free control-plane
+        choices (pump service order, steal victim order): same seed →
+        same sequence of permutations → replayable chaos runs."""
+        out = list(items)
+        self.rng.shuffle(out)
+        return out
+
+    # -- introspection ---------------------------------------------------
+
+    def topics(self) -> list[str]:
+        return sorted(self._subs)
+
+    def journal_topics(self) -> list[str]:
+        """The delivered-event topic sequence (determinism tests
+        compare this across same-seed runs)."""
+        return [ev.topic for ev in self.journal]
+
+
+__all__ = ["Event", "EventBus"]

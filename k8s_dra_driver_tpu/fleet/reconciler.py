@@ -66,7 +66,8 @@ class FleetReconciler:
                  ledger: ChipLedger,
                  policy: FleetPolicy | None = None,
                  metrics: FleetMetrics | None = None,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 bus=None):
         self.gateway = gateway
         self.supervisor = supervisor
         self.ledger = ledger
@@ -75,6 +76,16 @@ class FleetReconciler:
             self.policy.train_target_dp = supervisor.dp
         self.metrics = metrics or FleetMetrics()
         self.clock = clock
+        #: event-driven demand (cluster/bus.py): subscribe to the
+        #: gateway pump's per-step ``demand`` events and tick on the
+        #: CACHED latest instead of re-reading the metrics registry
+        #: every tick — O(events), and the reconciler sees exactly
+        #: what the pump published, not a racy re-scrape.  Pass the
+        #: gateway's own bus; None keeps the registry-read fallback.
+        self.bus = bus
+        self._bus_demand: DemandSignals | None = None
+        if bus is not None:
+            bus.subscribe("demand", self._on_demand)
         #: actuation log: (clock t, action kind, info dict) — the
         #: probe's and the tests' evidence of WHEN each decision fired
         self.events: list[tuple[float, str, dict]] = []
@@ -127,15 +138,32 @@ class FleetReconciler:
             gang_tp=self._gang_tp())
         if action is not None:
             applied += self._apply(action, now)
-        # 5. export the tick's view
+        # 5. export the tick's view; on a bus, the tick itself is an
+        #    event other subsystems (and the chaos journal) can see
         self._export()
+        if self.bus is not None:
+            self.bus.publish("reconciler_tick", actions=list(applied))
+            self.bus.pump()
         return applied
 
     # -- signals ---------------------------------------------------------
 
+    def _on_demand(self, ev) -> None:
+        """Cache the gateway pump's latest demand event (bus mode)."""
+        p = ev.payload
+        margin = p.get("slo_margin_ewma_s")
+        self._bus_demand = DemandSignals(
+            queue_depth=int(p.get("queue_depth", 0)),
+            arrival_rate_rps=float(p.get("arrival_rate_rps", 0.0)),
+            slo_margin_ewma_s=margin)
+
     def _demand(self) -> DemandSignals:
-        """Demand from the ``GatewayMetrics`` registry — the gauges
-        are the contract, not the gateway object's internals."""
+        """Demand signals: the cached bus event when riding the
+        gateway's bus (no registry re-read per tick), else scraped
+        from the ``GatewayMetrics`` registry — the gauges are the
+        contract, not the gateway object's internals."""
+        if self.bus is not None and self._bus_demand is not None:
+            return self._bus_demand
         reg = self.gateway.metrics.registry
         qd = reg.get_sample_value("tpu_gateway_queue_depth") or 0.0
         rate = reg.get_sample_value(

@@ -6,6 +6,8 @@ scheduling argument, Orca OSDI'22 the within-engine one PR 2 built)."""
 from .admission import (AdmissionError, AdmissionQueue, GatewayRequest,
                         FINISHED, REJECTED_DUPLICATE, REJECTED_FULL,
                         REJECTED_INVALID, SHED_EXPIRED)
+from .calibrate import Capacity, calibrate_capacity
+from .ctlprobe import NullEngine, control_plane_probe
 from .frontend import FleetGateway
 from .probe import gateway_probe
 from .replica import (DraChipLease, EngineReplica, ReplicaManager,
@@ -13,13 +15,17 @@ from .replica import (DraChipLease, EngineReplica, ReplicaManager,
                       resolve_container_path)
 from .router import (LeastLoadedRouter, PrefixAffinityRouter,
                      RoundRobinRouter, Router)
+from .sharded import ShardedGateway
 
 __all__ = [
-    "AdmissionError", "AdmissionQueue", "DraChipLease", "EngineReplica",
+    "AdmissionError", "AdmissionQueue", "Capacity", "DraChipLease",
+    "EngineReplica",
     "FINISHED", "FleetGateway", "GatewayRequest", "LeastLoadedRouter",
+    "NullEngine",
     "PrefixAffinityRouter", "REJECTED_DUPLICATE", "REJECTED_FULL",
     "REJECTED_INVALID", "ROLE_DECODE", "ROLE_PREFILL", "ROLE_UNIFIED",
     "ReplicaManager", "RoundRobinRouter", "Router",
-    "SHED_EXPIRED",
-    "gateway_probe", "resolve_container_path",
+    "SHED_EXPIRED", "ShardedGateway",
+    "calibrate_capacity", "control_plane_probe", "gateway_probe",
+    "resolve_container_path",
 ]

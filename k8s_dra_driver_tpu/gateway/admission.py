@@ -161,6 +161,28 @@ class AdmissionQueue:
     def peek(self) -> GatewayRequest | None:
         return self._q[0] if self._q else None
 
+    def uids(self) -> list:
+        """Queued uids, oldest first — the sharded gateway's
+        cross-pump duplicate check (gateway/sharded.py) scans every
+        sibling queue so the pool-wide uid contract spans shards."""
+        return [g.uid for g in self._q]
+
+    def steal_newest(self) -> GatewayRequest | None:
+        """Work-stealing donor side: remove and return the NEWEST
+        queued request.  Stealing from the tail keeps this queue's
+        FIFO head — and any drain victims requeued at the front —
+        exactly where they were; the stolen request was going to wait
+        longest here anyway."""
+        return self._q.pop() if self._q else None
+
+    def adopt(self, g: GatewayRequest) -> None:
+        """Work-stealing thief side: an already-admitted request joins
+        the TAIL of this queue.  No capacity check — same contract as
+        :meth:`requeue`: admission happened once, at the door; moving
+        a request between pump shards must never turn into a silent
+        drop."""
+        self._q.append(g)
+
     def requeue(self, g: GatewayRequest) -> None:
         """Drain path: an in-flight request returns to the FRONT of
         the queue (see class docstring) with its arrival time — and

@@ -1,0 +1,246 @@
+"""Control-plane ceiling probe: how many admission and routing
+decisions per second the gateway tier can make, isolated from compute.
+
+Methodology (recorded in every artifact's ``note``): the pool is made
+of **no-op engines** — ``enqueue``/``step``/``finish`` are O(1) host
+bookkeeping with ZERO device compute, no jax dispatch, no readback —
+so every measured second is control-plane work: admission-queue
+bookkeeping, router scoring, drain accounting, metrics, the event
+bus.  Arrivals are **open-loop trace replay** (gateway/loadgen.py) at
+``offered_x`` multiples of the null pool's own calibrated drain rate
+(gateway/calibrate.py); at the 10–100x levels the probe runs, the
+pump — not the pool — is the bottleneck by construction, so
+
+- ``admissions_per_s`` = arrivals processed through ``submit`` per
+  wall second (refusals included — saying no costs control plane
+  too), and
+- ``routes_per_s``    = successful placement decisions per wall
+  second
+
+are the CEILING of this tier on this host, the number ROADMAP #3 said
+nobody had ever measured.  The probe sweeps pump counts (1→2→4
+sharded pumps over the same pool) at fixed offered load;
+``goodput_flat_x`` = min/max goodput across pump counts — the
+acceptance bar is that sharding is scheduling, not a tax (flat within
+~10% on the hermetic bed).  In this single-threaded harness more
+pumps cannot RAISE throughput; what the sweep proves is that the
+sharded architecture costs nothing while enabling real parallelism
+later.  Schema pinned by tests/test_bench_smoke.py; the recorded
+artifact lives at tools/ctl_ceiling_cpu.json.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+
+class NullEngine:
+    """The no-op serving engine: honors the pool-facing contract
+    (``enqueue``/``cancel``/``step``/``occupancy``/``prefix_peek``)
+    with pure host bookkeeping.  A request activates into a free slot
+    and finishes after ``steps_per_request`` engine steps, returning a
+    Finished whose tokens are just its prompt — the gateway's
+    accounting cannot tell the difference, and no jax program ever
+    launches."""
+
+    def __init__(self, slots: int = 8, steps_per_request: int = 1):
+        self.slots = slots
+        self.steps_per_request = steps_per_request
+        self._pending: deque = deque()
+        self._active: dict = {}       # uid -> [steps_left, request]
+
+    def enqueue(self, req) -> None:
+        # the same minimal validity contract the real engine enforces
+        # at the door, so rejected_invalid semantics survive
+        prompt = np.asarray(req.prompt)
+        if prompt.ndim != 1 or prompt.size == 0:
+            raise ValueError("prompt must be a non-empty 1-D array")
+        if req.max_new < 1:
+            raise ValueError("max_new must be >= 1")
+        self._pending.append(req)
+
+    def cancel(self, uid) -> bool:
+        for req in self._pending:
+            if req.uid == uid:
+                self._pending.remove(req)
+                return True
+        return self._active.pop(uid, None) is not None
+
+    def occupancy(self) -> dict:
+        return {
+            "slots": self.slots,
+            "active": len(self._active),
+            "pending": len(self._pending),
+            "free_slots": self.slots - len(self._active),
+            "depth": len(self._active) + len(self._pending),
+            # an active row counts one emitted token, so gateway TTFT
+            # accounting fires exactly as it does on a real engine
+            "tokens": {uid: 1 for uid in self._active},
+        }
+
+    def prefix_peek(self, prompt) -> int:
+        return 0
+
+    def step(self) -> list:
+        from ..models.serving import Finished
+        finished = []
+        for uid in list(self._active):
+            slot = self._active[uid]
+            slot[0] -= 1
+            if slot[0] <= 0:
+                req = self._active.pop(uid)[1]
+                finished.append(Finished(
+                    uid=uid,
+                    tokens=np.asarray(req.prompt, np.int32),
+                    n_prompt=int(np.asarray(req.prompt).size)))
+        while self._pending and len(self._active) < self.slots:
+            req = self._pending.popleft()
+            self._active[req.uid] = [self.steps_per_request, req]
+        return finished
+
+
+def _pct(vals, q):
+    if not vals:
+        return 0.0
+    return float(np.percentile(np.asarray(vals), q))
+
+
+def control_plane_probe(pump_counts: tuple = (1, 2, 4),
+                        replicas: int = 4, slots: int = 8,
+                        n_requests: int = 2048,
+                        queue_capacity: int | None = None,
+                        trace_name: str = "bursty",
+                        offered_x: float = 20.0,
+                        slo_x: float = 8.0,
+                        prompt_len: int = 12,
+                        prefix_families: int = 16,
+                        seed: int = 0) -> dict:
+    """The ceiling sweep (module docstring).  ``offered_x`` is the
+    open-loop replay rate in calibrated-capacity multiples (keep it
+    ≥10: the point is a control-plane-bound run); ``slo_x`` scales
+    each request's SLO from the calibrated FULL-BACKLOG drain wall
+    (``n_requests x service_s``) and is generous on purpose — heavy
+    shedding here would measure deadline math, not decision
+    throughput.  Prompts cycle ``prefix_families`` shared heads so
+    router scoring and pump sharding do realistic work."""
+    from ..models.serving import Request
+    from .calibrate import calibrate_capacity
+    from .loadgen import load_trace, replay
+    from .replica import ReplicaManager
+    from .sharded import ShardedGateway
+
+    rng = np.random.default_rng(seed)
+    heads = [rng.integers(0, 1000, 8).astype(np.int32)
+             for _ in range(prefix_families)]
+
+    def one_prompt(i):
+        tail = rng.integers(0, 1000,
+                            max(prompt_len - 8, 2)).astype(np.int32)
+        return np.concatenate([heads[i % len(heads)], tail])
+
+    def reqs(tag, n):
+        return [Request(uid=f"{tag}{i}", prompt=one_prompt(i),
+                        max_new=1) for i in range(n)]
+
+    # TOTAL admission capacity held constant across pump counts (the
+    # per-pump bound is the total divided by the shard count), so the
+    # flatness comparison varies exactly one thing: how many pumps
+    # make the decisions
+    total_capacity = queue_capacity or max(n_requests // 4, 16)
+
+    def make_gw(n_pumps):
+        mgr = ReplicaManager(
+            lambda name: NullEngine(slots=slots),
+            replicas=replicas, depth_bound=slots)
+        return ShardedGateway(
+            mgr, pumps=n_pumps,
+            queue_capacity=max(total_capacity // n_pumps, 1),
+            seed=seed)
+
+    cal_n = min(n_requests, 512)
+    cap = calibrate_capacity(lambda: make_gw(1),
+                             lambda tag: reqs(tag, cal_n))
+    # SLO from the full-backlog drain wall: at 10-100x offered load
+    # the whole trace arrives nearly at once, so the meaningful
+    # deadline scale is "how long the backlog takes to drain", not
+    # one request's amortized service time
+    slo_s = slo_x * n_requests * cap.service_s
+    trace = load_trace(trace_name)
+
+    # warmup replay, discarded: the first replay in a process pays
+    # one-time costs (metric label creation, allocator warmth) that
+    # would otherwise land entirely on the first pump count and skew
+    # the flatness comparison
+    warm = reqs("warm_", n_requests)
+    replay(make_gw(pump_counts[0]), trace, offered_x=offered_x,
+           base_rps=cap.base_rps, make_request=lambda i: warm[i],
+           n_requests=n_requests, slo_s=slo_s)
+
+    levels = []
+    valid = True
+    for n_pumps in pump_counts:
+        gw = make_gw(n_pumps)
+        reqs_list = reqs(f"p{n_pumps}_", n_requests)
+        rep = replay(gw, trace, offered_x=offered_x,
+                     base_rps=cap.base_rps,
+                     make_request=lambda i: reqs_list[i],
+                     n_requests=n_requests, slo_s=slo_s)
+        wall = rep["wall_s"]
+        st = gw.stats()["outcomes"]
+        finished = [g for g in gw.outcomes.values()
+                    if g.status == "finished"]
+        attained = [g for g in finished
+                    if g.finished_s <= g.deadline_s]
+        waits_ms = [(g.dispatched_s - g.arrival_s) * 1000
+                    for g in finished if g.dispatched_s is not None]
+        accounted = (len(gw.outcomes) + len(gw.refused)
+                     == n_requests)
+        valid = valid and accounted
+        levels.append({
+            "pumps": n_pumps,
+            "wall_s": round(wall, 4),
+            "admissions_per_s": round(gw.admissions_total / wall, 1),
+            "routes_per_s": round(gw.routes_total / wall, 1),
+            "steps_per_s": round(rep["steps"] / wall, 1),
+            "finished": st.get("finished", 0),
+            "shed": st.get("shed_expired", 0),
+            "rejected": len(gw.refused),
+            "steals": gw.steals_total,
+            "goodput_rps": round(len(attained) / wall, 1),
+            "p99_queue_wait_ms": round(_pct(waits_ms, 99), 2),
+            "accounted": accounted,
+        })
+
+    goodputs = [lv["goodput_rps"] for lv in levels]
+    stress = max(levels, key=lambda lv: lv["admissions_per_s"])
+    return {
+        "pump_counts": list(pump_counts),
+        "replicas": replicas,
+        "slots": slots,
+        "requests_per_level": n_requests,
+        "trace": trace_name,
+        "offered_x": offered_x,
+        "base_rps": round(cap.base_rps, 1),
+        "slo_ms": round(slo_s * 1000, 1),
+        "levels": levels,
+        # the compact-line scalars: the best level's decision rates
+        # (the CEILING), and goodput flatness across the pump sweep
+        "admissions_per_s": stress["admissions_per_s"],
+        "routes_per_s": stress["routes_per_s"],
+        "goodput_flat_x": round(
+            min(goodputs) / max(max(goodputs), 1e-9), 3),
+        "valid": valid and all(g > 0 for g in goodputs),
+        "note": ("control-plane ceiling, NO-OP ENGINES: zero device "
+                 "compute or jax dispatch, so decisions/s isolates "
+                 "admission+routing+drain+metrics cost from model "
+                 "math; open-loop trace replay "
+                 f"({trace_name}) at {offered_x}x the null pool's "
+                 "self-calibrated capacity; goodput_flat_x = min/max "
+                 "goodput across the pump sweep (sharding must be "
+                 "scheduling, not a tax)"),
+    }
+
+
+__all__ = ["NullEngine", "control_plane_probe"]

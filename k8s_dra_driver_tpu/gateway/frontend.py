@@ -29,12 +29,27 @@ The pump is deliberately single-threaded and clock-injected: one
 ``step()`` = shed, health-poll, dispatch, step-ready-replicas, account
 — bursty arrival tests and the bench probe drive it with real or fake
 clocks without concurrency nondeterminism.
+
+Event model (cluster/bus.py): the pump OWNS an :class:`EventBus`.
+Engine prefix-cache hits/misses arrive as events (published by the
+``PrefixCache.stats_listeners`` tap wired at replica spawn) and fold
+into the fleet-wide counters at O(events) per step — the old
+per-step walk of every engine's ``stats()`` totals
+(``_scrape_engine_stats``) is gone, so a quiet pool costs nothing to
+account.  The pump publishes ``drain`` and ``demand`` events that the
+fleet reconciler (and any other observer) subscribes to instead of
+re-reading the metrics registry.  The bus is pumped synchronously at
+the end of ``step()``: events change WHEN bookkeeping happens inside
+a step, never outcomes.  N-pump sharding over one pool lives in
+gateway/sharded.py, which drives the phases below as separately
+callable pieces (``_shed``/``_dispatch``/``_account``).
 """
 
 from __future__ import annotations
 
 import time
 
+from ..cluster.bus import EventBus
 from ..models.serving import Finished, Request
 from ..utils import dispatch
 from ..utils.metrics import GatewayMetrics
@@ -64,7 +79,9 @@ class FleetGateway:
                  queue_capacity: int = 64,
                  metrics: GatewayMetrics | None = None,
                  clock=time.monotonic,
-                 auto_replace: bool = True):
+                 auto_replace: bool = True,
+                 bus: EventBus | None = None,
+                 pool_owner: bool = True):
         self.manager = manager
         self.router = router or PrefixAffinityRouter()
         self.queue = AdmissionQueue(queue_capacity)
@@ -80,10 +97,10 @@ class FleetGateway:
         #: per-replica dispatch attribution (utils/dispatch.py)
         self.per_replica = dispatch.Aggregator()
         self._steps = 0
-        # last-seen per-replica prefix counters, for the delta fold
-        # into the fleet-wide prefix metrics (replica names are never
-        # reused, so pruning to live names cannot alias)
-        self._prefix_seen: dict[str, tuple] = {}
+        #: control-plane throughput counters (the ceiling probe,
+        #: gateway/ctlprobe.py, divides these by wall time)
+        self.admissions_total = 0
+        self.routes_total = 0
         #: demand signals for the fleet reconciler: arrival-rate EWMA
         #: (updated once per pump step from the arrivals since the
         #: last one) and the signed SLO-margin EWMA over finished
@@ -92,20 +109,40 @@ class FleetGateway:
         self.slo_margin_ewma_s: float | None = None
         self._arrivals = 0
         self._rate_t = self.clock()
+        #: the event spine (module docstring).  ``pool_owner=False``
+        #: makes this a member pump of a ShardedGateway: the sharded
+        #: cycle owns the pool-level phases (health, replica stepping,
+        #: engine-event wiring, demand publication) and this pump only
+        #: sheds/dispatches its own shard.
+        self.bus = bus if bus is not None else EventBus()
+        self._pool_owner = pool_owner
+        if pool_owner:
+            self.metrics.pumps.set(1)
+            self.bus.subscribe("prefix", self._on_prefix_event)
+            for r in manager.replicas:
+                self._wire_replica(r)
+            listeners = getattr(manager, "spawn_listeners", None)
+            if listeners is not None:
+                listeners.append(self._wire_replica)
 
     # -- intake ----------------------------------------------------------
 
     def submit(self, req: Request,
-               slo_s: float | None = None) -> GatewayRequest:
+               slo_s: float | None = None, *,
+               extra_live: frozenset = frozenset()) -> GatewayRequest:
         """Admit or refuse; ALWAYS returns the request's gateway
         record with an explicit status (``queued`` or a terminal
         rejection) — refusal is a return value here, not an exception,
         because shedding under load is an outcome the caller must see,
-        not a bug."""
+        not a bug.  ``extra_live``: uids queued in SIBLING pump shards
+        (gateway/sharded.py), so the pool-wide duplicate contract
+        spans shards."""
         now = self.clock()
         self._arrivals += 1      # offered load counts refusals too
+        self.admissions_total += 1
         live = frozenset(
-            uid for r in self.manager.replicas for uid in r.in_flight)
+            uid for r in self.manager.replicas
+            for uid in r.in_flight) | extra_live
         try:
             g = self.queue.offer(req, now, slo_s=slo_s, live_uids=live)
         except AdmissionError as e:
@@ -144,13 +181,54 @@ class FleetGateway:
             self._rate_t = now
         # 1. shed-on-expired BEFORE dispatch: a dead-on-arrival-at-
         #    the-front request must never occupy a slot
-        for g in self.queue.shed_expired(now):
-            self._terminal(g, SHED_EXPIRED, done)
+        self._shed(now, done)
         # 2. health verdicts -> drain (stop dispatch, cancel, requeue)
         for replica in self.manager.poll_down():
             self._drain(replica)
         # 3. place what the pool can take; the rest stays queued
         #    (router returns None at the pool's depth bound)
+        self._dispatch(now, done)
+        # 4. advance every busy live replica — READY or DRAINING: a
+        #    gracefully draining replica (scale-down) must finish its
+        #    in-flight rows even though routers no longer feed it —
+        #    attributing its host dispatches to its name
+        for replica in list(self.manager.replicas):
+            if replica.state == DEAD or not replica.in_flight:
+                continue
+            with dispatch.track() as t:
+                finished = replica.step()
+            self.per_replica.add(replica.name, t)
+            self._account(replica, finished, done)
+        # 5. leases + gauges + event accounting: the bus delivers this
+        #    step's engine events (prefix hits/misses) into the
+        #    registry at O(events) cost, and the demand snapshot goes
+        #    out as an event for the reconciler to fold
+        self.manager.heartbeat()
+        self.metrics.queue_depth.set(len(self.queue))
+        counts = self.manager.counts()
+        for role, n in counts.pop("roles", {}).items():
+            self.metrics.replica_roles.labels(role=role).set(n)
+        for state, n in counts.items():
+            self.metrics.replicas.labels(state=state).set(n)
+        self._drain_migrations()
+        self.bus.publish("demand", queue_depth=len(self.queue),
+                         arrival_rate_rps=self.arrival_rate_rps,
+                         slo_margin_ewma_s=self.slo_margin_ewma_s)
+        self.bus.pump()
+        self._steps += 1
+        return done
+
+    # -- pump phases (gateway/sharded.py drives these separately) ---------
+
+    def _shed(self, now: float, done: list[GatewayRequest]) -> None:
+        """Phase 1: sweep expired queued requests into explicit
+        terminal SHED outcomes."""
+        for g in self.queue.shed_expired(now):
+            self._terminal(g, SHED_EXPIRED, done)
+
+    def _dispatch(self, now: float, done: list[GatewayRequest]) -> None:
+        """Phase 3: place what the pool can take; the rest stays
+        queued (router returns None at the pool's depth bound)."""
         while len(self.queue):
             g = self.queue.peek()
             target = self.router.route(g.request.prompt,
@@ -160,10 +238,10 @@ class FleetGateway:
             g = self.queue.pop(now)
             if g is None:
                 # the head expired AFTER this step's sweep — a drain
-                # victim phase 2 requeued past its deadline.  Shed it
-                # with the explicit status right now (never dispatch
-                # it dead, never crash the pump) and keep placing
-                # whatever live work sits behind it.
+                # victim requeued past its deadline.  Shed it with the
+                # explicit status right now (never dispatch it dead,
+                # never crash the pump) and keep placing whatever live
+                # work sits behind it.
                 for expired in self.queue.shed_expired(now):
                     self._terminal(expired, SHED_EXPIRED, done)
                 continue
@@ -179,30 +257,14 @@ class FleetGateway:
                 # request or a crashed pump
                 self._terminal(g, REJECTED_INVALID, done)
                 continue
+            self.routes_total += 1
             self.metrics.queue_wait_seconds.observe(now - g.arrival_s)
-        # 4. advance every busy live replica — READY or DRAINING: a
-        #    gracefully draining replica (scale-down) must finish its
-        #    in-flight rows even though routers no longer feed it —
-        #    attributing its host dispatches to its name
-        for replica in list(self.manager.replicas):
-            if replica.state == DEAD or not replica.in_flight:
-                continue
-            with dispatch.track() as t:
-                finished = replica.step()
-            self.per_replica.add(replica.name, t)
-            self._account(replica, finished, done)
-        # 5. leases + gauges + engine-level observability (prefix
-        #    effectiveness, KV migration) folded into the registry
-        self.manager.heartbeat()
-        self.metrics.queue_depth.set(len(self.queue))
-        counts = self.manager.counts()
-        for role, n in counts.pop("roles", {}).items():
-            self.metrics.replica_roles.labels(role=role).set(n)
-        for state, n in counts.items():
-            self.metrics.replicas.labels(state=state).set(n)
-        self._scrape_engine_stats()
-        self._steps += 1
-        return done
+
+    def pending(self) -> int:
+        """Queued (not yet dispatched) requests — the surface the
+        trace-replay loop (gateway/loadgen.py) polls, shared with
+        ShardedGateway."""
+        return len(self.queue)
 
     def run_until_idle(self, max_steps: int = 10_000
                        ) -> list[GatewayRequest]:
@@ -269,34 +331,41 @@ class FleetGateway:
         self.outcomes[g.uid] = g
         done.append(g)
 
-    def _scrape_engine_stats(self) -> None:
-        """Fold per-engine prefix-cache counters (hits/misses/bytes
-        reused) and the pool's KV-migration events into the gateway
-        registry as deltas — engine counters are lifetime totals, the
-        registry wants monotone fleet-wide counters, and replicas come
-        and go.  Runs at the end of every pump step, AFTER the
-        replicas stepped, so a retiring replica's last deltas are
-        never lost."""
-        live: dict[str, tuple] = {}
-        for r in self.manager.replicas:
-            stats = getattr(r.engine, "stats", None)
-            if stats is None:
-                continue
-            st = stats()
-            if "prefix_hits_total" not in st:
-                continue
-            cur = (st["prefix_hits_total"],
-                   st["prefix_misses_total"],
-                   st["prefix_bytes_reused_total"])
-            prev = self._prefix_seen.get(r.name, (0, 0, 0))
-            if cur[0] > prev[0]:
-                self.metrics.prefix_hits.inc(cur[0] - prev[0])
-            if cur[1] > prev[1]:
-                self.metrics.prefix_misses.inc(cur[1] - prev[1])
-            if cur[2] > prev[2]:
-                self.metrics.prefix_bytes_reused.inc(cur[2] - prev[2])
-            live[r.name] = cur
-        self._prefix_seen = live
+    def _wire_replica(self, replica: EngineReplica) -> None:
+        """Tap a replica's engine-level event sources into the bus.
+        Called for the initial pool and for every later spawn
+        (``ReplicaManager.spawn_listeners``), so per-step accounting
+        never has to walk the pool looking for newcomers.  Engines
+        without a PrefixCache (stubs, null engines) wire nothing —
+        and are therefore never touched by metrics accounting at all
+        (the O(events) contract tests/test_control_plane.py pins)."""
+        cache = getattr(replica.engine, "_prefix", None)
+        if cache is None or not hasattr(cache, "stats_listeners"):
+            return
+        name, bus = replica.name, self.bus
+        cache.stats_listeners.append(
+            lambda event, tokens, nbytes: bus.publish(
+                "prefix", replica=name, event=event,
+                tokens=tokens, nbytes=nbytes))
+
+    def _on_prefix_event(self, ev) -> None:
+        """Fold one engine prefix-cache event into the fleet-wide
+        counters — the O(events) replacement for the per-step
+        every-engine ``stats()`` scrape.  Totals stay equal to the
+        sum of engine counters because the events fire exactly where
+        those counters increment (``PrefixCache.longest_prefix``)."""
+        p = ev.payload
+        if p["event"] == "hit":
+            self.metrics.prefix_hits.inc()
+            if p["nbytes"]:
+                self.metrics.prefix_bytes_reused.inc(p["nbytes"])
+        elif p["event"] == "miss":
+            self.metrics.prefix_misses.inc()
+
+    def _drain_migrations(self) -> None:
+        """Fold the pool's KV-migration events into the registry —
+        already event-shaped (the migrator keeps a take-exactly-once
+        ledger), so the cost is O(migrations), not O(replicas)."""
         drain = getattr(self.manager, "drain_migration_events", None)
         if drain is not None:
             for wall_s, nbytes in drain():
@@ -324,6 +393,8 @@ class FleetGateway:
                 pass
             self.queue.requeue(g)
             self.metrics.requeued.inc()
+        self.bus.publish("drain", replica=replica.name,
+                         requeued=len(victims))
         if self.auto_replace:
             self.manager.replace(replica)
 

@@ -1,0 +1,199 @@
+"""Open-loop trace-replay load generation for the serving control
+plane.
+
+Until this module, every gateway/disagg probe generated arrivals
+inside the same thread it was measuring, evenly paced — closed-loop-
+ish Poisson-free traffic that can never expose a control-plane
+backlog, because a slow pump slows its own arrival generator.  The
+production evaluation discipline (Orca OSDI'22, DistServe OSDI'24,
+AlpaServe OSDI'23) is the opposite: **open-loop** arrivals whose
+times are fixed IN ADVANCE by a trace — a saturated pool delays
+nothing, the backlog is real, and overload converts into explicit
+shed/reject outcomes instead of silently stretched interarrivals.
+
+Traces are CHECKED-IN fixtures (``gateway/traces/*.json``), not
+runtime randomness: three canonical arrival shapes, each a unit-mean
+normalized interarrival sequence regenerable bit-for-bit from its
+recorded seed (pinned by tests/test_control_plane.py):
+
+- ``bursty``   — geometric bursts of near-simultaneous arrivals
+                 separated by long exponential gaps (the system-prompt
+                 burst pattern the affinity router exists for);
+- ``diurnal``  — sinusoidal rate modulation with exponential jitter
+                 (the day/night cycle compressed into one trace);
+- ``heavy_tail`` — Pareto(α=1.5) interarrivals, capped (flash crowds:
+                 most gaps tiny, a few enormous).
+
+Replay scales a trace by ``offered_x * base_rps`` where ``base_rps``
+comes from the shared calibration helper (gateway/calibrate.py), so
+"replayed bursty at 20x" is machine-relative and means the same thing
+in every artifact.  The ceiling probes run at 10–100x, where the
+control plane — not the engines — is the bottleneck by construction.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+import numpy as np
+
+TRACE_DIR = Path(__file__).parent / "traces"
+TRACE_NAMES = ("bursty", "diurnal", "heavy_tail")
+
+#: every fixture carries exactly these keys (schema pinned in
+#: tests/test_bench_smoke.py so a drifting fixture fails CI)
+TRACE_SCHEMA_KEYS = frozenset(
+    {"name", "kind", "seed", "n", "unit_mean", "interarrivals",
+     "note"})
+
+_FIXTURE_SEEDS = {"bursty": 7, "diurnal": 11, "heavy_tail": 13}
+_FIXTURE_N = 96
+
+
+def generate_trace(name: str, n: int = _FIXTURE_N,
+                   seed: int | None = None) -> dict:
+    """Regenerate a trace deterministically (the checked-in fixtures
+    are exactly ``generate_trace(name)`` — pinned in CI, so the
+    fixture files can always be audited against this code)."""
+    if seed is None:
+        seed = _FIXTURE_SEEDS[name]
+    rng = np.random.default_rng(seed)
+    if name == "bursty":
+        gaps: list[float] = []
+        while len(gaps) < n:
+            for _ in range(int(rng.integers(3, 9))):
+                gaps.append(float(rng.exponential(0.05)))
+            gaps.append(float(rng.exponential(4.0)))
+        arr = np.asarray(gaps[:n])
+    elif name == "diurnal":
+        i = np.arange(n)
+        rate = 1.0 + 0.8 * np.sin(2.0 * np.pi * i / n)
+        arr = rng.exponential(1.0, n) / np.maximum(rate, 0.2)
+    elif name == "heavy_tail":
+        arr = np.minimum(rng.pareto(1.5, n), 50.0)
+    else:
+        raise ValueError(f"unknown trace {name!r}; "
+                         f"have {TRACE_NAMES}")
+    arr = arr / arr.mean()          # unit mean: offered_x is exact
+    return {
+        "name": name,
+        "kind": "interarrival",
+        "seed": seed,
+        "n": n,
+        "unit_mean": 1.0,
+        "interarrivals": [round(float(g), 6) for g in arr],
+        "note": ("unit-mean normalized interarrivals; replay scales "
+                 "by offered_x * calibrated base_rps "
+                 "(gateway/calibrate.py); regenerable via "
+                 f"generate_trace({name!r})"),
+    }
+
+
+def load_trace(name: str) -> dict:
+    """Read a checked-in fixture and validate its schema."""
+    path = TRACE_DIR / f"{name}.json"
+    trace = json.loads(path.read_text())
+    missing = TRACE_SCHEMA_KEYS - set(trace)
+    if missing:
+        raise ValueError(f"trace {name!r} missing keys {missing}")
+    if not trace["interarrivals"]:
+        raise ValueError(f"trace {name!r} is empty")
+    return trace
+
+
+class VirtualClock:
+    """Injected time for hermetic, fully deterministic replays: the
+    gateway and the replay loop share one instance; ``sleep`` advances
+    it instead of blocking, so a replay with a virtual clock runs at
+    CPU speed with bit-identical scheduling across runs (the seeded-
+    bus determinism test rides this)."""
+
+    def __init__(self, t: float = 0.0, step_cost_s: float = 0.0):
+        self.t = t
+        # optional fixed cost charged per clock read — models a pump
+        # step taking nonzero time so overload math stays meaningful
+        # under virtual time
+        self.step_cost_s = step_cost_s
+
+    def __call__(self) -> float:
+        self.t += self.step_cost_s
+        return self.t
+
+    def sleep(self, dt: float) -> None:
+        if dt > 0:
+            self.t += dt
+
+
+def replay(gateway, trace: dict, *, offered_x: float,
+           base_rps: float, make_request, n_requests: int | None = None,
+           slo_s: float | None = None, clock=None, sleep=None,
+           max_steps: int = 500_000) -> dict:
+    """Replay ``trace`` open-loop through ``gateway`` (a FleetGateway
+    or ShardedGateway — anything with ``submit``/``step``/``pending``).
+
+    Arrival times are computed UP FRONT from the trace's interarrivals
+    at ``offered_x * base_rps`` and never adjusted: if the pump falls
+    behind, due arrivals are submitted in a burst on the next loop
+    iteration — exactly the backlog an open-loop harness exists to
+    create.  ``make_request(i)`` supplies the i-th request (the trace
+    cycles if ``n_requests`` exceeds its length).  With a
+    :class:`VirtualClock`, pass ``clock=vc`` and ``sleep=vc.sleep``
+    (and build the gateway with ``clock=vc``) for a deterministic
+    hermetic run; default is wall time.
+    """
+    import time as _time
+    clock = clock or _time.perf_counter
+    sleep = sleep or _time.sleep
+    gaps = trace["interarrivals"]
+    n = n_requests if n_requests is not None else len(gaps)
+    rate = offered_x * base_rps
+    t0 = clock()
+    sched, t = [], t0
+    for i in range(n):
+        t += gaps[i % len(gaps)] / rate
+        sched.append(t)
+    i = steps = 0
+    while True:
+        now = clock()
+        while i < n and now >= sched[i]:
+            gateway.submit(make_request(i), slo_s=slo_s)
+            i += 1
+        gateway.step()
+        steps += 1
+        if steps > max_steps:
+            raise RuntimeError(
+                f"trace replay not done after {max_steps} steps")
+        busy = gateway.pending() or any(
+            r.in_flight for r in gateway.manager.replicas)
+        if i >= n and not busy:
+            break
+        if i < n and not busy:
+            sleep(max(0.0, sched[i] - clock()))
+    return {
+        "trace": trace["name"],
+        "submitted": n,
+        "offered_x": offered_x,
+        "offered_rps": rate,
+        "wall_s": clock() - t0,
+        "steps": steps,
+    }
+
+
+def write_fixtures(directory: Path | None = None) -> list[Path]:
+    """(Re)write the checked-in fixtures from the generators — run
+    after changing a generator, never edit the JSON by hand."""
+    directory = directory or TRACE_DIR
+    directory.mkdir(parents=True, exist_ok=True)
+    out = []
+    for name in TRACE_NAMES:
+        path = directory / f"{name}.json"
+        path.write_text(json.dumps(generate_trace(name), indent=1)
+                        + "\n")
+        out.append(path)
+    return out
+
+
+__all__ = ["TRACE_DIR", "TRACE_NAMES", "TRACE_SCHEMA_KEYS",
+           "VirtualClock", "generate_trace", "load_trace", "replay",
+           "write_fixtures"]

@@ -56,6 +56,7 @@ def gateway_probe(replicas: int = 2, slots: int = 4,
 
     from ..models import TransformerConfig, init_params
     from ..models.serving import Request, ServingEngine
+    from .calibrate import calibrate_capacity
     from .frontend import FleetGateway
     from .replica import ReplicaManager
     from .router import PrefixAffinityRouter
@@ -90,23 +91,15 @@ def gateway_probe(replicas: int = 2, slots: int = 4,
                                        prefix_cache=prefix_cache),
             replicas=replicas, depth_bound=slots)
 
-    # -- warmup then calibration -----------------------------------------
-    # Two all-at-once drains: the first pays every compile (fill
-    # groups, suffix fills, decode programs), the second measures the
-    # pool's warm drain rate — calibrating on the compile drain once
-    # under-read capacity ~4x and made every sweep level sub-capacity.
-    for tag in ("w", "c"):
-        gw = FleetGateway(pool(), router=PrefixAffinityRouter(),
-                          queue_capacity=queue_capacity
-                          or 4 * n_requests)
-        for req in requests(tag, n_requests):
-            gw.submit(req)
-        t0 = time.perf_counter()
-        gw.run_until_idle()
-        cal_wall = time.perf_counter() - t0
-    base_rps = n_requests / cal_wall
-    service_s = cal_wall / n_requests
-    slo_s = slo_x * service_s
+    # -- warmup then calibration (the SHARED helper, so every probe's
+    # "Nx offered load" means the same thing: gateway/calibrate.py) --
+    cap = calibrate_capacity(
+        lambda: FleetGateway(pool(), router=PrefixAffinityRouter(),
+                             queue_capacity=queue_capacity
+                             or 4 * n_requests),
+        lambda tag: requests(tag, n_requests))
+    base_rps = cap.base_rps
+    slo_s = cap.slo_s(slo_x)
 
     # -- the sweep -------------------------------------------------------
     out_levels = []

@@ -202,18 +202,32 @@ class ReplicaManager:
         # idea but a separate count: a retire is a decision, not a
         # failure, and the two must stay distinguishable in metrics
         self._retired = 0
+        #: ``listener(replica)`` fired for every spawn (initial pool,
+        #: replace, add_replica) — how the gateway wires per-engine
+        #: event taps (prefix-cache stats listeners) without walking
+        #: the pool every step looking for newcomers
+        self.spawn_listeners: list[Callable] = []
         self.replicas: list[EngineReplica] = [
             self._spawn() for _ in range(replicas)]
+
+    def _notify_spawn(self, replica: EngineReplica) -> None:
+        for cb in self.spawn_listeners:
+            try:
+                cb(replica)
+            except Exception:
+                pass            # a broken tap must not fail a spawn
 
     def _spawn(self, role: str = ROLE_UNIFIED) -> EngineReplica:
         name = f"r{next(self._gen)}"
         lease = self.lease_factory(name) if self.lease_factory else None
         if lease is not None:
             lease.acquire()
-        return EngineReplica(
+        replica = EngineReplica(
             name, self.engine_factory(name),
             chip=self._chip_of(name), lease=lease,
             depth_bound=self.depth_bound, role=role)
+        self._notify_spawn(replica)
+        return replica
 
     @property
     def ready_replicas(self) -> list[EngineReplica]:

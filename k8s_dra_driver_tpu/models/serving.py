@@ -221,11 +221,26 @@ class PrefixCache:
         #: raising listener is isolated: observability must never
         #: break a fill.
         self.listeners: list = []
+        #: ``listener(event, tokens, nbytes)`` with event in {"hit",
+        #: "miss"}, fired exactly where the hit/miss counters above
+        #: increment — how the gateway keeps its fleet-wide prefix
+        #: metrics at O(events) per pump step instead of scraping
+        #: every engine's totals every step (cluster/bus.py).  Same
+        #: isolation contract as ``listeners``.
+        self.stats_listeners: list = []
 
     def _notify(self, event: str, key: tuple) -> None:
         for cb in self.listeners:
             try:
                 cb(event, key)
+            except Exception:
+                pass
+
+    def _notify_stats(self, event: str, tokens: int,
+                      nbytes: int) -> None:
+        for cb in self.stats_listeners:
+            try:
+                cb(event, tokens, nbytes)
             except Exception:
                 pass
 
@@ -264,10 +279,13 @@ class PrefixCache:
         best_p, best_key = self._best_match(prompt)
         if best_key is None:
             self.misses += 1
+            self._notify_stats("miss", 0, 0)
             return 0, None
         self.hits += 1
         self.tokens_reused += best_p
         self.bytes_reused += best_p * self.bytes_per_token
+        self._notify_stats("hit", best_p,
+                           best_p * self.bytes_per_token)
         self._touch(best_key)
         return best_p, self._store[best_key]
 

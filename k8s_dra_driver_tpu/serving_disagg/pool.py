@@ -194,6 +194,7 @@ class DisaggReplicaManager(ReplicaManager):
         prefix = getattr(replica.engine, "_prefix", None)
         if prefix is not None:
             self.index.attach(name, prefix)
+        self._notify_spawn(replica)
         return replica
 
     # -- the handoff (prefill -> decode) ---------------------------------

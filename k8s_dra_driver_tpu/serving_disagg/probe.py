@@ -55,6 +55,7 @@ def disagg_probe(prefill_replicas: int = 1, decode_replicas: int = 2,
     import jax
 
     from ..gateway import FleetGateway, ReplicaManager
+    from ..gateway.calibrate import calibrate_capacity
     from ..gateway.router import PrefixAffinityRouter
     from ..models import TransformerConfig, init_params
     from ..models.serving import Request, ServingEngine
@@ -100,19 +101,16 @@ def disagg_probe(prefill_replicas: int = 1, decode_replicas: int = 2,
                                  router=DisaggRouter(mgr.index),
                                  queue_capacity=4 * n_requests)
 
-    # -- warmup + calibration (gateway/probe.py discipline): the first
-    # drain pays every compile, the second measures the warm unified
-    # drain rate the offered level is set against
-    for _ in range(2):
-        _, gw = unified()
-        for req in reqs:
-            gw.submit(req)
-        t0 = time.perf_counter()
-        gw.run_until_idle()
-        cal_wall = time.perf_counter() - t0
-    base_rps = n_requests / cal_wall
-    service_s = cal_wall / n_requests
-    slo_s = slo_x * service_s
+    # -- warmup + calibration (the SHARED helper, gateway/calibrate.py:
+    # the first drain pays every compile, the last measures the warm
+    # unified drain rate the offered level is set against)
+    def cal_reqs(tag):
+        return [Request(uid=f"{tag}{r.uid}", prompt=r.prompt,
+                        max_new=r.max_new) for r in reqs]
+
+    cap = calibrate_capacity(lambda: unified()[1], cal_reqs)
+    base_rps = cap.base_rps
+    slo_s = cap.slo_s(slo_x)
     # pay the disagg pool's compiles (adopt/export programs) outside
     # the measured run too
     _, gw = disagg()

@@ -141,6 +141,17 @@ class GatewayMetrics:
             "tpu_gateway_replica_role",
             "Live replicas by role (unified/prefill/decode)",
             ["role"], registry=self.registry)
+        # sharded control plane (gateway/sharded.py): how many
+        # admission/routing pumps serve this pool, and how often the
+        # work-stealing spill moved a queued request off a hot shard
+        self.pumps = Gauge(
+            "tpu_gateway_pumps",
+            "Admission/routing pumps sharding this gateway",
+            registry=self.registry)
+        self.steals = Counter(
+            "tpu_gateway_steals_total",
+            "Queued requests moved between pump shards by "
+            "work-stealing", registry=self.registry)
         # demand gauges the fleet reconciler ticks on
         # (fleet/reconciler.py): arrival-rate EWMA over pump steps and
         # the signed SLO-margin EWMA over finished SLO-bearing

@@ -201,6 +201,58 @@ def test_probe_roster_pins_fleet_scalars():
     assert keys["fleet_regrow_ms"] == "regrow_ms"
 
 
+def test_control_plane_probe_tiny():
+    """The control-plane ceiling probe at the hermetic shape bench.py
+    pins (TINY_CTL_KWARGS): no-op engines, open-loop trace replay,
+    pump-count sweep — every arrival accounted, the decision-rate
+    scalars land, and goodput stays positive at every pump count."""
+    from k8s_dra_driver_tpu.gateway.ctlprobe import control_plane_probe
+    out = control_plane_probe(**bench.TINY_CTL_KWARGS)
+    assert out["valid"] is True
+    assert out["trace"] == "bursty"
+    assert out["base_rps"] > 0
+    # the compact-line scalars (bench._PROBE_SCALARS picks these up)
+    assert out["admissions_per_s"] > 0
+    assert out["routes_per_s"] > 0
+    assert 0 < out["goodput_flat_x"] <= 1.0
+    assert [lv["pumps"] for lv in out["levels"]] \
+        == list(bench.TINY_CTL_KWARGS["pump_counts"])
+    n = bench.TINY_CTL_KWARGS["n_requests"]
+    for lv in out["levels"]:
+        assert lv["accounted"] is True
+        assert lv["finished"] + lv["shed"] + lv["rejected"] == n
+        assert lv["goodput_rps"] > 0
+    assert "no-op engines" in out["note"].lower() \
+        or "NO-OP ENGINES" in out["note"]
+
+
+def test_probe_roster_pins_control_plane_scalars():
+    """Bench-line schema: the control-plane ceiling scalars
+    (admissions/s, route decisions/s, goodput flatness across the
+    pump sweep) are IN the compact line roster."""
+    probes = [p for p, _, _ in bench._PROBE_SCALARS]
+    assert "control_plane" in probes
+    keys = {k: f for _, k, f in bench._PROBE_SCALARS}
+    assert keys["ctl_admissions_per_s"] == "admissions_per_s"
+    assert keys["ctl_routes_per_s"] == "routes_per_s"
+    assert keys["ctl_goodput_flat_x"] == "goodput_flat_x"
+
+
+def test_loadgen_trace_fixture_schema():
+    """The checked-in trace fixtures bench's ctl probe replays: every
+    fixture parses, carries exactly the pinned schema keys, and is
+    regenerable bit-for-bit from its recorded seed."""
+    from k8s_dra_driver_tpu.gateway.loadgen import (TRACE_NAMES,
+                                                    TRACE_SCHEMA_KEYS,
+                                                    generate_trace,
+                                                    load_trace)
+    assert set(TRACE_NAMES) == {"bursty", "diurnal", "heavy_tail"}
+    for name in TRACE_NAMES:
+        t = load_trace(name)
+        assert set(t) == set(TRACE_SCHEMA_KEYS), name
+        assert t == generate_trace(name), name
+
+
 def test_probe_roster_pins_gateway_scalars():
     """Bench-line schema: the gateway sweep's judge-facing scalars
     (goodput, SLO attainment, stress p99 queue wait) are IN the

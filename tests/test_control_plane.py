@@ -1,0 +1,437 @@
+"""Async event-driven control plane (ISSUE 7): the event bus
+(cluster/bus.py), the sharded gateway (gateway/sharded.py), the
+trace-replay load generator (gateway/loadgen.py), and the O(events)
+metrics path.
+
+The acceptance invariants: the PR 3 shape — kill a replica mid-stream
+— holds through 2 pumps under bursty TRACE-REPLAY arrivals
+(exactly-once, byte-equal to the single-engine oracle, drained
+requeues absorbed by the surviving capacity), and the whole cycle is
+seeded-deterministic: same seed → identical event order → identical
+terminal statuses.  The bus changes scheduling, never outcomes.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.cluster.bus import EventBus
+from k8s_dra_driver_tpu.cluster.faults import FaultPlan
+from k8s_dra_driver_tpu.gateway import (FleetGateway, NullEngine,
+                                        ReplicaManager, ShardedGateway)
+from k8s_dra_driver_tpu.gateway.admission import AdmissionQueue
+from k8s_dra_driver_tpu.gateway.loadgen import (TRACE_NAMES,
+                                                TRACE_SCHEMA_KEYS,
+                                                VirtualClock,
+                                                generate_trace,
+                                                load_trace, replay)
+from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                       greedy_generate, init_params)
+from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
+
+# Stall guard (tests/conftest.py): replica kills + replay loops must
+# fail in seconds if a regression turns one into a hang.
+pytestmark = pytest.mark.timeout_s(300)
+
+# the exact test_gateway.py shape, so jit programs are shared when the
+# modules run in one process
+CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_head=8, d_ff=64, max_seq=48, n_kv_heads=2,
+                        dtype=jnp.float32)
+
+_PARAMS = None
+
+
+def params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+    return _PARAMS
+
+
+def prompt(seed, n):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab), np.int32)
+
+
+def oracle(pr, n_new):
+    out = greedy_generate(params(), jnp.asarray(pr)[None, :], CFG,
+                          n_tokens=n_new)
+    return np.asarray(out[0], np.int32)
+
+
+def make_req(uid, seed, n_prompt, max_new):
+    return Request(uid=uid, prompt=prompt(seed, n_prompt),
+                   max_new=max_new)
+
+
+def real_pool(replicas=2, slots=2, **kw):
+    return ReplicaManager(
+        lambda name: ServingEngine(params(), CFG, slots=slots),
+        replicas=replicas, **kw)
+
+
+def null_pool(replicas=2, slots=4, **kw):
+    return ReplicaManager(lambda name: NullEngine(slots=slots),
+                          replicas=replicas, depth_bound=slots, **kw)
+
+
+# -- the event bus (pure host logic) ---------------------------------------
+
+class TestEventBus:
+    def test_fifo_delivery_and_journal(self):
+        bus = EventBus(seed=1)
+        seen = []
+        bus.subscribe("a", lambda ev: seen.append(("a", ev.payload)))
+        bus.subscribe("b", lambda ev: seen.append(("b", ev.payload)))
+        bus.publish("a", x=1)
+        bus.publish("b", x=2)
+        bus.publish("a", x=3)
+        assert seen == []               # nothing delivered at publish
+        assert bus.pump() == 3
+        assert seen == [("a", {"x": 1}), ("b", {"x": 2}),
+                        ("a", {"x": 3})]
+        assert bus.journal_topics() == ["a", "b", "a"]
+
+    def test_cascades_settle_in_one_pump(self):
+        bus = EventBus()
+        seen = []
+
+        def chain(ev):
+            seen.append(ev.payload["n"])
+            if ev.payload["n"] < 3:
+                bus.publish("t", n=ev.payload["n"] + 1)
+
+        bus.subscribe("t", chain)
+        bus.publish("t", n=1)
+        assert bus.pump() == 3
+        assert seen == [1, 2, 3]
+
+    def test_raising_subscriber_is_isolated(self):
+        bus = EventBus()
+        seen = []
+        bus.subscribe("t", lambda ev: 1 / 0)
+        bus.subscribe("t", lambda ev: seen.append(ev.seq))
+        bus.publish("t")
+        bus.pump()
+        assert seen == [0] and bus.errors == 1
+
+    def test_seeded_shuffle_replays(self):
+        a = [EventBus(seed=5).shuffle(range(8)) for _ in range(2)]
+        assert a[0] == a[1]
+        # consecutive draws from ONE bus follow the seeded stream
+        bus1, bus2 = EventBus(seed=9), EventBus(seed=9)
+        assert [bus1.shuffle(range(6)) for _ in range(4)] \
+            == [bus2.shuffle(range(6)) for _ in range(4)]
+
+
+# -- queue verbs for sharding ----------------------------------------------
+
+class TestShardQueueVerbs:
+    def test_steal_newest_keeps_fifo_head(self):
+        q = AdmissionQueue(capacity=4)
+        for uid in ("a", "b", "c"):
+            q.offer(Request(uid=uid, prompt=np.ones(3, np.int32),
+                            max_new=1), 0.0)
+        g = q.steal_newest()
+        assert g.uid == "c"
+        assert q.uids() == ["a", "b"]
+        q2 = AdmissionQueue(capacity=1)     # adopt ignores capacity
+        q2.offer(Request(uid="x", prompt=np.ones(3, np.int32),
+                         max_new=1), 0.0)
+        q2.adopt(g)
+        assert q2.uids() == ["x", "c"]
+
+
+# -- trace fixtures + open-loop replay -------------------------------------
+
+class TestTraces:
+    def test_fixtures_match_their_generators(self):
+        """The checked-in fixtures are exactly generate_trace(name) —
+        auditable, never hand-edited."""
+        for name in TRACE_NAMES:
+            assert load_trace(name) == generate_trace(name), name
+
+    def test_fixture_schema_and_unit_mean(self):
+        for name in TRACE_NAMES:
+            t = load_trace(name)
+            assert set(t) == set(TRACE_SCHEMA_KEYS)
+            gaps = np.asarray(t["interarrivals"])
+            assert gaps.size == t["n"] and (gaps >= 0).all()
+            assert abs(gaps.mean() - 1.0) < 1e-3
+        # the shapes are genuinely different: bursty/heavy-tail have
+        # far higher interarrival variance than the diurnal cycle
+        cv = {n: float(np.std(load_trace(n)["interarrivals"]))
+              for n in TRACE_NAMES}
+        assert cv["bursty"] > cv["diurnal"]
+        assert cv["heavy_tail"] > cv["diurnal"]
+
+    def test_replay_is_open_loop(self):
+        """Arrival times come from the trace, not from completions: a
+        saturated null pool still receives every submission, and the
+        overflow converts to explicit rejections — never stretched
+        interarrivals."""
+        vc = VirtualClock(step_cost_s=0.0001)
+        mgr = null_pool(replicas=1, slots=1)
+        gw = ShardedGateway(mgr, pumps=1, queue_capacity=2,
+                            clock=vc, seed=0)
+        trace = load_trace("bursty")
+        n = 32
+        reqs = [Request(uid=f"o{i}", prompt=np.arange(4, i + 5,
+                                                      dtype=np.int32)
+                        [:4], max_new=1) for i in range(n)]
+        out = replay(gw, trace, offered_x=50.0, base_rps=100.0,
+                     make_request=lambda i: reqs[i], n_requests=n,
+                     slo_s=None, clock=vc, sleep=vc.sleep)
+        assert out["submitted"] == n
+        # every arrival reached a terminal record: finished or an
+        # explicit refusal (the open-loop overflow)
+        assert len(gw.outcomes) + len(gw.refused) == n
+        assert len(gw.refused) > 0      # the pool really saturated
+
+
+# -- O(events) metrics accounting (the ISSUE 7 small fix) ------------------
+
+class _CountingEngine(NullEngine):
+    """A null engine that counts stats() calls — the pin that the
+    per-step accounting no longer walks engines."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.stats_calls = 0
+
+    def stats(self):
+        self.stats_calls += 1
+        return {"prefix_hits_total": 0, "prefix_misses_total": 0,
+                "prefix_bytes_reused_total": 0}
+
+
+def test_pump_step_cost_is_o_events_not_o_replicas():
+    """REGRESSION PIN (ISSUE 7 small fix): the gateway used to call
+    every engine's stats() every pump step to delta-fold prefix
+    counters; with the event bus, a step with no prefix events calls
+    stats() ZERO times regardless of pool size."""
+    mgr = ReplicaManager(lambda name: _CountingEngine(slots=2),
+                         replicas=8, depth_bound=2)
+    gw = FleetGateway(mgr, queue_capacity=8)
+    for _ in range(25):
+        gw.step()
+    assert sum(r.engine.stats_calls for r in mgr.replicas) == 0
+
+
+def test_prefix_counters_still_equal_engine_totals():
+    """The event path reports the same fleet-wide totals the scrape
+    did: gateway counters == sum of engine PrefixCache counters after
+    a shared-prefix drain (events fire where the counters increment,
+    so they cannot drift)."""
+    rng = np.random.default_rng(0)
+    pre = rng.integers(0, CFG.vocab, 8).astype(np.int32)
+    mgr = ReplicaManager(
+        lambda name: ServingEngine(params(), CFG, slots=2,
+                                   prefix_cache=2), replicas=2)
+    gw = ShardedGateway(mgr, pumps=2, queue_capacity=16, seed=0)
+    for i in range(5):
+        tail = rng.integers(0, CFG.vocab, 4).astype(np.int32)
+        gw.submit(Request(uid=f"u{i}",
+                          prompt=np.concatenate([pre, tail]),
+                          max_new=2))
+    gw.run_until_idle()
+    text = gw.metrics.render().decode()
+    hits = int(re.search(
+        r"tpu_gateway_prefix_hits_total (\d+)\.0", text).group(1))
+    reused = int(float(re.search(
+        r"tpu_gateway_prefix_bytes_reused_total (\d+)\.0",
+        text).group(1)))
+    eng_hits = sum(r.engine.stats().get("prefix_hits_total", 0)
+                   for r in mgr.replicas)
+    eng_reused = sum(
+        r.engine.stats().get("prefix_bytes_reused_total", 0)
+        for r in mgr.replicas)
+    assert hits == eng_hits and hits >= 1
+    assert reused == eng_reused and reused > 0
+
+
+# -- sharded pump semantics ------------------------------------------------
+
+def test_door_spill_keeps_hot_shard_from_rejecting_early():
+    """A full home shard spills to the least-loaded sibling with room;
+    reject-on-full fires only when the TIER is full."""
+    vc = VirtualClock()
+    mgr = null_pool(replicas=1, slots=1)
+    gw = ShardedGateway(mgr, pumps=2, queue_capacity=2, clock=vc,
+                        steal=False, seed=0)
+    pr = np.arange(6, dtype=np.int32)     # one prompt -> one shard
+    records = [gw.submit(Request(uid=f"s{i}", prompt=pr.copy(),
+                                 max_new=1)) for i in range(5)]
+    # 4 queued (2 home + 2 spilled), the 5th rejected explicitly
+    assert [g.status for g in records[:4]] == ["queued"] * 4
+    assert records[4].status == "rejected_full"
+    assert sorted(len(p.queue) for p in gw.pumps) == [2, 2]
+
+
+def test_work_stealing_drains_a_hot_shard():
+    """All traffic hashes to one pump; the idle pump steals the
+    backlog tail instead of idling while the pool has capacity."""
+    vc = VirtualClock(step_cost_s=0.0001)
+    mgr = null_pool(replicas=2, slots=2)
+    gw = ShardedGateway(mgr, pumps=2, queue_capacity=16, clock=vc,
+                        seed=3)
+    pr = np.arange(8, dtype=np.int32)
+    for i in range(10):                   # same prompt head: one shard
+        gw.submit(Request(uid=f"w{i}",
+                          prompt=np.concatenate(
+                              [pr, np.asarray([i], np.int32)]),
+                          max_new=1))
+    gw.run_until_idle()
+    assert gw.steals_total > 0
+    assert gw.stats()["steals"] == gw.steals_total
+    assert len(gw.outcomes) == 10
+    assert all(g.status == "finished" for g in gw.outcomes.values())
+    m = re.search(r"tpu_gateway_steals_total (\d+)\.0",
+                  gw.metrics.render().decode())
+    assert int(m.group(1)) == gw.steals_total
+
+
+def test_sharded_matches_single_pump_byte_equal():
+    """Pump count is scheduling, never math: the same workload through
+    1 and 2 pumps finishes byte-identical."""
+    def drain(n_pumps):
+        gw = ShardedGateway(real_pool(replicas=2), pumps=n_pumps,
+                            queue_capacity=16, seed=0)
+        for i in range(6):
+            gw.submit(make_req(f"m{i}", 50 + i, 5 + (i % 2) * 3,
+                               3 + (i % 3)))
+        gw.run_until_idle()
+        return gw
+
+    one, two = drain(1), drain(2)
+    assert set(one.results) == set(two.results) == {
+        f"m{i}" for i in range(6)}
+    for uid in one.results:
+        np.testing.assert_array_equal(one.results[uid].tokens,
+                                      two.results[uid].tokens)
+
+
+# -- THE acceptance scenario (PR 3 shape, async sharded pump) --------------
+
+def _trace_burst_replay(gw, vc, reqs, slo_s):
+    """Drive ``gw`` with bursty TRACE-REPLAY arrivals on the shared
+    virtual clock (open-loop: arrival times fixed by the fixture)."""
+    trace = load_trace("bursty")
+    return replay(gw, trace, offered_x=4.0,
+                  base_rps=len(reqs) / 2.0,
+                  make_request=lambda i: reqs[i],
+                  n_requests=len(reqs), slo_s=slo_s,
+                  clock=vc, sleep=vc.sleep)
+
+
+def test_kill_replica_mid_stream_2_pumps_exactly_once_byte_equal():
+    """THE acceptance test re-run on the async sharded pump: 2 pumps
+    over 2 replicas, bursty trace-replay arrivals, r0 killed by an
+    injected fault after its first dispatch wave — every admitted
+    request finishes exactly once, byte-equal to the single-engine
+    oracle, and the drained requeues are absorbed by the surviving
+    capacity (they finish on live replicas, observable in metrics)."""
+    plan = FaultPlan.from_json({"rules": [
+        # the sharded cycle polls health ONCE per step regardless of
+        # pump count; skip past the pre-dispatch polls, then kill r0
+        # while its first wave is in flight
+        {"verb": "health", "kind": "Replica", "name": "r0",
+         "skip": 2, "times": 1, "error": "drop"}]})
+    vc = VirtualClock(step_cost_s=0.0005)
+    mgr = real_pool(replicas=2, fault_plan=plan)
+    gw = ShardedGateway(mgr, pumps=2, queue_capacity=32, clock=vc,
+                        seed=7)
+    reqs = [make_req(f"b{i}", 10 + i, 5 + (i % 2) * 3, 3 + (i % 3))
+            for i in range(11)]
+    _trace_burst_replay(gw, vc, reqs, slo_s=10_000.0)
+
+    # exactly once: every admitted uid has ONE terminal record
+    assert len(gw.refused) == 0
+    assert len(gw.outcomes) == len(reqs)
+    assert all(g.status == "finished" for g in gw.outcomes.values())
+    # byte-equal to the single-engine oracle, through the kill
+    for req in reqs:
+        np.testing.assert_array_equal(
+            gw.results[req.uid].tokens,
+            oracle(req.prompt, req.max_new),
+            err_msg=f"{req.uid} diverged from the oracle")
+    # the kill actually happened, and the requeues were absorbed:
+    # every drain victim finished on a replica that is still alive
+    st = gw.stats()
+    assert st["replicas"]["dead"] == 1
+    assert st["replicas"]["ready"] == 2          # replacement arrived
+    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
+    assert requeued, "fault fired before anything was in flight"
+    live = {r.name for r in mgr.replicas}
+    assert all(g.replica in live for g in requeued)
+    # both pumps carried traffic (the shard hash spread the uids)
+    by_pump = [0, 0]
+    for g in gw.outcomes.values():
+        by_pump[gw._shard(g.request.prompt)] += 1
+    assert all(n > 0 for n in by_pump), by_pump
+    text = gw.metrics.render().decode()
+    assert re.search(r"tpu_gateway_drains_total 1\.0", text)
+    m = re.search(r"tpu_gateway_requeued_total (\d+)\.0", text)
+    assert m and int(m.group(1)) == len(requeued)
+    # the drain rode the bus: the event journal shows it
+    assert "drain" in gw.bus.journal_topics()
+
+
+def test_same_seed_identical_event_order_and_outcomes():
+    """Seeded-bus determinism: the same chaos scenario run twice with
+    the same seed delivers the identical event sequence and identical
+    terminal statuses — `-m faults` runs replay."""
+    def run(seed):
+        plan = FaultPlan.from_json({"rules": [
+            {"verb": "health", "kind": "Replica", "name": "r0",
+             "skip": 2, "times": 1, "error": "drop"}]})
+        vc = VirtualClock(step_cost_s=0.0005)
+        mgr = real_pool(replicas=2, fault_plan=plan)
+        gw = ShardedGateway(mgr, pumps=2, queue_capacity=32,
+                            clock=vc, seed=seed)
+        reqs = [make_req(f"d{i}", 30 + i, 5 + (i % 2) * 3,
+                         3 + (i % 3)) for i in range(9)]
+        _trace_burst_replay(gw, vc, reqs, slo_s=10_000.0)
+        statuses = sorted((u, g.status, g.replica, g.requeues)
+                          for u, g in gw.outcomes.items())
+        return gw.bus.journal_topics(), statuses
+
+    ev_a, st_a = run(seed=11)
+    ev_b, st_b = run(seed=11)
+    assert ev_a == ev_b
+    assert st_a == st_b
+    assert "drain" in ev_a and "demand" in ev_a
+
+
+# -- reconciler on the bus -------------------------------------------------
+
+def test_reconciler_demand_rides_the_bus_not_the_registry():
+    """With a bus, the reconciler ticks on the pump's published demand
+    events and never re-reads the metrics registry."""
+    from k8s_dra_driver_tpu.fleet import ChipLedger, FleetReconciler
+
+    vc = VirtualClock(step_cost_s=0.001)
+    mgr = null_pool(replicas=1, slots=1)
+    gw = ShardedGateway(mgr, pumps=1, queue_capacity=8, clock=vc,
+                        seed=0)
+    rec = FleetReconciler(gw, None, ledger=ChipLedger([0, 1]),
+                          bus=gw.bus, clock=vc)
+    for i in range(6):
+        gw.submit(Request(uid=f"r{i}",
+                          prompt=np.arange(5, dtype=np.int32),
+                          max_new=1))
+    gw.step()                     # publishes + pumps a demand event
+    # prove the registry is NOT consulted on the bus path
+    rec.gateway = type("G", (), {"metrics": None,
+                                 "manager": gw.manager})()
+    d = rec._demand()
+    assert d.queue_depth > 0
+    assert d.arrival_rate_rps > 0
+    # and the tick publishes its own event onto the shared bus
+    rec.gateway = gw
+    rec.tick()
+    assert "reconciler_tick" in gw.bus.journal_topics()
