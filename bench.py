@@ -567,6 +567,14 @@ def _tpu_probes():
         return
     yield "devices", len(devs)
     yield "platform", platform
+    # Preflight truthfulness (r07 lesson): that round's live run
+    # "completed" but the tunnel presented platform=cpu with no TPU,
+    # and nothing in the line said so explicitly.  The boolean makes
+    # the three tunnel states distinguishable in the BENCH_r*.json
+    # trajectory: wedged tunnel = child cut at the deadline (no
+    # platform at all, tpu_child error), no chip = tpu_present false
+    # with platform "cpu", on-chip = tpu_present true.
+    yield "tpu_present", platform == "tpu"
     # Full-depth probes only on accelerators; the same chain sizes
     # on a CPU host would take hours (6000 x 4096^3 matmuls).
     on_accel = platform not in ("cpu", "none")
@@ -614,7 +622,8 @@ def _tpu_probes():
                 for b, t, h, i in shapes]
 
     # flash-vs-naive attention (compiled pallas, blocks from the
-    # pick_blocks autotune table); the CPU fallback uses a tiny
+    # ops/autotune.py table via pick_fwd_params); the CPU fallback
+    # uses a tiny
     # interpret-mode shape purely to keep the code path exercised
     # hermetically. Standard shape first, then the long-context
     # regime the kernel exists for.
@@ -1007,6 +1016,10 @@ def compact_summary(result: dict, sidecar: Path | None = None) -> dict:
     if "platform" in tpu:
         s["platform"] = str(tpu["platform"])[:12]
         s["devices"] = tpu.get("devices", 0)
+    # ALWAYS present, even when the probe child died before yielding
+    # a platform (the wedged-tunnel state): a missing platform must
+    # read as "no TPU this round", never be mistaken for on-chip
+    s["tpu_present"] = bool(tpu.get("tpu_present", False))
     errors: list[str] = []
     for name, obj in (("driver", drv), ("oop", oop),
                       ("rdv", rdv), ("tpu", tpu)):

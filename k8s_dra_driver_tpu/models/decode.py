@@ -34,7 +34,6 @@ import jax
 import jax.numpy as jnp
 
 from ..utils import dispatch
-from ..utils.flags import env_flag
 from .quant import ein, take_rows
 from .transformer import (Params, TransformerConfig, _dense_mlp, _moe_mlp,
                           rms_norm, rotary)
@@ -114,46 +113,6 @@ def _quantize_rows(x):
     return q, scale
 
 
-def _kernel_cached_attention(q, k_cache, v_cache, pos, t, cfg,
-                             k_scale, v_scale):
-    """int8-cache attention through the pallas flash kernel: the
-    causal trim is the kernel's absolute-position mask (q_offset=pos,
-    k_offset=0 — cache slots beyond the fill line are in the query's
-    future and mask out), GQA rides the kernel's native head routing,
-    and the dequant happens in VMEM (see _cached_attention)."""
-    from ..ops.flash_attention import (flash_block_attention,
-                                       normalize_flash_stats)
-    o, m, l = flash_block_attention(
-        q, k_cache, v_cache, pos, 0,
-        causal=True, scale=cfg.d_head ** -0.5,
-        window=cfg.attention_window or None,
-        k_scale=k_scale[..., 0], v_scale=v_scale[..., 0])
-    out, _ = normalize_flash_stats(o, m, l)
-    return out.astype(q.dtype)
-
-
-def _use_kv_kernel(pos) -> bool:
-    """OPT-IN gate for the int8-cache pallas flash-read path
-    (``_kernel_cached_attention``), the KV twin of
-    ``models/quant.py:_use_kernel`` and under the same discipline:
-    default OFF, ``TPU_KV_KERNEL=1`` enables (``0``/``""``/``false``
-    disable, read at TRACE time — flipping it later does not retrace
-    cached executables; fresh process per setting, as
-    tools/bench_int8.py does).
-
-    The artifact that justifies the gate: the r05 idle-machine capture
-    records the flash-read path at **0.188x** the bf16 baseline at
-    154M (tools/int8_decode_v5e.json ``int8_kv8_kernel`` — 2.87
-    ms/token where the XLA dequant path runs 0.44), a catastrophic
-    regression, while XLA's fused int8 read wins every clean capture.
-    ``TPU_QUANT_KERNEL=1`` (the weight-kernel opt-in) deliberately
-    does NOT enable this path: the two kernels fail independently and
-    a user opting into one must not silently get the other's 5x
-    slowdown.  The kernel also takes one scalar q_offset, so per-row
-    positions (continuous batching) always use the XLA path."""
-    return env_flag("TPU_KV_KERNEL") and jnp.ndim(pos) == 0
-
-
 def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
                       k_scale=None, v_scale=None):
     """q [B,T,H,D] at absolute positions pos..pos+T-1 against the full
@@ -175,20 +134,18 @@ def _cached_attention(q, k_cache, v_cache, pos, t, cfg,
     the int8 cache is a CAPACITY lever (the structural guarantee is
     storage: twice the batch x context per chip), not a speed one.
 
-    ``TPU_KV_KERNEL=1`` (opt-in; ``0``/unset disables, the same
-    parsing as TPU_QUANT_KERNEL so symmetric ``=0`` settings force
-    the pure-XLA path; read at TRACE time — flipping it later does
-    not retrace cached executables) routes the read through the
-    pallas flash kernel with in-VMEM dequantization
-    (ops/flash_attention.py k_scale/v_scale): HBM then streams int8
-    bytes by construction, insurance against an XLA dequant-fusion
-    regression.  Stays opt-in: every capture so far has XLA's fused
-    read beating it (the weight-quant lesson, models/quant.py
-    _use_kernel).
+    There is no pallas read path anymore: the gated int8-KV
+    flash-read kernel (``TPU_KV_KERNEL``) was RETIRED after shipping
+    disabled for two rounds — the r05 idle-machine capture recorded
+    it at 0.188x the bf16 baseline (2.87 ms/token vs the XLA dequant
+    path's 0.44 at 154M) while XLA's fused int8 read won every clean
+    capture; evidence and rationale in
+    tools/int8_kv_retirement_v5e.json (successor to the
+    ``int8_kv8_kernel`` rows of tools/int8_decode_v5e.json).  If a
+    future XLA dequant-fusion regression revives the need, rebuild
+    on the reworked fused-dequant kernels (models/quant.py) rather
+    than resurrecting the dead gate.
     """
-    if k_scale is not None and _use_kv_kernel(pos):
-        return _kernel_cached_attention(q, k_cache, v_cache, pos, t,
-                                        cfg, k_scale, v_scale)
     if k_scale is not None:
         k_cache = (k_cache.astype(jnp.float32)
                    * k_scale).astype(q.dtype)
@@ -626,7 +583,8 @@ def decode_fused_rows(params: Params, last: jax.Array,
     active_rows`` tokens instead of per token — the dispatch lever
     for continuous batching on high-latency (tunneled/remote)
     backends, where per-step RTT dominates the compiled step time
-    ~300x (BENCH_r05: 0.45 ms dispatch of every 0.80 ms wall step).
+    ~300x (BENCH_r05.json: 0.45 ms dispatch of every 0.80 ms wall
+    step).
 
     Per-row stop state rides as DATA: ``budget`` [B] is how many
     tokens each row may still emit (0 marks an inactive slot — it is

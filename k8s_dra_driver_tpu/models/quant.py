@@ -119,19 +119,31 @@ def quantize_for(spec: str, w: jax.Array) -> QTensor:
 # fuses into the dot's operand read or materializes the dequantized
 # weight through HBM; these kernels make the good case structural —
 # int8 blocks stream HBM->VMEM and convert in VMEM, so HBM sees half
-# of bf16's bytes by construction.  They stay OPT-IN
-# (``TPU_QUANT_KERNEL=1``): the XLA path's readings are stable and
-# win the weight-bound regime in every clean capture, while the
-# kernel's swing ~2.5x between captures on the tunneled chip
-# (tools/int8_decode_v5e.json provenance) — kept tested and
-# conformance-diffed as insurance against fusion regressions.
+# of bf16's bytes by construction.  Reworked for the recorded 660M
+# loss (pallas dequant 0.575x vs bf16 where XLA-int8 ran 1.61x,
+# tools/int8_decode_v5e.json): the per-channel rescale + downcast now
+# happen IN the kernel epilogue (the f32 [M, N] product never
+# round-trips HBM to meet its scale — that materialization was pure
+# kernel-side overhead the XLA path never paid), and the weight
+# tiles come from the ops/autotune.py table (``pick_int8_tiles``).
+# Still OPT-IN (``TPU_QUANT_KERNEL=1``): the XLA path's readings are
+# stable and win the weight-bound regime in every clean capture,
+# while the pre-rework kernel's swung ~2.5x between captures on the
+# tunneled chip — the reworked path's on-chip verdict (beat 1.4x at
+# 660M or retire, ROADMAP item 1) is owed to tools/bench_int8.py on
+# the next idle-chip round.
 # ------------------------------------------------------------------
 
-def _int8_matmul_kernel(x_ref, w_ref, o_ref, acc_scr, *, n_k: int):
+def _int8_matmul_kernel(x_ref, w_ref, s_ref, o_ref, acc_scr, *,
+                        n_k: int):
     """grid (..., n, k): k sequential innermost; x [.., M, bk],
-    w [.., bk, bn] int8, acc [M, bn] f32 written to o on the last k
-    step.  Used with both a 2-d grid (plain matmul) and a 3-d grid
-    with a leading expert dim (batched MoE matmul)."""
+    w [.., bk, bn] int8, s [.., 1, bn] f32 per-output-channel scales,
+    acc [M, bn] f32.  The last k step applies the FUSED epilogue:
+    ``o = (acc * s).astype(o.dtype)`` in VMEM — dequant-matmul and
+    rescale are one kernel, so HBM sees int8 weights in and model-
+    dtype outputs out, never the f32 accumulator.  Used with both a
+    2-d grid (plain matmul) and a 3-d grid with a leading expert dim
+    (batched MoE matmul)."""
     kk = pl.program_id(x_ref.ndim == 3 and 2 or 1)
 
     @pl.when(kk == 0)
@@ -146,10 +158,12 @@ def _int8_matmul_kernel(x_ref, w_ref, o_ref, acc_scr, *, n_k: int):
 
     @pl.when(kk == n_k - 1)
     def _done():
+        s = s_ref[0] if s_ref.ndim == 3 else s_ref[...]
+        out = (acc_scr[:] * s).astype(o_ref.dtype)
         if o_ref.ndim == 3:
-            o_ref[0] = acc_scr[:]
+            o_ref[0] = out
         else:
-            o_ref[...] = acc_scr[:]
+            o_ref[...] = out
 
 
 def _pad_to(x, axis, mult):
@@ -162,23 +176,45 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
+def pick_int8_tiles(m: int, k_dim: int, n_dim: int,
+                    dtype=jnp.bfloat16, batched: bool = False) -> dict:
+    """int8 weight tiles ``{"bk", "bn"}`` from the autotune table
+    (ops/autotune.py; recorded by tools/bench_autotune.py), falling
+    back to the heuristic the r05 capture ran with: full-K tiles (up
+    to 2048) at decode-shaped M — deeper K per grid step means fewer
+    revolutions of the [M, bn] accumulator per output tile — clamped
+    to 512 past M=256 so the double-buffered x tile stays bounded."""
+    from ..ops.autotune import get_autotuner, shape_key
+
+    def default():
+        return {"bk": 2048 if m <= 256 else 512, "bn": 512}
+
+    key = shape_key(m=m, k=k_dim, n=n_dim)
+    kernel = "int8_bmm" if batched else "int8_matmul"
+    return dict(get_autotuner().pick(kernel, key, dtype,
+                                     default).params)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret", "bk", "bn"))
 def int8_matmul(x: jax.Array, q: jax.Array, scale: jax.Array,
-                interpret: bool | None = None, bk: int = 2048,
-                bn: int = 512) -> jax.Array:
+                interpret: bool | None = None, bk: int | None = None,
+                bn: int | None = None) -> jax.Array:
     """[M, K] @ [K, N] int8 -> [M, N] x.dtype, rescaled by ``scale``
-    [N]-broadcastable f32.  The weight is read from HBM as int8 and
-    converted in VMEM.  ``bk``/``bn`` pick the weight tile; the
-    default takes the full contraction (up to 2048) per tile —
-    deeper K per grid step means fewer revolutions of the [M, bn]
-    accumulator per output tile.  (The r05 int8 recapture runs with
-    these tiles; the kernel path's capture-to-capture variance is
-    documented at ``_use_kernel`` — no tile schedule measured so far
-    makes it reliably beat XLA's fused einsum.)"""
+    [N]-broadcastable f32.  The weight is read from HBM as int8,
+    converted in VMEM, and the per-channel rescale + downcast run as
+    the kernel's fused epilogue — the f32 product never visits HBM
+    (pre-rework, the [M, N] f32 output was materialized and rescaled
+    by a separate XLA op; at 660M decode shapes that extra f32
+    round-trip was kernel-path-only overhead).  ``bk``/``bn``
+    default to the autotune table via :func:`pick_int8_tiles`;
+    explicit values win (the sweep tool measures specific tiles)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     m, k_dim = x.shape
     n_dim = q.shape[1]
+    tiles = pick_int8_tiles(m, k_dim, n_dim, x.dtype)
+    bk = tiles["bk"] if bk is None else bk
+    bn = tiles["bn"] if bn is None else bn
     # the kernel holds ALL of M per grid step: at large M a 2048-deep
     # x tile would blow VMEM (the decode gate _KERNEL_MAX_M keeps the
     # model paths at M<=64, but the function is public) — clamp K
@@ -191,6 +227,9 @@ def int8_matmul(x: jax.Array, q: jax.Array, scale: jax.Array,
     # every input dtype
     xp = _pad_to(_pad_to(x, 0, 16), 1, bk)
     qp = _pad_to(_pad_to(q, 0, bk), 1, bn)
+    sp = _pad_to(jnp.broadcast_to(
+        jnp.asarray(scale, jnp.float32).reshape(1, -1),
+        (1, n_dim)), 1, bn)
     mp = xp.shape[0]
     n_k = xp.shape[1] // bk
     n_n = qp.shape[1] // bn
@@ -200,15 +239,16 @@ def int8_matmul(x: jax.Array, q: jax.Array, scale: jax.Array,
         in_specs=[
             pl.BlockSpec((mp, bk), lambda n, kk: (0, kk)),
             pl.BlockSpec((bk, bn), lambda n, kk: (kk, n)),
+            pl.BlockSpec((1, bn), lambda n, kk: (0, n)),
         ],
         out_specs=pl.BlockSpec((mp, bn), lambda n, kk: (0, n)),
-        out_shape=jax.ShapeDtypeStruct((mp, qp.shape[1]), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((mp, qp.shape[1]), x.dtype),
         scratch_shapes=[pltpu.VMEM((mp, bn), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(xp, qp)
-    return (out[:m, :n_dim] * scale).astype(x.dtype)
+    )(xp, qp, sp)
+    return out[:m, :n_dim]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -217,16 +257,19 @@ def int8_bmm(x: jax.Array, q: jax.Array, scale: jax.Array,
     """Batched [G, M, K] @ [G, K, N] int8 -> [G, M, N] x.dtype,
     rescaled by ``scale`` [G, 1, N] f32 — the expert-batched matmul of
     the quantized MoE decode path (one grid step per expert; int8
-    converted in VMEM, same as :func:`int8_matmul`)."""
+    converted in VMEM and the per-expert rescale fused into the
+    epilogue, same as :func:`int8_matmul`)."""
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     g, m, k_dim = x.shape
     n_dim = q.shape[2]
-    bk = 2048 if m <= 256 else 512           # full-K tiles, as above
-    bk = min(bk, -(-k_dim // 128) * 128)
-    bn = min(512, -(-n_dim // 128) * 128)
+    tiles = pick_int8_tiles(m, k_dim, n_dim, x.dtype, batched=True)
+    bk = min(tiles["bk"], -(-k_dim // 128) * 128)
+    bn = min(tiles["bn"], -(-n_dim // 128) * 128)
     xp = _pad_to(_pad_to(x, 1, 16), 2, bk)
     qp = _pad_to(_pad_to(q, 1, bk), 2, bn)
+    sp = _pad_to(jnp.broadcast_to(
+        jnp.asarray(scale, jnp.float32), (g, 1, n_dim)), 2, bn)
     mp = xp.shape[1]
     n_k = xp.shape[2] // bk
     n_n = qp.shape[2] // bn
@@ -236,16 +279,16 @@ def int8_bmm(x: jax.Array, q: jax.Array, scale: jax.Array,
         in_specs=[
             pl.BlockSpec((1, mp, bk), lambda e, n, kk: (e, 0, kk)),
             pl.BlockSpec((1, bk, bn), lambda e, n, kk: (e, kk, n)),
+            pl.BlockSpec((1, 1, bn), lambda e, n, kk: (e, 0, n)),
         ],
         out_specs=pl.BlockSpec((1, mp, bn), lambda e, n, kk: (e, 0, n)),
-        out_shape=jax.ShapeDtypeStruct((g, mp, qp.shape[2]),
-                                       jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((g, mp, qp.shape[2]), x.dtype),
         scratch_shapes=[pltpu.VMEM((mp, bn), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(xp, qp)
-    return (out[:, :m, :n_dim] * scale).astype(x.dtype)
+    )(xp, qp, sp)
+    return out[:, :m, :n_dim]
 
 
 def _as_2d_matmul(spec: str, x: jax.Array, w: QTensor):

@@ -65,7 +65,7 @@ def _greedy_draft(draft_params, draft_cfg, cache: KVCache, last,
     input).  Runs ``draft_len + 1`` steps so the cache also holds the
     LAST proposal's K/V — on a full accept the position advances past
     it, and a missing entry there would silently degrade every later
-    draft (it cost a 2x iteration count before this was caught).
+    draft (it doubled the iteration count before this was caught).
     Returns (proposals [B, draft_len], updated draft cache)."""
 
     def step(carry, _):

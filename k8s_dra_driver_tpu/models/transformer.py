@@ -85,8 +85,10 @@ class TransformerConfig:
     # Recorded v5e train-step medians, index-only dispatch rewrite
     # included (tools/moe_dispatch_v5e.json): capacity 3.55x
     # dense and gmm 2.58x at E16/dff4096; 1.37x vs 1.17x at E8 mixed.
-    # Guidance: default to "capacity" for throughput — it beats gmm
-    # at every recorded shape; reach for "gmm" when token drops are
+    # Guidance (docs/KERNELS.md owns the flip criterion): default to
+    # "capacity" for throughput — it beats gmm at every recorded
+    # shape (the tile-packing rework's on-chip verdict is owed);
+    # reach for "gmm" when token drops are
     # unacceptable, and expect ~18-38% slower steps than capacity
     # for that guarantee (17.8% at E8 mixed, 37.5% at E16 heavy, per
     # the artifact), plus the sharded static-bound caveat in
@@ -442,7 +444,18 @@ def _moe_mlp_capacity(x, gates, layer, cfg: TransformerConfig):
     return jnp.einsum("btec,becd->btd", combine, y)
 
 
-_GMM_BLOCK_M = 128
+def _gmm_block_m(rows: int, w_in) -> int:
+    """Row-block size for the grouped matmuls, from the autotune
+    table (ops/gmm.py:pick_gmm_blocks — blocked-mode experts take
+    bigger blocks to cut weight re-streaming; the dead-tail skip
+    keeps the extra per-group padding cheap).  ``rows`` is the routed
+    row count (tokens x top_k); the pick keys on w_in's [e, d, f] —
+    w_out shares the block size because both gmms share the one
+    group padding."""
+    from ..ops.gmm import pick_gmm_blocks
+
+    e, d, f = w_in.shape
+    return pick_gmm_blocks(d, f, e, w_in.dtype, rows=rows)["block_m"]
 
 
 def _gmm_dispatch_combine(xf, gate_vals, expert_ids, w_in, w_out, e,
@@ -517,7 +530,8 @@ def _moe_mlp_gmm(x, gates, layer, cfg: TransformerConfig):
     gate_vals, expert_ids = jax.lax.top_k(gates.reshape(b * t, e), k)
     out = _gmm_dispatch_combine(x.reshape(b * t, d), gate_vals,
                                 expert_ids, layer["w_in"],
-                                layer["w_out"], e, _GMM_BLOCK_M)
+                                layer["w_out"], e,
+                                _gmm_block_m(b * t * k, layer["w_in"]))
     return out.reshape(b, t, d)
 
 
@@ -553,7 +567,13 @@ def _moe_mlp_gmm_sharded(x, gates, layer, cfg: TransformerConfig,
             f"moe_dispatch='gmm' needs n_experts ({e}) divisible by "
             f"the ep axis ({ep})")
     e_local = e // ep
-    bm = _GMM_BLOCK_M
+    # per-shard routed rows: the ep-gathered batch slice x sequence
+    # shard x top_k (the autotune pick is static — computed here,
+    # outside the shard_map)
+    b, t = x.shape[0], x.shape[1]
+    rows = (b // mesh.shape.get("dp", 1)) \
+        * (t // mesh.shape.get("sp", 1)) * k
+    bm = _gmm_block_m(rows, layer["w_in"])
 
     def block(x_b, gates_b, w_in_b, w_out_b):
         xg = jax.lax.all_gather(x_b, "ep", axis=0, tiled=True)

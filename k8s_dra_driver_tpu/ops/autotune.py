@@ -1,0 +1,225 @@
+"""Block-shape/layout autotuner for the pallas kernel tier.
+
+The three recorded kernel losses (ROADMAP item 1) share a root cause:
+block shapes and layout choices were hand-picked per kernel from
+one-off sweeps, so every new shape class re-litigates the same
+"which blocks?" question with no measurement discipline attached.
+This module is the one place that question is answered:
+
+- a **table** of chosen parameters, keyed ``(kernel, shape-key,
+  dtype, backend)`` — the checked-in instance
+  (``tools/autotune_v5e.json``) carries the recorded v5e choices
+  (seeded from tools/attention_sweep_v5e.json and refreshed by
+  ``tools/bench_autotune.py`` on an idle chip);
+- a **runtime path** (:func:`pick`) that is a pure lookup + per-kernel
+  heuristic fallback — it never measures, so it is safe at trace time
+  (``pick_blocks`` runs while a caller's jit is tracing) and on the
+  interpret-mode CPU suite, which exercises the exact same selection
+  code the chip takes;
+- a **measurement path** (:meth:`Autotuner.tune`) using the
+  differential-median harness (``ops/collectives.py:measure_chain``):
+  every candidate timed over one compiled chain pair with artifact
+  rejection, best *valid* candidate recorded with all runs listed.
+  Only eager tools call this — never the kernels themselves.
+
+Backend keys are the device kind (``tpu-v5e``/``cpu``/...), so a v5e
+table never silently configures a v4, and the CPU suite falls through
+to the deterministic heuristics unless a test injects entries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import pathlib
+import re
+import threading
+from typing import Any, Callable
+
+_REPO = pathlib.Path(__file__).resolve().parent.parent.parent
+DEFAULT_TABLE_PATH = _REPO / "tools" / "autotune_v5e.json"
+
+#: env override so tests/tools can point the singleton elsewhere
+TABLE_ENV = "TPU_AUTOTUNE_TABLE"
+
+
+def backend_key() -> str:
+    """Normalized backend id for table keys: the platform, refined to
+    the device kind on accelerators (``tpu-v5e``), so tables recorded
+    on one chip generation never configure another."""
+    import jax
+
+    platform = jax.default_backend()
+    if platform == "cpu":
+        return "cpu"
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:
+        return platform
+    kind = re.sub(r"[^a-z0-9]+", "-", kind).strip("-")
+    # "tpu-v5-lite" is marketed (and recorded in every artifact here)
+    # as v5e; collapse the alias so keys match the artifact names
+    kind = kind.replace("v5-lite", "v5e").replace("v5-litepod", "v5e")
+    return kind if kind.startswith(platform) else f"{platform}-{kind}"
+
+
+def shape_key(**dims) -> str:
+    """Canonical shape-key fragment: sorted ``name=value`` pairs with
+    ``None`` normalized to 0, e.g. ``d=64,g=1,tk=2048,tq=2048,w=0``.
+    One spelling everywhere, so tools and kernels cannot drift."""
+    parts = []
+    for name in sorted(dims):
+        v = dims[name]
+        v = 0 if v is None else v
+        parts.append(f"{name}={v}")
+    return ",".join(parts)
+
+
+def table_key(kernel: str, key: str, dtype, backend: str) -> str:
+    import jax.numpy as jnp  # local: keep module import light
+
+    return "|".join([kernel, key, jnp.dtype(dtype).name, backend])
+
+
+@dataclasses.dataclass
+class Choice:
+    """One resolved selection: the parameters plus where they came
+    from (``measured`` = table hit, ``default`` = heuristic)."""
+
+    params: dict[str, Any]
+    source: str
+
+    def __getitem__(self, name):
+        return self.params[name]
+
+
+class Autotuner:
+    """Table owner.  ``lookup``/``pick`` are cheap and pure;
+    ``tune`` measures (eager only) and ``save`` persists."""
+
+    def __init__(self, path: os.PathLike | str | None = None):
+        self.path = pathlib.Path(path) if path else None
+        self.table: dict[str, dict] = {}
+        self._loaded = False
+        self._lock = threading.Lock()
+
+    def _ensure_loaded(self) -> None:
+        if self._loaded:
+            return
+        with self._lock:
+            if self._loaded:
+                return
+            if self.path and self.path.exists():
+                try:
+                    data = json.loads(self.path.read_text())
+                    self.table = dict(data.get("entries", {}))
+                except (ValueError, OSError):
+                    # a torn table must never take the kernels down —
+                    # heuristics are always a valid fallback
+                    self.table = {}
+            self._loaded = True
+
+    # -- runtime path --------------------------------------------------
+
+    def lookup(self, kernel: str, key: str, dtype,
+               backend: str | None = None) -> dict | None:
+        self._ensure_loaded()
+        backend = backend or backend_key()
+        entry = self.table.get(table_key(kernel, key, dtype, backend))
+        return dict(entry["params"]) if entry else None
+
+    def pick(self, kernel: str, key: str, dtype,
+             default: Callable[[], dict] | dict,
+             backend: str | None = None) -> Choice:
+        """Table hit wins; otherwise the kernel's deterministic
+        heuristic.  Never measures — safe under tracing and on the
+        interpret-mode suite (the same selection path, different
+        source tag)."""
+        hit = self.lookup(kernel, key, dtype, backend)
+        if hit is not None:
+            return Choice(hit, "measured")
+        params = default() if callable(default) else dict(default)
+        return Choice(params, "default")
+
+    # -- measurement path (eager tools only) ---------------------------
+
+    def tune(self, kernel: str, key: str, dtype,
+             candidates: list[dict],
+             measure: Callable[[dict], tuple[float, bool]],
+             backend: str | None = None) -> dict:
+        """Measure every candidate with ``measure(params) ->
+        (seconds, valid)`` (callers wrap measure_chain /
+        measure_chain_samples so the differential-median discipline
+        and artifact rejection apply), record the best *valid* one,
+        and return its params.  All runs are kept in the entry so a
+        recorded choice stays auditable.  With no valid run the
+        fastest invalid one is recorded ``valid=False`` — visible,
+        never silently promoted."""
+        if not candidates:
+            raise ValueError("tune() needs at least one candidate")
+        self._ensure_loaded()
+        backend = backend or backend_key()
+        runs = []
+        for params in candidates:
+            try:
+                seconds, valid = measure(dict(params))
+            except Exception as e:      # one bad candidate (VMEM blow,
+                runs.append({"params": params, "error":    # bad tile)
+                             f"{type(e).__name__}: {e}"[:300]})
+                continue                # must not void the sweep
+            runs.append({"params": params,
+                         "ms": round(seconds * 1000, 4),
+                         "valid": bool(valid)})
+        timed = [r for r in runs if "ms" in r]
+        if not timed:
+            raise RuntimeError(
+                f"every candidate errored for {kernel}|{key}: {runs}")
+        pool = [r for r in timed if r["valid"]] or timed
+        best = min(pool, key=lambda r: r["ms"])
+        entry = {"params": best["params"], "ms": best["ms"],
+                 "valid": best["valid"], "source": "measured",
+                 "runs": runs}
+        with self._lock:
+            self.table[table_key(kernel, key, dtype, backend)] = entry
+        return dict(best["params"])
+
+    def save(self, path: os.PathLike | str | None = None,
+             meta: dict | None = None) -> pathlib.Path:
+        self._ensure_loaded()
+        path = pathlib.Path(path or self.path)
+        payload = {
+            "what": ("autotune table: chosen block shapes/layouts per "
+                     "(kernel, shape, dtype, backend); consumed by "
+                     "ops/autotune.py pick(), recorded by "
+                     "tools/bench_autotune.py (differential-median "
+                     "harness, idle chip)"),
+            **(meta or {}),
+            "entries": self.table,
+        }
+        path.write_text(json.dumps(payload, indent=1) + "\n")
+        return path
+
+
+_SINGLETON: Autotuner | None = None
+_SINGLETON_LOCK = threading.Lock()
+
+
+def get_autotuner() -> Autotuner:
+    """Process-wide table (``tools/autotune_v5e.json`` unless
+    ``TPU_AUTOTUNE_TABLE`` points elsewhere — read once, at first
+    use)."""
+    global _SINGLETON
+    with _SINGLETON_LOCK:
+        if _SINGLETON is None:
+            path = os.environ.get(TABLE_ENV) or DEFAULT_TABLE_PATH
+            _SINGLETON = Autotuner(path)
+        return _SINGLETON
+
+
+def reset_autotuner() -> None:
+    """Drop the singleton (tests that point TPU_AUTOTUNE_TABLE at a
+    scratch table call this around the monkeypatch)."""
+    global _SINGLETON
+    with _SINGLETON_LOCK:
+        _SINGLETON = None

@@ -4,6 +4,12 @@ The measurable half of the BASELINE metric ("JAX allreduce GB/s inside
 a DRA-allocated pod"): a psum over the full device mesh, timed, with
 algorithmic bus bandwidth reported the way collective benchmarks do
 (2*(n-1)/n scaling for ring allreduce).
+
+Evidence context: these probes WRITE the recorded artifacts — the
+per-round lines land in tools/bench_full_latest.json (and the
+BENCH_r*.json trajectory); the measurement-discipline anecdotes in
+the docstrings below (jitter swamping a differential, a transport
+glitch recording an impossible time) trace to those rounds.
 """
 
 from __future__ import annotations

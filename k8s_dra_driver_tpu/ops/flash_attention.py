@@ -15,13 +15,26 @@ ring step. Tile sizes respect the bf16 (16,128)/f32 (8,128) minimums
 (pallas_guide.md "Tiling Constraints"); sequence lengths that are not
 tile multiples are zero-padded up and the padded key columns masked
 in-kernel, so odd/prime lengths compile instead of degenerating to
-1-wide blocks. Default blocks come from the shape-keyed autotune table
-(``pick_blocks``), derived from a recorded v5e sweep
-(tools/sweep_attention.py → tools/attention_sweep_v5e.json, bf16
+1-wide blocks. Default blocks and layout choices come from the
+``ops/autotune.py`` table (``pick_fwd_params``; the checked-in
+``tools/autotune_v5e.json`` is seeded from the recorded v5e sweep
+tools/sweep_attention.py → tools/attention_sweep_v5e.json, bf16
 causal, differential-median timing with artifact rejection): 3.0-6.3x
 naive XLA at T=2048-4096 rising to 7-9.4x at T=8192 (133 achieved
 TFLOPs at T8192/D128), because naive attention's [B,H,T,T] f32 score
 tensor is HBM-bandwidth-bound while these scores never leave VMEM.
+
+The per-block body follows FlashAttention-2's work-partitioning
+lesson — non-matmul VPU work per block is what caps MXU occupancy:
+the softmax ``scale`` is folded into q ONCE outside the kernel
+(instead of a [bq, bk] multiply per block), the probability matrix
+drops to the K/V dtype for the second matmul so bf16 inputs keep
+both matmuls at full MXU rate (f32 accumulation via
+``preferred_element_type``), and INTERIOR blocks — strictly below
+the causal diagonal, inside the window band, no key padding — run a
+mask-free body: the [bq, bk] iota/compare/select mask work is paid
+only by diagonal-, window-edge- and padded-tail blocks (at T=8192
+with 1024-blocks that is 8 of 36 causal blocks).
 
 Differentiation: ``pl.pallas_call`` has no JVP rule, so the kernels
 are forward-only; ``flash_attention`` (the normalized public entry
@@ -49,7 +62,17 @@ forward runs 0.57/0.45/0.51 ms at H_kv = 8/4/2 — grouped heads cost
 no kernel time (the differences are within the backend's jitter);
 the real win is the 4x smaller K/V footprint in HBM and cache.  (An
 earlier single-run capture showed 1.9x; treat single-run deltas on
-this backend as jitter.)
+this backend as jitter.)  The forward additionally offers a
+GQA-aware K/V STREAMING grid (``kv_reuse``, autotune-selected): the
+grid becomes (batch*H_kv, q-block, k-block, group) with the group
+dimension innermost and a g-independent K/V index map, so
+consecutive programs covering one group's query heads reuse the
+resident K/V block — the K/V HBM stream drops from once per query
+head to once per KV head, paid for with group-sized VMEM scratch and
+output windows (``_default_fwd_params`` bounds the residency).
+Interpret-mode parity for the packed grid is pinned in
+tests/test_flash_attention.py; its on-chip timing entry is owed to
+tools/bench_autotune.py on the next live round.
 
 Sliding-window (local) attention: ``window=W`` masks each query to its
 W most recent positions and — in the single-device (zero-offset) path
@@ -97,10 +120,10 @@ _K_TILE = 128
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
-                  n_k: int, scale: float, causal: bool, k_valid: int,
+                  n_k: int, causal: bool, k_valid: int,
                   window: int | None = None, has_seg: bool = False,
-                  n_kw: int | None = None, has_scales: bool = False):
-    """One (batch*head, q-block, k-block) program.
+                  n_kw: int | None = None, group: int = 1):
+    """One (batch-head, q-block, k-block[, group]) program.
 
     K is a grid dimension so pallas double-buffers the K/V block DMAs
     against compute (pallas_guide.md "Patterns: Double Buffering" — the
@@ -115,7 +138,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
     indices >= k_valid are zero padding and masked out.  With
     ``has_seg``, ``rest`` additionally starts with segment-id refs
     qseg [1, bq, 1] / kseg [1, 1, bk] (int32): queries attend only to
-    keys of the same segment (packed-sequence masking).
+    keys of the same segment (packed-sequence masking).  q arrives
+    PRE-SCALED (the softmax scale is folded in outside the kernel).
 
     ``n_kw`` set means the NARROW window grid: the innermost grid
     dimension spans only the ≤n_kw K blocks a q-block's sliding window
@@ -126,23 +150,32 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
     *grid steps*, not just O(T·W) computed blocks inside an O(T²)
     grid (the previous predicate-only design kept the full grid and
     its per-step pipeline overhead).
+
+    ``group`` > 1 is the GQA K/V-reuse grid (batch*H_kv, i, j, g), g
+    innermost: the K/V BlockSpec index is g-independent, so the g
+    steps covering one group's query heads reuse the resident K/V
+    block instead of re-streaming it per query head.  Scratch and the
+    o/m/l output windows then carry ``group*bq`` rows with each g's
+    rows at ``[g*bq, (g+1)*bq)`` (the statistics must persist per
+    head across the j sweep, which is OUTER of g).
     """
-    qseg_ref = kseg_ref = kscale_ref = vscale_ref = None
+    qseg_ref = kseg_ref = None
     if has_seg:
         qseg_ref, kseg_ref, *rest = rest
-    if has_scales:
-        kscale_ref, vscale_ref, *rest = rest
     o_ref, m_ref, l_ref, acc_scr, m_scr, l_scr = rest
     j = pl.program_id(2)
     bq = q_ref.shape[1]
+    d = q_ref.shape[2]
     block_k = k_ref.shape[1]
     padded = k_valid < n_k * block_k
+    rows = pl.ds(pl.program_id(3) * bq, bq) if group > 1 \
+        else slice(None)
 
     @pl.when(j == 0)
     def _init():
-        acc_scr[:] = jnp.zeros_like(acc_scr)
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[rows] = jnp.zeros((bq, d), jnp.float32)
+        m_scr[rows] = jnp.full((bq, 128), _NEG_INF, jnp.float32)
+        l_scr[rows] = jnp.zeros((bq, 128), jnp.float32)
 
     if n_kw is not None:
         # window-relative -> absolute K block (shared span math keeps
@@ -171,61 +204,89 @@ def _flash_kernel(q_ref, k_ref, v_ref, qoff_ref, koff_ref, *rest,
         run &= q_start <= k_start + block_k - 1 + (window - 1)
     run &= in_range
 
-    @pl.when(run)
-    def _update():
+    def _accum(masked: bool):
         # MXU inputs stay in the source dtype (bf16 runs at full MXU
-        # rate); accumulation is f32 via preferred_element_type.
-        # With per-position scales (int8 KV cache) the dequant happens
-        # HERE, in VMEM — HBM only ever streams the int8 bytes, the
-        # structural guarantee XLA's fusion choice can't undo.
-        k_blk = k_ref[0]
-        if has_scales:
-            k_blk = (k_blk.astype(jnp.float32)
-                     * kscale_ref[0]).astype(q_ref.dtype)
+        # rate); accumulation is f32 via preferred_element_type.  q is
+        # pre-scaled, and p drops to the K/V dtype for the second
+        # matmul, so BOTH matmuls run at source-dtype MXU rate — the
+        # FlashAttention-2 lesson: per-block VPU work (the old
+        # [bq, bk] scale multiply, the f32 p·v matmul) is what kept
+        # measured occupancy at ~15% of the matmul ceiling.
         s = jax.lax.dot_general(
-            q_ref[0], k_blk, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale   # [bq, bk]
+            q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [bq, bk]
         mask = None
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            mask = q_pos >= k_pos
-            if window is not None:
-                mask &= q_pos - k_pos < window
-        if padded:
-            k_local = j_abs * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (bq, block_k), 1)
-            valid = k_local < k_valid
-            mask = valid if mask is None else (mask & valid)
-        if has_seg:
-            seg = qseg_ref[0] == kseg_ref[0]          # [bq,1]==[1,bk]
-            mask = seg if mask is None else (mask & seg)
-        if mask is not None:
-            s = jnp.where(mask, s, _NEG_INF)
-        m = m_scr[:, :1]                              # [bq, 1]
-        l = l_scr[:, :1]
+        if masked:
+            if causal:
+                q_pos = q_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 0)
+                k_pos = k_start + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 1)
+                mask = q_pos >= k_pos
+                if window is not None:
+                    mask &= q_pos - k_pos < window
+            if padded:
+                k_local = j_abs * block_k + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, block_k), 1)
+                valid = k_local < k_valid
+                mask = valid if mask is None else (mask & valid)
+            if has_seg:
+                seg = qseg_ref[0] == kseg_ref[0]      # [bq,1]==[1,bk]
+                mask = seg if mask is None else (mask & seg)
+            if mask is not None:
+                s = jnp.where(mask, s, _NEG_INF)
+        m = m_scr[rows][:, :1]                            # [bq, 1]
+        l = l_scr[rows][:, :1]
         m_new = jnp.maximum(m, s.max(axis=1, keepdims=True))
         p = jnp.exp(s - m_new)
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + p.sum(axis=1, keepdims=True)
-        v_blk = v_ref[0].astype(jnp.float32)
-        if has_scales:
-            v_blk = v_blk * vscale_ref[0]
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+        acc_scr[rows] = acc_scr[rows] * corr + jax.lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        m_scr[rows] = jnp.broadcast_to(m_new, (bq, 128))
+        l_scr[rows] = jnp.broadcast_to(l_new, (bq, 128))
+
+    # Interior blocks — strictly below the causal diagonal, inside
+    # the window band, no padded keys — take a mask-free body; only
+    # edge blocks pay the [bq, bk] iota/compare/select VPU work.
+    # Segment masking is data-dependent on every block, so it keeps
+    # the single masked body.
+    if has_seg:
+        @pl.when(run)
+        def _update():
+            _accum(True)
+    elif not causal and not padded:
+        @pl.when(run)
+        def _update():
+            _accum(False)
+    else:
+        edge = False
+        if causal:
+            # fully unmasked iff min(q_pos) >= max(k_pos) ...
+            edge = q_start < k_start + block_k - 1
+            if window is not None:
+                # ... and max(q_pos) - min(k_pos) inside the window
+                edge |= (q_start + bq - 1) - k_start >= window
+        if padded:
+            tail = (j_abs + 1) * block_k > k_valid
+            edge = tail if edge is False else (edge | tail)
+
+        @pl.when(run & ~edge)
+        def _interior():
+            _accum(False)
+
+        @pl.when(run & edge)
+        def _edge():
+            _accum(True)
 
     @pl.when(last)
     def _done():
-        o_ref[0] = acc_scr[:]
-        m_ref[0] = m_scr[:]
-        l_ref[0] = l_scr[:]
+        o_ref[0, rows] = acc_scr[rows]
+        m_ref[0, rows] = m_scr[rows]
+        l_ref[0, rows] = l_scr[rows]
 
 
 def _window_kv_span(i, bq: int, bk: int, window: int, n_k: int):
@@ -331,7 +392,8 @@ def flash_block_attention(q, k, v, q_offset, k_offset, *,
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
                                              "block_k", "interpret",
-                                             "window", "narrow_window"))
+                                             "window", "narrow_window",
+                                             "kv_reuse"))
 def _flash_block_attention(q, k, v, q_offset, k_offset, *,
                            causal: bool = True,
                            scale: float | None = None,
@@ -339,8 +401,8 @@ def _flash_block_attention(q, k, v, q_offset, k_offset, *,
                            interpret: bool | None = None,
                            window: int | None = None,
                            narrow_window: bool = False,
-                           q_segments=None, k_segments=None,
-                           k_scale=None, v_scale=None):
+                           kv_reuse: bool = False,
+                           q_segments=None, k_segments=None):
     """Unnormalized flash attention of q against one K/V block.
 
     q: [B, Tq, H, D]; k/v: [B, Tk, H_kv, D] where H is a multiple of
@@ -356,13 +418,11 @@ def _flash_block_attention(q, k, v, q_offset, k_offset, *,
     sequence masking — a query attends only to keys with its segment
     id (composable with causal/window; both must be given together).
 
-    ``k_scale``/``v_scale`` ([B, Tk, H_kv] f32, given together):
-    per-(batch, position, kv-head) symmetric dequant scales for an
-    int8 K/V — the serving int8-KV-cache read path
-    (models/decode.py).  Dequantization happens inside the kernel in
-    VMEM, so HBM streams int8 bytes by construction instead of
-    depending on XLA fusing the read-side dequant (the 660M
-    regression in tools/int8_decode_v5e.json).
+    ``kv_reuse`` (static; effective only when H_kv < H and the narrow
+    window grid is off): the GQA K/V-streaming grid — group innermost
+    with a g-independent K/V index map, so one group's query heads
+    share each resident K/V block instead of re-streaming it per
+    head.  Selected by the autotune table via ``pick_fwd_params``.
 
     Forward-only (no autodiff rule): differentiate through
     ``flash_attention`` / ``ring_attention`` which carry custom VJPs.
@@ -376,14 +436,15 @@ def _flash_block_attention(q, k, v, q_offset, k_offset, *,
     if (q_segments is None) != (k_segments is None):
         raise ValueError("q_segments and k_segments must be given "
                          "together")
-    if (k_scale is None) != (v_scale is None):
-        raise ValueError("k_scale and v_scale must be given together")
     has_seg = q_segments is not None
-    has_scales = k_scale is not None
 
     b_, tq, h, d = q.shape
     tk = k.shape[1]
     h_kv, group = _kv_heads(h, k)
+    # fold the softmax scale into q once ([Tq, D] work) instead of a
+    # [bq, bk] multiply per (i, j) block inside the kernel
+    if scale != 1.0:
+        q = (q.astype(jnp.float32) * jnp.float32(scale)).astype(q.dtype)
     bq, tq_pad = _block_and_pad(tq, block_q, _Q_TILE)
     bk, tk_pad = _block_and_pad(tk, block_k, _K_TILE)
     q = _pad_seq(q, tq_pad)
@@ -402,6 +463,7 @@ def _flash_block_attention(q, k, v, q_offset, k_offset, *,
     koff = jnp.asarray(k_offset, jnp.int32).reshape(1, 1)
 
     n_k = tk_pad // bk
+    n_q = tq_pad // bq
     # Sliding window + zero offsets: NARROW the innermost grid to
     # the ≤n_kw K blocks a q-block's window can touch, with the K/V
     # index maps translating window-relative j to absolute blocks.
@@ -416,17 +478,21 @@ def _flash_block_attention(q, k, v, q_offset, k_offset, *,
     # flash_attention); the eager wrapper above validates that the
     # flag comes with literal zero offsets.
     narrow = window is not None and narrow_window
+    group_grid = bool(kv_reuse) and group > 1 and not narrow
     if narrow:
         # widest span of any q-block's [lo, hi] range (+1 boundary)
         n_kw = min(n_k, (bq + window - 2) // bk + 2)
-        grid = (b_ * h, tq_pad // bq, n_kw)
+        grid = (b_ * h, n_q, n_kw)
+    elif group_grid:
+        n_kw = None
+        grid = (b_ * h_kv, n_q, n_k, group)
     else:
         n_kw = None
-        grid = (b_ * h, tq_pad // bq, n_k)
-    kernel = functools.partial(_flash_kernel, n_k=n_k, scale=scale,
+        grid = (b_ * h, n_q, n_k)
+    kernel = functools.partial(_flash_kernel, n_k=n_k,
                                causal=causal, k_valid=tk, window=window,
                                has_seg=has_seg, n_kw=n_kw,
-                               has_scales=has_scales)
+                               group=group if group_grid else 1)
 
     def kv_j(i, j):
         if not narrow:
@@ -434,68 +500,99 @@ def _flash_block_attention(q, k, v, q_offset, k_offset, *,
         lo, hi = _window_kv_span(i, bq, bk, window, n_k)
         return jnp.minimum(lo + j, hi)
 
+    if group_grid:
+        # grid (bh_kv, i, j, g), g innermost: K/V block index is
+        # g-INDEPENDENT, so the g steps sharing one KV head reuse the
+        # resident K/V block (HBM streams K/V once per KV head, not
+        # once per query head); q/o rows route to head kvh*group + g.
+        def q_head(bh, g):
+            return bh // h_kv * h + (bh % h_kv) * group + g
+
+        q_spec = pl.BlockSpec(
+            (1, bq, d), lambda bh, i, j, g: (q_head(bh, g), i, 0))
+        kv_spec = pl.BlockSpec(
+            (1, bk, d), lambda bh, i, j, g: (bh, j, 0))
+        seg_specs = [
+            pl.BlockSpec((1, bq, 1),
+                         lambda bh, i, j, g: (bh // h_kv, i, 0)),
+            pl.BlockSpec((1, 1, bk),
+                         lambda bh, i, j, g: (bh // h_kv, 0, j)),
+        ]
+        # outputs carry group*bq rows per block (g's rows at g*bq),
+        # index g-independent — the block stays VMEM-resident across
+        # the whole (j, g) sweep of a q-block, flushed once
+        out_rows = group * bq
+        out_index = lambda bh, i, j, g: (bh, i, 0)   # noqa: E731
+        out_bh = b_ * h_kv
+        semantics = ("parallel", "arbitrary", "arbitrary", "arbitrary")
+    else:
+        q_spec = pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0))
+        kv_spec = pl.BlockSpec(
+            (1, bk, d), lambda bh, i, j: (kv_of(bh), kv_j(i, j), 0))
+        seg_specs = [
+            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh // h, i, 0)),
+            pl.BlockSpec((1, 1, bk),
+                         lambda bh, i, j: (bh // h, 0, kv_j(i, j))),
+        ]
+        out_rows = bq
+        out_index = lambda bh, i, j: (bh, i, 0)      # noqa: E731
+        out_bh = b_ * h
+        semantics = ("parallel", "arbitrary", "arbitrary")
+
     in_specs = [
-        pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-        pl.BlockSpec((1, bk, d),
-                     lambda bh, i, j: (kv_of(bh), kv_j(i, j), 0)),
-        pl.BlockSpec((1, bk, d),
-                     lambda bh, i, j: (kv_of(bh), kv_j(i, j), 0)),
+        q_spec, kv_spec, kv_spec,
         pl.BlockSpec(memory_space=pltpu.SMEM),
         pl.BlockSpec(memory_space=pltpu.SMEM),
     ]
     inputs = [qf, kf, vf, qoff, koff]
     if has_seg:
         # [B, T] -> [B, Tq_pad, 1] / [B, 1, Tk_pad] so the kernel's
-        # compare is 2D tiles end-to-end (grid bh -> batch via // h)
+        # compare is 2D tiles end-to-end (grid bh -> batch index)
         qseg = _pad_segments(jnp.asarray(q_segments, jnp.int32),
                              tq_pad)[:, :, None]
         kseg = _pad_segments(jnp.asarray(k_segments, jnp.int32),
                              tk_pad)[:, None, :]
-        in_specs += [
-            pl.BlockSpec((1, bq, 1), lambda bh, i, j: (bh // h, i, 0)),
-            pl.BlockSpec((1, 1, bk),
-                         lambda bh, i, j: (bh // h, 0, kv_j(i, j))),
-        ]
+        in_specs += seg_specs
         inputs += [qseg, kseg]
-    if has_scales:
-        # [B, Tk, H_kv] -> [B*H_kv, Tk_pad, 1], same head routing as
-        # the K/V blocks (padded positions get scale 0 -> zero keys,
-        # already masked by k_valid/causal anyway)
-        def flat_scale(s):
-            s = jnp.asarray(s, jnp.float32)
-            s = s.transpose(0, 2, 1).reshape(b_ * h_kv, s.shape[1], 1)
-            if s.shape[1] != tk_pad:
-                s = jnp.pad(s, ((0, 0), (0, tk_pad - s.shape[1]),
-                                (0, 0)))
-            return s
-        scale_spec = pl.BlockSpec(
-            (1, bk, 1), lambda bh, i, j: (kv_of(bh), kv_j(i, j), 0))
-        in_specs += [scale_spec, scale_spec]
-        inputs += [flat_scale(k_scale), flat_scale(v_scale)]
 
     o, m, l = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda bh, i, j: (bh, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda bh, i, j: (bh, i, 0)),
+            pl.BlockSpec((1, out_rows, d), out_index),
+            pl.BlockSpec((1, out_rows, 128), out_index),
+            pl.BlockSpec((1, out_rows, 128), out_index),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b_ * h, tq_pad, d), jnp.float32),
-            jax.ShapeDtypeStruct((b_ * h, tq_pad, 128), jnp.float32),
-            jax.ShapeDtypeStruct((b_ * h, tq_pad, 128), jnp.float32),
+            jax.ShapeDtypeStruct((out_bh, n_q * out_rows, d),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((out_bh, n_q * out_rows, 128),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((out_bh, n_q * out_rows, 128),
+                                 jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, d), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
-            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((out_rows, d), jnp.float32),
+            pltpu.VMEM((out_rows, 128), jnp.float32),
+            pltpu.VMEM((out_rows, 128), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+            dimension_semantics=semantics),
         interpret=interpret,
     )(*inputs)
+
+    if group_grid:
+        # rows (i, g, r) -> head kvh*group + g at q position i*bq + r
+        def unpack(x, width):
+            x = x.reshape(b_, h_kv, n_q, group, bq, width)
+            x = x.transpose(0, 1, 3, 2, 4, 5)
+            return x.reshape(b_, h, tq_pad, width)
+
+        o = unpack(o, d).transpose(0, 2, 1, 3)[:, :tq]
+        m = unpack(m, 128)[:, :, :tq, 0]
+        l = unpack(l, 128)[:, :, :tq, 0]
+        return o, m, l
 
     # [B*H, Tq, D] -> [B, Tq, H, D];  stats -> [B, H, Tq]; drop padding
     o = o.reshape(b_, h, tq_pad, d).transpose(0, 2, 1, 3)[:, :tq]
@@ -962,24 +1059,49 @@ def attention_delta(do, out):
 # Normalized single-device flash attention, differentiable.
 # --------------------------------------------------------------------------
 
-def pick_blocks(tq: int, tk: int, head_dim: int) -> tuple[int, int]:
-    """Autotuned ``(block_q, block_k)`` by shape.
+def _default_fwd_params(tq: int, tk: int, head_dim: int,
+                        kv_group: int = 1,
+                        window: int | None = None) -> dict:
+    """Heuristic fallback when the autotune table has no entry.
 
-    Derived from a v5e sweep (bf16, causal, tools/sweep_attention.py,
-    recorded in tools/attention_sweep_v5e.json): big blocks win —
-    (1024, 1024) is best at every swept shape (T ∈ {2048, 4096, 8192}
-    × D ∈ {64, 128}), 3.0-9.4x naive XLA, because each grid program
-    amortizes its K/V DMA over more MXU work while staying
-    VMEM-resident (~10 MB at D=128).  The sweep's one dissenting entry
-    — (1024, 512) apparently fastest at T=8192/D=64 — did not
-    reproduce under 3x re-measurement (see the artifact's
-    ``remeasurement`` note); (1024, 1024) is the true best there too.
-    The one real exception: short sequences at D=64 prefer (512, 1024)
-    — at T=2048/D=64 the halved q-block keeps enough programs in
-    flight to cover DMA latency (6.25x vs 4.86x).
+    Big blocks win on v5e (the recorded basis is the sweep cited in
+    ``pick_fwd_params``); GQA defaults to the K/V-reuse grid with the
+    q block shrunk until the group-sized f32 scratch + output
+    residency (acc [g*bq, d] + two [g*bq, 128] stats) stays ≤ ~4 MB.
+    """
+    bq = 512 if (head_dim < 128 and tq <= 2048) else 1024
+    kv_reuse = kv_group > 1 and window is None
+    if kv_reuse:
+        while (kv_group * bq * (head_dim + 256) * 4 > 4 * 2 ** 20
+               and bq > 256):
+            bq //= 2
+    bq = min(bq, _round_up(tq, _Q_TILE))
+    bk = min(1024, _round_up(tk, _K_TILE))
+    return {"block_q": bq, "block_k": bk, "kv_reuse": kv_reuse}
 
-    Sliding-window runs use the SAME table (deliberately — there is
-    no window parameter here): the narrow grid computes a band
+
+def pick_fwd_params(tq: int, tk: int, head_dim: int,
+                    kv_group: int = 1, window: int | None = None,
+                    dtype=jnp.bfloat16) -> dict:
+    """Forward block shapes + layout by shape, from the autotune
+    table (``ops/autotune.py``; checked-in ``tools/autotune_v5e.json``
+    seeded from the recorded sweep, refreshed by
+    tools/bench_autotune.py), falling back to ``_default_fwd_params``
+    — a pure lookup either way, safe at trace time and identical on
+    the interpret-mode CPU suite.
+
+    What the recorded evidence says (tools/attention_sweep_v5e.json,
+    bf16 causal, differential-median with artifact rejection): big
+    blocks win — (1024, 1024) at every swept shape (T ∈ {2048, 4096,
+    8192} × D ∈ {64, 128}), 3.0-9.4x naive XLA, because each grid
+    program amortizes its K/V DMA over more MXU work while staying
+    VMEM-resident (~10 MB at D=128).  The one real exception: short
+    sequences at D=64 prefer (512, 1024) — at T=2048/D=64 the halved
+    q-block keeps enough programs in flight to cover DMA latency
+    (6.25x vs 4.86x).
+
+    Sliding-window shapes key on ``w`` but currently inherit the
+    causal entries' block choice: the narrow grid computes a band
     ~``bq + window + bk`` keys wide per q-block, so smaller blocks
     narrow the band — but recorded at T=8192/W=1024
     (tools/kernel_claims_v5e.json, median-of-5), (512, 512)'s ~35%
@@ -987,24 +1109,55 @@ def pick_blocks(tq: int, tk: int, head_dim: int) -> tuple[int, int]:
     0.94 ms vs 0.69 ms.  Band-narrowing via block choice does not
     pay on v5e; the window win comes from the narrow grid alone.
     """
-    bq = 512 if (head_dim < 128 and tq <= 2048) else 1024
-    bq = min(bq, _round_up(tq, _Q_TILE))
-    bk = min(1024, _round_up(tk, _K_TILE))
-    return bq, bk
+    from .autotune import get_autotuner, shape_key
+
+    key = shape_key(tq=tq, tk=tk, d=head_dim, g=kv_group,
+                    w=window or 0)
+    choice = get_autotuner().pick(
+        "flash_fwd", key, dtype,
+        functools.partial(_default_fwd_params, tq, tk, head_dim,
+                          kv_group, window))
+    params = dict(choice.params)
+    # whatever the source, blocks must be tile-legal for THIS shape
+    params["block_q"] = min(params["block_q"],
+                            _round_up(tq, _Q_TILE))
+    params["block_k"] = min(params["block_k"],
+                            _round_up(tk, _K_TILE))
+    params.setdefault("kv_reuse", False)
+    return params
+
+
+def pick_blocks(tq: int, tk: int, head_dim: int) -> tuple[int, int]:
+    """Back-compat view of ``pick_fwd_params``: just the autotuned
+    ``(block_q, block_k)`` pair (the backward kernels and older
+    callers key on shape alone)."""
+    params = pick_fwd_params(tq, tk, head_dim)
+    return params["block_q"], params["block_k"]
 
 
 def _flash_forward(q, k, v, segment_ids, causal, scale, interpret,
                    block_q, block_k, window):
-    """Normalized output + logsumexp (the flash residual pair)."""
+    """Normalized output + logsumexp (the flash residual pair).
+
+    Blocks AND layout (the GQA ``kv_reuse`` grid) come from the
+    autotune table; explicit caller blocks suppress the layout pick
+    too — a sweep measuring specific blocks must not have the table
+    silently swap the grid underneath it.
+    """
+    kv_reuse = False
     if block_q is None or block_k is None:
-        auto_q, auto_k = pick_blocks(q.shape[1], k.shape[1], q.shape[-1])
-        block_q = block_q if block_q is not None else auto_q
-        block_k = block_k if block_k is not None else auto_k
+        params = pick_fwd_params(q.shape[1], k.shape[1], q.shape[-1],
+                                 kv_group=q.shape[2] // k.shape[2],
+                                 window=window, dtype=q.dtype)
+        block_q = block_q if block_q is not None else params["block_q"]
+        block_k = block_k if block_k is not None else params["block_k"]
+        kv_reuse = params["kv_reuse"]
     o, m, l = flash_block_attention(q, k, v, 0, 0, causal=causal,
                                     scale=scale, interpret=interpret,
                                     block_q=block_q, block_k=block_k,
                                     window=window,
                                     narrow_window=window is not None,
+                                    kv_reuse=kv_reuse,
                                     q_segments=segment_ids,
                                     k_segments=segment_ids)
     out, lse = normalize_flash_stats(o, m, l)

@@ -19,6 +19,24 @@ body runs — the pallas_guide.md "Scalar Prefetch" pattern.  Static
 shapes throughout: group sizes are data, but every array shape is a
 function of the static row-capacity bound.
 
+MegaBlocks-style tile packing (the rework for the recorded moe_heavy
+loss — gmm 36.22 ms vs capacity 26.34, tools/moe_dispatch_v5e.json):
+the static row bound over-provisions ``n_experts`` tile-remainder
+blocks, and pre-rework every one of them ran a full matmul on zero
+rows — the "per-group remainder dispatch".  A second prefetch scalar
+now carries the LIVE block count (sum of padded group sizes /
+block_m) and the kernels skip dead-tail blocks' MXU work entirely
+(their weight DMA was already elided by the clamped expert index;
+outputs are zero-filled for value hygiene).  Block shapes come from
+the ops/autotune.py table (``pick_gmm_blocks``): in blocked mode the
+expert weight re-streams once per row block, so weight traffic
+scales with 1/block_m — the default jumps block_m to 512 for experts
+too big for the weight-stationary mode (~4x less weight traffic at
+E16/dff4096 for ≤ block_m-1 padding rows per expert, which the
+dead-tail skip makes cheap).  The ``gmm_ms <= capacity_ms`` verdict
+on moe_heavy is owed to tools/bench_moe.py on the next idle-chip
+round.
+
 Autodiff via ``jax.custom_vjp`` (pallas has no JVP rule):
 ``dx = gmm(dy, w^T)`` reuses the forward kernel with transposed
 experts; ``dw[e] = x_e^T dy_e`` is a second kernel accumulating over
@@ -58,45 +76,63 @@ def _block_experts(group_sizes: jax.Array, n_blocks: int,
     return jnp.minimum(eb, group_sizes.shape[0] - 1).astype(jnp.int32)
 
 
-def _gmm_whole_kernel(eb_ref, x_ref, w_ref, o_ref):
+def _gmm_whole_kernel(eb_ref, nu_ref, x_ref, w_ref, o_ref):
     """Weight-stationary mode, grid (m,): the whole expert matrix is
     one block, so consecutive row blocks of the same (sorted) expert
     elide the weight DMA — w streams HBM once per expert instead of
     once per row block (the difference between ~64 MB and ~576 MB of
-    weight traffic at E16/dff4096)."""
-    x = x_ref[...]
-    o_ref[...] = jax.lax.dot_general(
-        x, w_ref[0].astype(x.dtype), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+    weight traffic at E16/dff4096).  Row blocks past the live count
+    (``nu_ref``, the tile-packed bound) skip the MXU entirely and
+    zero-fill their (never-read) output rows."""
+    live = pl.program_id(0) < nu_ref[0]
+
+    @pl.when(live)
+    def _run():
+        x = x_ref[...]
+        o_ref[...] = jax.lax.dot_general(
+            x, w_ref[0].astype(x.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+    @pl.when(jnp.logical_not(live))
+    def _dead():
+        o_ref[...] = jnp.zeros_like(o_ref)
 
 
-def _gmm_kernel(eb_ref, x_ref, w_ref, o_ref, acc, *, n_k: int):
+def _gmm_kernel(eb_ref, nu_ref, x_ref, w_ref, o_ref, acc, *, n_k: int):
     """Blocked fallback for experts too big for VMEM residency: grid
     (n, m, k), k sequential innermost (accumulation), m middle so that
     when n_k == 1 consecutive same-expert row blocks still elide the
-    weight fetch."""
+    weight fetch.  Dead-tail row blocks (i >= ``nu_ref``) skip every
+    k-step's matmul; the zero-initialized accumulator writes out as
+    their zero fill."""
     kk = pl.program_id(2)
 
     @pl.when(kk == 0)
     def _init():
         acc[:] = jnp.zeros_like(acc)
 
-    x = x_ref[...]
-    acc[:] += jax.lax.dot_general(
-        x, w_ref[0].astype(x.dtype), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    @pl.when(pl.program_id(1) < nu_ref[0])
+    def _live():
+        x = x_ref[...]
+        acc[:] += jax.lax.dot_general(
+            x, w_ref[0].astype(x.dtype), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when(kk == n_k - 1)
     def _done():
         o_ref[...] = acc[:].astype(o_ref.dtype)
 
 
-def _gmm_dw_kernel(eb_ref, x_ref, dy_ref, o_ref, acc, *, n_m: int):
+def _gmm_dw_kernel(eb_ref, nu_ref, x_ref, dy_ref, o_ref, acc, *,
+                   n_m: int):
     """grid (k, n, m), m sequential innermost.  Rows are sorted by
     expert, so an expert's m-blocks are consecutive: the accumulator
     resets on each expert boundary and the (expert, k, n) output block
     is written on the expert's last m-block — the output block stays
-    VMEM-resident across the consecutive same-index iterations."""
+    VMEM-resident across the consecutive same-index iterations.
+    Dead-tail row blocks contribute exact zeros, so they skip the
+    matmul (init/write logic still runs: the final expert's output
+    block is written on the LAST m-block, which may be dead)."""
     i = pl.program_id(2)
     prev = eb_ref[jnp.maximum(i - 1, 0)]
     nxt = eb_ref[jnp.minimum(i + 1, n_m - 1)]
@@ -106,10 +142,12 @@ def _gmm_dw_kernel(eb_ref, x_ref, dy_ref, o_ref, acc, *, n_m: int):
     def _init():
         acc[:] = jnp.zeros_like(acc)
 
-    x = x_ref[...]
-    acc[:] += jax.lax.dot_general(
-        x, dy_ref[...].astype(x.dtype), (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)
+    @pl.when(i < nu_ref[0])
+    def _live():
+        x = x_ref[...]
+        acc[:] += jax.lax.dot_general(
+            x, dy_ref[...].astype(x.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
 
     @pl.when((i == n_m - 1) | (nxt != cur))
     def _done():
@@ -123,6 +161,60 @@ def _pad_dim(x, axis, mult):
     widths = [(0, 0)] * x.ndim
     widths[axis] = (0, pad)
     return jnp.pad(x, widths)
+
+
+def _whole_mode(kp: int, np_: int, itemsize: int,
+                interpret: bool) -> bool:
+    """Weight-stationary when a whole (padded) expert matrix fits a
+    ~4 MB VMEM block (double-buffered well under the ~16 MB/core
+    budget); interpret mode has no VMEM, gate on elements so the
+    hermetic f32 CPU suite exercises the same mode bf16 takes on
+    TPU."""
+    return (kp * np_ * itemsize <= 4 * 2 ** 20
+            or (interpret and kp * np_ <= 2 ** 21))
+
+
+def pick_gmm_blocks(k_dim: int, n_dim: int, n_experts: int,
+                    dtype=jnp.bfloat16, rows: int | None = None,
+                    interpret: bool | None = None) -> dict:
+    """Grouped-matmul blocks ``{"block_m", "block_k", "block_n"}``
+    from the autotune table (ops/autotune.py; recorded by
+    tools/bench_autotune.py), falling back to the traffic heuristic:
+
+    - experts that fit the weight-stationary mode keep block_m=128
+      (weight streams once per expert regardless, and small blocks
+      minimize tile padding);
+    - blocked-mode experts (e.g. E16/dff4096 bf16: 8 MB each) jump to
+      block_m=512 — weight traffic in blocked mode scales with
+      1/block_m (each row block re-streams its expert's weights), so
+      4x fewer row blocks beat the ≤ block_m-1 extra padding rows per
+      expert, which the dead-tail skip makes near-free — bounded by
+      ``rows`` (the routed token count) so tiny workloads don't pad
+      n_experts*512 rows for a 32-row batch.
+
+    The routing layer must pad group sizes to the SAME block_m this
+    returns (models/transformer.py calls this before routing).
+    """
+    from .autotune import get_autotuner, shape_key
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    kp = _round_up(k_dim, 128)
+    np_ = _round_up(n_dim, 128)
+
+    def default():
+        bm = 128
+        if not _whole_mode(kp, np_, jnp.dtype(dtype).itemsize,
+                           interpret):
+            bm = 512
+            while bm > 128 and rows is not None \
+                    and n_experts * bm > rows:
+                bm //= 2
+        return {"block_m": bm, "block_k": 512, "block_n": 512}
+
+    key = shape_key(k=k_dim, n=n_dim, e=n_experts, r=rows)
+    return dict(get_autotuner().pick("gmm", key, dtype,
+                                     default).params)
 
 
 @functools.partial(jax.jit, static_argnames=("block_m", "block_k",
@@ -139,33 +231,39 @@ def _gmm_impl(x, w, group_sizes, block_m=128, block_k=512, block_n=512,
     np_ = _round_up(n_dim, 128)
     n_m = m // block_m
     eb = _block_experts(group_sizes, n_m, block_m)
-    # Weight-stationary when a whole (padded) expert matrix fits a
-    # ~4 MB VMEM block (double-buffered well under the ~16 MB/core
-    # budget); interpret mode has no VMEM, gate on elements so the
-    # hermetic f32 CPU suite exercises the same mode bf16 takes on TPU
-    whole = (kp * np_ * jnp.dtype(w.dtype).itemsize <= 4 * 2 ** 20
-             or (interpret and kp * np_ <= 2 ** 21))
+    # tile packing: the number of LIVE row blocks (groups are padded
+    # to block_m multiples, so this is exact); blocks past it are the
+    # static bound's dead tail — the kernels skip their MXU work and
+    # the index maps pin their input DMAs to already-resident blocks
+    nu = (jnp.sum(group_sizes) // block_m).astype(jnp.int32)[None]
+
+    def live_i(i, nu):
+        return jnp.minimum(i, jnp.maximum(nu[0] - 1, 0))
+
+    whole = _whole_mode(kp, np_, jnp.dtype(w.dtype).itemsize,
+                        interpret)
     if whole:
         xp = _pad_dim(x, 1, kp)
         wp = _pad_dim(_pad_dim(w, 1, kp), 2, np_)
         out = pl.pallas_call(
             _gmm_whole_kernel,
             grid_spec=pltpu.PrefetchScalarGridSpec(
-                num_scalar_prefetch=1,
+                num_scalar_prefetch=2,
                 grid=(n_m,),
                 in_specs=[
-                    pl.BlockSpec((block_m, kp), lambda i, eb: (i, 0)),
+                    pl.BlockSpec((block_m, kp),
+                                 lambda i, eb, nu: (live_i(i, nu), 0)),
                     pl.BlockSpec((1, kp, np_),
-                                 lambda i, eb: (eb[i], 0, 0)),
+                                 lambda i, eb, nu: (eb[i], 0, 0)),
                 ],
                 out_specs=pl.BlockSpec((block_m, np_),
-                                       lambda i, eb: (i, 0)),
+                                       lambda i, eb, nu: (i, 0)),
             ),
             out_shape=jax.ShapeDtypeStruct((m, np_), x.dtype),
             compiler_params=pltpu.CompilerParams(
                 dimension_semantics=("arbitrary",)),
             interpret=interpret,
-        )(eb, xp, wp)
+        )(eb, nu, xp, wp)
         return out[:, :n_dim]
     bk = min(block_k, kp)
     bn = min(block_n, np_)
@@ -175,23 +273,28 @@ def _gmm_impl(x, w, group_sizes, block_m=128, block_k=512, block_n=512,
     out = pl.pallas_call(
         functools.partial(_gmm_kernel, n_k=n_k),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(n_n, n_m, n_k),
             in_specs=[
-                pl.BlockSpec((block_m, bk),
-                             lambda j, i, kk, eb: (i, kk)),
-                pl.BlockSpec((1, bk, bn),
-                             lambda j, i, kk, eb: (eb[i], kk, j)),
+                pl.BlockSpec(
+                    (block_m, bk),
+                    lambda j, i, kk, eb, nu:
+                        (live_i(i, nu),
+                         jnp.where(i < nu[0], kk, 0))),
+                pl.BlockSpec(
+                    (1, bk, bn),
+                    lambda j, i, kk, eb, nu:
+                        (eb[i], jnp.where(i < nu[0], kk, 0), j)),
             ],
             out_specs=pl.BlockSpec((block_m, bn),
-                                   lambda j, i, kk, eb: (i, j)),
+                                   lambda j, i, kk, eb, nu: (i, j)),
             scratch_shapes=[pltpu.VMEM((block_m, bn), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((m, wp.shape[2]), x.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(eb, xp, wp)
+    )(eb, nu, xp, wp)
     return out[:, :n_dim]
 
 
@@ -216,19 +319,27 @@ def _gmm_dw(x, dy, group_sizes, block_m=128, block_k=1024, block_n=1024,
     dyp = _pad_dim(dy, 1, bn)
     n_m, n_k, n_n = m // block_m, xp.shape[1] // bk, dyp.shape[1] // bn
     eb = _block_experts(group_sizes, n_m, block_m)
+    nu = (jnp.sum(group_sizes) // block_m).astype(jnp.int32)[None]
+
+    def live_i(i, nu):
+        return jnp.minimum(i, jnp.maximum(nu[0] - 1, 0))
+
     dw = pl.pallas_call(
         functools.partial(_gmm_dw_kernel, n_m=n_m),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
+            num_scalar_prefetch=2,
             grid=(n_k, n_n, n_m),
             in_specs=[
                 pl.BlockSpec((block_m, bk),
-                             lambda kq, j, i, eb: (i, kq)),
+                             lambda kq, j, i, eb, nu:
+                                 (live_i(i, nu), kq)),
                 pl.BlockSpec((block_m, bn),
-                             lambda kq, j, i, eb: (i, j)),
+                             lambda kq, j, i, eb, nu:
+                                 (live_i(i, nu), j)),
             ],
-            out_specs=pl.BlockSpec((1, bk, bn),
-                                   lambda kq, j, i, eb: (eb[i], kq, j)),
+            out_specs=pl.BlockSpec(
+                (1, bk, bn),
+                lambda kq, j, i, eb, nu: (eb[i], kq, j)),
             scratch_shapes=[pltpu.VMEM((bk, bn), jnp.float32)],
         ),
         out_shape=jax.ShapeDtypeStruct((e, xp.shape[1], dyp.shape[1]),
@@ -236,7 +347,7 @@ def _gmm_dw(x, dy, group_sizes, block_m=128, block_k=1024, block_n=1024,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary", "arbitrary")),
         interpret=interpret,
-    )(eb, xp, dyp)
+    )(eb, nu, xp, dyp)
     # empty experts own no row block: their output block is never
     # written (uninitialized memory, NaN under the interpreter) —
     # select, don't multiply: 0 * NaN is still NaN

@@ -10,8 +10,8 @@ full sequence on each device's head subset, then resharding back.
 When to use which (the scaling-book framing):
 - Ulysses: 2 collectives per attention regardless of S, and the local
   compute is a single dense flash call (best MXU shape) — wins while
-  heads are plentiful (S <= H) and the all-to-all payload (the whole
-  activation, 2x) fits comfortably in ICI bandwidth.
+  heads are plentiful (S <= H) and the all-to-all payload (twice the
+  activation) fits comfortably in ICI bandwidth.
 - Ring: S ppermutes each fully overlapped with block compute, O(T/S)
   peak memory for K/V — wins when S exceeds the head count, for very
   long T (K/V never gathered), or when overlap hides the fabric

@@ -32,9 +32,9 @@ def env_flag(name: str, default: bool = False) -> bool:
     """One boolean-env convention for the whole tree: unset ->
     ``default``; ``""``, ``"0"``, ``"false"`` (any case) -> False;
     anything else -> True.  Shared by the FAKE_CLUSTER argparse
-    default and the kernel opt-ins (TPU_QUANT_KERNEL /
-    TPU_KV_KERNEL, models/quant.py + models/decode.py) so ``=0`` and
-    ``=false`` mean "off" everywhere and the parsers cannot drift."""
+    default and the kernel opt-in (TPU_QUANT_KERNEL,
+    models/quant.py) so ``=0`` and ``=false`` mean "off" everywhere
+    and the parsers cannot drift."""
     raw = os.environ.get(name)
     if raw is None:
         return default

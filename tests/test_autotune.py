@@ -1,0 +1,215 @@
+"""ops/autotune.py: the block-shape/layout autotuner.
+
+The runtime path (pick) must be a pure, deterministic lookup — safe
+at trace time and identical on the interpret-mode CPU suite — while
+the measurement path (tune) applies the differential-median
+discipline through whatever ``measure`` callable the tools hand it.
+The checked-in v5e table must parse and resolve for the seeded keys.
+"""
+
+import json
+from pathlib import Path
+
+import jax.numpy as jnp
+import pytest
+
+from k8s_dra_driver_tpu.ops.autotune import (DEFAULT_TABLE_PATH,
+                                             Autotuner, backend_key,
+                                             get_autotuner,
+                                             reset_autotuner,
+                                             shape_key, table_key)
+
+REPO = Path(__file__).parent.parent
+
+
+def test_shape_key_is_canonical():
+    assert shape_key(tq=2048, tk=2048, d=64, g=1, w=None) == \
+        "d=64,g=1,tk=2048,tq=2048,w=0"
+    # kwarg order cannot change the key
+    assert shape_key(b=1, a=2) == shape_key(a=2, b=1)
+
+
+def test_table_key_includes_dtype_and_backend():
+    k1 = table_key("flash_fwd", "d=64", jnp.bfloat16, "tpu-v5e")
+    k2 = table_key("flash_fwd", "d=64", jnp.float32, "tpu-v5e")
+    k3 = table_key("flash_fwd", "d=64", jnp.bfloat16, "cpu")
+    assert len({k1, k2, k3}) == 3
+    assert k1 == "flash_fwd|d=64|bfloat16|tpu-v5e"
+
+
+def test_pick_falls_back_to_default_and_reports_source(tmp_path):
+    tuner = Autotuner(tmp_path / "none.json")
+    choice = tuner.pick("flash_fwd", "d=64", jnp.bfloat16,
+                        default=lambda: {"block_q": 512},
+                        backend="cpu")
+    assert choice.source == "default"
+    assert choice["block_q"] == 512
+
+
+def test_pick_prefers_table_hit(tmp_path):
+    path = tmp_path / "table.json"
+    key = table_key("flash_fwd", "d=64", jnp.bfloat16, "cpu")
+    path.write_text(json.dumps({"entries": {
+        key: {"params": {"block_q": 256, "block_k": 512,
+                         "kv_reuse": True}, "source": "measured"}}}))
+    tuner = Autotuner(path)
+    choice = tuner.pick("flash_fwd", "d=64", jnp.bfloat16,
+                        default={"block_q": 512}, backend="cpu")
+    assert choice.source == "measured"
+    assert choice["block_q"] == 256 and choice["kv_reuse"] is True
+    # a hit must hand back a COPY: caller mutation cannot poison the
+    # table for the next lookup
+    choice.params["block_q"] = 9999
+    again = tuner.pick("flash_fwd", "d=64", jnp.bfloat16,
+                       default={"block_q": 512}, backend="cpu")
+    assert again["block_q"] == 256
+
+
+def test_torn_table_falls_back_to_heuristics(tmp_path):
+    path = tmp_path / "torn.json"
+    path.write_text("{not json")
+    tuner = Autotuner(path)
+    choice = tuner.pick("k", "s", jnp.float32, default={"x": 1},
+                        backend="cpu")
+    assert choice.source == "default" and choice["x"] == 1
+
+
+def test_tune_records_best_valid_candidate(tmp_path):
+    tuner = Autotuner(tmp_path / "t.json")
+    timings = {(256,): (0.002, True), (512,): (0.001, True),
+               (1024,): (0.0005, False)}      # fastest is INVALID
+
+    def measure(params):
+        return timings[(params["bq"],)]
+
+    best = tuner.tune("k", "s", jnp.bfloat16,
+                      [{"bq": 256}, {"bq": 512}, {"bq": 1024}],
+                      measure, backend="cpu")
+    assert best == {"bq": 512}                # best VALID wins
+    entry = tuner.table[table_key("k", "s", jnp.bfloat16, "cpu")]
+    assert entry["valid"] is True
+    assert len(entry["runs"]) == 3            # every run auditable
+    # the tuned entry is immediately live for pick()
+    assert tuner.pick("k", "s", jnp.bfloat16, default={},
+                      backend="cpu")["bq"] == 512
+
+
+def test_tune_survives_erroring_candidate(tmp_path):
+    tuner = Autotuner(tmp_path / "t.json")
+
+    def measure(params):
+        if params["bq"] == 256:
+            raise RuntimeError("VMEM blowup")
+        return 0.001, True
+
+    best = tuner.tune("k", "s", jnp.bfloat16,
+                      [{"bq": 256}, {"bq": 512}], measure,
+                      backend="cpu")
+    assert best == {"bq": 512}
+    runs = tuner.table[table_key("k", "s", jnp.bfloat16, "cpu")]["runs"]
+    assert any("error" in r for r in runs)
+
+
+def test_tune_all_invalid_is_recorded_not_promoted(tmp_path):
+    tuner = Autotuner(tmp_path / "t.json")
+    best = tuner.tune("k", "s", jnp.bfloat16,
+                      [{"bq": 256}, {"bq": 512}],
+                      lambda p: (0.001 * p["bq"], False),
+                      backend="cpu")
+    assert best == {"bq": 256}                # fastest of the invalid
+    entry = tuner.table[table_key("k", "s", jnp.bfloat16, "cpu")]
+    assert entry["valid"] is False            # visibly so
+
+
+def test_save_load_roundtrip(tmp_path):
+    tuner = Autotuner(tmp_path / "t.json")
+    tuner.tune("k", "s", jnp.bfloat16, [{"bq": 512}],
+               lambda p: (0.001, True), backend="cpu")
+    path = tuner.save()
+    again = Autotuner(path)
+    assert again.lookup("k", "s", jnp.bfloat16,
+                        backend="cpu") == {"bq": 512}
+
+
+def test_singleton_honors_env_override(tmp_path, monkeypatch):
+    path = tmp_path / "custom.json"
+    key = table_key("k", "s", jnp.bfloat16, "cpu")
+    path.write_text(json.dumps({"entries": {
+        key: {"params": {"bq": 64}, "source": "measured"}}}))
+    monkeypatch.setenv("TPU_AUTOTUNE_TABLE", str(path))
+    reset_autotuner()
+    try:
+        assert get_autotuner().lookup(
+            "k", "s", jnp.bfloat16, backend="cpu") == {"bq": 64}
+    finally:
+        monkeypatch.delenv("TPU_AUTOTUNE_TABLE")
+        reset_autotuner()
+
+
+def test_backend_key_is_cpu_on_this_suite():
+    assert backend_key() == "cpu"
+
+
+def test_checked_in_v5e_table_parses_and_resolves():
+    """The committed table (seeded from the recorded sweep): parses,
+    every entry carries params + provenance, and the seeded flash
+    keys resolve through a real lookup."""
+    data = json.loads(DEFAULT_TABLE_PATH.read_text())
+    assert data["entries"], "empty table"
+    for key, entry in data["entries"].items():
+        assert "params" in entry and "source" in entry, key
+    tuner = Autotuner(DEFAULT_TABLE_PATH)
+    hit = tuner.lookup("flash_fwd",
+                       shape_key(tq=8192, tk=8192, d=128, g=1, w=0),
+                       jnp.bfloat16, backend="tpu-v5e")
+    assert hit == {"block_q": 1024, "block_k": 1024,
+                   "kv_reuse": False}
+    # the T2048/D64 exception from the sweep survives seeding
+    hit = tuner.lookup("flash_fwd",
+                       shape_key(tq=2048, tk=2048, d=64, g=1, w=0),
+                       jnp.bfloat16, backend="tpu-v5e")
+    assert hit["block_q"] == 512 and hit["block_k"] == 1024
+
+
+def test_flash_pick_clamps_table_blocks_to_shape(monkeypatch,
+                                                 tmp_path):
+    """A table entry recorded at a big shape must come out
+    tile-legal when the same key pattern is consulted for a smaller
+    one (pick_fwd_params clamps blocks to the padded lengths)."""
+    from k8s_dra_driver_tpu.ops.flash_attention import pick_fwd_params
+
+    path = tmp_path / "t.json"
+    key = table_key("flash_fwd", shape_key(tq=64, tk=64, d=32, g=1,
+                                           w=0), jnp.float32, "cpu")
+    path.write_text(json.dumps({"entries": {
+        key: {"params": {"block_q": 1024, "block_k": 1024,
+                         "kv_reuse": False}, "source": "measured"}}}))
+    monkeypatch.setenv("TPU_AUTOTUNE_TABLE", str(path))
+    reset_autotuner()
+    try:
+        p = pick_fwd_params(64, 64, 32, dtype=jnp.float32)
+        assert p["block_q"] == 64       # round_up(64, 16)
+        assert p["block_k"] == 128      # round_up(64, 128)
+    finally:
+        monkeypatch.delenv("TPU_AUTOTUNE_TABLE")
+        reset_autotuner()
+
+
+@pytest.mark.parametrize("g,expect", [(1, False), (4, True)])
+def test_default_fwd_params_gqa_reuse(g, expect):
+    from k8s_dra_driver_tpu.ops.flash_attention import \
+        _default_fwd_params
+    p = _default_fwd_params(2048, 2048, 64, kv_group=g)
+    assert p["kv_reuse"] is expect
+    # windows stay off the packed grid (narrow grid owns them)
+    p = _default_fwd_params(2048, 2048, 64, kv_group=g, window=256)
+    assert p["kv_reuse"] is False
+
+
+def test_default_fwd_params_bounds_group_residency():
+    from k8s_dra_driver_tpu.ops.flash_attention import \
+        _default_fwd_params
+    p = _default_fwd_params(8192, 8192, 128, kv_group=8)
+    assert p["kv_reuse"] is True
+    # acc + stats residency capped at ~4 MB
+    assert 8 * p["block_q"] * (128 + 256) * 4 <= 4 * 2 ** 20

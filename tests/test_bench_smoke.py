@@ -371,6 +371,43 @@ def test_final_line_fits_driver_capture():
     assert parsed["detail_file"] == "tools/bench_full_latest.json"
 
 
+def test_compact_line_pins_tpu_present_preflight():
+    """r07 regression guard: that round's live bench "completed" but
+    the tunnel presented platform=cpu with no TPU, and the line did
+    not say so explicitly.  The compact line now always carries a
+    tpu_present boolean — true only for a real on-chip round, false
+    for the no-chip state, and STILL false (not absent) when a wedged
+    tunnel killed the probe child before it reported a platform —
+    so the three tunnel states are distinguishable across the
+    BENCH_r*.json trajectory."""
+    res = _worst_case_result()
+    res["detail"]["tpu"]["tpu_present"] = True
+    line = bench.compact_summary(res)
+    assert line["summary"]["tpu_present"] is True
+    assert line["summary"]["platform"] == "tpu"
+
+    res = _worst_case_result()
+    res["detail"]["tpu"]["platform"] = "cpu"
+    res["detail"]["tpu"]["tpu_present"] = False
+    line = bench.compact_summary(res)
+    assert line["summary"]["tpu_present"] is False
+    assert line["summary"]["platform"] == "cpu"
+
+    # wedged tunnel: the child died before yielding anything
+    res = _worst_case_result()
+    res["detail"]["tpu"] = {"child_error": {"returncode": -9,
+                                            "stderr_tail": "deadline"}}
+    line = bench.compact_summary(res)
+    assert line["summary"]["tpu_present"] is False
+    assert "platform" not in line["summary"]
+    assert "tpu_child" in line["summary"]["errors"]
+
+    # the probe stream itself yields the same boolean into the
+    # sidecar section (pin the generator's key, not just the summary)
+    src = open(bench.__file__).read()
+    assert '"tpu_present", platform == "tpu"' in src
+
+
 def test_fit_line_clips_tail_not_headline():
     """If a future probe roster outgrows the budget, _fit_line drops
     trailing summary keys — never the attention speedups up front."""

@@ -290,40 +290,26 @@ class TestInt8KVCache:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-6, atol=1e-6)
 
-    @pytest.mark.parametrize("gqa,window", [(False, 0), (True, 0),
-                                            (False, 6), (True, 6)])
-    def test_kv_kernel_path_matches_xla_path(self, monkeypatch, gqa,
-                                             window):
-        """TPU_KV_KERNEL=1 routes the int8-cache read through the
-        pallas flash kernel (in-VMEM dequant); its output must match
-        the XLA dequant path bit-for-bit in masking semantics —
-        mid-fill cache (stale garbage beyond pos must mask out), GQA
-        head routing, sliding window."""
-        from k8s_dra_driver_tpu.models.decode import (_cached_attention,
-                                                      _quantize_rows)
-        b, s_len, h, d = 2, 24, 4, 16
-        h_kv = 2 if gqa else h
-        cfg = dataclasses.replace(CFG, n_kv_heads=h_kv if gqa else 0,
-                                  attention_window=window, d_head=d,
-                                  n_heads=h)
-        q = jax.random.normal(jax.random.PRNGKey(0), (b, 1, h, d))
-        k = jax.random.normal(jax.random.PRNGKey(1), (b, s_len, h_kv, d))
-        v = jax.random.normal(jax.random.PRNGKey(2), (b, s_len, h_kv, d))
-        kq, ks = _quantize_rows(k)
-        vq, vs = _quantize_rows(v)
-        # garbage beyond the fill line: must be masked, not attended
-        fill = 13
-        kq = kq.at[:, fill:].set(107)
-        vq = vq.at[:, fill:].set(-93)
-        pos = jnp.int32(fill - 1)
-        # the reference MUST be the XLA path even if the shell exports
-        # the kernel flag (e.g. after a manual bench_int8 run)
-        monkeypatch.delenv("TPU_KV_KERNEL", raising=False)
-        want = _cached_attention(q, kq, vq, pos, 1, cfg, ks, vs)
-        monkeypatch.setenv("TPU_KV_KERNEL", "1")
-        got = _cached_attention(q, kq, vq, pos, 1, cfg, ks, vs)
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   rtol=2e-5, atol=2e-5)
+    def test_kv_kernel_path_is_retired(self):
+        """The gated int8-KV flash-read path is GONE, not merely off:
+        its 0.188x evidence lives in the retirement artifact
+        (tools/int8_kv_retirement_v5e.json) and no shipping code
+        consults TPU_KV_KERNEL anymore — a dead gate must not come
+        back without fresh recorded evidence."""
+        import json
+        import pathlib
+
+        from k8s_dra_driver_tpu.models import decode
+        assert not hasattr(decode, "_use_kv_kernel")
+        assert not hasattr(decode, "_kernel_cached_attention")
+        src = pathlib.Path(decode.__file__).read_text()
+        assert 'env_flag("TPU_KV_KERNEL")' not in src
+        art = json.loads(
+            (pathlib.Path(decode.__file__).parents[2] / "tools"
+             / "int8_kv_retirement_v5e.json").read_text())
+        assert art["decision"] == "retired"
+        assert art["evidence"][
+            "int8_kv8_kernel_speedup_vs_bf16_154m"] == 0.188
 
     def test_quantize_rows_error_bounded(self):
         from k8s_dra_driver_tpu.models.decode import _quantize_rows
@@ -464,24 +450,6 @@ class TestFusedGeneration:
 
         per_step, fused = drain(1), drain(24)
         assert per_step >= 8 * fused, (per_step, fused)
-
-    def test_kv_kernel_gate_defaults_off(self, monkeypatch):
-        """The int8-KV flash-read path stays opt-in: default OFF, and
-        the WEIGHT-kernel opt-in (TPU_QUANT_KERNEL) must not leak
-        into it — tools/int8_decode_v5e.json records it at 0.188x
-        bf16 at 154M (int8_kv8_kernel), the artifact behind the
-        gate."""
-        from k8s_dra_driver_tpu.models.decode import _use_kv_kernel
-        monkeypatch.delenv("TPU_KV_KERNEL", raising=False)
-        monkeypatch.setenv("TPU_QUANT_KERNEL", "1")
-        assert _use_kv_kernel(jnp.int32(0)) is False
-        monkeypatch.setenv("TPU_KV_KERNEL", "1")
-        assert _use_kv_kernel(jnp.int32(0)) is True
-        # per-row positions (continuous batching) never take it
-        assert _use_kv_kernel(jnp.zeros(3, jnp.int32)) is False
-        monkeypatch.setenv("TPU_KV_KERNEL", "0")
-        assert _use_kv_kernel(jnp.int32(0)) is False
-
 
 class TestSamplingAndRope:
     def test_top_p_limits_support(self):

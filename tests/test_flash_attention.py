@@ -615,3 +615,120 @@ def test_ring_attention_segments_match_reference(use_flash):
     np.testing.assert_allclose(val, val_ref, rtol=1e-4)
     for g, gr in zip(grads, grads_ref):
         np.testing.assert_allclose(g, gr, atol=2e-4, rtol=2e-4)
+
+
+class TestAutotunedVariants:
+    """Every autotuner-selected layout runs interpret-mode parity +
+    gradient checks in the FAST tier — windowed, GQA (the packed
+    K/V-reuse grid), and plain causal — so a bad tuned shape or grid
+    fails CI hermetically before it ever reaches a chip
+    (ops/autotune.py pick_fwd_params is the selection under test)."""
+
+    @pytest.mark.parametrize("t,d,h,h_kv,window", [
+        (128, 32, 4, 4, None),          # causal, interior fast path
+        (128, 32, 4, 1, None),          # MQA: packed grid, group=4
+        (130, 32, 4, 2, None),          # GQA + tail padding
+        (128, 32, 4, 4, 32),            # narrow-window grid
+        (128, 32, 4, 2, 32),            # window + GQA (flat grid)
+    ])
+    def test_selected_params_parity(self, t, d, h, h_kv, window):
+        from k8s_dra_driver_tpu.ops.flash_attention import \
+            pick_fwd_params
+        q = rand((2, t, h, d), 0)
+        k = rand((2, t, h_kv, d), 1)
+        v = rand((2, t, h_kv, d), 2)
+        params = pick_fwd_params(t, t, d, kv_group=h // h_kv,
+                                 window=window, dtype=q.dtype)
+        # the selection this test covers must be the one the entry
+        # point takes: GQA without a window selects the packed grid
+        assert params["kv_reuse"] is (h_kv < h and window is None)
+        out = flash_attention(q, k, v, causal=True, window=window)
+        ref = attention_reference(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.parametrize("h_kv,window", [(1, None), (2, None),
+                                             (4, 32), (2, 32)])
+    def test_selected_params_grads(self, h_kv, window):
+        """custom_vjp through the auto-selected layout (packed grid
+        for GQA, narrow grid for windows) against XLA autodiff of
+        the reference."""
+        t, d, h = 96, 32, 4
+        q = rand((1, t, h, d), 0)
+        k = rand((1, t, h_kv, d), 1)
+        v = rand((1, t, h_kv, d), 2)
+        w = rand((1, t, h, d), 3)
+
+        def loss(fn):
+            return lambda q, k, v: jnp.sum(
+                fn(q, k, v, causal=True, window=window) * w)
+
+        val, grads = jax.value_and_grad(
+            loss(flash_attention), argnums=(0, 1, 2))(q, k, v)
+        val_ref, grads_ref = jax.value_and_grad(
+            loss(attention_reference), argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(val, val_ref, rtol=1e-4)
+        for g, gr, name in zip(grads, grads_ref, "dq dk dv".split()):
+            np.testing.assert_allclose(g, gr, atol=2e-4, rtol=2e-4,
+                                       err_msg=name)
+
+    def test_packed_grid_equals_flat_grid(self):
+        """kv_reuse reorders the grid and the output row layout but
+        performs the same per-head block sweep: both grids must agree
+        tightly (same arithmetic, different residency)."""
+        B, T, H, HKV, D = 2, 96, 8, 2, 32
+        q, k, v = (rand((B, T, x, D), i) for i, x in
+                   enumerate((H, HKV, HKV)))
+        kw = dict(causal=True, block_q=16, block_k=128)
+        o1, m1, l1 = flash_block_attention(q, k, v, 0, 0,
+                                           kv_reuse=True, **kw)
+        o2, m2, l2 = flash_block_attention(q, k, v, 0, 0,
+                                           kv_reuse=False, **kw)
+        np.testing.assert_allclose(o1, o2, atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(m1, m2, atol=1e-6)
+        np.testing.assert_allclose(l1, l2, atol=1e-6, rtol=1e-6)
+
+    def test_packed_grid_with_segments_and_offsets(self):
+        """The packed grid composes with packed-sequence masking and
+        ring-style offsets (the stats must merge across blocks like
+        the flat grid's)."""
+        B, T, H, HKV, D = 1, 64, 4, 2, 32
+        q, k, v = (rand((B, T, x, D), i) for i, x in
+                   enumerate((H, HKV, HKV)))
+        seg = jnp.asarray(np.repeat([0, 1], T // 2)[None])
+        kw = dict(causal=True, block_q=16, block_k=128,
+                  q_segments=seg, k_segments=seg)
+        o1, m1, l1 = flash_block_attention(q, k, v, 64, 0,
+                                           kv_reuse=True, **kw)
+        o2, m2, l2 = flash_block_attention(q, k, v, 64, 0,
+                                           kv_reuse=False, **kw)
+        np.testing.assert_allclose(o1, o2, atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(l1, l2, atol=1e-6, rtol=1e-6)
+
+    def test_prescaled_q_respects_explicit_scale(self):
+        """The scale is folded into q outside the kernel now; an
+        explicit non-default scale must still match the reference
+        exactly (not silently use d**-0.5)."""
+        q, k, v = (rand((1, 64, 2, 32), i) for i in range(3))
+        out = flash_attention(q, k, v, causal=True, scale=0.3)
+        ref = attention_reference(q, k, v, causal=True, scale=0.3)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_interior_blocks_far_below_diagonal(self):
+        """Ring-style offsets can place every block strictly below
+        the causal diagonal — the mask-free interior body must then
+        carry the whole result (non-square Tq != Tk)."""
+        q = rand((1, 32, 2, 32), 0)
+        k = rand((1, 256, 2, 32), 1)
+        v = rand((1, 256, 2, 32), 2)
+        q_off = 256                      # queries strictly after keys
+        o, m, l = flash_block_attention(q, k, v, q_off, 0,
+                                        causal=True, block_q=16,
+                                        block_k=128)
+        from k8s_dra_driver_tpu.ops.flash_attention import \
+            normalize_flash_stats
+        out, _ = normalize_flash_stats(o, m, l)
+        scale = 32 ** -0.5
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        p = jax.nn.softmax(s, axis=-1)   # fully unmasked: all keys
+        ref = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)

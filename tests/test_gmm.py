@@ -175,3 +175,121 @@ class TestGmmDispatch:
         with pytest.raises(ValueError, match="divisible"):
             forward(shard_params(params, bad, mesh), tokens, bad,
                     mesh=mesh)
+
+
+class TestTilePacking:
+    """The MegaBlocks-style rework: dead-tail row blocks (the static
+    bound's over-provisioning past the last live group) are skipped,
+    zero-filled, and excluded from gradients — pinned against the
+    per-group einsum oracle in BOTH kernel modes, at the bigger
+    autotuned block_m values."""
+
+    @pytest.mark.parametrize("bm,dead_blocks", [(128, 2), (256, 1),
+                                                (512, 1)])
+    def test_dead_tail_matches_oracle_whole_mode(self, bm,
+                                                 dead_blocks):
+        groups = [bm, 0, bm]
+        live = sum(groups)
+        m = live + dead_blocks * bm            # static-bound tail
+        x = jax.random.normal(jax.random.PRNGKey(0), (m, 96))
+        x = x * (jnp.arange(m)[:, None] < live)     # routing zeros
+        w = jax.random.normal(jax.random.PRNGKey(1), (3, 96, 160))
+        gs = jnp.asarray(groups, jnp.int32)
+        got = gmm(x, w, gs, bm)
+        want = reference_gmm(x[:live], w, gs)
+        np.testing.assert_allclose(np.asarray(got[:live]),
+                                   np.asarray(want), rtol=2e-5,
+                                   atol=2e-5)
+        # dead rows: zero-filled, never NaN (pl.when skip hygiene)
+        tail = np.asarray(got[live:])
+        assert not np.isnan(tail).any()
+        assert np.abs(tail).max() == 0.0
+
+    def test_dead_tail_matches_oracle_blocked_mode(self):
+        """k*n too big for the whole-expert VMEM block on this suite
+        (interpret gate: kp*np_ > 2**21) — the blocked kernel's
+        dead-tail skip and its input-DMA index clamps."""
+        bm = 256
+        groups = [2 * bm, 0, bm]
+        live = sum(groups)
+        m = live + bm                          # one dead block
+        k_dim, n_dim = 1024, 2176
+        x = jax.random.normal(jax.random.PRNGKey(0), (m, k_dim))
+        x = x * (jnp.arange(m)[:, None] < live)
+        w = jax.random.normal(jax.random.PRNGKey(1),
+                              (3, k_dim, n_dim))
+        gs = jnp.asarray(groups, jnp.int32)
+        got = gmm(x, w, gs, bm)
+        want = reference_gmm(x[:live], w, gs)
+        np.testing.assert_allclose(np.asarray(got[:live]),
+                                   np.asarray(want), rtol=2e-3,
+                                   atol=2e-3)
+        assert np.abs(np.asarray(got[live:])).max() == 0.0
+
+    def test_dead_tail_grads_match_reference(self):
+        """custom VJP with a dead tail: dx/dw must equal autodiff of
+        the oracle on the live rows, dead x rows get zero cotangent,
+        and nothing NaNs (the dw kernel's last block may be dead —
+        its write path must still run)."""
+        bm = 128
+        groups = [bm, 0, 2 * bm]
+        live = sum(groups)
+        m = live + 2 * bm
+        x = jax.random.normal(jax.random.PRNGKey(0), (m, 96))
+        x = x * (jnp.arange(m)[:, None] < live)
+        w = jax.random.normal(jax.random.PRNGKey(1), (3, 96, 160))
+        gs = jnp.asarray(groups, jnp.int32)
+
+        def loss(x, w):
+            return jnp.sum(gmm(x, w, gs, bm) ** 2)
+
+        def loss_ref(xl, w):
+            return jnp.sum(reference_gmm(xl, w, gs) ** 2)
+
+        gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+        gx_ref, gw_ref = jax.grad(loss_ref, argnums=(0, 1))(x[:live], w)
+        np.testing.assert_allclose(np.asarray(gx[:live]), gx_ref,
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(gw), gw_ref,
+                                   rtol=2e-4, atol=2e-4)
+        assert np.abs(np.asarray(gx[live:])).max() == 0.0
+
+    def test_pick_gmm_blocks_defaults(self):
+        """The selection heuristic behind _gmm_block_m: small experts
+        keep block_m=128 (weight-stationary mode), blocked-mode
+        experts jump to 512 to cut weight re-streaming, and the
+        routed-row bound stops tiny workloads from over-padding."""
+        from k8s_dra_driver_tpu.ops.gmm import pick_gmm_blocks
+        small = pick_gmm_blocks(256, 512, 4, rows=4096,
+                                interpret=False)
+        assert small["block_m"] == 128
+        heavy = pick_gmm_blocks(1024, 4096, 16, rows=16384,
+                                interpret=False)
+        assert heavy["block_m"] == 512
+        tiny = pick_gmm_blocks(1024, 4096, 16, rows=64,
+                               interpret=False)
+        assert tiny["block_m"] == 128          # rows bound binds
+
+    def test_pick_gmm_blocks_honors_table(self, monkeypatch,
+                                          tmp_path):
+        import json
+
+        from k8s_dra_driver_tpu.ops.autotune import (reset_autotuner,
+                                                     shape_key,
+                                                     table_key)
+        from k8s_dra_driver_tpu.ops.gmm import pick_gmm_blocks
+        path = tmp_path / "t.json"
+        key = table_key("gmm", shape_key(k=96, n=160, e=3, r=512),
+                        jnp.float32, "cpu")
+        path.write_text(json.dumps({"entries": {
+            key: {"params": {"block_m": 256, "block_k": 512,
+                             "block_n": 512},
+                  "source": "measured"}}}))
+        monkeypatch.setenv("TPU_AUTOTUNE_TABLE", str(path))
+        reset_autotuner()
+        try:
+            p = pick_gmm_blocks(96, 160, 3, jnp.float32, rows=512)
+            assert p["block_m"] == 256
+        finally:
+            monkeypatch.delenv("TPU_AUTOTUNE_TABLE")
+            reset_autotuner()

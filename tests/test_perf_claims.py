@@ -1,0 +1,89 @@
+"""Perf-claims lint (tools/lint_perf_claims.py) in the fast tier.
+
+CLAUDE.md's rule — every perf claim traces to a recorded artifact —
+is enforced mechanically for the kernel tier (ops/ + models/): a
+stale number can no longer outlive its evidence (the round-8
+trigger: a "0.188x" citation pointing at a kernel path that had
+shipped disabled for two rounds).
+"""
+
+import sys
+import textwrap
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+
+import lint_perf_claims  # noqa: E402
+
+
+def test_repo_perf_claims_are_cited():
+    """THE gate: every numeric perf claim in ops/ and models/
+    docstrings cites a tools/*.json (or BENCH_r*.json) artifact that
+    exists and parses."""
+    problems = lint_perf_claims.lint()
+    assert problems == [], "\n".join(problems)
+
+
+def _scratch_repo(tmp_path, body, artifact=True):
+    mod_dir = tmp_path / "k8s_dra_driver_tpu" / "ops"
+    mod_dir.mkdir(parents=True)
+    (tmp_path / "k8s_dra_driver_tpu" / "models").mkdir()
+    (mod_dir / "fake.py").write_text(textwrap.dedent(body))
+    tools = tmp_path / "tools"
+    tools.mkdir()
+    if artifact:
+        (tools / "fake_v5e.json").write_text('{"ok": true}')
+    return tmp_path
+
+
+def test_uncited_claim_is_flagged(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        """Module docs, no citation."""
+        def f():
+            """This kernel runs 3.7x faster than XLA."""
+    ''')
+    problems = lint_perf_claims.lint(repo)
+    assert len(problems) == 1
+    assert "3.7x" in problems[0] and "[f]" in problems[0]
+
+
+def test_module_citation_covers_functions(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        """Module docs citing tools/fake_v5e.json."""
+        def f():
+            """This kernel runs 3.7x faster than XLA."""
+    ''')
+    assert lint_perf_claims.lint(repo) == []
+
+
+def test_dangling_citation_is_flagged(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        """Module cites tools/gone_v5e.json (deleted artifact)."""
+    ''', artifact=False)
+    problems = lint_perf_claims.lint(repo)
+    assert len(problems) == 1
+    assert "missing or unparseable" in problems[0]
+
+
+def test_unparseable_artifact_is_flagged(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        """Module cites tools/fake_v5e.json."""
+    ''')
+    (repo / "tools" / "fake_v5e.json").write_text("{torn")
+    problems = lint_perf_claims.lint(repo)
+    assert len(problems) == 1
+    assert "missing or unparseable" in problems[0]
+
+
+def test_tile_spellings_are_not_claims(tmp_path):
+    """Shape spellings like 2x2 slices or 4x4 tiles are not perf
+    claims; unit-bearing numbers (TF, GB/s, ms/token) are."""
+    repo = _scratch_repo(tmp_path, '''
+        """A 2x2 slice of the 4x4 mesh — no evidence needed."""
+    ''')
+    assert lint_perf_claims.lint(repo) == []
+    repo2 = _scratch_repo(tmp_path / "r2", '''
+        """Hits 111 TF at T8192 on this shape."""
+    ''', artifact=False)
+    problems = lint_perf_claims.lint(repo2)
+    assert len(problems) == 1 and "111" in problems[0]

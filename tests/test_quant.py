@@ -207,9 +207,9 @@ def test_kernel_gate_is_opt_in(monkeypatch):
     """_use_kernel: the pallas path requires TPU_QUANT_KERNEL truthy
     AND a decode-shaped m — the XLA einsum is the stable,
     artifact-backed default (the kernel's capture-to-capture variance
-    is why; see quant.py).  '0' and '' disable like unset, matching
-    TPU_KV_KERNEL's parsing so symmetric =0 settings force the pure
-    XLA path for measurements."""
+    is why; see quant.py).  '0' and '' disable like unset (the one
+    env_flag parsing), so an explicit =0 forces the pure XLA path
+    for measurements."""
     from k8s_dra_driver_tpu.models.quant import _use_kernel
 
     monkeypatch.delenv("TPU_QUANT_KERNEL", raising=False)
@@ -221,3 +221,77 @@ def test_kernel_gate_is_opt_in(monkeypatch):
     assert _use_kernel(8) is False             # explicit off
     monkeypatch.setenv("TPU_QUANT_KERNEL", "")
     assert _use_kernel(8) is False             # empty = off
+
+
+class TestFusedDequantKernels:
+    """The reworked pallas path: dequant-matmul AND the per-channel
+    rescale are ONE kernel (fused epilogue — the f32 product never
+    round-trips HBM) with tiles from the autotune table.  Parity is
+    pinned against the explicit dequantized einsum in interpret mode,
+    including ragged (non-tile-multiple) dims and output dtype."""
+
+    @pytest.mark.parametrize("m,k,n", [(8, 96, 160), (3, 200, 130),
+                                       (64, 256, 512)])
+    def test_int8_matmul_matches_dequant_einsum(self, m, k, n):
+        from k8s_dra_driver_tpu.models.quant import (int8_matmul,
+                                                     quantize)
+        x = jax.random.normal(jax.random.PRNGKey(0), (m, k))
+        w = jax.random.normal(jax.random.PRNGKey(1), (k, n))
+        q = quantize(w, (0,))
+        got = int8_matmul(x, q.q, q.scale.reshape(1, n))
+        want = x @ q.dequant()
+        assert got.dtype == x.dtype        # epilogue downcasts
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_int8_matmul_bf16_output_dtype(self):
+        from k8s_dra_driver_tpu.models.quant import (int8_matmul,
+                                                     quantize)
+        x = jax.random.normal(jax.random.PRNGKey(0), (8, 128),
+                              jnp.bfloat16)
+        w = jax.random.normal(jax.random.PRNGKey(1), (128, 256))
+        q = quantize(w, (0,))
+        got = int8_matmul(x, q.q, q.scale.reshape(1, 256))
+        assert got.dtype == jnp.bfloat16
+        want = (x.astype(jnp.float32) @ q.dequant())
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want),
+            rtol=2e-2, atol=2e-2)
+
+    def test_int8_bmm_matches_dequant_einsum(self):
+        from k8s_dra_driver_tpu.models.quant import int8_bmm, quantize
+        g, m, k, n = 3, 5, 96, 130
+        x = jax.random.normal(jax.random.PRNGKey(0), (g, m, k))
+        w = jax.random.normal(jax.random.PRNGKey(1), (g, k, n))
+        q = quantize(w, (1,))                  # per (expert, channel)
+        got = int8_bmm(x, q.q, q.scale.reshape(g, 1, n))
+        want = jnp.einsum("gmk,gkn->gmn", x, q.dequant())
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_pick_int8_tiles_default_and_table(self, monkeypatch,
+                                               tmp_path):
+        import json
+
+        from k8s_dra_driver_tpu.models.quant import pick_int8_tiles
+        from k8s_dra_driver_tpu.ops.autotune import (reset_autotuner,
+                                                     shape_key,
+                                                     table_key)
+        # heuristic: full-K tiles at decode M, clamped past M=256
+        assert pick_int8_tiles(8, 2048, 512) == {"bk": 2048,
+                                                 "bn": 512}
+        assert pick_int8_tiles(512, 2048, 512)["bk"] == 512
+        path = tmp_path / "t.json"
+        key = table_key("int8_matmul", shape_key(m=8, k=2048, n=512),
+                        jnp.bfloat16, "cpu")
+        path.write_text(json.dumps({"entries": {
+            key: {"params": {"bk": 1024, "bn": 256},
+                  "source": "measured"}}}))
+        monkeypatch.setenv("TPU_AUTOTUNE_TABLE", str(path))
+        reset_autotuner()
+        try:
+            assert pick_int8_tiles(8, 2048, 512) == {"bk": 1024,
+                                                     "bn": 256}
+        finally:
+            monkeypatch.delenv("TPU_AUTOTUNE_TABLE")
+            reset_autotuner()

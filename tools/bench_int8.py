@@ -10,20 +10,25 @@ config, ops/collectives.py:decode_probe, differential-median harness):
 - ``int8_xla``    — the default path: XLA's einsum fuses the int8
   convert into the dot (and, as recorded, outruns the kernel).
 
-Run on a idle v5e chip from the repo root:
+Shared setup (header provenance, fresh-subprocess measurement,
+autotune-shape emission) comes from tools/benchlib.py; the artifact
+records the autotuner's chosen int8 tiles per shape so a future
+regression bisects to a tuning change vs a kernel change.
+
+Run on an idle v5e chip from the repo root:
     python tools/bench_int8.py
 """
 
 from __future__ import annotations
 
 import json
-import os
 import pathlib
-import platform
-import subprocess
 import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+import benchlib  # noqa: E402
 
 
 #: the two recorded shapes: "small" (the bench default, 154M params)
@@ -37,56 +42,39 @@ SHAPES = {
 
 
 def measure(shape: dict, int8: bool, kernel: bool = False,
-            reps: int = 2, kv_int8: bool = False,
-            kv_kernel: bool = False) -> dict:
+            reps: int = 2, kv_int8: bool = False) -> dict:
     """Each measurement runs in a fresh subprocess: jit caches key on
-    shapes, not on TPU_QUANT_KERNEL/TPU_KV_KERNEL, so an in-process
-    comparison would silently reuse one path's executable for both."""
+    shapes, not on TPU_QUANT_KERNEL, so an in-process comparison
+    would silently reuse one path's executable for both
+    (benchlib.measure_in_subprocess owns the mechanics)."""
     code = (
         "import json, sys\n"
         "from k8s_dra_driver_tpu.ops.collectives import decode_probe\n"
         f"res = decode_probe(reps={reps}, int8={int8}, "
         f"kv_int8={kv_int8}, **{shape!r})\n"
         "print('RESULT ' + json.dumps(res))\n")
-    env = dict(os.environ)
     # set the flag explicitly both ways (unset already means XLA —
-    # the kernels are opt-in): hardening against an ambient
-    # TPU_QUANT_KERNEL=1 inherited through dict(os.environ)
-    env["TPU_QUANT_KERNEL"] = "1" if kernel else "0"
-    if kv_kernel:
-        env["TPU_KV_KERNEL"] = "1"
-    else:
-        env.pop("TPU_KV_KERNEL", None)
-    proc = subprocess.run(
-        [sys.executable, "-c", code], capture_output=True, text=True,
-        env=env, cwd=str(pathlib.Path(__file__).resolve().parent.parent))
-    for line in proc.stdout.splitlines():
-        if line.startswith("RESULT "):
-            res = json.loads(line[len("RESULT "):])
-            return {k: (round(v, 4) if isinstance(v, float) else v)
-                    for k, v in res.items()}
-    # one transient tunnel glitch must not discard the other 15
-    # readings of an interleaved run — record the failure and move on
-    return {"valid": False, "ms_per_token": float("inf"),
-            "error": proc.stderr[-500:].strip() or "no RESULT line"}
+    # the kernel is opt-in): hardening against an ambient
+    # TPU_QUANT_KERNEL=1 inherited through the environment
+    res = benchlib.measure_in_subprocess(
+        code, env={"TPU_QUANT_KERNEL": "1" if kernel else "0"})
+    if "error" in res:
+        # one transient tunnel glitch must not discard the other
+        # readings of an interleaved run — record it and move on
+        return {"valid": False, "ms_per_token": float("inf"),
+                "error": res["error"]}
+    return res
 
 
 def main() -> None:
-    from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
-    enable_persistent_cache()
-    import jax
-    out = {
-        "what": ("decode ms/token for bf16 vs weight-only int8, kernel "
-                 "vs XLA-fallback paths; the artifact behind "
-                 "models/quant.py's recorded perf claims"),
-        "host": platform.node(),
-        "device": str(jax.devices()[0]),
-        "commit": subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True).stdout.strip(),
-        "harness": "ops/collectives.py:decode_probe "
-                   "(_differential_median over scan lengths)",
-        "provenance_note": (
+    benchlib.setup_jax()
+    out = benchlib.artifact_header(
+        what=("decode ms/token for bf16 vs weight-only int8, kernel "
+              "vs XLA-fallback paths; the artifact behind "
+              "models/quant.py's recorded perf claims"),
+        harness="ops/collectives.py:decode_probe "
+                "(_differential_median over scan lengths)",
+        provenance_note=(
             "Run on an IDLE machine: an r05 capture taken while the "
             "test suite loaded the host recorded a 2x-degraded bf16 "
             "baseline (3.75 vs 1.84 ms/token at 660M) and briefly "
@@ -96,7 +84,7 @@ def main() -> None:
             "swing ~2.5x (1.26 vs 3.20 ms/token, same code) — the "
             "basis for keeping the kernel opt-in "
             "(models/quant.py:_use_kernel)."),
-    }
+    )
     # The tunneled chip's observed throughput drifts by 3-5x across
     # minutes; each variant keeps its best *valid* (physical-floor-
     # checked) reading over several interleaved rounds — the floor
@@ -107,11 +95,9 @@ def main() -> None:
         "bf16": dict(int8=False),
         "int8_kernel": dict(int8=True, kernel=True),
         "int8_kv8": dict(int8=True, kv_int8=True),
-        # int8 KV read through the pallas flash kernel (in-VMEM
-        # dequant, TPU_KV_KERNEL=1): the structural fix candidate for
-        # the 660M read-side fusion regression
-        "int8_kv8_kernel": dict(int8=True, kv_int8=True,
-                                kv_kernel=True),
+        # int8_kv8_kernel is GONE: the int8-KV flash-read path was
+        # retired (tools/int8_kv_retirement_v5e.json) — 0.188x bf16
+        # in the r05 clean capture, shipped disabled for two rounds
         "int8_xla": dict(int8=True),      # the default path
     }
     rounds = 2
@@ -129,16 +115,31 @@ def main() -> None:
                          and better):
                     sec[name] = res
         if sec["bf16"]["valid"]:
-            for name in ("int8_kernel", "int8_kv8",
-                         "int8_kv8_kernel", "int8_xla"):
+            for name in ("int8_kernel", "int8_kv8", "int8_xla"):
                 if sec[name]["valid"]:
                     sec[f"{name}_speedup_vs_bf16"] = round(
                         sec["bf16"]["ms_per_token"]
                         / sec[name]["ms_per_token"], 3)
         out[shape_name] = sec
     out["rounds"] = rounds
-    path = pathlib.Path(__file__).parent / "int8_decode_v5e.json"
-    path.write_text(json.dumps(out, indent=1) + "\n")
+    # the autotuner's chosen int8 tiles for each measured shape: the
+    # int8_kernel variant's decode matmuls run M=batch rows against
+    # each layer's [K, N] weights — record what the selection path
+    # resolved so a future regression bisects to tuning vs kernel
+    from k8s_dra_driver_tpu.models.quant import pick_int8_tiles
+    choices = {}
+    for shape_name, shape in SHAPES.items():
+        d_model = shape.get("d_model", 1024)
+        d_ff = shape.get("d_ff", 4096)
+        batch = shape.get("batch", 8)
+        choices[shape_name] = {
+            "attn_qkv": pick_int8_tiles(batch, d_model, d_model),
+            "mlp_in": pick_int8_tiles(batch, d_model, d_ff),
+            "mlp_out": pick_int8_tiles(batch, d_ff, d_model),
+        }
+    out["autotune"] = benchlib.autotune_note(choices)
+    benchlib.write_artifact(
+        pathlib.Path(__file__).parent / "int8_decode_v5e.json", out)
     print(json.dumps(out, indent=1))
 
 

@@ -15,6 +15,12 @@ session measurements only; this tool records them properly
   under the causal-optimum (1024, 1024) blocks vs the band-narrowing
   (512, 512) choice ``pick_blocks`` deliberately rejects.
 
+Shared setup (header provenance, autotune-shape emission) comes from
+tools/benchlib.py; the artifact records what the autotuner resolved
+for every measured shape — including whether the GQA rows ran the
+K/V-reuse grid — so a future regression bisects to a tuning change
+vs a kernel change.
+
 Run on an idle v5e chip from the repo root:
     python tools/bench_kernel_claims.py
 """
@@ -23,21 +29,21 @@ from __future__ import annotations
 
 import json
 import pathlib
-import platform
-import subprocess
 import sys
 
 sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent))
+
+import benchlib  # noqa: E402
 
 OUT = pathlib.Path(__file__).parent / "kernel_claims_v5e.json"
 
 
 def main() -> None:
-    from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
-    enable_persistent_cache()
-    import jax
+    benchlib.setup_jax()
 
     from k8s_dra_driver_tpu.ops import attention_probe
+    from k8s_dra_driver_tpu.ops.flash_attention import pick_fwd_params
 
     def row(**kw):
         r = attention_probe(batch=4, seq=2048, heads=8, iters=16,
@@ -52,26 +58,28 @@ def main() -> None:
         r = attention_probe(batch=1, seq=8192, heads=8, iters=16,
                             window=1024, samples=5,
                             block_q=bq, block_k=bk)
-        r["blocks"] = "auto(1024,1024)" if bq is None else f"({bq},{bk})"
+        r["blocks"] = "auto" if bq is None else f"({bq},{bk})"
         win.append({k: (round(v, 3) if isinstance(v, float) else v)
                     for k, v in r.items()})
 
-    out = {
-        "what": ("evidence for two flash-kernel docstring claims: "
-                 "GQA forward never costs kernel time vs MHA (modest "
-                 "gain from reduced K/V traffic; the footprint is the "
-                 "big win) and window block choice (band-narrowing "
-                 "(512,512) loses to the causal-optimum (1024,1024)); "
-                 "median-of-5 flash samples per row, all runs listed"),
-        "host": platform.node(),
-        "device": str(jax.devices()[0]),
-        "commit": subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True).stdout.strip(),
-        "gqa_parity_b4_t2048_h8": gqa,
-        "window_blocks_t8192_w1024": win,
-    }
-    OUT.write_text(json.dumps(out, indent=1))
+    out = benchlib.artifact_header(
+        what=("evidence for two flash-kernel docstring claims: "
+              "GQA forward never costs kernel time vs MHA (modest "
+              "gain from reduced K/V traffic; the footprint is the "
+              "big win) and window block choice (band-narrowing "
+              "(512,512) loses to the causal-optimum (1024,1024)); "
+              "median-of-5 flash samples per row, all runs listed"),
+        harness="ops/collectives.py:attention_probe "
+                "(measure_chain_samples differential-median)",
+    )
+    out["gqa_parity_b4_t2048_h8"] = gqa
+    out["window_blocks_t8192_w1024"] = win
+    out["autotune"] = benchlib.autotune_note({
+        f"gqa_kv{kv or 8}": pick_fwd_params(2048, 2048, 64,
+                                            kv_group=8 // (kv or 8))
+        for kv in (None, 4, 2)
+    } | {"window_t8192": pick_fwd_params(8192, 8192, 64, window=1024)})
+    benchlib.write_artifact(OUT, out)
     summary = {
         "gqa_flash_ms_by_kv_heads": {str(r["kv_heads"]): r["flash_ms"]
                                      for r in gqa},

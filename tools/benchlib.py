@@ -1,0 +1,101 @@
+"""Shared setup for the tools/bench_*.py evidence recorders.
+
+The gateway probes got this discipline in PR 7 (gateway/calibrate.py:
+ONE definition of "self-calibrated capacity" so probes cannot drift);
+the kernel-evidence recorders get the same treatment here: one
+definition of the artifact header (host/device/commit/harness
+provenance every artifact must carry), one fresh-subprocess
+measurement rule (jit caches key on shapes, not env flags — an
+in-process A/B silently reuses one path's executable for both), and
+one way to emit the autotuner's chosen shapes into an artifact so a
+future regression can be bisected to a tuning change vs a kernel
+change.
+
+Import as ``import benchlib`` from a tools/ script (they all put the
+repo root AND tools/ on sys.path) or as ``from tools import benchlib``
+from tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pathlib
+import platform
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+
+def setup_jax():
+    """Repo path + persistent compilation cache + jax import — every
+    recorder's preamble (probe wall time on the tunneled chip is
+    compile-dominated; a warm cache is the difference between a
+    finished artifact and a deadline kill)."""
+    if str(REPO) not in sys.path:
+        sys.path.insert(0, str(REPO))
+    from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
+    enable_persistent_cache()
+    import jax
+    return jax
+
+
+def artifact_header(what: str, harness: str, **extra) -> dict:
+    """The provenance block every recorded artifact leads with."""
+    import jax
+    return {
+        "what": what,
+        "host": platform.node(),
+        "device": str(jax.devices()[0]),
+        "commit": subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=str(REPO),
+            capture_output=True, text=True).stdout.strip(),
+        "harness": harness,
+        **extra,
+    }
+
+
+def autotune_note(choices: dict) -> dict:
+    """Record WHAT the autotuner chose for the shapes a recorder
+    measured (``choices``: name -> params dict from the real runtime
+    pickers), plus which table/backend resolved them — the bisection
+    anchor: if a future capture regresses, this says whether the
+    tuning changed under the kernel or the kernel changed under the
+    tuning."""
+    from k8s_dra_driver_tpu.ops.autotune import backend_key, get_autotuner
+
+    tuner = get_autotuner()
+    return {
+        "backend": backend_key(),
+        "table": str(tuner.path.relative_to(REPO)
+                     if tuner.path and tuner.path.is_relative_to(REPO)
+                     else tuner.path),
+        "choices": choices,
+    }
+
+
+def measure_in_subprocess(code: str, env: dict | None = None,
+                          timeout_s: float = 1200) -> dict:
+    """Run ``code`` in a fresh interpreter and parse its
+    ``RESULT <json>`` line; float values rounded for artifacts.
+    Returns ``{"error": ...}`` instead of raising — one transient
+    tunnel glitch must not void an interleaved capture."""
+    full_env = dict(os.environ)
+    full_env.update(env or {})
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True,
+            text=True, env=full_env, cwd=str(REPO), timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout_s}s"}
+    for line in proc.stdout.splitlines():
+        if line.startswith("RESULT "):
+            res = json.loads(line[len("RESULT "):])
+            return {k: (round(v, 4) if isinstance(v, float) else v)
+                    for k, v in res.items()}
+    return {"error": proc.stderr[-500:].strip() or "no RESULT line"}
+
+
+def write_artifact(path: os.PathLike | str, payload: dict) -> None:
+    pathlib.Path(path).write_text(json.dumps(payload, indent=1) + "\n")

@@ -1,0 +1,123 @@
+"""Static lint: perf claims in docstrings must cite live artifacts.
+
+CLAUDE.md's rule is that every perf claim traces to a recorded
+artifact; until now nothing enforced it, so a number could outlive
+its evidence (the round-8 trigger: models/decode.py cited "0.188x"
+against a kernel path that had already shipped disabled for two
+rounds).  This lint makes the rule mechanical for the kernel tier:
+
+- scope: every docstring in ``k8s_dra_driver_tpu/ops`` and
+  ``k8s_dra_driver_tpu/models``;
+- a **claim** is a perf-shaped number — ``1.61x`` / ``0.188x``
+  speedups, ``111 TF`` / ``133 TFLOPs``, ``820 GB/s``,
+  ``2.87 ms/token``, ``14836 tokens/s``;
+- every docstring containing a claim must cite at least one
+  ``tools/<name>.json`` artifact **that exists and parses** — either
+  in the same docstring or (for function/class docstrings) in the
+  module docstring, which sets the module's evidence context;
+- every artifact citation anywhere in scope must resolve, claims or
+  not: a dangling citation is a stale pointer.
+
+Run from the repo root (CI runs it in the fast tier,
+tests/test_perf_claims.py)::
+
+    python tools/lint_perf_claims.py
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SCOPES = ("k8s_dra_driver_tpu/ops", "k8s_dra_driver_tpu/models")
+
+#: perf-shaped numbers: "1.61x" (not "2x2" tile spellings), and
+#: numbers wearing a throughput/latency/bandwidth unit
+CLAIM_RE = re.compile(
+    r"\b\d+(?:\.\d+)?x(?![\w])"
+    r"|\b\d+(?:\.\d+)?\s*(?:TFLOPs?\b|TF\b|GB/s|MB/s"
+    r"|ms/token|tokens?/s|tok/s)")
+
+#: recorded evidence lives in tools/*.json plus the per-round
+#: BENCH_r*/MULTICHIP_r* captures at the repo root
+ARTIFACT_RE = re.compile(
+    r"tools/[\w.\-]+\.json|(?:BENCH|MULTICHIP)_r\d+\.json")
+
+
+def _docstrings(tree: ast.Module):
+    """Yield (kind, name, lineno, docstring) for the module and every
+    class/function that has one."""
+    doc = ast.get_docstring(tree)
+    if doc:
+        yield "module", "<module>", 1, doc
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            doc = ast.get_docstring(node)
+            if doc:
+                yield type(node).__name__, node.name, node.lineno, doc
+
+
+def _artifact_ok(cite: str, repo: pathlib.Path) -> bool:
+    path = repo / cite
+    if not path.exists():
+        return False
+    try:
+        json.loads(path.read_text())
+    except ValueError:
+        return False
+    return True
+
+
+def lint_file(path: pathlib.Path,
+              repo: pathlib.Path = REPO) -> list[str]:
+    rel = path.relative_to(repo)
+    tree = ast.parse(path.read_text())
+    entries = list(_docstrings(tree))
+    module_cites = []
+    for kind, _, _, doc in entries:
+        if kind == "module":
+            module_cites = ARTIFACT_RE.findall(doc)
+    problems = []
+    for kind, name, lineno, doc in entries:
+        cites = ARTIFACT_RE.findall(doc)
+        for cite in cites:
+            if not _artifact_ok(cite, repo):
+                problems.append(
+                    f"{rel}:{lineno} [{name}] cites {cite} which is "
+                    "missing or unparseable")
+        claims = CLAIM_RE.findall(doc)
+        if claims and not (cites or module_cites):
+            shown = ", ".join(sorted(set(claims))[:5])
+            problems.append(
+                f"{rel}:{lineno} [{name}] makes perf claims ({shown}) "
+                "but neither it nor the module docstring cites a "
+                "tools/*.json artifact")
+    return problems
+
+
+def lint(repo: pathlib.Path = REPO) -> list[str]:
+    problems = []
+    for scope in SCOPES:
+        for path in sorted((repo / scope).glob("*.py")):
+            problems.extend(lint_file(path, repo))
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} perf-claim lint problem(s)")
+        return 1
+    print("perf-claims lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

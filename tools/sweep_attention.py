@@ -3,9 +3,11 @@
 Measures the pallas flash kernel against naive XLA attention across
 long-context shapes and (block_q, block_k) tilings with the
 differential-median harness (fixed dispatch overhead cancels), and
-prints a JSON report.  The autotune table in
-ops/flash_attention.py:pick_blocks is derived from this sweep; re-run
-after kernel changes:
+prints a JSON report.  The ops/autotune.py table consumed by
+ops/flash_attention.py:pick_fwd_params was originally seeded from
+this sweep; tools/bench_autotune.py is the richer successor (it also
+sweeps the GQA K/V-reuse grid and writes the table directly) — keep
+this tool for the flash-vs-naive speedup evidence:
 
     python tools/sweep_attention.py [--quick]
 
