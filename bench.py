@@ -95,6 +95,16 @@ TINY_FLEET_KWARGS = dict(tp=2, train_dp=2, batch=4, seq_len=16,
                          d_model=32, n_layers=2, heads=4, d_ff=64,
                          vocab=64)
 
+#: hermetic shape for the multi-tenant fleet probe (same contract:
+#: test_bench_smoke pins exactly what bench streams) — a dp=2/tp=1
+#: floor-zero gang plus one hi-priority serving replica over a 3-chip
+#: ledger, one two-tenant cascade cycle (burst -> park -> grant ->
+#: serve -> release -> regrow from the parked checkpoint)
+TINY_MT_KWARGS = dict(tp=1, train_dp=2, batch=4, seq_len=16,
+                      n_requests=10, max_new=4, slots=2,
+                      d_model=32, n_layers=2, heads=4, d_ff=64,
+                      vocab=64)
+
 #: control-plane ceiling probe (gateway/ctlprobe.py): NO-OP engines +
 #: open-loop trace replay, so the scalars isolate admission/routing
 #: decisions per second from model compute.  Always CPU-meaningful
@@ -490,6 +500,45 @@ def _fleet_probe(timeout_s: float = 300.0) -> dict:
         + "import json\n"
         "from k8s_dra_driver_tpu.fleet.probe import fleet_probe\n"
         f"print(json.dumps(fleet_probe(**json.loads({kwargs!r}))))\n")
+    env = cpu_jax_env(8)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = ("8-virtual-device CPU mesh; " +
+                       payload.get("note", ""))
+    return payload
+
+
+def _fleet_multitenant_probe(timeout_s: float = 300.0) -> dict:
+    """Multi-tenant fleet probe (fleet/probe.py multitenant_probe) in
+    a CPU-pinned subprocess: preemption-cascade MTTR, the bin-packed
+    vs naive placement regrow-width ratio, and the fair-share
+    allocation error through one two-tenant contention cycle.  Always
+    a CPU-mesh run — arbitration wall time (park + checkpoint +
+    replica spawn + EXPAND regrow) is what is measured."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    kwargs = json.dumps(TINY_MT_KWARGS)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.fleet.probe import "
+        "multitenant_probe\n"
+        f"r = multitenant_probe(**json.loads({kwargs!r}))\n"
+        "r.pop('frag', None)\n"
+        "print(json.dumps(r))\n")
     env = cpu_jax_env(8)
     try:
         res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
@@ -978,6 +1027,10 @@ _PROBE_SCALARS = (
     ("fleet", "fleet_scaleup_ms", "scaleup_ms"),
     ("fleet", "fleet_preempt_ms", "preempt_ms"),
     ("fleet", "fleet_regrow_ms", "regrow_ms"),
+    ("fleet_multitenant", "mt_preempt_cascade_ms",
+     "preempt_cascade_ms"),
+    ("fleet_multitenant", "mt_frag_win_x", "frag_win_x"),
+    ("fleet_multitenant", "mt_fairshare_err", "fairshare_err"),
     ("control_plane", "ctl_admissions_per_s", "admissions_per_s"),
     ("control_plane", "ctl_routes_per_s", "routes_per_s"),
     ("control_plane", "ctl_goodput_flat_x", "goodput_flat_x"),
@@ -1195,6 +1248,14 @@ def main() -> None:
                 timeout_s=min(300.0, _remaining() - 60.0))
         else:
             fleet = {"error": "skipped: wall budget"}
+        # 3c2. Multi-tenant fleet probe (hermetic, CPU subprocess):
+        #      one two-tenant cascade cycle — cascade MTTR, packed-vs-
+        #      naive regrow width, fair-share error.
+        if _remaining() > 120:
+            fleet_mt = _fleet_multitenant_probe(
+                timeout_s=min(300.0, _remaining() - 60.0))
+        else:
+            fleet_mt = {"error": "skipped: wall budget"}
         # 3d. Control-plane ceiling probe (hermetic, CPU subprocess):
         #     admissions/s + routes/s over no-op engines under
         #     open-loop trace replay, swept over pump counts.
@@ -1212,6 +1273,7 @@ def main() -> None:
         compute["allreduce_cpu_mesh8"] = cpu_mesh
         compute["supervisor_recovery"] = recovery
         compute["fleet"] = fleet
+        compute["fleet_multitenant"] = fleet_mt
         compute["control_plane"] = ctl
         detail["tpu"] = compute
         detail["baseline_note"] = (

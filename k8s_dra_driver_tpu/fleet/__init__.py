@@ -7,26 +7,43 @@ ledger's health-and-ownership view, hysteresis policy in between, and
 actuation exclusively through existing machinery — replica
 scale-up/drain/retire and the gang supervisor's
 checkpoint-then-shrink / EXPAND-regrow ``request_width`` API.
+The multi-tenant tier (tenancy.py + binpack.py) generalizes the loop
+from 1×1 to N gangs + N pools: per-tenant quotas/priority
+classes/floors, a fair-share arbiter with a strict-priority
+preemption cascade, and ICI-topology bin-packing with link-domain
+overlap tokens.
 """
 
+from .binpack import Placement, TopologyBinPacker
 from .policy import (Action, DemandSignals, FleetPolicy, PolicyConfig,
-                     PREEMPT, REGROW, SCALE_DOWN, SCALE_UP)
-from .reconciler import FleetReconciler
-from .supply import ChipLedger, SupplyView
+                     Streaks, PREEMPT, REGROW, SCALE_DOWN, SCALE_UP)
+from .reconciler import FleetReconciler, read_demand
+from .supply import (ChipLedger, SupplyView, owner_tenant,
+                     serving_tag, training_tag)
+from .tenancy import (FairShareArbiter, MtAction, MtConfig,
+                      MultiTenantReconciler, ServingTenant,
+                      TenantRegistry, TenantSpec, TenantState,
+                      TrainingTenant, entitlements)
 
 __all__ = [
-    "Action", "ChipLedger", "DemandSignals", "FleetPolicy",
-    "FleetReconciler", "PolicyConfig", "SupplyView",
+    "Action", "ChipLedger", "DemandSignals", "FairShareArbiter",
+    "FleetPolicy", "FleetReconciler", "MtAction", "MtConfig",
+    "MultiTenantReconciler", "Placement", "PolicyConfig",
+    "ServingTenant", "Streaks", "SupplyView", "TenantRegistry",
+    "TenantSpec", "TenantState", "TopologyBinPacker",
+    "TrainingTenant", "entitlements", "owner_tenant", "read_demand",
+    "serving_tag", "training_tag",
     "PREEMPT", "REGROW", "SCALE_DOWN", "SCALE_UP",
-    "fleet_probe",
+    "fleet_probe", "fragmentation_probe", "multitenant_probe",
 ]
 
 
 def __getattr__(name):
-    # the probe pulls in the models layer (jax, orbax) — loaded on
+    # the probes pull in the models layer (jax, orbax) — loaded on
     # demand so control-plane consumers stay light (the parallel/
     # package's lazy pattern)
-    if name == "fleet_probe":
-        from .probe import fleet_probe
-        return fleet_probe
+    if name in ("fleet_probe", "fragmentation_probe",
+                "multitenant_probe"):
+        from . import probe
+        return getattr(probe, name)
     raise AttributeError(name)

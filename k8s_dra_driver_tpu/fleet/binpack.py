@@ -1,0 +1,229 @@
+"""ICI-topology bin-packing for k concurrent chip owners.
+
+The 1x1 fleet kept fragmentation at bay with two conventions — gang
+from the HEAD of the ledger order, serving from the TAIL — which stop
+working the moment a second gang or a second pool exists: k owners
+interleaving first-fit allocations shred the ICI order into
+single-chip holes, and a victim tenant that later frees its chips
+hands back confetti instead of a regrow block.  This module is the
+placement brain the multi-tenant reconciler (fleet/tenancy.py) asks
+"WHICH chip/run", generalizing the ledger's contiguous-run logic to
+k owners with two ideas from the reference driver's MIG placement
+model (SURVEY §2.1 #11):
+
+- **Link domains as overlap tokens.**  MIG profiles publish
+  overlapping ``memorySlice<i>`` capacities so the scheduler can
+  never co-allocate two profiles that straddle the same physical
+  slice (reference deviceinfo.go:195-198).  The TPU analog: the
+  ledger order (= ICI order, parallel/mesh.py) is partitioned into
+  fixed **link domains** of ``domain_size`` adjacent chips — the
+  chips sharing one ICI link group — and a domain is a token at most
+  ONE tenant may hold.  A placement whose domains contain another
+  tenant's chip is a conflict: two tenants never straddle the same
+  link domain, so one tenant's traffic cannot ride (or jam) a
+  domain whose remaining chips belong to someone else, and a freed
+  tenant always frees whole domains.
+- **Anti-fragmentation scoring.**  Among conflict-free candidates,
+  prefer placements that keep each tenant's chips dense (fill a
+  domain the tenant already holds, pack next to its own block) and
+  far from OTHER tenants' blocks — the farther a new allocation
+  lands from a victim gang, the wider that gang's future
+  contiguous-run regrow (the ``largest_free_block`` the 1x1 regrow
+  rule scans for, now per tenant).
+
+``naive_first_fit`` is the strawman the fragmentation probe
+(fleet/probe.py) compares against: lowest-index free chip, no domain
+or distance awareness — what k interleaved tenants would do with the
+1x1 conventions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .supply import ChipLedger, owner_tenant
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """One placement decision: the chips (in ledger order) and the
+    link domains the run touches."""
+
+    chips: tuple
+    domains: tuple
+
+
+class TopologyBinPacker:
+    """Placement scoring over a :class:`~.supply.ChipLedger` whose
+    owners were synced via ``sync_multi`` (tenant-qualified tags).
+
+    ``domain_size`` chips per link domain, counted in LEDGER order
+    (position, not chip id — ledger order is ICI order).  All methods
+    are pure reads over the ledger's current owners/health; the
+    caller claims what it actuates.
+    """
+
+    def __init__(self, ledger: ChipLedger, *, domain_size: int = 2):
+        if domain_size < 1:
+            raise ValueError("domain_size must be >= 1")
+        self.ledger = ledger
+        self.domain_size = domain_size
+        self._pos = {c: i for i, c in enumerate(ledger.chips)}
+
+    # -- domains ---------------------------------------------------------
+
+    def domain_of(self, chip: int) -> int:
+        return self._pos[chip] // self.domain_size
+
+    def domain_chips(self, domain: int) -> list[int]:
+        lo = domain * self.domain_size
+        return self.ledger.chips[lo:lo + self.domain_size]
+
+    def conflict_table(self) -> dict[int, set]:
+        """domain -> set of tenants currently holding chips in it.
+        The overlap-token view: any domain with more than one tenant
+        is a straddle (the invariant the packer exists to prevent);
+        a domain with exactly one is that tenant's token."""
+        table: dict[int, set] = {}
+        for c in self.ledger.chips:
+            t = owner_tenant(self.ledger.owners.get(c))
+            if t is not None:
+                table.setdefault(self.domain_of(c), set()).add(t)
+        return table
+
+    def _conflicts(self, chips, tenant: str) -> bool:
+        """Would ``tenant`` taking ``chips`` straddle a domain that
+        holds another tenant's chip?"""
+        table = self.conflict_table()
+        for c in chips:
+            holders = table.get(self.domain_of(c), set())
+            if holders - {tenant}:
+                return True
+        return False
+
+    # -- candidate sets --------------------------------------------------
+
+    def _free_healthy(self) -> list[int]:
+        return self.ledger.healthy_free()
+
+    def _tenant_chips(self, tenant: str) -> list[int]:
+        return [c for c in self.ledger.chips
+                if owner_tenant(self.ledger.owners.get(c)) == tenant]
+
+    def _other_chips(self, tenant: str) -> list[int]:
+        return [c for c in self.ledger.chips
+                if owner_tenant(self.ledger.owners.get(c))
+                not in (None, tenant)]
+
+    @staticmethod
+    def _min_dist(pos, positions) -> int:
+        if not positions:
+            return 0
+        return min(abs(pos - p) for p in positions)
+
+    # -- single-chip placement (serving replicas) ------------------------
+
+    def place_chip(self, tenant: str) -> int | None:
+        """Best free healthy chip for one more ``tenant`` replica, or
+        None when every candidate is gone or domain-conflicted.
+
+        Score (lexicographic): fill a domain the tenant already
+        partially holds; then land as FAR from other tenants' chips
+        as possible (their regrow blocks stay wide); then as NEAR the
+        tenant's own chips as possible (dense); then highest index
+        (the serving-from-the-tail convention as the final tie)."""
+        own = [self._pos[c] for c in self._tenant_chips(tenant)]
+        own_domains = {p // self.domain_size for p in own}
+        others = [self._pos[c] for c in self._other_chips(tenant)]
+        best, best_key = None, None
+        for c in self._free_healthy():
+            if self._conflicts((c,), tenant):
+                continue
+            p = self._pos[c]
+            key = (p // self.domain_size in own_domains,
+                   self._min_dist(p, others),
+                   -self._min_dist(p, own) if own else 0,
+                   p)
+            if best_key is None or key > best_key:
+                best, best_key = c, key
+        return best
+
+    # -- contiguous-run placement (gang homes) ---------------------------
+
+    def place_run(self, tenant: str, n: int, *,
+                  usable_owner: str | None = None) -> Placement | None:
+        """Best ICI-contiguous run of ``n`` chips that are healthy and
+        free — or owned by ``usable_owner`` (the tenant's own training
+        tag: a gang re-forms from scratch, so its chips count toward
+        its own regrow block, exactly the 1x1
+        ``contiguous_available`` rule).  None when no conflict-free
+        run exists.
+
+        Score: maximize overlap with the tenant's current chips (a
+        regrow should extend the block, not relocate it), then leave
+        the largest remaining free run (future allocations — anyone's
+        — stay unfragmented), then lowest start (the gang-from-the-
+        head convention as the final tie)."""
+        chips = self.ledger.chips
+        usable = []
+        for c in chips:
+            owner = self.ledger.owners.get(c)
+            ok = (c not in self.ledger.unhealthy
+                  and (owner is None
+                       or (usable_owner is not None
+                           and owner == usable_owner)))
+            usable.append(ok)
+        own = set(self._tenant_chips(tenant))
+        best, best_key = None, None
+        for start in range(len(chips) - n + 1):
+            window = chips[start:start + n]
+            if not all(usable[start + i] for i in range(n)):
+                continue
+            if self._conflicts(window, tenant):
+                continue
+            taken = set(window)
+            remaining = self._largest_free_run(exclude=taken)
+            key = (len(own & taken), remaining, -start)
+            if best_key is None or key > best_key:
+                domains = tuple(sorted({self.domain_of(c)
+                                        for c in window}))
+                best = Placement(chips=tuple(window), domains=domains)
+                best_key = key
+        return best
+
+    def _largest_free_run(self, exclude=frozenset()) -> int:
+        best = run = 0
+        for c in self.ledger.chips:
+            if (self.ledger.owners.get(c) is None
+                    and c not in self.ledger.unhealthy
+                    and c not in exclude):
+                run += 1
+                best = max(best, run)
+            else:
+                run = 0
+        return best
+
+    def regrow_width(self, tenant: str, *, tp: int = 1,
+                     target_dp: int = 1) -> int:
+        """Largest power-of-two dp ≤ ``target_dp`` whose ``dp*tp``
+        chips have a conflict-free contiguous home counting the
+        tenant's own training chips; 0 when nothing fits."""
+        from .supply import training_tag
+        best, dp = 0, 1
+        while dp <= target_dp:
+            if self.place_run(tenant, dp * tp,
+                              usable_owner=training_tag(tenant)):
+                best = dp
+            dp *= 2
+        return best
+
+    # -- the strawman ----------------------------------------------------
+
+    def naive_first_fit(self, n: int = 1) -> list[int]:
+        """Lowest-index free healthy chips, no domain or distance
+        awareness — the 1x1-convention baseline the fragmentation
+        probe scores the packer against."""
+        return self._free_healthy()[:n]
+
+
+__all__ = ["Placement", "TopologyBinPacker"]

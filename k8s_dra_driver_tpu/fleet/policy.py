@@ -56,6 +56,65 @@ class Action:
     dp: int | None = None       # target gang width for preempt/regrow
 
 
+def pressured(d: DemandSignals, cfg) -> bool:
+    """Deep queue, or a bad SLO-margin EWMA WITH work actually
+    waiting.  The margin clause is gated on a non-empty queue because
+    the EWMA only updates when SLO-bearing requests finish: after
+    traffic stops, a stale negative margin with nothing queued is
+    history, not actionable pressure — acting on it would scale up an
+    idle pool and (worse) block calm forever.  ``cfg`` is anything
+    with ``queue_high``/``margin_floor_s`` (PolicyConfig, or the
+    multi-tenant MtConfig in fleet/tenancy.py)."""
+    return (d.queue_depth >= cfg.queue_high
+            or (d.queue_depth > 0
+                and d.slo_margin_ewma_s is not None
+                and d.slo_margin_ewma_s < cfg.margin_floor_s))
+
+
+def is_calm(d: DemandSignals, cfg) -> bool:
+    """Empty queue and the arrival EWMA decayed low.  Margin is
+    deliberately absent (see :func:`pressured`): an empty queue IS
+    the SLO recovering."""
+    return (d.queue_depth == 0
+            and d.arrival_rate_rps <= cfg.arrival_low_rps)
+
+
+class Streaks:
+    """Consecutive-tick pressure/calm counting — the hysteresis core
+    shared by :class:`FleetPolicy` (one global pair of counters) and
+    the multi-tenant arbiter (one pair PER TENANT, fleet/tenancy.py).
+    A tick that is neither pressured nor calm resets both; once a
+    streak reaches its threshold it stays "fired" for as long as the
+    condition persists — the multi-tenant preemption cascade needs
+    one action per tick under SUSTAINED pressure, not one action per
+    re-armed streak."""
+
+    def __init__(self, *, up_after: int, down_after: int):
+        self.up_after = up_after
+        self.down_after = down_after
+        self.hot = 0
+        self.calm = 0
+
+    def update(self, pressured_now: bool, calm_now: bool) -> None:
+        if pressured_now:
+            self.hot += 1
+            self.calm = 0
+        elif calm_now:
+            self.calm += 1
+            self.hot = 0
+        else:
+            self.hot = 0
+            self.calm = 0
+
+    @property
+    def hot_fired(self) -> bool:
+        return self.hot >= self.up_after
+
+    @property
+    def calm_fired(self) -> bool:
+        return self.calm >= self.down_after
+
+
 @dataclasses.dataclass
 class PolicyConfig:
     queue_high: int = 4          # queue depth that signals pressure
@@ -86,24 +145,12 @@ class FleetPolicy:
     # -- signal classification -------------------------------------------
 
     def pressured(self, d: DemandSignals) -> bool:
-        """Deep queue, or a bad SLO-margin EWMA WITH work actually
-        waiting.  The margin clause is gated on a non-empty queue
-        because the EWMA only updates when SLO-bearing requests
-        finish: after traffic stops, a stale negative margin with
-        nothing queued is history, not actionable pressure — acting
-        on it would scale up an idle pool and (worse) block calm
-        forever."""
-        return (d.queue_depth >= self.cfg.queue_high
-                or (d.queue_depth > 0
-                    and d.slo_margin_ewma_s is not None
-                    and d.slo_margin_ewma_s < self.cfg.margin_floor_s))
+        """Module-level :func:`pressured` over this policy's config."""
+        return pressured(d, self.cfg)
 
     def is_calm(self, d: DemandSignals) -> bool:
-        """Empty queue and the arrival EWMA decayed low.  Margin is
-        deliberately absent (see ``pressured``): an empty queue IS the
-        SLO recovering."""
-        return (d.queue_depth == 0
-                and d.arrival_rate_rps <= self.cfg.arrival_low_rps)
+        """Module-level :func:`is_calm` over this policy's config."""
+        return is_calm(d, self.cfg)
 
     # -- width rules ------------------------------------------------------
 
@@ -179,4 +226,5 @@ class FleetPolicy:
 
 
 __all__ = ["Action", "DemandSignals", "FleetPolicy", "PolicyConfig",
+           "Streaks", "is_calm", "pressured",
            "PREEMPT", "REGROW", "SCALE_DOWN", "SCALE_UP"]

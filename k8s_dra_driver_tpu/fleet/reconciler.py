@@ -53,6 +53,29 @@ from .supply import ChipLedger
 log = logging.getLogger(__name__)
 
 
+def read_demand(gateway) -> DemandSignals:
+    """One gateway's demand signals scraped from its
+    ``GatewayMetrics`` registry — the gauges are the contract, not
+    the gateway object's internals.  Shared by the 1x1 reconciler's
+    no-bus fallback and the multi-tenant arbiter (fleet/tenancy.py),
+    which reads one of these PER TENANT pool."""
+    reg = gateway.metrics.registry
+    qd = reg.get_sample_value("tpu_gateway_queue_depth") or 0.0
+    rate = reg.get_sample_value("tpu_gateway_arrival_rate_rps") or 0.0
+    # the gauge defaults to 0.0 before any SLO-bearing request
+    # finishes; the gateway object knows the difference, so prefer
+    # its None when it has seen nothing (0.0 would read "exactly on
+    # deadline" — neutral, but None is honest)
+    margin = getattr(gateway, "slo_margin_ewma_s", None)
+    if margin is None:
+        margin_sample = reg.get_sample_value(
+            "tpu_gateway_slo_margin_ewma_seconds")
+        margin = margin_sample if margin_sample else None
+    return DemandSignals(queue_depth=int(qd),
+                         arrival_rate_rps=float(rate),
+                         slo_margin_ewma_s=margin)
+
+
 class FleetReconciler:
     """Demand-driven autoscaling + chip arbitration (module docstring).
 
@@ -159,27 +182,11 @@ class FleetReconciler:
 
     def _demand(self) -> DemandSignals:
         """Demand signals: the cached bus event when riding the
-        gateway's bus (no registry re-read per tick), else scraped
-        from the ``GatewayMetrics`` registry — the gauges are the
-        contract, not the gateway object's internals."""
+        gateway's bus (no registry re-read per tick), else
+        :func:`read_demand` over the gateway's registry."""
         if self.bus is not None and self._bus_demand is not None:
             return self._bus_demand
-        reg = self.gateway.metrics.registry
-        qd = reg.get_sample_value("tpu_gateway_queue_depth") or 0.0
-        rate = reg.get_sample_value(
-            "tpu_gateway_arrival_rate_rps") or 0.0
-        # the gauge defaults to 0.0 before any SLO-bearing request
-        # finishes; the gateway object knows the difference, so prefer
-        # its None when it has seen nothing (0.0 would read "exactly
-        # on deadline" — neutral, but None is honest)
-        margin = getattr(self.gateway, "slo_margin_ewma_s", None)
-        if margin is None:
-            margin_sample = reg.get_sample_value(
-                "tpu_gateway_slo_margin_ewma_seconds")
-            margin = margin_sample if margin_sample else None
-        return DemandSignals(queue_depth=int(qd),
-                             arrival_rate_rps=float(rate),
-                             slo_margin_ewma_s=margin)
+        return read_demand(self.gateway)
 
     def _gang_tp(self) -> int:
         if self.supervisor is None:
@@ -292,4 +299,4 @@ class FleetReconciler:
             self._thread = None
 
 
-__all__ = ["FleetReconciler"]
+__all__ = ["FleetReconciler", "read_demand"]

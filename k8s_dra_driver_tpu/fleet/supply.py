@@ -42,6 +42,28 @@ log = logging.getLogger(__name__)
 TRAINING = "training"
 
 
+def training_tag(tenant: str) -> str:
+    """Multi-tenant training owner tag (``sync_multi``)."""
+    return f"training:{tenant}"
+
+
+def serving_tag(tenant: str, replica: str) -> str:
+    """Multi-tenant serving owner tag (``sync_multi``)."""
+    return f"serving:{tenant}:{replica}"
+
+
+def owner_tenant(owner: str | None) -> str | None:
+    """The tenant a multi-tenant owner tag belongs to, or None for a
+    free chip.  Only meaningful on ledgers synced via ``sync_multi``
+    (the 1x1 ``sync`` tags carry no tenant segment)."""
+    if not owner:
+        return None
+    parts = owner.split(":")
+    if len(parts) >= 2 and parts[0] in ("serving", TRAINING):
+        return parts[1]
+    return None
+
+
 @dataclasses.dataclass(frozen=True)
 class SupplyView:
     """One tick's supply snapshot, in ledger (ICI) order."""
@@ -149,6 +171,40 @@ class ChipLedger:
                     if c in self.owners:
                         self.owners[c] = TRAINING
 
+    def sync_multi(self, records) -> None:
+        """The k-tenant twin of :meth:`sync`: ``records`` is an
+        iterable of ``(tenant, manager_or_None, supervisor_or_None)``
+        triples and owner tags become tenant-qualified
+        (``serving:{tenant}:{replica}`` / ``training:{tenant}``, see
+        :func:`owner_tenant`) so the bin-packer's overlap-token
+        conflict table (fleet/binpack.py) can tell WHOSE chip sits in
+        a link domain, not just that one does."""
+        for c in self.chips:
+            self.owners[c] = None
+        for tenant, manager, supervisor in records:
+            if manager is not None:
+                for r in manager.replicas:
+                    if r.state != "dead" and r.chip in self.owners:
+                        self.owners[r.chip] = serving_tag(tenant,
+                                                          r.name)
+            if supervisor is not None:
+                for w in getattr(supervisor, "workers", []):
+                    if not w.alive:
+                        continue
+                    for c in w.chips:
+                        if c in self.owners:
+                            self.owners[c] = training_tag(tenant)
+
+    def claim(self, chip: int, owner: str) -> None:
+        """Claim a specific chip for ``owner`` immediately — the
+        multi-tenant twin of :meth:`take_for_serving`'s pending claim,
+        used after the bin-packer picked WHICH chip: two decisions in
+        one tick can never double-book it."""
+        if self.owners.get(chip) is not None:
+            raise ValueError(f"chip {chip} already owned by "
+                             f"{self.owners[chip]}")
+        self.owners[chip] = owner
+
     def healthy_free(self) -> list[int]:
         return [c for c in self.chips
                 if self.owners[c] is None and c not in self.unhealthy]
@@ -203,4 +259,5 @@ class ChipLedger:
                           largest_free_block=best)
 
 
-__all__ = ["ChipLedger", "SupplyView", "TRAINING"]
+__all__ = ["ChipLedger", "SupplyView", "TRAINING", "owner_tenant",
+           "serving_tag", "training_tag"]

@@ -54,6 +54,11 @@ class GatewayRequest:
     first_token_s: float | None = None
     finished_s: float | None = None
     requeues: int = 0                # drain evictions survived
+    #: tenant tag (multi-tenant fleets, fleet/tenancy.py): pure
+    #: accounting — placement and admission never read it, but every
+    #: queue-wait sample and terminal outcome carries it into the
+    #: per-tenant metric series
+    tenant: str | None = None
 
     @property
     def uid(self):
@@ -110,12 +115,14 @@ class AdmissionQueue:
 
     def offer(self, req: Request, now_s: float,
               slo_s: float | None = None,
-              live_uids: frozenset | None = None) -> GatewayRequest:
+              live_uids: frozenset | None = None,
+              tenant: str | None = None) -> GatewayRequest:
         """Admit or refuse; refusal raises :class:`AdmissionError`
         with the explicit status (reject-on-full, never a silent
         drop).  ``live_uids``: uids currently dispatched or queued
         elsewhere in the gateway, so the engine-level duplicate-uid
-        contract holds pool-wide."""
+        contract holds pool-wide.  ``tenant`` rides the record into
+        per-tenant accounting; admission itself is tenant-blind."""
         if any(g.uid == req.uid for g in self._q) or (
                 live_uids and req.uid in live_uids):
             raise AdmissionError(
@@ -128,7 +135,7 @@ class AdmissionQueue:
         g = GatewayRequest(
             request=req, arrival_s=now_s,
             deadline_s=(now_s + slo_s) if slo_s is not None
-            else float("inf"))
+            else float("inf"), tenant=tenant)
         self._q.append(g)
         return g
 

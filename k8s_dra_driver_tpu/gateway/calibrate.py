@@ -13,7 +13,9 @@ The discipline is the round-5 lesson baked in: at least TWO
 all-at-once drains through FRESH pools — the first pays every compile
 (fill groups, suffix fills, decode programs), only the LAST is timed.
 Calibrating on the compile drain once under-read capacity ~4x and made
-every sweep level silently sub-capacity.
+every sweep level silently sub-capacity (the BENCH_r05.json round's
+gateway sweep; the refreshed artifacts since — e.g.
+tools/ctl_ceiling_cpu.json — calibrate through this helper).
 """
 
 from __future__ import annotations

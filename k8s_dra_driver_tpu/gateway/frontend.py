@@ -81,8 +81,14 @@ class FleetGateway:
                  clock=time.monotonic,
                  auto_replace: bool = True,
                  bus: EventBus | None = None,
-                 pool_owner: bool = True):
+                 pool_owner: bool = True,
+                 tenant: str | None = None):
         self.manager = manager
+        #: this pool's tenant in a multi-tenant fleet
+        #: (fleet/tenancy.py): tags the pump's ``demand`` events so
+        #: the arbiter can tell k pools apart on one bus, and is the
+        #: default tag for untagged submits
+        self.tenant = tenant
         self.router = router or PrefixAffinityRouter()
         self.queue = AdmissionQueue(queue_capacity)
         self.metrics = metrics or GatewayMetrics()
@@ -129,27 +135,36 @@ class FleetGateway:
 
     def submit(self, req: Request,
                slo_s: float | None = None, *,
+               tenant: str | None = None,
                extra_live: frozenset = frozenset()) -> GatewayRequest:
         """Admit or refuse; ALWAYS returns the request's gateway
         record with an explicit status (``queued`` or a terminal
         rejection) — refusal is a return value here, not an exception,
         because shedding under load is an outcome the caller must see,
-        not a bug.  ``extra_live``: uids queued in SIBLING pump shards
-        (gateway/sharded.py), so the pool-wide duplicate contract
-        spans shards."""
+        not a bug.  ``tenant`` tags the record for the per-tenant
+        metric series (defaults to the gateway's own tenant; never
+        affects placement or admission).  ``extra_live``: uids queued
+        in SIBLING pump shards (gateway/sharded.py), so the pool-wide
+        duplicate contract spans shards."""
         now = self.clock()
+        tenant = tenant if tenant is not None else self.tenant
         self._arrivals += 1      # offered load counts refusals too
         self.admissions_total += 1
         live = frozenset(
             uid for r in self.manager.replicas
             for uid in r.in_flight) | extra_live
         try:
-            g = self.queue.offer(req, now, slo_s=slo_s, live_uids=live)
+            g = self.queue.offer(req, now, slo_s=slo_s, live_uids=live,
+                                 tenant=tenant)
         except AdmissionError as e:
             g = GatewayRequest(request=req, arrival_s=now,
-                               deadline_s=now, status=e.status)
+                               deadline_s=now, status=e.status,
+                               tenant=tenant)
             self.refused.append(g)
             self.metrics.requests.labels(outcome=e.status).inc()
+            if tenant is not None:
+                self.metrics.tenant_requests.labels(
+                    tenant=tenant, outcome=e.status).inc()
             return g
         # uid reuse after a terminal outcome starts a FRESH lifecycle:
         # the old record is forgotten so the exactly-once guard in
@@ -213,7 +228,8 @@ class FleetGateway:
         self._drain_migrations()
         self.bus.publish("demand", queue_depth=len(self.queue),
                          arrival_rate_rps=self.arrival_rate_rps,
-                         slo_margin_ewma_s=self.slo_margin_ewma_s)
+                         slo_margin_ewma_s=self.slo_margin_ewma_s,
+                         tenant=self.tenant)
         self.bus.pump()
         self._steps += 1
         return done
@@ -259,6 +275,9 @@ class FleetGateway:
                 continue
             self.routes_total += 1
             self.metrics.queue_wait_seconds.observe(now - g.arrival_s)
+            if g.tenant is not None:
+                self.metrics.tenant_queue_wait_seconds.labels(
+                    tenant=g.tenant).observe(now - g.arrival_s)
 
     def pending(self) -> int:
         """Queued (not yet dispatched) requests — the surface the
@@ -328,6 +347,9 @@ class FleetGateway:
         else:
             outcome = status
         self.metrics.requests.labels(outcome=outcome).inc()
+        if g.tenant is not None:
+            self.metrics.tenant_requests.labels(
+                tenant=g.tenant, outcome=outcome).inc()
         self.outcomes[g.uid] = g
         done.append(g)
 

@@ -28,7 +28,9 @@ Replay scales a trace by ``offered_x * base_rps`` where ``base_rps``
 comes from the shared calibration helper (gateway/calibrate.py), so
 "replayed bursty at 20x" is machine-relative and means the same thing
 in every artifact.  The ceiling probes run at 10–100x, where the
-control plane — not the engines — is the bottleneck by construction.
+control plane — not the engines — is the bottleneck by construction
+(tools/ctl_ceiling_cpu.json is the recorded ceiling artifact
+measured through this replay loop).
 """
 
 from __future__ import annotations
@@ -45,10 +47,18 @@ TRACE_NAMES = ("bursty", "diurnal", "heavy_tail")
 #: tests/test_bench_smoke.py so a drifting fixture fails CI)
 TRACE_SCHEMA_KEYS = frozenset(
     {"name", "kind", "seed", "n", "unit_mean", "interarrivals",
-     "note"})
+     "tenants", "note"})
 
 _FIXTURE_SEEDS = {"bursty": 7, "diurnal": 11, "heavy_tail": 13}
 _FIXTURE_N = 96
+
+#: per-arrival tenant tags (multi-tenant fleets, fleet/tenancy.py):
+#: three generic tenant labels with a fixed skew — replays tag each
+#: submit so the per-tenant gateway series populate; drawn AFTER the
+#: interarrivals from the same seeded stream, so adding them changed
+#: no arrival time in any fixture
+_TENANT_LABELS = ("a", "b", "c")
+_TENANT_WEIGHTS = (0.5, 0.3, 0.2)
 
 
 def generate_trace(name: str, n: int = _FIXTURE_N,
@@ -76,6 +86,8 @@ def generate_trace(name: str, n: int = _FIXTURE_N,
         raise ValueError(f"unknown trace {name!r}; "
                          f"have {TRACE_NAMES}")
     arr = arr / arr.mean()          # unit mean: offered_x is exact
+    tenants = [str(t) for t in rng.choice(
+        _TENANT_LABELS, size=n, p=_TENANT_WEIGHTS)]
     return {
         "name": name,
         "kind": "interarrival",
@@ -83,9 +95,11 @@ def generate_trace(name: str, n: int = _FIXTURE_N,
         "n": n,
         "unit_mean": 1.0,
         "interarrivals": [round(float(g), 6) for g in arr],
+        "tenants": tenants,
         "note": ("unit-mean normalized interarrivals; replay scales "
                  "by offered_x * calibrated base_rps "
-                 "(gateway/calibrate.py); regenerable via "
+                 "(gateway/calibrate.py); per-arrival tenant tags "
+                 "skewed 0.5/0.3/0.2; regenerable via "
                  f"generate_trace({name!r})"),
     }
 
@@ -146,6 +160,7 @@ def replay(gateway, trace: dict, *, offered_x: float,
     clock = clock or _time.perf_counter
     sleep = sleep or _time.sleep
     gaps = trace["interarrivals"]
+    tenants = trace.get("tenants") or None
     n = n_requests if n_requests is not None else len(gaps)
     rate = offered_x * base_rps
     t0 = clock()
@@ -157,7 +172,11 @@ def replay(gateway, trace: dict, *, offered_x: float,
     while True:
         now = clock()
         while i < n and now >= sched[i]:
-            gateway.submit(make_request(i), slo_s=slo_s)
+            if tenants is not None:
+                gateway.submit(make_request(i), slo_s=slo_s,
+                               tenant=tenants[i % len(tenants)])
+            else:
+                gateway.submit(make_request(i), slo_s=slo_s)
             i += 1
         gateway.step()
         steps += 1

@@ -72,10 +72,14 @@ class ShardedGateway:
                  auto_replace: bool = True,
                  steal: bool = True,
                  shard_tokens: int = 8,
-                 seed: int = 0):
+                 seed: int = 0,
+                 tenant: str | None = None):
         if pumps < 1:
             raise ValueError("ShardedGateway needs >= 1 pump")
         self.manager = manager
+        #: same contract as FleetGateway.tenant: tags demand events
+        #: and defaults untagged submits (fleet/tenancy.py)
+        self.tenant = tenant
         self.metrics = metrics or GatewayMetrics()
         self.bus = bus if bus is not None else EventBus(seed=seed)
         self.clock = clock
@@ -133,7 +137,8 @@ class ShardedGateway:
         head = arr[:max(min(self.shard_tokens, arr.size - 1), 1)]
         return zlib.crc32(head.tobytes()) % len(self.pumps)
 
-    def submit(self, req, slo_s: float | None = None) -> GatewayRequest:
+    def submit(self, req, slo_s: float | None = None, *,
+               tenant: str | None = None) -> GatewayRequest:
         """Admit into the prompt's home shard (or refuse with the
         explicit status).  The duplicate-uid contract spans shards:
         sibling pumps' queued uids ride in as ``extra_live``.  Door
@@ -154,8 +159,10 @@ class ShardedGateway:
         for j, p in enumerate(self.pumps):
             if j != i:
                 extra.update(p.queue.uids())
-        g = self.pumps[i].submit(req, slo_s,
-                                 extra_live=frozenset(extra))
+        g = self.pumps[i].submit(
+            req, slo_s, tenant=(tenant if tenant is not None
+                                else self.tenant),
+            extra_live=frozenset(extra))
         if g.status == QUEUED:
             self._owner[req.uid] = i
         return g
@@ -211,7 +218,8 @@ class ShardedGateway:
         self.pumps[0]._drain_migrations()
         self.bus.publish("demand", queue_depth=self.pending(),
                          arrival_rate_rps=self.arrival_rate_rps,
-                         slo_margin_ewma_s=self.slo_margin_ewma_s)
+                         slo_margin_ewma_s=self.slo_margin_ewma_s,
+                         tenant=self.tenant)
         self.bus.pump()
         self._steps += 1
         return done

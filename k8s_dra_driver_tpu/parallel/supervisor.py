@@ -62,8 +62,15 @@ External control (the fleet reconciler's surface, fleet/):
   the same REFORM path an eviction takes (checkpoint-then-shrink
   preemption); grows pass through the EXPAND transition, closing the
   shrink-only gap (the reconciler's heal-driven regrow is its first
-  consumer).  :meth:`GangSupervisor.readmit` is the chip up-signal
-  twin of eviction: healed chips return to the buildable set.
+  consumer).  ``exclude=`` pins the placement (fleet/binpack.py picks
+  WHICH chips in a multi-tenant fleet), :meth:`GangSupervisor.park`
+  is the full-reclaim verb (checkpoint, release every chip, idle in
+  PARKED until the next request_width), and concurrent requests
+  queue latest-wins at the boundary — coalesced to a no-op when the
+  gang already matches — so external controllers can never race the
+  state machine.  :meth:`GangSupervisor.readmit` is the chip
+  up-signal twin of eviction: healed chips return to the buildable
+  set (the placement fence is arbitration, not health, and stays).
 - ``listeners`` mirror plugin/health.py's hook: each state transition
   calls ``listener(state, info)`` so external controllers observe
   RUNNING→…→RESUME without polling.
@@ -90,15 +97,19 @@ log = logging.getLogger(__name__)
 
 # supervisor states (the contract FAILURE_SEMANTICS.md documents);
 # EXPAND marks an externally requested GROW re-formation — the only
-# transition the failure paths never emit
+# transition the failure paths never emit.  PARKED is the full-reclaim
+# state (fleet/tenancy.py preemption cascades): checkpointed, every
+# chip released, waiting for a request_width to re-form.
 RUNNING = "running"
 SUSPECT = "suspect"
 EVICT = "evict"
 REFORM = "reform"
 EXPAND = "expand"
 RESUME = "resume"
+PARKED = "parked"
 FAILED = "failed"
-STATES = (RUNNING, SUSPECT, EVICT, REFORM, EXPAND, RESUME, FAILED)
+STATES = (RUNNING, SUSPECT, EVICT, REFORM, EXPAND, RESUME, PARKED,
+          FAILED)
 
 CONTRACT_FILENAME = "gang.json"
 
@@ -222,7 +233,8 @@ class GangSupervisor:
                  soft_deadline_s: float | None = None,
                  checkpoint_every: int = 4,
                  max_recoveries: int = 4,
-                 init_seed: int = 0):
+                 init_seed: int = 0,
+                 placement_exclude=()):
         self.job = job
         self.ckpt = ckpt
         self.dir = Path(coordination_dir)
@@ -256,11 +268,21 @@ class GangSupervisor:
         self.listeners: list = []
         self._gen = 0                    # formation generation
         self._dead_chips: set = set()
+        # placement arbitration (fleet/tenancy.py): chips an external
+        # arbiter fenced off from this gang — healthy, just someone
+        # else's.  Disjoint from _dead_chips so a heal (readmit)
+        # never hands the gang a chip the arbiter took away.
+        self._placement_excluded: set = set(
+            int(c) for c in placement_exclude)
         self._unhealthy: dict = {}
         self._unhealthy_lock = threading.Lock()
-        # externally requested dp width (request_width), consumed at
-        # the next step boundary by step_once
-        self._requested_dp: int | None = None
+        # externally requested operation (request_width / park),
+        # consumed at the next step boundary by step_once.  A single
+        # latest-wins slot: a second request arriving while a
+        # REFORM/EXPAND is already in flight queues here and is
+        # coalesced at the boundary if the gang already matches it —
+        # requests can never race the state machine mid-transition.
+        self._requested: tuple | None = None
         self._width_lock = threading.Lock()
         self._step = 0
         self._total_steps = 0
@@ -285,23 +307,48 @@ class GangSupervisor:
         exactly like the gateway's replica drain wiring."""
         health_monitor.listeners.append(self.on_health)
 
-    def request_width(self, dp: int) -> None:
+    def request_width(self, dp: int, *, exclude=None) -> None:
         """Ask the gang to re-form at ``dp`` data-parallel rows at the
         next step boundary (the fleet reconciler's resize verb):
         checkpoint-then-shrink preemption when ``dp`` is smaller,
-        EXPAND regrow when larger.  Thread-safe; the latest request
-        wins.  Raises ``ValueError`` for a width no formation could
-        ever run (static infeasibility); a width that is merely
-        infeasible RIGHT NOW (chips vanished since the request) is
-        dropped at apply time with a warning instead of killing the
-        run."""
+        EXPAND regrow when larger — including regrow out of PARKED.
+        ``exclude`` (optional) replaces the placement-exclusion set,
+        so a multi-tenant arbiter can pin WHICH chips the formation
+        may use (fleet/binpack.py chose them); None keeps the current
+        placement fence.
+
+        Concurrency contract: thread-safe, latest request wins, and a
+        request arriving while a REFORM/EXPAND is already in flight
+        QUEUES for the next step boundary — it never races the state
+        machine.  A request the gang already satisfies (same dp, same
+        placement) coalesces to a no-op at the boundary instead of
+        burning a reform.  Raises ``ValueError`` for a width no
+        formation could ever run (static infeasibility); a width that
+        is merely infeasible RIGHT NOW (chips vanished since the
+        request) is dropped at apply time with a warning instead of
+        killing the run."""
         if dp < 1:
             raise ValueError(f"dp must be >= 1, got {dp}")
         if self.job.batch % dp:
             raise ValueError(
                 f"dp {dp} does not divide global batch {self.job.batch}")
         with self._width_lock:
-            self._requested_dp = dp
+            self._requested = ("width", dp,
+                               None if exclude is None
+                               else frozenset(int(c) for c in exclude))
+
+    def park(self) -> None:
+        """Full reclaim, the floor-zero verb of the multi-tenant
+        preemption cascade (fleet/tenancy.py): at the next step
+        boundary the gang checkpoints its CURRENT step, releases
+        EVERY chip, and idles in PARKED — zero steps lost, exactly
+        like a controlled shrink, but the tenant's whole allocation
+        returns to the pool.  A later ``request_width`` re-forms from
+        the parked checkpoint through EXPAND→REFORM→RESUME.
+        Thread-safe; latest request wins (a park followed by a
+        request_width before the boundary resolves to the resize)."""
+        with self._width_lock:
+            self._requested = ("park",)
 
     def readmit(self, chips) -> None:
         """Chip up-signal, the heal twin of eviction: the caller (the
@@ -353,7 +400,8 @@ class GangSupervisor:
         import numpy as np
 
         mesh, step_fn, init_state = self.job.build(
-            dp, exclude_chips=frozenset(self._dead_chips))
+            dp, exclude_chips=frozenset(self._dead_chips
+                                        | self._placement_excluded))
         self.dp = dp
         self.mesh, self.step_fn, self.init_state = (mesh, step_fn,
                                                     init_state)
@@ -372,6 +420,7 @@ class GangSupervisor:
             "world_devices": int(grid.size),
             "workers": [w.name for w in self.workers],
             "excluded_chips": sorted(self._dead_chips),
+            "placement_excluded": sorted(self._placement_excluded),
         }
         (self.dir / CONTRACT_FILENAME).write_text(
             json.dumps(self.contract, indent=1))
@@ -522,7 +571,7 @@ class GangSupervisor:
         log.warning("resumed at step %d on dp=%d (%d step(s) to "
                     "replay)", at, new_dp, lost)
 
-    def _resize(self, target: int) -> None:
+    def _resize(self, target: int, exclude=None) -> None:
         """Apply an externally requested width change (request_width):
         checkpoint the CURRENT step first — a controlled resize must
         lose nothing — then re-form through the same REFORM path an
@@ -530,12 +579,19 @@ class GangSupervisor:
         shrink-only failure paths never emit; restore onto the new
         mesh layout rides the same sharding-aware elastic path a
         recovery uses (a dp change is a placement change, not a math
-        change)."""
-        cause = "expand" if target > self.dp else "preempt"
+        change).  A parked gang skips the save (its checkpoint was
+        written at park time; there is nothing live to save) and
+        resumes from it."""
+        parked = self.state == PARKED
+        cause = "expand" if (parked or target > self.dp) else "preempt"
         t0 = time.perf_counter()
-        self.ckpt.save(self._step, self.params, self.opt,
-                       extra=self.loader.state_dict())
+        if not parked:
+            self.ckpt.save(self._step, self.params, self.opt,
+                           extra=self.loader.state_dict())
         from_dp = self.dp
+        old_placement = set(self._placement_excluded)
+        if exclude is not None:
+            self._placement_excluded = set(exclude)
         if cause == "expand":
             self._transition(EXPAND)
         self._transition(REFORM)
@@ -546,9 +602,10 @@ class GangSupervisor:
             # and apply): keep training at the current width — _form
             # mutated nothing, and the reconciler sees the unchanged
             # dp gauge and may re-request when supply returns
+            self._placement_excluded = old_placement
             log.warning("resize to dp=%d infeasible (%s); staying at "
                         "dp=%d", target, e, from_dp)
-            self._transition(RUNNING)
+            self._transition(PARKED if parked else RUNNING)
             return
         self._transition(RESUME)
         params, opt = self.init_state(self._key())
@@ -567,6 +624,44 @@ class GangSupervisor:
         self._transition(RUNNING)
         log.warning("resized gang dp %d -> %d (%s) at step %d",
                     from_dp, target, cause, at)
+
+    def _park(self) -> None:
+        """Apply a queued :meth:`park`: checkpoint the current step,
+        release every chip (workers cleared, device buffers dropped),
+        and idle in PARKED.  Zero steps lost by construction — the
+        checkpoint IS the current step, and the later unpark restores
+        it through the normal elastic path."""
+        self.ckpt.save(self._step, self.params, self.opt,
+                       extra=self.loader.state_dict())
+        from_dp = self.dp
+        self.workers = []
+        self.dp = 0
+        # drop the live program and its device buffers: a parked
+        # tenant must hold no HBM, only its checkpoint on disk
+        self.params = self.opt = None
+        self.mesh = self.step_fn = None
+        self.contract = {
+            "generation": self._gen,
+            "num_workers": 0,
+            "dp": 0,
+            "world_devices": 0,
+            "workers": [],
+            "parked": True,
+            "excluded_chips": sorted(self._dead_chips),
+            "placement_excluded": sorted(self._placement_excluded),
+        }
+        (self.dir / CONTRACT_FILENAME).write_text(
+            json.dumps(self.contract, indent=1))
+        self._gen += 1
+        self.metrics.dp_width.set(0)
+        self.recoveries.append(Recovery(
+            cause="park", victims=[], from_dp=from_dp, to_dp=0,
+            restored_step=self._step, steps_lost=0))
+        self.metrics.restarts.labels(cause="park").inc()
+        self._pending = None
+        self._transition(PARKED)
+        log.warning("parked gang (was dp=%d) at step %d; all chips "
+                    "released", from_dp, self._step)
 
     def _key(self):
         import jax
@@ -597,9 +692,28 @@ class GangSupervisor:
         if self._step >= self._total_steps:
             return False
         with self._width_lock:
-            target, self._requested_dp = self._requested_dp, None
-        if target is not None and target != self.dp:
-            self._resize(target)
+            op, self._requested = self._requested, None
+        if op is not None:
+            if op[0] == "park":
+                if self.state != PARKED:
+                    self._park()
+                    return self._step < self._total_steps
+            else:
+                _, target, exclude = op
+                same_placement = (
+                    exclude is None
+                    or set(exclude) == self._placement_excluded)
+                if (self.state == PARKED or target != self.dp
+                        or not same_placement):
+                    self._resize(target, exclude)
+                    return self._step < self._total_steps
+                # coalesced: the gang already matches the request
+                # (same width, same placement) — an idempotent no-op,
+                # not another REFORM arc
+        if self.state == PARKED:
+            # parked gangs idle at zero cost: stay live for the
+            # co-loop (an unpark request_width may arrive any tick)
+            # but run nothing and poll nobody — there are no workers
             return self._step < self._total_steps
         victims, cause = self._poll_down()
         if victims:
@@ -656,7 +770,7 @@ class GangSupervisor:
         return self.report()
 
 
-__all__ = ["CONTRACT_FILENAME", "EVICT", "EXPAND", "FAILED", "REFORM",
-           "RESUME", "RUNNING", "STATES", "SUSPECT", "ElasticTrainJob",
-           "GangDeath", "GangSupervisor", "Recovery",
+__all__ = ["CONTRACT_FILENAME", "EVICT", "EXPAND", "FAILED", "PARKED",
+           "REFORM", "RESUME", "RUNNING", "STATES", "SUSPECT",
+           "ElasticTrainJob", "GangDeath", "GangSupervisor", "Recovery",
            "SupervisorError", "SupervisorReport"]

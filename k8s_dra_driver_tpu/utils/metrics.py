@@ -166,6 +166,22 @@ class GatewayMetrics:
             "EWMA of the signed SLO margin over finished SLO-bearing "
             "requests (negative = sustained SLO pressure)",
             registry=self.registry)
+        # per-tenant observability (ISSUE 9 satellite): requests
+        # tagged with a tenant at submit sample tenant-labeled series
+        # alongside the pool-wide ones, so one shared gateway can
+        # answer "WHOSE queue wait / SLO attainment degraded" —
+        # rendered through the same render_all() combined exposition
+        self.tenant_queue_wait_seconds = Histogram(
+            "tpu_gateway_tenant_queue_wait_seconds",
+            "Admission-queue wait per dispatch, labeled by the "
+            "request's tenant tag", ["tenant"],
+            registry=self.registry, buckets=_GATEWAY_BUCKETS)
+        self.tenant_requests = Counter(
+            "tpu_gateway_tenant_requests_total",
+            "Terminal request outcomes per tenant tag (the per-tenant "
+            "SLO-attainment series: finished_attained/finished_late/"
+            "shed/rejected)", ["tenant", "outcome"],
+            registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -266,6 +282,26 @@ class FleetMetrics:
             "tpu_fleet_gang_dp_target",
             "dp width the reconciler most recently requested from the "
             "gang supervisor", registry=self.registry)
+        # multi-tenant fleet (fleet/tenancy.py): the arbiter's actions
+        # are counters (a cascade step that does not advance
+        # tpu_fleet_mt_actions_total did not happen), and the
+        # held-vs-entitled gauge pair is the fair-share surface — an
+        # operator watches |held - entitled| converge to zero
+        self.mt_actions = Counter(
+            "tpu_fleet_mt_actions_total",
+            "Multi-tenant arbiter actions by tenant and kind "
+            "(grant/reclaim_park/reclaim_shrink/reclaim_drain/"
+            "release/regrow)", ["tenant", "action"],
+            registry=self.registry)
+        self.tenant_chips = Gauge(
+            "tpu_fleet_tenant_chips",
+            "Chips currently held per tenant (ledger ownership)",
+            ["tenant"], registry=self.registry)
+        self.tenant_entitled = Gauge(
+            "tpu_fleet_tenant_entitled",
+            "Fair-share chip entitlement per tenant (floors + "
+            "priority-ordered water-fill)", ["tenant"],
+            registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

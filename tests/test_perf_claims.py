@@ -17,11 +17,19 @@ import lint_perf_claims  # noqa: E402
 
 
 def test_repo_perf_claims_are_cited():
-    """THE gate: every numeric perf claim in ops/ and models/
-    docstrings cites a tools/*.json (or BENCH_r*.json) artifact that
-    exists and parses."""
+    """THE gate: every numeric perf claim in ops/, models/, fleet/,
+    and gateway/ docstrings cites a tools/*.json (or BENCH_r*.json)
+    artifact that exists and parses."""
     problems = lint_perf_claims.lint()
     assert problems == [], "\n".join(problems)
+
+
+def test_scope_covers_the_control_plane_tiers():
+    """ISSUE 9 satellite: the lint's scope grew from the kernel tier
+    to the fleet/gateway control-plane tiers, whose docstrings carry
+    throughput/latency claims too."""
+    assert "k8s_dra_driver_tpu/fleet" in lint_perf_claims.SCOPES
+    assert "k8s_dra_driver_tpu/gateway" in lint_perf_claims.SCOPES
 
 
 def _scratch_repo(tmp_path, body, artifact=True):

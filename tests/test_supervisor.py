@@ -414,7 +414,11 @@ def test_request_width_validates_statically(tmp_path):
         sup.request_width(3)                # batch 8 % 3 != 0
     sup.request_width(2)
     sup.request_width(4)                    # latest request wins
-    assert sup._requested_dp == 4
+    assert sup._requested == ("width", 4, None)
+    sup.park()                              # ... including over a park
+    assert sup._requested == ("park",)
+    sup.request_width(4, exclude=[6, 7])
+    assert sup._requested == ("width", 4, frozenset({6, 7}))
     ckpt.close()
 
 
@@ -473,3 +477,134 @@ def test_external_resize_preempt_then_expand(tmp_path):
     assert reg.get_sample_value("tpu_train_restarts_total",
                                 {"cause": "expand"}) == 1
     assert reg.get_sample_value("tpu_train_dp_width") == 4
+
+
+# -- concurrent-resize guard + park (ISSUE 9 satellites) -------------------
+
+def test_concurrent_resize_queues_and_coalesces(tmp_path):
+    """ISSUE 9 satellite: a second request_width arriving while a
+    REFORM/EXPAND is in flight queues for the next boundary instead
+    of racing the state machine, and a request the gang already
+    matches coalesces to a no-op — pinned on the exact transition
+    sequence."""
+    from k8s_dra_driver_tpu.parallel import supervisor as sv
+    sup, ckpt = _supervisor(tmp_path, dp=2, batch=8, tp=2)
+    sup.begin(16)
+    sup.step_once()
+    sup.step_once()                          # two warm steps
+    base = list(sup.transitions)
+
+    # idempotent coalesce: same width, same placement -> NO new arc,
+    # and the boundary still runs a real train step
+    steps_before = sup._step
+    sup.request_width(2)
+    sup.step_once()
+    assert sup.transitions == base
+    assert sup._step == steps_before + 1
+
+    # duplicate requests before the boundary: latest wins, ONE arc
+    sup.request_width(1)
+    sup.request_width(1)
+    sup.step_once()
+    assert sup.transitions[len(base):] == [sv.REFORM, sv.RESUME,
+                                           sv.RUNNING]
+    assert sup.dp == 1
+    sup.step_once()                          # nothing queued: a step,
+    assert sup.transitions[len(base) + 3:] == []   # not another arc
+
+    # a request issued DURING an in-flight EXPAND (from a transition
+    # listener) queues: the first arc completes untouched, the queued
+    # request applies at the NEXT boundary as its own arc
+    issued = []
+
+    def mid_reform_request(state, info):
+        if state == sv.REFORM and not issued:
+            issued.append(True)
+            sup.request_width(1)             # arrives mid-transition
+
+    sup.listeners.append(mid_reform_request)
+    marker = len(sup.transitions)
+    sup.request_width(2)
+    sup.step_once()                          # the expand arc, intact
+    assert sup.transitions[marker:] == [sv.EXPAND, sv.REFORM,
+                                        sv.RESUME, sv.RUNNING]
+    assert sup.dp == 2 and issued == [True]
+    sup.step_once()                          # the queued shrink lands
+    assert sup.transitions[marker + 4:] == [sv.REFORM, sv.RESUME,
+                                            sv.RUNNING]
+    assert sup.dp == 1
+    sup.listeners.clear()
+    while sup.step_once():
+        pass
+    report = sup.report()
+    ckpt.close()
+    # controlled resizes throughout: zero steps lost, exactly-once
+    assert all(r.steps_lost == 0 for r in report.recoveries)
+    steps = [s for s, _ in report.losses]
+    assert steps == list(range(1, len(steps) + 1))
+
+
+def test_park_releases_chips_and_unparks_losslessly(tmp_path):
+    """The full-reclaim verb (fleet/tenancy.py cascades): park
+    checkpoints the CURRENT step, releases every chip and device
+    buffer, idles in PARKED at zero cost, and a later request_width
+    re-forms from the parked checkpoint with zero steps lost."""
+    from k8s_dra_driver_tpu.parallel import supervisor as sv
+    sup, ckpt = _supervisor(tmp_path, dp=2, batch=8, tp=2)
+    sup.begin(10)
+    for _ in range(3):
+        sup.step_once()
+    sup.park()
+    assert sup.step_once() is True
+    assert sup.state == sv.PARKED
+    assert sup.dp == 0 and sup.workers == []
+    assert sup.params is None and sup.opt is None
+    assert sup.contract["parked"] is True
+    assert sup.contract["num_workers"] == 0
+    assert sup.metrics.registry.get_sample_value(
+        "tpu_train_dp_width") == 0
+    assert sup.metrics.registry.get_sample_value(
+        "tpu_train_restarts_total", {"cause": "park"}) == 1
+    # parked ticks are idle, not train steps
+    before = sup._step
+    assert sup.step_once() is True
+    assert sup._step == before
+    # unpark through EXPAND: restore from the parked checkpoint
+    sup.request_width(2)
+    sup.step_once()
+    assert sup.state == sv.RUNNING and sup.dp == 2
+    assert sv.EXPAND in sup.transitions
+    while sup.step_once():
+        pass
+    report = sup.report()
+    ckpt.close()
+    assert [r.cause for r in report.recoveries] == ["park", "expand"]
+    assert [(r.from_dp, r.to_dp) for r in report.recoveries] \
+        == [(2, 0), (0, 2)]
+    assert all(r.steps_lost == 0 for r in report.recoveries)
+    steps = [s for s, _ in report.losses]
+    assert steps == list(range(1, 11))       # lossless through the gap
+
+
+def test_placement_exclusion_fences_the_formation(tmp_path):
+    """placement_exclude (constructor) and request_width(exclude=)
+    pin WHICH chips a formation may use — the multi-tenant arbiter's
+    placement surface — and stay disjoint from health exclusion
+    (readmit never returns an arbitrated-away chip)."""
+    sup, ckpt = _supervisor(tmp_path, dp=1, batch=8, tp=2,
+                            placement_exclude=[0, 1, 2, 3])
+    sup.begin(4)
+    sup.step_once()
+    chips = {c for w in sup.workers for c in w.chips}
+    assert chips <= {4, 5, 6, 7}
+    assert sup.contract["placement_excluded"] == [0, 1, 2, 3]
+    # a resize with a new fence re-places the gang
+    sup.request_width(1, exclude=[c for c in range(8) if c not in
+                                  (0, 1)])
+    sup.step_once()
+    chips = {c for w in sup.workers for c in w.chips}
+    assert chips == {0, 1}
+    # readmit touches health state only, never the placement fence
+    sup.readmit([5])
+    assert 5 in sup._placement_excluded
+    ckpt.close()

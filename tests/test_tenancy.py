@@ -1,0 +1,729 @@
+"""Multi-tenant fleet (fleet/tenancy.py + fleet/binpack.py): quotas,
+priority classes, fair-share preemption cascades, and ICI-topology
+bin-packing across N gangs + N pools.
+
+THE acceptance invariants (ISSUE 9): three tenants (hi serving / mid
+gang / lo gang) on the 8-device hermetic mesh — a high-priority burst
+preempts across BOTH lower tenants in strict priority order (the
+floor-zero lo gang is fully reclaimed — PARKED — before mid is
+touched), zero training steps lost anywhere, every loss step applied
+exactly once, quota floors never violated at any tick; when calm
+returns both victims regrow (priority order again), and the
+fragmentation probe shows the bin-packed placement regrows a strictly
+wider gang than naive first-fit.  The chaos twin (``-m faults``)
+kills a chip inside the HIGH-priority gang mid-cascade and pins that
+the cascade still resolves in priority order with byte-equal serving
+outputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.fleet import (ChipLedger, MtConfig,
+                                      MultiTenantReconciler,
+                                      ServingTenant, TenantRegistry,
+                                      TenantSpec, TenantState,
+                                      TopologyBinPacker,
+                                      TrainingTenant, entitlements,
+                                      serving_tag, training_tag)
+from k8s_dra_driver_tpu.fleet.tenancy import FairShareArbiter
+from k8s_dra_driver_tpu.gateway import FleetGateway, ReplicaManager
+from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                       greedy_generate, init_params)
+from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
+
+pytestmark = pytest.mark.timeout_s(300)
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_head=8, d_ff=64, max_seq=48, n_kv_heads=2,
+                        dtype=jnp.float32)
+
+_PARAMS = None
+
+
+def params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+    return _PARAMS
+
+
+def prompt(seed, n):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab), np.int32)
+
+
+def oracle(pr, n_new):
+    out = greedy_generate(params(), jnp.asarray(pr)[None, :], CFG,
+                          n_tokens=n_new)
+    return np.asarray(out[0], np.int32)
+
+
+class Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- specs + registry (pure host logic) ------------------------------------
+
+class TestTenantRegistry:
+    def test_spec_validation(self):
+        with pytest.raises(ValueError):
+            TenantSpec("x", priority=1, quota=1, floor=2)
+        with pytest.raises(ValueError):
+            TenantSpec("x", priority=1, quota=1, share=0.0)
+
+    def test_floors_must_fit_capacity(self):
+        reg = TenantRegistry(capacity=4)
+        reg.add(TenantSpec("a", priority=2, quota=4, floor=3), object())
+        with pytest.raises(ValueError):
+            reg.add(TenantSpec("b", priority=1, quota=4, floor=2),
+                    object())
+        with pytest.raises(ValueError):    # duplicate name
+            reg.add(TenantSpec("a", priority=1, quota=1), object())
+
+    def test_priority_ordering(self):
+        reg = TenantRegistry()
+        reg.add(TenantSpec("lo", priority=1, quota=2), object())
+        reg.add(TenantSpec("hi", priority=3, quota=2), object())
+        reg.add(TenantSpec("mid", priority=2, quota=2), object())
+        assert [s.name for s in reg.by_priority()] == \
+            ["hi", "mid", "lo"]
+
+
+def _st(spec, kind, chips, wanted, **kw):
+    return TenantState(spec=spec, kind=kind, chips=frozenset(chips),
+                       wanted=wanted, **kw)
+
+
+class TestEntitlements:
+    HI = TenantSpec("hi", priority=3, quota=6, floor=2)
+    MID = TenantSpec("mid", priority=2, quota=6, floor=2)
+    LO = TenantSpec("lo", priority=1, quota=2, floor=0)
+
+    def test_priority_fill_under_contention(self):
+        """A pressured high class absorbs ALL headroom before a lower
+        class sees a chip; floors always hold."""
+        states = [
+            _st(self.HI, "serving", {6, 7}, 6, pressured=True),
+            _st(self.MID, "training", {2, 3, 4, 5}, 4, gang_dp=4),
+            _st(self.LO, "training", {0, 1}, 2, gang_dp=2),
+        ]
+        assert entitlements(states, 8) == {"hi": 6, "mid": 2, "lo": 0}
+
+    def test_calm_returns_headroom_down_the_classes(self):
+        states = [
+            _st(self.HI, "serving", {6, 7}, 2, calm=True),
+            _st(self.MID, "training", {2, 3}, 4, gang_dp=2),
+            _st(self.LO, "training", set(), 2, gang_dp=0, parked=True),
+        ]
+        assert entitlements(states, 8) == {"hi": 2, "mid": 4, "lo": 2}
+
+    def test_share_weights_split_one_class(self):
+        """Inside one priority class, headroom splits by share weight
+        (weighted max-min water-fill)."""
+        a = TenantSpec("a", priority=1, quota=8, floor=0, share=2.0)
+        b = TenantSpec("b", priority=1, quota=8, floor=0, share=1.0)
+        states = [_st(a, "serving", set(), 8, pressured=True),
+                  _st(b, "serving", set(), 8, pressured=True)]
+        ent = entitlements(states, 6)
+        assert ent["a"] + ent["b"] == 6
+        assert ent["a"] == 4 and ent["b"] == 2
+
+    def test_quota_caps_entitlement(self):
+        a = TenantSpec("a", priority=2, quota=3, floor=0)
+        b = TenantSpec("b", priority=1, quota=8, floor=0)
+        states = [_st(a, "serving", set(), 8, pressured=True),
+                  _st(b, "serving", set(), 8, pressured=True)]
+        ent = entitlements(states, 8)
+        assert ent["a"] == 3            # quota beats priority
+        assert ent["b"] == 5            # the rest flows down
+
+
+# -- the bin-packer (pure host logic) --------------------------------------
+
+class TestBinPacker:
+    def rig(self, n=8, domain_size=2):
+        led = ChipLedger(list(range(n)))
+        return led, TopologyBinPacker(led, domain_size=domain_size)
+
+    def test_no_two_tenants_straddle_a_link_domain(self):
+        """The overlap-token invariant: a half-free domain whose other
+        chip belongs to another tenant is NOT placeable."""
+        led, packer = self.rig()
+        led.owners[0] = training_tag("gang")    # domain (0,1) is gang's
+        led.owners[3] = serving_tag("other", "r0")  # (2,3) is other's
+        chip = packer.place_chip("me")
+        assert chip in (4, 5, 6, 7)             # never 1 or 2
+        # the gang itself CAN fill its own half domain
+        run = packer.place_run("gang", 2,
+                               usable_owner=training_tag("gang"))
+        assert run is not None and run.chips == (0, 1)
+
+    def test_conflict_table_reports_holders(self):
+        led, packer = self.rig()
+        led.owners[0] = training_tag("g")
+        led.owners[5] = serving_tag("s", "r0")
+        table = packer.conflict_table()
+        assert table == {0: {"g"}, 2: {"s"}}
+
+    def test_place_chip_fills_own_domain_and_avoids_others(self):
+        led, packer = self.rig()
+        led.owners[0] = training_tag("gang")
+        led.owners[1] = training_tag("gang")
+        # first chip for A: far end of the board, away from the gang
+        a1 = packer.place_chip("A")
+        assert a1 == 7
+        led.owners[a1] = serving_tag("A", "r0")
+        # second chip for A: fills A's own half-open domain
+        a2 = packer.place_chip("A")
+        assert a2 == 6
+        led.owners[a2] = serving_tag("A", "r1")
+        # B lands in a fully free domain, not straddling anyone's
+        b1 = packer.place_chip("B")
+        assert b1 in (4, 5) or b1 in (2, 3)
+        assert packer.domain_of(b1) not in (
+            packer.domain_of(0), packer.domain_of(7))
+
+    def test_place_run_prefers_extending_own_block(self):
+        led, packer = self.rig()
+        led.owners[2] = training_tag("g")
+        led.owners[3] = training_tag("g")
+        run = packer.place_run("g", 4, usable_owner=training_tag("g"))
+        assert run is not None
+        assert {2, 3} <= set(run.chips)         # extend, don't relocate
+        assert len(run.chips) == 4
+
+    def test_place_run_skips_unhealthy_and_conflicted(self):
+        led, packer = self.rig()
+        led.unhealthy = {1: "ecc"}
+        led.owners[5] = serving_tag("other", "r0")
+        run = packer.place_run("me", 2)
+        assert run is not None
+        assert 1 not in run.chips
+        # domain (4,5) holds other's chip: 4 is conflicted for me
+        assert 4 not in run.chips and 5 not in run.chips
+
+    def test_regrow_width_counts_own_chips(self):
+        led, packer = self.rig()
+        led.owners[0] = training_tag("g")
+        led.owners[1] = training_tag("g")
+        led.owners[6] = serving_tag("s", "r0")
+        led.owners[7] = serving_tag("s", "r1")
+        assert packer.regrow_width("g", tp=1, target_dp=8) == 4
+        assert packer.regrow_width("g", tp=2, target_dp=4) == 2
+
+
+def test_fragmentation_probe_packed_beats_naive():
+    """THE fragmentation criterion: after the same churn, bin-packed
+    placement regrows a STRICTLY wider gang than naive first-fit."""
+    from k8s_dra_driver_tpu.fleet.probe import fragmentation_probe
+    out = fragmentation_probe()
+    assert out["packed_regrow"] > out["naive_regrow"]
+    assert out["frag_win_x"] > 1.0
+    assert out["packed_regrow"] == 4 and out["naive_regrow"] == 2
+
+
+# -- the arbiter (pure host logic, stub ledger) ----------------------------
+
+class TestArbiterCascade:
+    HI = TenantSpec("hi", priority=3, quota=6, floor=2)
+    MID = TenantSpec("mid", priority=2, quota=6, floor=2)
+    LO = TenantSpec("lo", priority=1, quota=2, floor=0)
+
+    def rig(self):
+        led = ChipLedger(list(range(8)))
+        for c in (0, 1):
+            led.owners[c] = training_tag("lo")
+        for c in (2, 3, 4, 5):
+            led.owners[c] = training_tag("mid")
+        led.owners[6] = serving_tag("hi", "r0")
+        led.owners[7] = serving_tag("hi", "r1")
+        packer = TopologyBinPacker(led, domain_size=2)
+        arb = FairShareArbiter(up_after=1, down_after=1,
+                               regrow_after=1)
+        return led, packer, arb
+
+    def states(self, hi_chips, mid_chips, lo_chips, *, hot=True,
+               lo_parked=False):
+        return [
+            _st(self.HI, "serving", hi_chips, 6 if hot else 2,
+                pressured=hot, calm=not hot),
+            _st(self.MID, "training", mid_chips, 4,
+                gang_dp=len(mid_chips), gang_tp=1),
+            _st(self.LO, "training", lo_chips, 2,
+                gang_dp=len(lo_chips), gang_tp=1, parked=lo_parked),
+        ]
+
+    def test_cascade_is_strict_priority_order(self):
+        """Blocked grant -> the LOWEST class gives ground; a
+        floor-zero gang is parked outright (fully reclaimed), and mid
+        is untouched while lo has anything left."""
+        led, packer, arb = self.rig()
+        a = arb.decide(self.states({6, 7}, {2, 3, 4, 5}, {0, 1}),
+                       led, packer)
+        assert (a.kind, a.tenant, a.beneficiary) == \
+            ("reclaim_park", "lo", "hi")
+        # lo parked; next blocked grant takes from mid — one pow2
+        # step, never below its floor
+        for c in (0, 1):
+            led.owners[c] = serving_tag("hi", "r2")  # already granted
+        a = arb.decide(self.states({0, 1, 6, 7}, {2, 3, 4, 5}, set(),
+                                   lo_parked=True), led, packer)
+        assert (a.kind, a.tenant, a.dp) == ("reclaim_shrink", "mid", 2)
+
+    def test_floored_victims_are_never_taken_below_floor(self):
+        """A gang whose next power-of-two shrink would land below its
+        floor is NOT a victim — the cascade skips it (and, with
+        nobody else to take from, emits nothing)."""
+        mid3 = TenantSpec("mid", priority=2, quota=6, floor=3)
+        led = ChipLedger(list(range(8)))
+        led.unhealthy = {0: "ecc", 1: "ecc"}     # no free supply
+        for c in (2, 3, 4, 5):
+            led.owners[c] = training_tag("mid")
+        led.owners[6] = serving_tag("hi", "r0")
+        led.owners[7] = serving_tag("hi", "r1")
+        packer = TopologyBinPacker(led, domain_size=2)
+        arb = FairShareArbiter(up_after=1, down_after=1,
+                               regrow_after=1)
+        states = [
+            _st(self.HI, "serving", {6, 7}, 6, pressured=True),
+            _st(mid3, "training", {2, 3, 4, 5}, 4, gang_dp=4,
+                gang_tp=1),
+        ]
+        # mid holds 4 > entitlement 3, but dp4 -> dp2 would hold only
+        # 2 chips < floor 3: the shrink is refused, mid keeps 4
+        a = arb.decide(states, led, packer)
+        assert a is None
+
+    def test_no_preemption_for_equal_or_lower_priority(self):
+        led, packer, arb = self.rig()
+        peer = TenantSpec("peer", priority=2, quota=6, floor=0)
+        states = [
+            _st(peer, "serving", set(), 6, pressured=True),
+            _st(self.MID, "training", {2, 3, 4, 5}, 4, gang_dp=4,
+                gang_tp=1),
+        ]
+        # board has free chips 0,1,6,7 in this rig? claim them first
+        for c in (0, 1, 6, 7):
+            led.owners[c] = training_tag("mid")
+        states[1] = _st(self.MID, "training",
+                        {0, 1, 2, 3, 4, 5, 6, 7}, 8, gang_dp=8,
+                        gang_tp=1)
+        a = arb.decide(states, led, packer)
+        assert a is None                # same class: no cascade
+
+    def test_calm_release_then_regrow_in_priority_order(self):
+        led, packer, arb = self.rig()
+        # hi swollen to 4, mid shrunk to 2, lo parked; free 0,1
+        led.owners[0] = led.owners[1] = None
+        led.owners[4] = serving_tag("hi", "r2")
+        led.owners[5] = serving_tag("hi", "r3")
+        states = self.states({4, 5, 6, 7}, {2, 3}, set(),
+                             hot=False, lo_parked=True)
+        a = arb.decide(states, led, packer)
+        assert a.kind == "release" and a.tenant == "hi"
+        # once hi is back at entitlement, regrows go highest-first
+        led.owners[4] = led.owners[5] = None
+        states = self.states({6, 7}, {2, 3}, set(),
+                             hot=False, lo_parked=True)
+        a = arb.decide(states, led, packer)
+        assert a.kind == "regrow" and a.tenant == "mid" and a.dp == 4
+
+
+# -- per-tenant request tagging (satellite 1) ------------------------------
+
+class _StubEngine:
+    slots = 2
+
+
+def test_submit_tags_tenant_series_and_refusals():
+    """ISSUE 9 satellite: the tenant tag rides admission into the
+    per-tenant outcome counter (refusals included) and defaults to
+    the gateway's own tenant."""
+    mgr = ReplicaManager(lambda name: _StubEngine(), replicas=0)
+    gw = FleetGateway(mgr, queue_capacity=1, tenant="hi")
+    g = gw.submit(Request(uid="a", prompt=np.ones(4, np.int32),
+                          max_new=1))
+    assert g.tenant == "hi"             # gateway default
+    g2 = gw.submit(Request(uid="b", prompt=np.ones(4, np.int32),
+                           max_new=1), tenant="other")
+    assert g2.tenant == "other"         # explicit tag wins
+    assert g2.status == "rejected_full"
+    reg = gw.metrics.registry
+    assert reg.get_sample_value(
+        "tpu_gateway_tenant_requests_total",
+        {"tenant": "other", "outcome": "rejected_full"}) == 1
+
+
+def test_bus_tagged_demand_reaches_the_arbiter():
+    """Each tenant pump's ``demand`` events arrive on the shared bus
+    TAGGED, and the multi-tenant reconciler ticks on the cached
+    per-tenant view instead of re-reading k registries."""
+    from k8s_dra_driver_tpu.cluster.bus import EventBus
+    bus = EventBus()
+    mgrs, gws = {}, {}
+    for name in ("a", "b"):
+        mgrs[name] = ReplicaManager(lambda n: _StubEngine(),
+                                    replicas=0)
+        gws[name] = FleetGateway(mgrs[name], queue_capacity=8,
+                                 tenant=name, bus=bus)
+    registry = TenantRegistry(capacity=4)
+    registry.add(TenantSpec("a", priority=2, quota=2),
+                 ServingTenant(gws["a"]))
+    registry.add(TenantSpec("b", priority=1, quota=2),
+                 ServingTenant(gws["b"]))
+    rec = MultiTenantReconciler(registry,
+                                ledger=ChipLedger([0, 1, 2, 3]),
+                                bus=bus)
+    for i in range(5):
+        gws["a"].submit(Request(uid=f"q{i}",
+                                prompt=np.ones(4, np.int32),
+                                max_new=1))
+    gws["a"].step()
+    gws["b"].step()
+    assert rec._bus_demand["a"]["queue_depth"] == 5
+    assert rec._bus_demand["b"]["queue_depth"] == 0
+    rec.tick()      # consumes the cached view without error
+    assert rec.arbiter.entitled["a"] >= 0
+
+
+def test_trace_fixtures_carry_tenant_tags():
+    """Loadgen fixtures gained per-arrival tenant tags and stay
+    regenerable bit-for-bit (the schema pin in test_bench_smoke runs
+    the full check; this pins the tag content contract)."""
+    from k8s_dra_driver_tpu.gateway.loadgen import (TRACE_NAMES,
+                                                    load_trace)
+    for name in TRACE_NAMES:
+        t = load_trace(name)
+        assert len(t["tenants"]) == t["n"]
+        assert set(t["tenants"]) <= {"a", "b", "c"}
+
+
+# -- THE acceptance scenario (3 tenants, real gangs + real serving) --------
+
+def _gang(tmp_path, name, *, dp, chips, batch):
+    from k8s_dra_driver_tpu.models.checkpoint import TrainCheckpointer
+    from k8s_dra_driver_tpu.parallel.supervisor import (ElasticTrainJob,
+                                                        GangSupervisor)
+    motif = np.random.default_rng(0).integers(0, 64, 32)
+    job = ElasticTrainJob(CFG, np.tile(motif, 64), batch=batch,
+                          seq_len=16, tp=1)
+    ckpt = TrainCheckpointer(tmp_path / f"ckpt-{name}")
+    sup = GangSupervisor(
+        job, ckpt, coordination_dir=tmp_path / f"coord-{name}",
+        dp=dp, checkpoint_every=2, step_deadline_s=120.0,
+        first_step_deadline_s=600.0,
+        placement_exclude=[c for c in range(8) if c not in chips])
+    return sup, ckpt
+
+
+def test_acceptance_cascade_across_two_tenants(tmp_path):
+    """THE acceptance test (ISSUE 9): hi's burst preempts across BOTH
+    lower tenants in strict priority order — lo (floor 0) is FULLY
+    reclaimed (parked) before mid is touched, mid never drops below
+    its floor, hi never exceeds its quota, zero steps lost, losses
+    exactly once; calm regrows both victims (priority order), and
+    every cascade step is visible in the mt metrics."""
+    from k8s_dra_driver_tpu.parallel import supervisor as sv
+
+    clock = Clock()
+    sup_lo, ckpt_lo = _gang(tmp_path, "lo", dp=2, chips={0, 1},
+                            batch=4)
+    sup_mid, ckpt_mid = _gang(tmp_path, "mid", dp=4,
+                              chips={2, 3, 4, 5}, batch=8)
+    mgr = ReplicaManager(
+        lambda name: ServingEngine(params(), CFG, slots=2),
+        replicas=2, chip_of=lambda name: 6 + int(name[1:]),
+        depth_bound=2)
+    gw = FleetGateway(mgr, queue_capacity=64, clock=clock,
+                      auto_replace=False, tenant="hi")
+    ledger = ChipLedger(list(range(8)))
+    registry = TenantRegistry(capacity=8)
+    registry.add(TenantSpec("hi", priority=3, quota=6, floor=2),
+                 ServingTenant(gw))
+    registry.add(TenantSpec("mid", priority=2, quota=4, floor=2),
+                 TrainingTenant(sup_mid, target_dp=4))
+    registry.add(TenantSpec("lo", priority=1, quota=2, floor=0),
+                 TrainingTenant(sup_lo, target_dp=2))
+    rec = MultiTenantReconciler(
+        registry, ledger=ledger,
+        packer=TopologyBinPacker(ledger, domain_size=2),
+        config=MtConfig(queue_high=4, up_after=2, down_after=3,
+                        regrow_after=3, arrival_low_rps=0.5),
+        clock=clock)
+
+    sup_lo.begin(10_000)
+    sup_mid.begin(10_000)
+    live = {"lo": True, "mid": True}
+    floor_ok = {"mid": True, "hi": True}
+    quota_ok = True
+
+    def pump():
+        nonlocal quota_ok
+        gw.step()
+        for name, sup in (("lo", sup_lo), ("mid", sup_mid)):
+            if live[name]:
+                live[name] = sup.step_once()
+        rec.tick()
+        clock.advance(1.0)
+        # floors/quota sampled EVERY tick: never violated, not just
+        # at the end
+        mid_chips = {c for w in sup_mid.workers if w.alive
+                     for c in w.chips}
+        if sup_mid.state != sv.PARKED and len(mid_chips) < 2:
+            floor_ok["mid"] = False
+        hi_live = [r for r in mgr.replicas if r.state != "dead"]
+        if len(hi_live) < 2:
+            floor_ok["hi"] = False
+        if len(hi_live) > 6:
+            quota_ok = False
+
+    # -- the burst: deep sustained queue against a FULL board --------
+    wave = [Request(uid=f"a{i}", prompt=prompt(100 + i, 5),
+                    max_new=3) for i in range(24)]
+    for r in wave:
+        gw.submit(r, slo_s=120.0)
+    for _ in range(80):
+        pump()
+        if (not len(gw.queue)
+                and not any(r.in_flight for r in mgr.replicas)
+                and sup_lo.state == sv.PARKED
+                and sup_mid.dp == 2):
+            break
+
+    # strict priority order: lo FULLY reclaimed (parked) before mid
+    # was touched
+    kinds = [(k, i.get("tenant")) for _, k, i in rec.events]
+    assert ("reclaim_park", "lo") in kinds
+    assert ("reclaim_shrink", "mid") in kinds
+    assert kinds.index(("reclaim_park", "lo")) \
+        < kinds.index(("reclaim_shrink", "mid"))
+    assert ("reclaim_shrink", "lo") not in kinds   # park, not nibble
+    assert sup_lo.recoveries and \
+        sup_lo.recoveries[0].cause == "park"
+    pre = [r for r in sup_mid.recoveries if r.cause == "preempt"]
+    assert len(pre) == 1
+    assert (pre[0].from_dp, pre[0].to_dp) == (4, 2)
+    # zero steps lost ANYWHERE in the cascade
+    assert all(r.steps_lost == 0 for r in sup_lo.recoveries)
+    assert all(r.steps_lost == 0 for r in sup_mid.recoveries)
+    # grants landed on the reclaimed chips and served
+    grants = [i for _, k, i in rec.events if k == "grant"]
+    assert len(grants) >= 3
+    granted_chips = {g["chip"] for g in grants}
+    assert granted_chips <= {0, 1, 4, 5}      # lo's + mid's freed
+    assert {0, 1} <= granted_chips            # lo's block was used
+    granted_names = {g["replica"] for g in grants}
+    assert any(g.status == "finished" and g.replica in granted_names
+               for g in gw.outcomes.values()), \
+        "no granted replica ever served"
+    # every burst request reached exactly one terminal FINISHED
+    assert len(gw.outcomes) == len(wave)
+    assert all(g.status == "finished" for g in gw.outcomes.values())
+
+    # -- calm: releases, then regrow BOTH victims in priority order --
+    for _ in range(120):
+        pump()
+        exp_mid = [r for r in sup_mid.recoveries
+                   if r.cause == "expand"]
+        exp_lo = [r for r in sup_lo.recoveries if r.cause == "expand"]
+        if (exp_mid and exp_lo and sup_mid.dp == 4 and sup_lo.dp == 2
+                and sup_lo.state == sv.RUNNING
+                and sup_mid.state == sv.RUNNING
+                and sup_lo._step > exp_lo[0].restored_step
+                and sup_mid._step > exp_mid[0].restored_step):
+            break
+    exp_mid = [r for r in sup_mid.recoveries if r.cause == "expand"]
+    exp_lo = [r for r in sup_lo.recoveries if r.cause == "expand"]
+    assert len(exp_mid) == 1 and (exp_mid[0].from_dp,
+                                  exp_mid[0].to_dp) == (2, 4)
+    assert len(exp_lo) == 1 and exp_lo[0].from_dp == 0  # unpark
+    assert exp_lo[0].to_dp == 2
+    assert sv.PARKED in sup_lo.transitions
+    assert sv.EXPAND in sup_mid.transitions
+    # regrow order: the higher class regrew first
+    regrows = [(k, i.get("tenant")) for _, k, i in rec.events
+               if k == "regrow"]
+    assert [t for _, t in regrows[:2]] == ["mid", "lo"]
+    # floors and quota held at EVERY sampled tick
+    assert floor_ok["mid"], "mid dropped below its floor mid-cascade"
+    assert floor_ok["hi"], "hi dropped below its floor"
+    assert quota_ok, "hi exceeded its quota"
+
+    # exactly-once training on BOTH gangs, through park and regrow
+    for sup in (sup_lo, sup_mid):
+        steps = [s for s, _ in sup.losses]
+        assert steps == list(range(1, len(steps) + 1))
+        assert np.isfinite([l for _, l in sup.losses]).all()
+
+    # the cascade is visible in the mt metrics + per-tenant series
+    freg = rec.metrics.registry
+    for tenant, action, n in (("lo", "reclaim_park", 1),
+                              ("mid", "reclaim_shrink", 1),
+                              ("mid", "regrow", 1),
+                              ("lo", "regrow", 1)):
+        assert freg.get_sample_value(
+            "tpu_fleet_mt_actions_total",
+            {"tenant": tenant, "action": action}) == n, (tenant, action)
+    assert freg.get_sample_value("tpu_fleet_mt_actions_total",
+                                 {"tenant": "hi",
+                                  "action": "grant"}) >= 3
+    assert freg.get_sample_value("tpu_fleet_tenant_chips",
+                                 {"tenant": "mid"}) == 4
+    # satellite 1 end-to-end: the tenant-labeled gateway series
+    # populated and render through the combined exposition
+    from k8s_dra_driver_tpu.utils.metrics import render_all
+    text = render_all(rec.metrics, gw.metrics, sup_lo.metrics,
+                      sup_mid.metrics).decode()
+    assert 'tpu_gateway_tenant_requests_total{outcome=' in text \
+        or 'tpu_gateway_tenant_requests_total{tenant=' in text
+    assert gw.metrics.registry.get_sample_value(
+        "tpu_gateway_tenant_requests_total",
+        {"tenant": "hi", "outcome": "finished_attained"}) == len(wave)
+    assert gw.metrics.registry.get_sample_value(
+        "tpu_gateway_tenant_queue_wait_seconds_count",
+        {"tenant": "hi"}) >= len(wave)
+    ckpt_lo.close()
+    ckpt_mid.close()
+
+
+# -- the chaos twin: a chip dies inside the HIGH gang mid-cascade ----------
+
+@pytest.mark.faults
+def test_chaos_chip_death_in_high_gang_mid_cascade(tmp_path):
+    """ISSUE 9 satellite: ScriptedChipHealth kills a chip inside the
+    HIGH-priority tenant's gang (mid — the higher of the two gangs)
+    while the cascade is in flight.  The cascade still resolves in
+    strict priority order (lo parked; mid's loss is a FAILURE
+    eviction, never a cascade reclaim — its floor holds against
+    decisions), training losses stay exactly-once through the health
+    eviction and the heal-driven regrow, and serving outputs are
+    byte-equal to the single-engine oracle end to end."""
+    from k8s_dra_driver_tpu.cluster.faults import (FaultPlan,
+                                                   FaultRule,
+                                                   ScriptedChipHealth)
+    from k8s_dra_driver_tpu.parallel import supervisor as sv
+
+    clock = Clock()
+    sup_lo, ckpt_lo = _gang(tmp_path, "lo", dp=2, chips={0, 1},
+                            batch=4)
+    sup_mid, ckpt_mid = _gang(tmp_path, "mid", dp=2, chips={2, 3},
+                              batch=4)
+    plan = FaultPlan([
+        # chip 3 (inside mid's gang) dies on the ledger's 5th poll —
+        # mid-cascade: after the park fired but while the freed chips
+        # are still being granted out ...
+        FaultRule(verb="health", kind="Chip", name="3", skip=4,
+                  times=1, error="drop"),
+        # ... and heals ~18 polls later, after the cascade resolved
+        FaultRule(verb="health", kind="Chip", name="3", skip=18,
+                  times=1, error="heal"),
+    ])
+    scripted = ScriptedChipHealth(plan, chips=[3])
+    ledger = ChipLedger(list(range(8)), health_source=scripted)
+    # ONE health observation for everyone: gangs and pool judge chips
+    # from the ledger's view (mirrors the 1x1 chaos twin)
+    sup_mid.health_source = ledger.current_unhealthy
+    sup_lo.health_source = ledger.current_unhealthy
+    mgr = ReplicaManager(
+        lambda name: ServingEngine(params(), CFG, slots=2),
+        replicas=2, chip_of=lambda name: 6 + int(name[1:]),
+        health_source=ledger.current_unhealthy, depth_bound=2)
+    gw = FleetGateway(mgr, queue_capacity=64, clock=clock,
+                      auto_replace=False, tenant="hi")
+    registry = TenantRegistry(capacity=8)
+    registry.add(TenantSpec("hi", priority=3, quota=6, floor=2),
+                 ServingTenant(gw))
+    registry.add(TenantSpec("mid", priority=2, quota=2, floor=2),
+                 TrainingTenant(sup_mid, target_dp=2))
+    registry.add(TenantSpec("lo", priority=1, quota=2, floor=0),
+                 TrainingTenant(sup_lo, target_dp=2))
+    rec = MultiTenantReconciler(
+        registry, ledger=ledger,
+        packer=TopologyBinPacker(ledger, domain_size=2),
+        config=MtConfig(queue_high=3, up_after=2, down_after=3,
+                        regrow_after=3, arrival_low_rps=0.5),
+        clock=clock)
+    sup_lo.begin(10_000)
+    sup_mid.begin(10_000)
+    live = {"lo": True, "mid": True}
+
+    def pump():
+        gw.step()
+        for name, sup in (("lo", sup_lo), ("mid", sup_mid)):
+            if live[name]:
+                live[name] = sup.step_once()
+        rec.tick()
+        clock.advance(1.0)
+
+    # a front-loaded burst keeps pressure on while the cascade and
+    # the chip kill interleave; no SLO: every request must finish
+    reqs = [Request(uid=f"c{i}", prompt=prompt(300 + i, 5 + (i % 2)),
+                    max_new=3 + (i % 2)) for i in range(16)]
+    for r in reqs:
+        gw.submit(r)
+    for rnd in range(120):
+        pump()
+        exp_mid = [r for r in sup_mid.recoveries
+                   if r.cause == "expand"]
+        exp_lo = [r for r in sup_lo.recoveries if r.cause == "expand"]
+        healed = any(k == "readmit" for _, k, _ in rec.events)
+        if (exp_mid and exp_lo and healed and sup_mid.dp == 2
+                and sup_lo.dp == 2 and not len(gw.queue)
+                and not any(r.in_flight for r in mgr.replicas)
+                and sup_mid._step > exp_mid[0].restored_step
+                and sup_lo._step > exp_lo[0].restored_step):
+            break
+
+    # the kill landed INSIDE mid's gang and was a failure eviction,
+    # not a cascade decision
+    health = [r for r in sup_mid.recoveries if r.cause == "health"]
+    assert len(health) == 1
+    assert (health[0].from_dp, health[0].to_dp) == (2, 1)
+    kinds = [(k, i.get("tenant")) for _, k, i in rec.events]
+    assert ("reclaim_park", "lo") in kinds     # cascade order held
+    assert ("reclaim_shrink", "mid") not in kinds
+    assert ("reclaim_drain", "mid") not in kinds
+    # heal forwarded exactly once, mid regrew after it
+    assert any(k == "readmit" and i.get("chips") == [3]
+               for _, k, i in rec.events)
+    exp_mid = [r for r in sup_mid.recoveries if r.cause == "expand"]
+    assert exp_mid and exp_mid[0].to_dp == 2
+    # losses exactly-once on both gangs THROUGH the health eviction:
+    # lo's park/unpark is lossless (plain contiguous); mid's FAILURE
+    # eviction may rewind, but only to a recovery's restored step —
+    # replayed steps re-run in the restored trajectory (applied
+    # once), and nothing is ever skipped or silently doubled
+    lo_steps = [s for s, _ in sup_lo.losses]
+    assert lo_steps == list(range(1, len(lo_steps) + 1))
+    mid_steps = [s for s, _ in sup_mid.losses]
+    rewind_starts = [r.restored_step + 1 for r in sup_mid.recoveries
+                     if r.steps_lost > 0]
+    prev = 0
+    for s in mid_steps:
+        if s == prev + 1:
+            prev = s
+            continue
+        assert s <= prev and s in rewind_starts, \
+            f"loss step {s} after {prev} is not a recovery replay"
+        rewind_starts.remove(s)
+        prev = s
+    assert all(r.steps_lost == 0 for r in sup_lo.recoveries)
+    # byte-equal serving end to end
+    assert len(gw.outcomes) == len(reqs)
+    for r in reqs:
+        assert gw.outcomes[r.uid].status == "finished"
+        np.testing.assert_array_equal(
+            gw.results[r.uid].tokens, oracle(r.prompt, r.max_new),
+            err_msg=f"{r.uid} diverged from the oracle")
+    ckpt_lo.close()
+    ckpt_mid.close()

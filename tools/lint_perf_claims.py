@@ -6,8 +6,10 @@ its evidence (the round-8 trigger: models/decode.py cited "0.188x"
 against a kernel path that had already shipped disabled for two
 rounds).  This lint makes the rule mechanical for the kernel tier:
 
-- scope: every docstring in ``k8s_dra_driver_tpu/ops`` and
-  ``k8s_dra_driver_tpu/models``;
+- scope: every docstring in ``k8s_dra_driver_tpu/ops``,
+  ``k8s_dra_driver_tpu/models``, ``k8s_dra_driver_tpu/fleet``, and
+  ``k8s_dra_driver_tpu/gateway`` (the control-plane tiers carry
+  throughput/latency claims too — admissions/s, TTFT wins);
 - a **claim** is a perf-shaped number — ``1.61x`` / ``0.188x``
   speedups, ``111 TF`` / ``133 TFLOPs``, ``820 GB/s``,
   ``2.87 ms/token``, ``14836 tokens/s``;
@@ -33,7 +35,8 @@ import re
 import sys
 
 REPO = pathlib.Path(__file__).resolve().parent.parent
-SCOPES = ("k8s_dra_driver_tpu/ops", "k8s_dra_driver_tpu/models")
+SCOPES = ("k8s_dra_driver_tpu/ops", "k8s_dra_driver_tpu/models",
+          "k8s_dra_driver_tpu/fleet", "k8s_dra_driver_tpu/gateway")
 
 #: perf-shaped numbers: "1.61x" (not "2x2" tile spellings), and
 #: numbers wearing a throughput/latency/bandwidth unit
