@@ -849,6 +849,22 @@ def tpu_probe_stream() -> None:
     """
     from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
     enable_persistent_cache()
+    # Opt-in device tracing (docs/OBSERVABILITY.md): when
+    # TPU_DRA_PROFILE_DIR is set, every probe runs under a
+    # jax.profiler trace with launch-site TraceAnnotations on, so the
+    # captured XProf timeline names each XLA program after its
+    # control-plane dispatch label.  Unset (the hermetic suite, the
+    # official line) this is a no-op — no profiler import, no
+    # per-launch cost.
+    profile_dir = os.environ.get("TPU_DRA_PROFILE_DIR")
+    if profile_dir:
+        from k8s_dra_driver_tpu.utils import dispatch, profiling
+        dispatch.enable_annotations()
+        with profiling.trace(profile_dir):
+            for key, res in _tpu_probes():
+                print(json.dumps({"probe": key, "result": res}),
+                      flush=True)
+        return
     for key, res in _tpu_probes():
         print(json.dumps({"probe": key, "result": res}), flush=True)
 
@@ -1034,6 +1050,7 @@ _PROBE_SCALARS = (
     ("control_plane", "ctl_admissions_per_s", "admissions_per_s"),
     ("control_plane", "ctl_routes_per_s", "routes_per_s"),
     ("control_plane", "ctl_goodput_flat_x", "goodput_flat_x"),
+    ("control_plane", "ctl_trace_overhead_x", "trace_overhead_x"),
     ("allreduce_cpu_mesh8", "cpu_mesh_gbps", "gbps"),
 )
 

@@ -128,5 +128,50 @@ class EventBus:
         compare this across same-seed runs)."""
         return [ev.topic for ev in self.journal]
 
+    def journal_dump(self, limit: int | None = None) -> list[dict]:
+        """JSON-safe journal records ``{seq, topic, payload}`` —
+        payloads SUMMARIZED (:func:`_safe`: bounded depth, truncated
+        sequences, repr'd objects) so chaos replay and the flight
+        recorder (cluster/flightrec.py) can reconstruct what happened
+        without ``journal_topics``'s payload amnesia.  ``limit`` keeps
+        only the newest N.  Schema pinned in test_control_plane."""
+        events = list(self.journal)
+        if limit is not None:
+            events = events[-limit:]
+        return [{"seq": ev.seq, "topic": ev.topic,
+                 "payload": _safe(ev.payload)} for ev in events]
+
+
+#: journal_dump summarization bounds — wide enough that every payload
+#: the control plane publishes today survives intact; tight enough
+#: that a pathological payload cannot balloon a flight-recorder dump
+_SAFE_DEPTH = 4
+_SAFE_ITEMS = 8
+_SAFE_REPR = 120
+
+
+def _safe(value, depth: int = _SAFE_DEPTH):
+    """Summarize ``value`` into something ``json.dumps`` always
+    accepts: plain scalars pass (non-finite floats become strings —
+    JSON has no NaN), dicts/sequences recurse depth-bounded with long
+    sequences truncated to their head plus a ``"...+N"`` marker, and
+    anything else collapses to a truncated ``repr``."""
+    if value is None or isinstance(value, (bool, int, str)):
+        return value
+    if isinstance(value, float):
+        return value if value == value and abs(value) != float("inf") \
+            else str(value)
+    if depth <= 0:
+        return repr(value)[:_SAFE_REPR]
+    if isinstance(value, dict):
+        return {str(k): _safe(v, depth - 1) for k, v in value.items()}
+    if isinstance(value, (list, tuple, set, frozenset, deque)):
+        items = list(value)
+        out = [_safe(v, depth - 1) for v in items[:_SAFE_ITEMS]]
+        if len(items) > _SAFE_ITEMS:
+            out.append(f"...+{len(items) - _SAFE_ITEMS}")
+        return out
+    return repr(value)[:_SAFE_REPR]
+
 
 __all__ = ["Event", "EventBus"]

@@ -90,7 +90,8 @@ class FleetReconciler:
                  policy: FleetPolicy | None = None,
                  metrics: FleetMetrics | None = None,
                  clock=time.monotonic,
-                 bus=None):
+                 bus=None,
+                 tracer=None):
         self.gateway = gateway
         self.supervisor = supervisor
         self.ledger = ledger
@@ -112,6 +113,14 @@ class FleetReconciler:
         #: actuation log: (clock t, action kind, info dict) — the
         #: probe's and the tests' evidence of WHEN each decision fired
         self.events: list[tuple[float, str, dict]] = []
+        #: optional span recorder (utils/tracing.py): every actuation
+        #: ALSO lands as an instant "reconcile" span on the
+        #: reconciler's own trace, so a preemption cascade and the
+        #: request drains it caused line up on one timeline — and the
+        #: flight recorder's preempt trigger fires off the same span
+        self.tracer = tracer
+        self._trace_ctx = (tracer.begin("reconciler")
+                           if tracer is not None else None)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -246,6 +255,9 @@ class FleetReconciler:
 
     def _event(self, t: float, kind: str, **info) -> None:
         self.events.append((t, kind, info))
+        if self.tracer is not None:
+            self.tracer.emit(self._trace_ctx, "reconcile", t,
+                             track="reconciler", kind=kind, **info)
 
     # -- observability ---------------------------------------------------
 
@@ -261,16 +273,20 @@ class FleetReconciler:
         self.metrics.pressure_ticks.set(self.policy.hot)
         self.metrics.calm_ticks.set(self.policy.calm)
 
-    def serve_metrics(self, address: str = "127.0.0.1:0"):
+    def serve_metrics(self, address: str = "127.0.0.1:0",
+                      debug_source=None):
         """Mount the fleet's combined exposition — reconciler +
         gateway + supervisor registries on one ``/metrics``
-        (utils/httpendpoint.py) — and return the started endpoint."""
+        (utils/httpendpoint.py) — and return the started endpoint.
+        ``debug_source`` (e.g. a flight recorder's ``debug_payload``,
+        cluster/flightrec.py) additionally mounts ``/debugz``."""
         from ..utils.httpendpoint import HTTPEndpoint
         extras = [self.gateway.metrics]
         if self.supervisor is not None:
             extras.append(self.supervisor.metrics)
         endpoint = HTTPEndpoint(address, self.metrics,
-                                extra_metrics=extras)
+                                extra_metrics=extras,
+                                debug_source=debug_source)
         endpoint.start()
         return endpoint
 

@@ -370,7 +370,8 @@ class MultiTenantReconciler:
                  config: MtConfig | None = None,
                  metrics: FleetMetrics | None = None,
                  clock=time.monotonic,
-                 bus=None):
+                 bus=None,
+                 tracer=None):
         self.registry = registry
         self.ledger = ledger
         self.packer = packer or TopologyBinPacker(ledger)
@@ -389,6 +390,13 @@ class MultiTenantReconciler:
         #: tests' and the probe's evidence of WHEN and in WHAT ORDER
         #: each cascade step fired
         self.events: list[tuple[float, str, dict]] = []
+        #: optional span recorder (utils/tracing.py), same contract
+        #: as the 1x1 reconciler: every arbiter actuation doubles as
+        #: an instant "reconcile" span, and reclaim kinds trip the
+        #: flight recorder's preempt trigger (cluster/flightrec.py)
+        self.tracer = tracer
+        self._trace_ctx = (tracer.begin("arbiter")
+                           if tracer is not None else None)
 
     # -- signals ---------------------------------------------------------
 
@@ -550,6 +558,9 @@ class MultiTenantReconciler:
 
     def _event(self, t: float, kind: str, **info) -> None:
         self.events.append((t, kind, info))
+        if self.tracer is not None:
+            self.tracer.emit(self._trace_ctx, "reconcile", t,
+                             track="reconciler", kind=kind, **info)
 
     # -- observability ---------------------------------------------------
 

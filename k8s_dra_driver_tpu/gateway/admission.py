@@ -59,6 +59,13 @@ class GatewayRequest:
     #: queue-wait sample and terminal outcome carries it into the
     #: per-tenant metric series
     tenant: str | None = None
+    #: causal-trace cursor (utils/tracing.py ``TraceContext``),
+    #: attached at admission when the gateway runs with a tracer.
+    #: Deliberately carried on the record — not in a side table — so
+    #: drain → requeue → re-dispatch CONTINUES the same trace (the
+    #: drain-gap span) and work stealing moves the trace with the
+    #: request across pump shards.  None when tracing is off.
+    trace: Any | None = None
 
     @property
     def uid(self):

@@ -51,7 +51,7 @@ import time
 
 from ..cluster.bus import EventBus
 from ..models.serving import Finished, Request
-from ..utils import dispatch
+from ..utils import dispatch, tracing
 from ..utils.metrics import GatewayMetrics
 from .admission import (DISPATCHED, FINISHED, QUEUED,
                         REJECTED_INVALID, SHED_EXPIRED, AdmissionError,
@@ -82,7 +82,8 @@ class FleetGateway:
                  auto_replace: bool = True,
                  bus: EventBus | None = None,
                  pool_owner: bool = True,
-                 tenant: str | None = None):
+                 tenant: str | None = None,
+                 tracer=None):
         self.manager = manager
         #: this pool's tenant in a multi-tenant fleet
         #: (fleet/tenancy.py): tags the pump's ``demand`` events so
@@ -122,6 +123,15 @@ class FleetGateway:
         #: sheds/dispatches its own shard.
         self.bus = bus if bus is not None else EventBus()
         self._pool_owner = pool_owner
+        #: optional causal-span recorder (utils/tracing.py).  Every
+        #: tracing touch below is behind ``is not None`` so the traced
+        #: pump stays within the bench-pinned ≤1.05x overhead budget
+        #: and an untraced pump pays one attribute check per phase.
+        self.tracer = tracer
+        self._trace_ctx = (tracer.begin(f"gw-{tenant or 'pool'}")
+                           if tracer is not None else None)
+        if tracer is not None and pool_owner:
+            tracing.wire_pool(tracer, manager)
         if pool_owner:
             self.metrics.pumps.set(1)
             self.bus.subscribe("prefix", self._on_prefix_event)
@@ -165,6 +175,14 @@ class FleetGateway:
             if tenant is not None:
                 self.metrics.tenant_requests.labels(
                     tenant=tenant, outcome=e.status).inc()
+            if self.tracer is not None:
+                # refusals get a one-span trace: the admit span IS the
+                # terminal record (no dispatch ever happens), so the
+                # exactly-once accounting can tell "refused at the
+                # door" from "admitted and orphaned"
+                g.trace = self.tracer.begin(req.uid, tenant)
+                self.tracer.emit(g.trace, "admit", now,
+                                 track="gateway", status=e.status)
             return g
         # uid reuse after a terminal outcome starts a FRESH lifecycle:
         # the old record is forgotten so the exactly-once guard in
@@ -172,6 +190,14 @@ class FleetGateway:
         # twice within ONE lifecycle), not client uid recycling
         self.outcomes.pop(req.uid, None)
         self.results.pop(req.uid, None)
+        if self.tracer is not None:
+            # admission is recorded ON the dispatch span (its t0 is
+            # arrival, its ``depth`` attr is the depth seen here), not
+            # as its own span: admission is the hottest path in the
+            # control plane and one emit per request there is the
+            # single biggest slice of the ≤1.05x overhead budget
+            g.trace = self.tracer.begin(req.uid, tenant)
+            g.trace.admit_depth = len(self.queue)
         self.metrics.queue_depth.set(len(self.queue))
         return g
 
@@ -199,7 +225,7 @@ class FleetGateway:
         self._shed(now, done)
         # 2. health verdicts -> drain (stop dispatch, cancel, requeue)
         for replica in self.manager.poll_down():
-            self._drain(replica)
+            self._drain(replica, now)
         # 3. place what the pool can take; the rest stays queued
         #    (router returns None at the pool's depth bound)
         self._dispatch(now, done)
@@ -230,6 +256,8 @@ class FleetGateway:
                          arrival_rate_rps=self.arrival_rate_rps,
                          slo_margin_ewma_s=self.slo_margin_ewma_s,
                          tenant=self.tenant)
+        if self.tracer is not None:
+            self.tracer.flush()     # ONE "spans" event per step
         self.bus.pump()
         self._steps += 1
         return done
@@ -247,8 +275,15 @@ class FleetGateway:
         queued (router returns None at the pool's depth bound)."""
         while len(self.queue):
             g = self.queue.peek()
-            target = self.router.route(g.request.prompt,
-                                       self.manager.replicas)
+            if self.tracer is None:
+                route_s = 0.0
+                target = self.router.route(g.request.prompt,
+                                           self.manager.replicas)
+            else:
+                rt0 = self.clock()
+                target = self.router.route(g.request.prompt,
+                                           self.manager.replicas)
+                route_s = self.clock() - rt0
             if target is None:
                 break
             g = self.queue.pop(now)
@@ -278,6 +313,20 @@ class FleetGateway:
             if g.tenant is not None:
                 self.metrics.tenant_queue_wait_seconds.labels(
                     tenant=g.tenant).observe(now - g.arrival_s)
+            if self.tracer is not None and g.trace is not None:
+                # first placement spans [arrival, dispatch] — the
+                # queue wait; a post-drain placement spans
+                # [drained, re-dispatch] — the drain gap the
+                # queue-wait histogram cannot attribute on its own
+                gap = (g.requeues > 0
+                       and g.trace.drained_s is not None)
+                self.tracer.emit(
+                    g.trace, "drain_gap" if gap else "dispatch",
+                    g.trace.drained_s if gap else g.arrival_s, now,
+                    track=target.name, replica=target.name,
+                    route_s=route_s, requeues=g.requeues,
+                    depth=g.trace.admit_depth,
+                    why=getattr(self.router, "last_reason", None))
 
     def pending(self) -> int:
         """Queued (not yet dispatched) requests — the surface the
@@ -350,6 +399,33 @@ class FleetGateway:
         if g.tenant is not None:
             self.metrics.tenant_requests.labels(
                 tenant=g.tenant, outcome=outcome).inc()
+            # per-tenant SLO attainment: only SLO-bearing requests
+            # count (an inf-deadline request cannot attain or miss
+            # anything); a shed IS a miss — the user never got tokens
+            if g.deadline_s != float("inf"):
+                if outcome == _FINISHED_ATTAINED:
+                    self.metrics.tenant_slo_attained.labels(
+                        tenant=g.tenant).inc()
+                elif outcome in (_FINISHED_LATE, SHED_EXPIRED):
+                    self.metrics.tenant_slo_missed.labels(
+                        tenant=g.tenant).inc()
+        if self.tracer is not None and g.trace is not None:
+            end = (g.finished_s if g.finished_s is not None
+                   else self.clock())
+            f = self.results.get(g.uid)
+            toks = getattr(f, "tokens", None) if f is not None else None
+            attrs = {"status": status, "outcome": outcome,
+                     "tokens": 0 if toks is None else len(toks),
+                     "requeues": g.requeues}
+            if g.first_token_s is not None:
+                attrs["ttft_s"] = g.first_token_s - g.arrival_s
+            # the span covers decode (first token -> finish); sheds
+            # and rejects collapse to an instant at the terminal time
+            self.tracer.emit(
+                g.trace, "terminal",
+                g.first_token_s if g.first_token_s is not None
+                else end, end,
+                track=g.replica or "gateway", **attrs)
         self.outcomes[g.uid] = g
         done.append(g)
 
@@ -395,17 +471,23 @@ class FleetGateway:
                 self.metrics.kv_bytes_moved.inc(nbytes)
                 self.metrics.kv_migrate_seconds.observe(wall_s)
 
-    def _drain(self, replica: EngineReplica) -> None:
+    def _drain(self, replica: EngineReplica,
+               now: float | None = None) -> None:
         """Health-driven drain: the replica stops receiving dispatch
         (state DEAD), its in-flight rows are pulled back through the
         engine's active-cancel hook and requeued AT THE FRONT with
         their deadlines unchanged, and (``auto_replace``) a cold
-        replacement joins the pool under a fresh name."""
+        replacement joins the pool under a fresh name.  ``now`` is the
+        pump cycle's timestamp: drained_s must not run AHEAD of the
+        cycle clock, or a victim re-dispatched later in the same cycle
+        would get a negative-duration drain-gap span."""
         self.metrics.drains.inc()
         self.manager.mark_down(replica)
         self.router.forget(replica.name)
         victims = list(replica.in_flight.values())
         replica.in_flight.clear()
+        if now is None:
+            now = self.clock() if self.tracer is not None else 0.0
         for g in reversed(victims):     # appendleft x reversed = FIFO
             try:
                 replica.cancel(g.uid)
@@ -415,6 +497,18 @@ class FleetGateway:
                 pass
             self.queue.requeue(g)
             self.metrics.requeued.inc()
+            if self.tracer is not None and g.trace is not None:
+                # the victim's trace continues: this instant starts
+                # the drain gap the next placement span closes
+                g.trace.drained_s = now
+                self.tracer.emit(g.trace, "requeue", now,
+                                 track=replica.name,
+                                 replica=replica.name,
+                                 requeues=g.requeues)
+        if self.tracer is not None:
+            self.tracer.emit(self._trace_ctx, "drain", now,
+                             track="gateway", replica=replica.name,
+                             requeued=len(victims))
         self.bus.publish("drain", replica=replica.name,
                          requeued=len(victims))
         if self.auto_replace:

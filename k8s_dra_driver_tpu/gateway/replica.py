@@ -137,6 +137,12 @@ class EngineReplica:
         # THE drain worklist, kept gateway-side so a dead engine's
         # internals are never needed to know what it owed
         self.in_flight: dict = {}
+        #: span recorder handed down by the gateway
+        #: (utils/tracing.py ``wire_pool`` — set for the initial pool
+        #: and every later spawn); None when tracing is off, and the
+        #: unified replica itself never emits — the disagg roles
+        #: (serving_disagg/pool.py) use it for prefill/migrate arcs
+        self.tracer = None
 
     @property
     def ready(self) -> bool:
@@ -207,6 +213,9 @@ class ReplicaManager:
         #: event taps (prefix-cache stats listeners) without walking
         #: the pool every step looking for newcomers
         self.spawn_listeners: list[Callable] = []
+        #: span recorder (utils/tracing.py ``wire_pool``): manager-
+        #: level arcs — the disagg handoff's migrate span — emit here
+        self.tracer = None
         self.replicas: list[EngineReplica] = [
             self._spawn() for _ in range(replicas)]
 

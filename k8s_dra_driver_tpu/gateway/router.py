@@ -39,6 +39,13 @@ from ..models.serving import _overlap
 class Router:
     """Interface: pick a replica for a prompt, or None to hold it."""
 
+    #: why the LAST route() picked its replica — a one-word tag the
+    #: gateway copies into the dispatch span's attrs (utils/tracing),
+    #: so a trace can tell an affinity placement from a load spill
+    #: without re-deriving the router's decision.  Overwritten per
+    #: call; meaningless when route() returned None.
+    last_reason: str | None = None
+
     def route(self, prompt: np.ndarray, replicas: list):
         raise NotImplementedError
 
@@ -59,6 +66,8 @@ def _under_bound(replica) -> bool:
 class LeastLoadedRouter(Router):
     """Pure least-queue-depth spill (also the affinity fallback)."""
 
+    last_reason = "least_loaded"
+
     def route(self, prompt, replicas):
         ready = [r for r in replicas if r.ready and _under_bound(r)]
         if not ready:
@@ -68,6 +77,8 @@ class LeastLoadedRouter(Router):
 
 class RoundRobinRouter(Router):
     """Affinity-blind baseline: next ready replica in turn."""
+
+    last_reason = "round_robin"
 
     def __init__(self):
         self._i = 0
@@ -119,8 +130,10 @@ class PrefixAffinityRouter(Router):
             # least depth, then name order
             pick = min((r for a, r in scored if a == best),
                        key=lambda r: (_depth(r), r.name))
+            self.last_reason = "affinity"
         else:
             pick = min(ready, key=lambda r: (_depth(r), r.name))
+            self.last_reason = "spill"
         hist = self._routed.setdefault(pick.name,
                                        deque(maxlen=self.history))
         hist.append(prompt)

@@ -43,7 +43,7 @@ import zlib
 import numpy as np
 
 from ..cluster.bus import EventBus
-from ..utils import dispatch
+from ..utils import dispatch, tracing
 from ..utils.metrics import GatewayMetrics
 from .admission import QUEUED, GatewayRequest
 from .frontend import FleetGateway, _RATE_ALPHA
@@ -73,10 +73,19 @@ class ShardedGateway:
                  steal: bool = True,
                  shard_tokens: int = 8,
                  seed: int = 0,
-                 tenant: str | None = None):
+                 tenant: str | None = None,
+                 tracer=None):
         if pumps < 1:
             raise ValueError("ShardedGateway needs >= 1 pump")
         self.manager = manager
+        #: shared span recorder: member pumps emit the per-request
+        #: spans (admit/dispatch/terminal); the sharded cycle adds
+        #: the tier-only arcs (door spill, steal, pool-level drain)
+        self.tracer = tracer
+        self._trace_ctx = (tracer.begin(f"gw-{tenant or 'pool'}")
+                           if tracer is not None else None)
+        if tracer is not None:
+            tracing.wire_pool(tracer, manager)
         #: same contract as FleetGateway.tenant: tags demand events
         #: and defaults untagged submits (fleet/tenancy.py)
         self.tenant = tenant
@@ -99,7 +108,7 @@ class ShardedGateway:
                 manager, router=router_factory(),
                 queue_capacity=queue_capacity, metrics=self.metrics,
                 clock=clock, auto_replace=False, bus=self.bus,
-                pool_owner=False)
+                pool_owner=False, tracer=tracer)
             p.outcomes = self.outcomes
             p.results = self.results
             p.refused = self.refused
@@ -149,7 +158,7 @@ class ShardedGateway:
         router's least-depth spill already makes)."""
         self.admissions_total += 1
         self._arrivals += 1
-        i = self._shard(req.prompt)
+        i = home = self._shard(req.prompt)
         if len(self.pumps[i].queue) >= self.pumps[i].queue.capacity:
             j = min(range(len(self.pumps)),
                     key=lambda k: (len(self.pumps[k].queue), k))
@@ -165,6 +174,12 @@ class ShardedGateway:
             extra_live=frozenset(extra))
         if g.status == QUEUED:
             self._owner[req.uid] = i
+            if (self.tracer is not None and g.trace is not None
+                    and i != home):
+                # door spill: admitted, but away from its affinity
+                # home — the trace records the placement sacrifice
+                self.tracer.emit(g.trace, "spill", g.arrival_s,
+                                 track="gateway", home=home, pump=i)
         return g
 
     # -- the cycle --------------------------------------------------------
@@ -187,7 +202,7 @@ class ShardedGateway:
         #    fault-plan skip counts and probe costs stay pump-count-
         #    independent), then drain
         for replica in self.manager.poll_down():
-            self._drain(replica)
+            self._drain(replica, now)
         # 2. admission pumps in seeded order: shed + dispatch
         for i in self.bus.shuffle(range(len(self.pumps))):
             self.pumps[i]._shed(now, done)
@@ -220,6 +235,8 @@ class ShardedGateway:
                          arrival_rate_rps=self.arrival_rate_rps,
                          slo_margin_ewma_s=self.slo_margin_ewma_s,
                          tenant=self.tenant)
+        if self.tracer is not None:
+            self.tracer.flush()     # ONE "spans" event per cycle
         self.bus.pump()
         self._steps += 1
         return done
@@ -265,22 +282,31 @@ class ShardedGateway:
             self._owner[g.uid] = thief
             self.steals_total += 1
             self.metrics.steals.inc()
+            if self.tracer is not None and g.trace is not None:
+                self.tracer.emit(g.trace, "steal", now,
+                                 track="gateway", donor=donor,
+                                 thief=thief)
             thieves.add(thief)
         for i in sorted(thieves):
             self.pumps[i]._dispatch(now, done)
 
-    def _drain(self, replica: EngineReplica) -> None:
+    def _drain(self, replica: EngineReplica,
+               now: float | None = None) -> None:
         """Pool-level drain: same contract as the single pump's
         (active-cancel, requeue at the FRONT with deadlines unchanged,
         optional cold replacement) except each victim returns to the
         queue of the pump that OWNED it — its shard home, so affinity
-        re-forms where the family lives."""
+        re-forms where the family lives.  ``now`` is the cycle's
+        timestamp (see FleetGateway._drain: drained_s must not run
+        ahead of the clock the re-dispatch spans read)."""
         self.metrics.drains.inc()
         self.manager.mark_down(replica)
         for p in self.pumps:
             p.router.forget(replica.name)
         victims = list(replica.in_flight.values())
         replica.in_flight.clear()
+        if now is None:
+            now = self.clock() if self.tracer is not None else 0.0
         for g in reversed(victims):     # appendleft x reversed = FIFO
             try:
                 replica.cancel(g.uid)
@@ -289,6 +315,16 @@ class ShardedGateway:
             owner = self._owner.get(g.uid, 0)
             self.pumps[owner].queue.requeue(g)
             self.metrics.requeued.inc()
+            if self.tracer is not None and g.trace is not None:
+                g.trace.drained_s = now
+                self.tracer.emit(g.trace, "requeue", now,
+                                 track=replica.name,
+                                 replica=replica.name,
+                                 requeues=g.requeues)
+        if self.tracer is not None:
+            self.tracer.emit(self._trace_ctx, "drain", now,
+                             track="gateway", replica=replica.name,
+                             requeued=len(victims))
         self.bus.publish("drain", replica=replica.name,
                          requeued=len(victims))
         if self.auto_replace:

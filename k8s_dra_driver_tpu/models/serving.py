@@ -593,10 +593,14 @@ class ServingEngine:
         fill = (_prefill_suffix_jit if start > 0
                 else _decode._prefill_jit)
         c = self.prefill_chunk or req.prompt.size
-        for off in range(start, req.prompt.size, c):
-            logits, one = fill(self.params,
-                               req.prompt[None, off:off + c],
-                               self.cfg, one, off == 0)
+        # the whole chunk loop is one phase on a device timeline —
+        # per-launch labels alone scatter a long prompt's fill into
+        # unattributable fragments (utils/dispatch.py annotated)
+        with dispatch.annotated("prefill_export"):
+            for off in range(start, req.prompt.size, c):
+                logits, one = fill(self.params,
+                                   req.prompt[None, off:off + c],
+                                   self.cfg, one, off == 0)
         if self._prefix is not None:
             self._prefix.insert(req.prompt, one)
         carry = None

@@ -509,10 +509,15 @@ class GangSupervisor:
         return dp
 
     def _transition(self, state: str) -> None:
+        prev = self.state
         self.state = state
         self.transitions.append(state)
         self.metrics.set_state(state, STATES)
-        info = {"dp": self.dp, "step": self._step,
+        # "from" rides along so listeners (the tracing span emitter,
+        # utils/tracing.py attach_supervisor) see the full edge, not
+        # just the destination — a PARKED->RESUME and a SUSPECT->
+        # RESUME edge mean very different things to a flight recorder
+        info = {"from": prev, "dp": self.dp, "step": self._step,
                 "generation": self._gen}
         for listener in list(self.listeners):
             try:

@@ -73,6 +73,11 @@ class KVMigrator:
         self.tokens_moved = 0
         self.wall_s = 0.0
         self._events: list[tuple[float, int]] = []
+        #: the most recent (wall_s, bytes) sample, kept even after
+        #: ``take_events`` drains the ledger — how the handoff span
+        #: (serving_disagg/pool.py) attributes the transfer it just
+        #: caused without racing the metrics fold
+        self.last_event: tuple[float, int] | None = None
 
     def migrate_entry(self, entry: KVCache, dest=None) -> KVCache:
         """Reshard one [1, S] cache onto ``dest`` and return the
@@ -90,6 +95,7 @@ class KVMigrator:
         self.bytes_moved += nbytes
         self.tokens_moved += int(jax.device_get(entry.pos))
         self.wall_s += wall
+        self.last_event = (wall, nbytes)
         self._events.append((wall, nbytes))
         return out
 

@@ -129,7 +129,17 @@ class PrefillReplica(EngineReplica):
             req = self.pending.popleft()
             if self._fetch is not None:
                 self._fetch(self, req.prompt)
+            t0 = (self.tracer.clock() if self.tracer is not None
+                  else 0.0)
             self.blocks[req.uid] = self.engine.prefill_export(req)
+            if self.tracer is not None:
+                g = self.in_flight.get(req.uid)
+                if g is not None and g.trace is not None:
+                    self.tracer.emit(
+                        g.trace, "prefill", t0, self.tracer.clock(),
+                        track=self.name,
+                        reused_tokens=self.blocks[req.uid]
+                        .reused_tokens)
             n += 1
         return []                 # a prefill replica never finishes
 
@@ -217,9 +227,21 @@ class DisaggReplicaManager(ReplicaManager):
                 best, best_key = r, key
         if best is None:
             return None
+        t0 = self.tracer.clock() if self.tracer is not None else 0.0
         moved = self.migrator.migrate_block(
             block, self.dest_device_of(best))
         best.engine.adopt_block(moved)
+        if self.tracer is not None:
+            # the migrate span covers transfer + adopt — the whole
+            # prefill→decode handoff the request waited on; bytes
+            # come from the migrator's last sample (full-buffer size)
+            g = source.in_flight.get(block.request.uid)
+            if g is not None and g.trace is not None:
+                _, nbytes = self.migrator.last_event or (0.0, 0)
+                self.tracer.emit(
+                    g.trace, "migrate", t0, self.tracer.clock(),
+                    track=best.name, source=source.name,
+                    dest=best.name, nbytes=nbytes)
         return best
 
     # -- the fleet-index fetch (remote prefix -> local cache) ------------

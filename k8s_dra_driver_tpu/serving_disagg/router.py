@@ -51,16 +51,19 @@ class DisaggRouter(Router):
             if p >= self.min_affinity:
                 for r in prefill:
                     if r.name == holder:
+                        self.last_reason = "index_affinity"
                         return r
                 # the holder is busy, draining, or a decode replica:
                 # any prefill replica can pull the entry through the
                 # index, so spill by depth without losing the reuse
+            self.last_reason = "prefill_spill"
             return min(prefill, key=lambda r: (_depth(r), r.name))
         fallback = [r for r in replicas
                     if r.ready and _under_bound(r)
                     and getattr(r, "role", None) != ROLE_PREFILL]
         if not fallback:
             return None
+        self.last_reason = "decode_fallback"
         return min(fallback, key=lambda r: (_depth(r), r.name))
 
     def forget(self, name: str) -> None:

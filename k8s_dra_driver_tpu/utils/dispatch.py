@@ -69,6 +69,33 @@ class DispatchCounter:
 #: the process-global counter every wrapped launch site feeds
 COUNTER = DispatchCounter()
 
+#: when True, every counted launch site also wraps its call in a
+#: ``jax.profiler`` TraceAnnotation named by its dispatch label, so a
+#: device trace captured via utils/profiling.py ``trace()`` shows
+#: WHICH control-plane launch caused each XLA program — the bridge
+#: between the span layer (utils/tracing.py) and XProf timelines.
+#: Off by default: annotations cost a profiler call per launch, and
+#: the hermetic suite and the bench hot paths must not pay it.
+ANNOTATE = False
+
+
+def enable_annotations(on: bool = True) -> None:
+    """Flip launch-site TraceAnnotations (bench.py turns this on when
+    ``TPU_DRA_PROFILE_DIR`` is set, alongside ``profiling.trace``)."""
+    global ANNOTATE
+    ANNOTATE = on
+
+
+def annotated(label: str):
+    """Context for a MULTI-launch host phase (e.g. a chunked prefill
+    loop, models/serving.py): a real TraceAnnotation when annotations
+    are on, a nullcontext — no jax import, no profiler call — when
+    off."""
+    if not ANNOTATE:
+        return contextlib.nullcontext()
+    from . import profiling
+    return profiling.annotate(label)
+
 
 class Tracked:
     """Delta view filled in when a :func:`track` region closes."""
@@ -137,6 +164,10 @@ class _Counted:
 
     def __call__(self, *args, **kwargs):
         COUNTER.record(self._label)
+        if ANNOTATE:
+            from . import profiling
+            with profiling.annotate(self._label):
+                return self._fn(*args, **kwargs)
         return self._fn(*args, **kwargs)
 
     def __getattr__(self, name):

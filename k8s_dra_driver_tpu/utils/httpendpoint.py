@@ -7,6 +7,8 @@ given.  The Go pprof handlers map to their closest Python equivalents:
 
 - ``/metrics``            — Prometheus exposition of the driver registry
 - ``/healthz``            — liveness
+- ``/debugz``             — flight-recorder dump as JSON (mounted when
+  a ``debug_source`` is given, cluster/flightrec.py)
 - ``/debug/pprof/``       — index
 - ``/debug/pprof/goroutine`` (and ``/debug/stacks``) — live stack dump
   of every Python thread (the goroutine-profile analog)
@@ -19,6 +21,7 @@ given.  The Go pprof handlers map to their closest Python equivalents:
 from __future__ import annotations
 
 import collections
+import json
 import sys
 import threading
 import time
@@ -82,10 +85,15 @@ class HTTPEndpoint:
 
     def __init__(self, address: str, metrics: DriverMetrics,
                  pprof_prefix: str = "/debug/pprof",
-                 extra_metrics=()):
+                 extra_metrics=(),
+                 debug_source=None):
         host, _, port = address.rpartition(":")
         self.metrics = metrics
         self.extra_metrics = tuple(extra_metrics)
+        #: zero-arg callable returning a JSON-serializable dict —
+        #: mounted on ``/debugz`` (a flight recorder's
+        #: ``debug_payload``, cluster/flightrec.py); None = 404
+        self.debug_source = debug_source
         self._profile_lock = threading.Lock()
         prefix = pprof_prefix.rstrip("/")
         endpoint = self
@@ -110,6 +118,18 @@ class HTTPEndpoint:
                                "text/plain; version=0.0.4")
                 elif path == "/healthz":
                     self._send(b"ok", "text/plain")
+                elif path == "/debugz":
+                    if endpoint.debug_source is None:
+                        return self._send(b"no debug source",
+                                          "text/plain", 404)
+                    try:
+                        body = json.dumps(endpoint.debug_source(),
+                                          sort_keys=True).encode()
+                    except Exception as e:
+                        return self._send(
+                            f"debug dump failed: {e}".encode(),
+                            "text/plain", 500)
+                    self._send(body, "application/json")
                 elif path in (f"{prefix}/goroutine", "/debug/stacks"):
                     self._send(_thread_stacks().encode(), "text/plain")
                 elif path == f"{prefix}/profile":

@@ -182,6 +182,20 @@ class GatewayMetrics:
             "SLO-attainment series: finished_attained/finished_late/"
             "shed/rejected)", ["tenant", "outcome"],
             registry=self.registry)
+        # per-tenant SLO attainment proper (ISSUE 11 satellite): the
+        # outcome-labeled counter above needs client-side arithmetic
+        # to answer "what fraction of tenant X's SLO-bearing requests
+        # attained"; this pair is the direct ratio — attained vs
+        # missed (finished late OR shed), inf-deadline requests
+        # excluded because they carry no SLO to attain
+        self.tenant_slo_attained = Counter(
+            "tpu_gateway_tenant_slo_attained_total",
+            "SLO-bearing requests finished within deadline, per "
+            "tenant tag", ["tenant"], registry=self.registry)
+        self.tenant_slo_missed = Counter(
+            "tpu_gateway_tenant_slo_missed_total",
+            "SLO-bearing requests finished late or shed at deadline, "
+            "per tenant tag", ["tenant"], registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -258,6 +258,30 @@ def test_control_plane_probe_tiny():
         assert lv["goodput_rps"] > 0
     assert "no-op engines" in out["note"].lower() \
         or "NO-OP ENGINES" in out["note"]
+    # the span-layer on/off wall ratio rides every probe record; at
+    # the tiny shape the paired drive is too noisy for the ≤1.05
+    # budget itself (the committed full-shape artifact pins that —
+    # test_ctl_artifact_pins_trace_overhead), so the hermetic run
+    # asserts presence and sanity only
+    assert 0.5 < out["trace_overhead_x"] < 1.5
+
+
+def test_ctl_artifact_pins_trace_overhead():
+    """THE overhead budget (ISSUE 11): tracing must stay ~free at the
+    measured control-plane ceiling.  The recorded full-shape artifact
+    (repo rule: perf claims trace to tools/*.json) must show the
+    span layer costing ≤1.05x wall in the paired closed-loop drive,
+    and must carry the scalar the compact bench line picks up."""
+    artifact = Path(__file__).parent.parent / "tools" / \
+        "ctl_ceiling_cpu.json"
+    doc = bench.json.loads(artifact.read_text())
+    res = doc["result"]
+    assert res["valid"] is True
+    assert 0 < res["trace_overhead_x"] <= 1.05
+    # same shape the bench run streams (CTL_KWARGS), so the artifact
+    # is evidence for the line's scalar, not a different experiment
+    assert res["pump_counts"] == list(bench.CTL_KWARGS["pump_counts"])
+    assert res["requests_per_level"] == bench.CTL_KWARGS["n_requests"]
 
 
 def test_probe_roster_pins_control_plane_scalars():
@@ -270,6 +294,7 @@ def test_probe_roster_pins_control_plane_scalars():
     assert keys["ctl_admissions_per_s"] == "admissions_per_s"
     assert keys["ctl_routes_per_s"] == "routes_per_s"
     assert keys["ctl_goodput_flat_x"] == "goodput_flat_x"
+    assert keys["ctl_trace_overhead_x"] == "trace_overhead_x"
 
 
 def test_loadgen_trace_fixture_schema():

@@ -119,6 +119,42 @@ class TestEventBus:
         bus.pump()
         assert seen == [0] and bus.errors == 1
 
+    def test_journal_dump_schema(self):
+        """ISSUE 11 satellite: journal_dump() is the flight
+        recorder's bus section — JSON-safe {seq, topic, payload}
+        records with summarized payloads (depth-bounded, long
+        sequences truncated to head + '...+N', non-finite floats
+        stringified, arbitrary objects repr'd)."""
+        import json
+
+        bus = EventBus()
+        bus.publish("plain", n=3, name="r0", ok=True, w=0.5)
+        bus.publish("hairy",
+                    arr=list(range(20)),            # > _SAFE_ITEMS
+                    bad=float("nan"),
+                    deep={"a": {"b": {"c": {"d": {"e": 1}}}}},
+                    obj=np.arange(500))             # not JSON-safe
+        bus.pump()
+        dump = bus.journal_dump()
+        assert [sorted(d) for d in dump] \
+            == [["payload", "seq", "topic"]] * 2
+        assert [d["topic"] for d in dump] == ["plain", "hairy"]
+        assert dump[0]["seq"] == 0 and dump[1]["seq"] == 1
+        # untouched simple payloads survive verbatim
+        assert dump[0]["payload"] == {"n": 3, "name": "r0",
+                                      "ok": True, "w": 0.5}
+        hairy = dump[1]["payload"]
+        assert hairy["arr"][:8] == list(range(8))
+        assert hairy["arr"][8] == "...+12"
+        assert hairy["bad"] == "nan"
+        assert isinstance(hairy["obj"], str)        # repr'd, clipped
+        assert len(hairy["obj"]) <= 120
+        # the whole dump is json.dumps-able — the recorder's contract
+        json.dumps(dump)
+        # limit keeps only the newest N
+        assert [d["topic"] for d in bus.journal_dump(limit=1)] \
+            == ["hairy"]
+
     def test_seeded_shuffle_replays(self):
         a = [EventBus(seed=5).shuffle(range(8)) for _ in range(2)]
         assert a[0] == a[1]

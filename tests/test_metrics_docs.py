@@ -1,0 +1,63 @@
+"""Metrics↔docs lint (tools/lint_metrics_docs.py) in the fast tier.
+
+docs/OBSERVABILITY.md is the single reference page for every metric
+family the four registries export; the lint keeps it bidirectionally
+complete — an exported-but-undocumented series fails here, and so
+does a documented-but-gone name (ISSUE 11 satellite, sibling of
+tests/test_perf_claims.py).
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+
+import lint_metrics_docs  # noqa: E402
+
+
+def test_metrics_and_docs_agree():
+    """THE gate: live registries ↔ docs/OBSERVABILITY.md, both
+    directions clean."""
+    problems = lint_metrics_docs.lint()
+    assert problems == [], "\n".join(problems)
+
+
+def test_live_roster_excludes_created_noise():
+    """prometheus_client's auto *_created timestamp gauges are
+    exposition noise, not families anyone documents — the lint's live
+    roster must not demand them."""
+    live = lint_metrics_docs.live_series()
+    assert live, "no live series — registries failed to instantiate"
+    assert not any(n.endswith("_created") for n in live)
+    # the four prefixes are all present (one registry missing from
+    # live_series() would silently shrink the doc requirement)
+    prefixes = {n.split("_")[1] for n in live}
+    assert {"dra", "gateway", "train", "fleet"} <= prefixes
+
+
+def test_undocumented_series_is_flagged(tmp_path):
+    doc = tmp_path / "OBSERVABILITY.md"
+    doc.write_text("# nothing documented here\n")
+    problems = lint_metrics_docs.lint(doc)
+    assert problems
+    assert any("tpu_gateway_queue_depth" in p for p in problems)
+
+
+def test_stale_doc_name_is_flagged(tmp_path):
+    doc = tmp_path / "OBSERVABILITY.md"
+    real = Path(lint_metrics_docs.DOC).read_text()
+    doc.write_text(real + "\nand `tpu_gateway_gone_total` too\n")
+    problems = lint_metrics_docs.lint(doc)
+    assert len(problems) == 1
+    assert "tpu_gateway_gone_total" in problems[0]
+    assert "stale pointer" in problems[0]
+
+
+def test_histogram_views_resolve(tmp_path):
+    """The doc may reference a histogram's _bucket/_sum/_count PromQL
+    views without the lint calling them stale."""
+    doc = tmp_path / "OBSERVABILITY.md"
+    real = Path(lint_metrics_docs.DOC).read_text()
+    doc.write_text(real + "\nsum: `tpu_gateway_queue_wait_seconds_sum`"
+                   " buckets: `tpu_gateway_queue_wait_seconds_bucket`\n")
+    assert lint_metrics_docs.lint(doc) == []

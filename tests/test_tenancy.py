@@ -592,6 +592,17 @@ def test_acceptance_cascade_across_two_tenants(tmp_path):
     assert gw.metrics.registry.get_sample_value(
         "tpu_gateway_tenant_queue_wait_seconds_count",
         {"tenant": "hi"}) >= len(wave)
+    # ISSUE 11 satellite: the direct per-tenant SLO-attainment pair —
+    # every burst request carried a 120 s SLO and finished within it,
+    # so attained == len(wave) and missed never incremented (absent
+    # labels read as None, not 0)
+    assert gw.metrics.registry.get_sample_value(
+        "tpu_gateway_tenant_slo_attained_total",
+        {"tenant": "hi"}) == len(wave)
+    assert gw.metrics.registry.get_sample_value(
+        "tpu_gateway_tenant_slo_missed_total",
+        {"tenant": "hi"}) is None
+    assert "tpu_gateway_tenant_slo_attained_total" in text
     ckpt_lo.close()
     ckpt_mid.close()
 

@@ -1,0 +1,668 @@
+"""Causal tracing + fleet flight recorder (ISSUE 11).
+
+THE acceptance invariants: a bursty trace-replay run with a
+mid-stream replica kill AND a reconciler preemption produces a
+flight-recorder dump that reconstructs the full causal chain —
+admission → drain → requeue → re-dispatch → terminal for every drain
+victim (on the SAME trace: victims continue their trace with a
+drain-gap span, they never start a new one), and preempt →
+checkpoint-then-shrink → scale-up grant on the control-plane tracks —
+with exactly-once span accounting (one dispatch, one terminal per
+admitted uid; door refusals are one-span admit traces) and a
+byte-identical Chrome-trace export under the same seed.  The
+per-request critical-path breakdown must agree with the
+GatewayMetrics histograms on the same run, the two accountings of
+one truth.
+
+The overhead budget itself (``ctl_trace_overhead_x`` ≤ 1.05x) is
+pinned against the recorded artifact in tests/test_bench_smoke.py —
+this module pins semantics, not speed.
+"""
+
+import json
+from urllib.error import HTTPError
+from urllib.request import urlopen
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.cluster.bus import EventBus
+from k8s_dra_driver_tpu.cluster.faults import (FaultPlan, FaultRule,
+                                               ScriptedChipHealth)
+from k8s_dra_driver_tpu.cluster.flightrec import (REASONS,
+                                                  FlightRecorder,
+                                                  default_trigger)
+from k8s_dra_driver_tpu.fleet import (ChipLedger, FleetPolicy,
+                                      FleetReconciler, PolicyConfig)
+from k8s_dra_driver_tpu.gateway import (FleetGateway, NullEngine,
+                                        ReplicaManager, ShardedGateway)
+from k8s_dra_driver_tpu.gateway.loadgen import (VirtualClock,
+                                                load_trace, replay)
+from k8s_dra_driver_tpu.models import TransformerConfig, init_params
+from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
+from k8s_dra_driver_tpu.utils.httpendpoint import HTTPEndpoint
+from k8s_dra_driver_tpu.utils.metrics import DriverMetrics
+from k8s_dra_driver_tpu.utils.tracing import (Tracer,
+                                              attach_supervisor,
+                                              chrome_trace,
+                                              critical_path,
+                                              export_chrome)
+
+# Stall guard (tests/conftest.py): replica kills, reform loops and
+# replay loops must fail in seconds if a regression hangs one.
+pytestmark = pytest.mark.timeout_s(300)
+
+# the exact test_gateway.py shape, so jit programs are shared when
+# the modules run in one process
+CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_head=8, d_ff=64, max_seq=48, n_kv_heads=2,
+                        dtype=jnp.float32)
+
+_PARAMS = None
+
+
+def params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+    return _PARAMS
+
+
+def prompt(seed, n):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab), np.int32)
+
+
+def make_req(uid, seed, n_prompt, max_new):
+    return Request(uid=uid, prompt=prompt(seed, n_prompt),
+                   max_new=max_new)
+
+
+def null_pool(replicas=2, slots=4, steps=3, **kw):
+    """Host-only pool; steps_per_request > 1 keeps work in flight
+    across pump steps so a scripted kill drains mid-stream."""
+    return ReplicaManager(
+        lambda name: NullEngine(slots=slots, steps_per_request=steps),
+        replicas=replicas, depth_bound=slots, **kw)
+
+
+def traced_sharded(mgr, vc, *, pumps=2, seed=7, capacity=32):
+    bus = EventBus(seed=seed)
+    tracer = Tracer(bus=bus, clock=vc)
+    gw = ShardedGateway(mgr, pumps=pumps, queue_capacity=capacity,
+                        clock=vc, seed=seed, bus=bus, tracer=tracer)
+    return gw, tracer
+
+
+def spans_by_trace(spans):
+    per = {}
+    for r in spans:
+        per.setdefault(r["trace"], []).append(r)
+    return per
+
+
+# -- the tracer itself (pure host logic) -----------------------------------
+
+class TestTracer:
+    def test_emit_builds_a_causal_chain(self):
+        tr = Tracer()
+        ctx = tr.begin("u1", tenant="acme")
+        assert ctx.trace_id == "t-u1"
+        a = tr.emit(ctx, "dispatch", 1.0, 2.0, track="r0", depth=3)
+        b = tr.emit(ctx, "terminal", 2.0, 2.5, track="r0")
+        c = tr.emit(ctx, "mark", 5.0)           # instant event
+        assert a["trace"] == b["trace"] == "t-u1"
+        assert a["parent"] == 0                 # chain root
+        assert b["parent"] == a["span"]         # causal link
+        assert c["parent"] == b["span"]
+        assert a["tenant"] == "acme"
+        assert a["attrs"] == {"depth": 3}
+        assert "attrs" not in b                 # no empty dicts
+        assert c["t0"] == c["t1"] == 5.0        # t1=None → instant
+        assert tr.emitted_total == 3
+        assert list(tr.spans) == [a, b, c]
+
+    def test_span_ids_are_tracer_global_and_monotone(self):
+        tr = Tracer()
+        x, y = tr.begin("x"), tr.begin("y")
+        ids = [tr.emit(x, "a", 0.0)["span"],
+               tr.emit(y, "b", 0.0)["span"],
+               tr.emit(x, "c", 0.0)["span"]]
+        assert ids == sorted(ids) and len(set(ids)) == 3
+        # interleaving never crosses chains: each ctx links its OWN
+        # previous span
+        recs = list(tr.spans)
+        assert recs[2]["parent"] == recs[0]["span"]
+        assert recs[1]["parent"] == 0
+
+    def test_ring_is_bounded_but_total_keeps_counting(self):
+        tr = Tracer(capacity=4)
+        ctx = tr.begin("u")
+        for i in range(10):
+            tr.emit(ctx, "s", float(i))
+        assert len(tr.spans) == 4
+        assert tr.emitted_total == 10
+        assert tr.spans[0]["t0"] == 6.0         # oldest evicted
+
+    def test_flush_publishes_one_batched_bus_event(self):
+        bus = EventBus(seed=1)
+        tr = Tracer(bus=bus)
+        ctx = tr.begin("u")
+        for i in range(3):
+            tr.emit(ctx, "s", float(i))
+        assert tr.flush() == 3
+        assert tr.flush() == 0                  # batch was consumed
+        bus.pump()
+        ev = [e for e in bus.journal_dump() if e["topic"] == "spans"]
+        assert len(ev) == 1                     # ONE event, not 3
+        assert ev[0]["payload"]["n"] == 3
+        # a tracer without a bus flushes to nowhere, silently
+        assert Tracer().flush() == 0
+
+    def test_broken_sink_never_fails_emit(self):
+        tr = Tracer()
+        seen = []
+        tr.sinks.append(lambda rec: 1 / 0)
+        tr.sinks.append(seen.append)
+        rec = tr.emit(tr.begin("u"), "s", 0.0)
+        assert seen == [rec]
+
+    def test_critical_path_breakdown(self):
+        tr = Tracer()
+        ctx = tr.begin("u")
+        tr.emit(ctx, "dispatch", 0.0, 2.0, route_s=0.5)
+        tr.emit(ctx, "prefill", 2.0, 3.0)
+        tr.emit(ctx, "migrate", 3.0, 3.5)
+        tr.emit(ctx, "terminal", 3.5, 7.5, tokens=4)
+        tr.emit(ctx, "drain_gap", 8.0, 9.0, route_s=0.25)
+        other = tr.begin("v")
+        tr.emit(other, "dispatch", 0.0, 100.0)  # must be ignored
+        cp = critical_path(tr.spans, "t-u")
+        assert cp["queue_wait"] == 2.0
+        assert cp["route"] == 0.75              # both placements
+        assert cp["prefill"] == 1.0
+        assert cp["migrate"] == 0.5
+        assert cp["decode"] == 4.0
+        assert cp["decode_per_token"] == 1.0
+        assert cp["drain_gap"] == 1.0
+        assert cp["total"] == 9.0
+        assert cp["spans"] == 5
+        empty = critical_path(tr.spans, "t-missing")
+        assert empty["spans"] == 0 and empty["total"] == 0.0
+
+    def test_chrome_trace_shape_and_byte_determinism(self):
+        tr = Tracer()
+        ctx = tr.begin("u", tenant="acme")
+        tr.emit(ctx, "dispatch", 1.5, 2.0, track="r0", depth=2)
+        tr.emit(ctx, "terminal", 2.0, 2.25, track="r1")
+        doc = chrome_trace(tr.spans)
+        assert doc["displayTimeUnit"] == "ms"
+        meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+        xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        # one tid per track, discovered in span order
+        assert [(m["args"]["name"], m["tid"]) for m in meta] \
+            == [("r0", 1), ("r1", 2)]
+        assert xs[0]["ts"] == 1.5e6 and xs[0]["dur"] == 0.5e6
+        assert xs[0]["args"]["trace"] == "t-u"
+        assert xs[0]["args"]["depth"] == 2      # attrs ride along
+        assert xs[0]["args"]["tenant"] == "acme"
+        assert xs[0]["args"]["parent"] == 0
+        # deterministic serialization: same spans ⇒ same bytes, and
+        # the export is loadable JSON
+        a, b = export_chrome(tr.spans), export_chrome(tr.spans)
+        assert a == b
+        assert json.loads(a) == doc
+
+
+# -- exactly-once span accounting (the satellite) --------------------------
+
+def _run_killed(seed, n=11):
+    """The PR 7 kill shape on a host-only pool: 2 pumps, bursty
+    trace-replay, r0 dropped by an injected health fault while its
+    first wave is in flight — with tracing on."""
+    plan = FaultPlan.from_json({"rules": [
+        {"verb": "health", "kind": "Replica", "name": "r0",
+         "skip": 2, "times": 1, "error": "drop"}]})
+    vc = VirtualClock(step_cost_s=0.0005)
+    mgr = null_pool(replicas=2, slots=4, steps=3, fault_plan=plan)
+    gw, tracer = traced_sharded(mgr, vc, pumps=2, seed=seed)
+    reqs = [make_req(f"x{i}", 10 + i, 5 + (i % 2) * 3, 3 + (i % 3))
+            for i in range(n)]
+    trace = load_trace("bursty")
+    replay(gw, trace, offered_x=4.0, base_rps=len(reqs) / 2.0,
+           make_request=lambda i: reqs[i], n_requests=len(reqs),
+           slo_s=10_000.0, clock=vc, sleep=vc.sleep)
+    return gw, tracer, reqs
+
+
+def test_exactly_once_span_accounting_through_a_kill():
+    """Kill r0 mid-stream with tracing on: every admitted uid gets
+    exactly ONE dispatch and ONE terminal span; drain victims carry a
+    requeue + drain-gap pair per requeue ON THE SAME trace (the trace
+    continues, it is not restarted); parent pointers form an unbroken
+    chain; no span belongs to an unknown trace."""
+    gw, tracer, reqs = _run_killed(seed=7)
+    assert len(gw.refused) == 0
+    assert len(gw.outcomes) == len(reqs)
+    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
+    assert requeued, "fault fired before anything was in flight"
+
+    spans = list(tracer.spans)
+    per = spans_by_trace(spans)
+
+    # exactly one terminal span per admitted uid — the span-level
+    # twin of the outcomes-dict exactly-once contract
+    term = [r for r in spans if r["name"] == "terminal"]
+    assert sorted(r["trace"] for r in term) \
+        == sorted(f"t-{r.uid}" for r in reqs)
+
+    for g in gw.outcomes.values():
+        recs = sorted(per[f"t-{g.uid}"], key=lambda r: r["span"])
+        names = [r["name"] for r in recs]
+        assert names.count("dispatch") == 1, (g.uid, names)
+        assert names.count("terminal") == 1, (g.uid, names)
+        assert names.count("drain_gap") == g.requeues, (g.uid, names)
+        assert names.count("requeue") == g.requeues, (g.uid, names)
+        assert names[-1] == "terminal"          # terminal closes it
+        # the causal chain is unbroken: each span's parent is the
+        # previous span on the trace, rooted at 0
+        assert recs[0]["parent"] == 0
+        for a, b in zip(recs, recs[1:]):
+            assert b["parent"] == a["span"], (g.uid, names)
+        # the dispatch span carries the admission record (depth) and
+        # starts at arrival — admission is folded, never lost
+        d = recs[names.index("dispatch")]
+        assert d["t0"] == g.arrival_s
+        assert d["attrs"]["depth"] >= 0
+        t = recs[names.index("terminal")]
+        assert t["attrs"]["status"] == "finished"
+        assert t["attrs"]["requeues"] == g.requeues
+
+    # a victim's drain gap starts at the drain instant its requeue
+    # span recorded — the latency the queue-wait histogram alone
+    # cannot attribute
+    for g in requeued:
+        recs = per[f"t-{g.uid}"]
+        rq = [r for r in recs if r["name"] == "requeue"][-1]
+        dg = [r for r in recs if r["name"] == "drain_gap"][-1]
+        assert dg["t0"] == rq["t0"]
+        assert dg["t1"] >= dg["t0"]
+        assert rq["attrs"]["replica"] == "r0"
+        assert dg["attrs"]["replica"] != "r0"   # re-dispatch moved it
+
+    # no orphans: every trace is a request trace or the pool track
+    assert set(per) <= {f"t-{r.uid}" for r in reqs} | {"t-gw-pool"}
+
+    # the pool-level drain span recorded the incident once, with the
+    # victim count the per-request requeue spans account for
+    drains = [r for r in spans if r["name"] == "drain"]
+    assert len(drains) == 1
+    assert drains[0]["trace"] == "t-gw-pool"
+    assert drains[0]["attrs"]["replica"] == "r0"
+    assert drains[0]["attrs"]["requeued"] == len(requeued)
+
+    # spans rode the bus batched (one "spans" event per step), never
+    # one event per span
+    dump = gw.bus.journal_dump(limit=4096)
+    batches = [e["payload"]["n"] for e in dump
+               if e["topic"] == "spans"]
+    assert batches and sum(batches) == tracer.emitted_total
+    assert len(batches) < tracer.emitted_total
+
+
+def test_same_seed_byte_identical_chrome_export():
+    """Determinism pin: the same kill scenario under the same seed
+    exports byte-identical Chrome traces (and identical outcomes)."""
+    def run(seed):
+        gw, tracer, _ = _run_killed(seed=seed)
+        statuses = sorted((u, g.status, g.replica, g.requeues)
+                          for u, g in gw.outcomes.items())
+        return export_chrome(list(tracer.spans)), statuses
+
+    a1, s1 = run(11)
+    a2, s2 = run(11)
+    assert a1 == a2
+    assert s1 == s2
+
+
+def test_door_refusals_are_one_span_admit_traces():
+    """A refused request's whole trace is ONE admit span carrying the
+    rejection status — distinguishable from 'admitted and orphaned'
+    by construction."""
+    vc = VirtualClock(step_cost_s=0.0005)
+    mgr = null_pool(replicas=1, slots=2, steps=2)
+    gw, tracer = traced_sharded(mgr, vc, pumps=1, seed=3, capacity=2)
+    reqs = [make_req(f"q{i}", 40 + i, 5, 2) for i in range(6)]
+    for r in reqs:
+        gw.submit(r)
+    gw.run_until_idle()
+    assert gw.refused, "capacity 2 never refused out of 6"
+    per = spans_by_trace(list(tracer.spans))
+    for g in gw.refused:
+        recs = per[f"t-{g.uid}"]
+        assert len(recs) == 1
+        (rec,) = recs
+        assert rec["name"] == "admit"
+        assert rec["attrs"]["status"] == g.status
+        assert rec["t0"] == rec["t1"]           # instant
+    # admitted uids still get full chains, refused ones ONLY admit
+    refused_uids = {g.uid for g in gw.refused}
+    for uid, g in gw.outcomes.items():
+        assert uid not in refused_uids
+        assert [r["name"] for r in per[f"t-{uid}"]].count("terminal") \
+            == 1
+
+
+def test_critical_path_agrees_with_queue_wait_histogram():
+    """The cross-check: on a fault-free run, the sum of per-trace
+    queue_wait from critical_path equals the
+    tpu_gateway_queue_wait_seconds histogram sum — the span layer and
+    the metrics layer account the same truth."""
+    vc = VirtualClock(step_cost_s=0.0005)
+    mgr = null_pool(replicas=2, slots=4, steps=2)
+    gw, tracer = traced_sharded(mgr, vc, pumps=2, seed=5)
+    reqs = [make_req(f"c{i}", 60 + i, 5 + (i % 2) * 3, 2)
+            for i in range(9)]
+    trace = load_trace("bursty")
+    replay(gw, trace, offered_x=4.0, base_rps=len(reqs) / 2.0,
+           make_request=lambda i: reqs[i], n_requests=len(reqs),
+           slo_s=10_000.0, clock=vc, sleep=vc.sleep)
+    assert len(gw.outcomes) == len(reqs)
+    assert all(g.requeues == 0 for g in gw.outcomes.values())
+
+    spans = list(tracer.spans)
+    total = sum(critical_path(spans, f"t-{r.uid}")["queue_wait"]
+                for r in reqs)
+    hist = gw.metrics.registry.get_sample_value(
+        "tpu_gateway_queue_wait_seconds_sum")
+    assert total == pytest.approx(hist, rel=1e-9, abs=1e-12)
+    cnt = gw.metrics.registry.get_sample_value(
+        "tpu_gateway_queue_wait_seconds_count")
+    assert cnt == len(reqs)
+    # per-request sanity: the breakdown is internally consistent
+    for r in reqs:
+        cp = critical_path(spans, f"t-{r.uid}")
+        assert cp["drain_gap"] == 0.0
+        assert cp["total"] >= cp["queue_wait"]
+
+
+# -- the flight recorder ---------------------------------------------------
+
+class TestFlightRecorder:
+    def test_default_trigger_matrix(self):
+        t = default_trigger
+        assert t({"name": "drain"}) == "drain"
+        assert t({"name": "terminal",
+                  "attrs": {"status": "shed_expired"}}) == "slo_shed"
+        assert t({"name": "terminal",
+                  "attrs": {"status": "finished"}}) is None
+        assert t({"name": "gang",
+                  "attrs": {"to": "evict"}}) == "eviction"
+        assert t({"name": "gang",
+                  "attrs": {"to": "EVICT"}}) == "eviction"
+        assert t({"name": "gang",
+                  "attrs": {"to": "failed"}}) == "failed"
+        assert t({"name": "gang",
+                  "attrs": {"to": "parked"}}) == "preempt"
+        assert t({"name": "gang",
+                  "attrs": {"to": "resume"}}) is None
+        for kind in ("preempt", "reclaim_park", "reclaim_shrink",
+                     "reclaim_drain"):
+            assert t({"name": "reconcile",
+                      "attrs": {"kind": kind}}) == "preempt"
+        assert t({"name": "reconcile",
+                  "attrs": {"kind": "scale_up"}}) is None
+        assert t({"name": "dispatch"}) is None
+        # every reason the default trigger can produce is declared
+        assert {"drain", "slo_shed", "eviction", "failed",
+                "preempt"} == set(REASONS)
+
+    def test_trigger_dump_contents_and_json_safety(self):
+        vc = VirtualClock()
+        bus = EventBus(seed=2)
+        tr = Tracer(bus=bus, clock=vc)
+        metrics = DriverMetrics()
+        rec = FlightRecorder(tr, bus=bus, metrics=(metrics,),
+                             min_new_spans=2)
+        ctx = tr.begin("u")
+        tr.emit(ctx, "dispatch", 0.0, 1.0, track="r0")
+        tr.emit(ctx, "terminal", 1.0, 1.0, track="r0",
+                status="shed_expired")
+        assert len(rec.dumps) == 1
+        d = rec.dumps[0]
+        assert d["reason"] == "slo_shed"
+        assert d["reasons"] == ["slo_shed"]
+        # the triggering span itself is inside the window
+        assert [r["name"] for r in d["spans"]] \
+            == ["dispatch", "terminal"]
+        assert d["spans_emitted_total"] == 2
+        assert [m["reason"] for m in d["marks"]] == ["slo_shed"]
+        assert "bus" in d
+        assert "tpu_dra_" in d["metrics"]
+        json.dumps(d)                           # JSON-safe end to end
+
+    def test_cascade_coalesces_into_one_dump(self):
+        tr = Tracer(clock=VirtualClock())
+        rec = FlightRecorder(tr, min_new_spans=8)
+        ctx = tr.begin("gw-pool")
+        tr.emit(ctx, "drain", 0.0, track="gateway", replica="r0")
+        tr.emit(ctx, "drain", 0.0, track="gateway", replica="r1")
+        # the second trigger arrived 1 span after the dump: one
+        # incident, annotated — not two dumps
+        assert len(rec.dumps) == 1
+        assert rec.dumps[0]["reasons"] == ["drain", "drain"]
+        assert len(rec.marks) == 2              # marks never coalesce
+        # enough fresh spans re-arm a full dump
+        for i in range(10):
+            tr.emit(ctx, "dispatch", float(i))
+        tr.emit(ctx, "drain", 99.0, track="gateway", replica="r2")
+        assert len(rec.dumps) == 2
+        assert rec.dumps[1]["reasons"] == ["drain"]
+
+    def test_dump_dir_writes_numbered_files(self, tmp_path):
+        tr = Tracer(clock=VirtualClock())
+        rec = FlightRecorder(tr, min_new_spans=1,
+                             dump_dir=tmp_path / "fr")
+        ctx = tr.begin("gw-pool")
+        tr.emit(ctx, "drain", 0.0)
+        tr.emit(ctx, "terminal", 1.0, status="shed_expired")
+        names = sorted(p.name for p in (tmp_path / "fr").iterdir())
+        assert names == ["flightrec-001-drain.json",
+                         "flightrec-002-slo_shed.json"]
+        doc = json.loads((tmp_path / "fr" / names[0]).read_text())
+        assert doc["reason"] == "drain"
+
+    def test_debugz_serves_the_payload_over_http(self):
+        tr = Tracer(clock=VirtualClock())
+        rec = FlightRecorder(tr, min_new_spans=1)
+        ctx = tr.begin("u")
+        tr.emit(ctx, "drain", 0.0)              # one stored incident
+        tr.emit(ctx, "dispatch", 1.0, 2.0)
+        ep = HTTPEndpoint("127.0.0.1:0", DriverMetrics(),
+                          debug_source=rec.debug_payload)
+        ep.start()
+        try:
+            body = urlopen(f"http://{ep.address}/debugz",
+                           timeout=5).read().decode()
+        finally:
+            ep.stop()
+        doc = json.loads(body)
+        assert doc["reason"] == "debugz"
+        assert doc["stored_dumps"] == 1
+        assert [r["name"] for r in doc["spans"]] \
+            == ["drain", "dispatch"]
+        # poking the endpoint never perturbed the incident history
+        assert len(rec.dumps) == 1
+
+    def test_debugz_is_404_without_a_source(self):
+        ep = HTTPEndpoint("127.0.0.1:0", DriverMetrics())
+        ep.start()
+        try:
+            with pytest.raises(HTTPError) as exc:
+                urlopen(f"http://{ep.address}/debugz", timeout=5)
+            assert exc.value.code == 404
+        finally:
+            ep.stop()
+
+
+# -- THE acceptance test ---------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _train_rig(tmp_path, *, dp, tp, batch=8):
+    from k8s_dra_driver_tpu.models.checkpoint import TrainCheckpointer
+    from k8s_dra_driver_tpu.parallel.supervisor import (
+        ElasticTrainJob, GangSupervisor)
+    motif = np.random.default_rng(0).integers(0, 64, 32)
+    job = ElasticTrainJob(CFG, np.tile(motif, 64), batch=batch,
+                          seq_len=16, tp=tp)
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    sup = GangSupervisor(job, ckpt,
+                         coordination_dir=tmp_path / "coord",
+                         dp=dp, checkpoint_every=2,
+                         step_deadline_s=120.0,
+                         first_step_deadline_s=600.0)
+    return sup, ckpt
+
+
+@pytest.mark.faults
+def test_acceptance_kill_plus_preemption_reconstructed_in_dump(tmp_path):
+    """THE acceptance test (ISSUE 11): the test_fleet chaos shape — a
+    scripted replica kill under paced load forces a reconciler
+    preemption (gang dp=2→1, checkpoint-then-shrink) and a scale-up
+    on the freed chips — run with the tracer + flight recorder wired
+    across gateway, supervisor and reconciler.  The dump must
+    reconstruct the full causal chain: admission → drain → requeue →
+    re-dispatch → terminal for every victim, and preempt → gang
+    REFORM/RESUME → scale-up grant on the control-plane tracks, with
+    exactly-once span accounting and both incident triggers marked."""
+    from k8s_dra_driver_tpu.parallel import supervisor as sv
+
+    clock = _Clock()
+    sup, ckpt = _train_rig(tmp_path, dp=2, tp=2)
+    plan = FaultPlan([
+        # chip 4 (replica r0) dies on the ledger's 3rd poll, while
+        # its first dispatch wave is in flight
+        FaultRule(verb="health", kind="Chip", name="4", skip=2,
+                  times=1, error="drop")])
+    scripted = ScriptedChipHealth(plan, chips=[4])
+    ledger = ChipLedger([0, 1, 2, 3, 4, 5], health_source=scripted)
+    mgr = ReplicaManager(
+        lambda name: ServingEngine(params(), CFG, slots=2),
+        replicas=2, chip_of=lambda name: 4 + int(name[1:]),
+        health_source=ledger.current_unhealthy, depth_bound=2)
+    bus = EventBus(seed=3)
+    tracer = Tracer(bus=bus, clock=clock)
+    gw = FleetGateway(mgr, queue_capacity=64, clock=clock,
+                      auto_replace=False, bus=bus, tracer=tracer)
+    attach_supervisor(tracer, sup)
+    policy = FleetPolicy(PolicyConfig(
+        queue_high=3, up_after=2, down_after=99, regrow_after=99,
+        min_replicas=1, max_replicas=2, min_train_dp=1,
+        arrival_low_rps=0.5))
+    rec = FleetReconciler(gw, sup, ledger=ledger, policy=policy,
+                          clock=clock, bus=bus, tracer=tracer)
+    recorder = FlightRecorder(
+        tracer, bus=bus,
+        metrics=(gw.metrics, sup.metrics, rec.metrics),
+        dump_dir=tmp_path / "flightrec")
+
+    sup.begin(10_000)
+    sup_live = True
+    reqs = [Request(uid=f"f{i}", prompt=prompt(300 + i, 5 + (i % 2)),
+                    max_new=3 + (i % 2)) for i in range(14)]
+    for rnd in range(80):
+        for r in reqs[2 * rnd:2 * rnd + 2]:
+            gw.submit(r)                        # no SLO: all finish
+        gw.step()
+        sup_live = sup.step_once() if sup_live else False
+        rec.tick()
+        clock.advance(1.0)
+        if len(gw.outcomes) == len(reqs) \
+                and any(k == "scale_up" for _, k, _ in rec.events) \
+                and any(r.cause == "preempt" for r in sup.recoveries):
+            break
+
+    # the incident happened as scripted: drain + requeue, one
+    # preempt recovery with zero steps lost, one scale-up grant
+    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
+    assert requeued, "fault fired before anything was in flight"
+    assert len(gw.outcomes) == len(reqs)
+    assert all(g.status == "finished" for g in gw.outcomes.values())
+    pre = [r for r in sup.recoveries if r.cause == "preempt"]
+    assert len(pre) == 1 and pre[0].steps_lost == 0
+    assert (pre[0].from_dp, pre[0].to_dp) == (2, 1)
+    ups = [i for _, k, i in rec.events if k == "scale_up"]
+    assert len(ups) == 1
+
+    # ---- the causal chain, read back from the span stream ----
+    spans = list(tracer.spans)
+    per = spans_by_trace(spans)
+    for g in gw.outcomes.values():
+        recs = sorted(per[f"t-{g.uid}"], key=lambda r: r["span"])
+        names = [r["name"] for r in recs]
+        assert names.count("dispatch") == 1
+        assert names.count("terminal") == 1
+        assert names.count("drain_gap") == g.requeues
+        assert names.count("requeue") == g.requeues
+        assert recs[0]["parent"] == 0
+        for a, b in zip(recs, recs[1:]):
+            assert b["parent"] == a["span"]
+    # a victim's chain reads admission → drain → requeue →
+    # re-dispatch → terminal in causal (span-id) order
+    victim = sorted(per[f"t-{requeued[0].uid}"],
+                    key=lambda r: r["span"])
+    order = [r["name"] for r in victim]
+    assert order[0] == "dispatch" and order[-1] == "terminal"
+    assert order.index("requeue") < order.index("drain_gap")
+    rq = next(r for r in victim if r["name"] == "requeue")
+    dg = next(r for r in victim if r["name"] == "drain_gap")
+    assert rq["attrs"]["replica"] == "r0"
+    assert dg["t0"] == rq["t0"]                 # the gap is honest
+    assert dg["attrs"]["replica"] != "r0"
+
+    # the preemption cascade on the reconciler track: preempt fired
+    # before the grant it unblocked, both as reconcile spans
+    recon = [r for r in spans if r["name"] == "reconcile"]
+    assert all(r["trace"] == "t-reconciler" for r in recon)
+    kinds = [r["attrs"]["kind"] for r in recon]
+    assert "preempt" in kinds and "scale_up" in kinds
+    assert kinds.index("preempt") < kinds.index("scale_up")
+    # and the gang side shows the shrink re-formation it caused
+    gang = [r for r in spans if r["name"] == "gang"]
+    tos = [r["attrs"]["to"] for r in gang]
+    assert sv.REFORM in tos and sv.RESUME in tos
+    reform = next(r for r in gang if r["attrs"]["to"] == sv.REFORM)
+    assert reform["track"] == "supervisor"
+
+    # ---- the flight recorder caught both incidents ----
+    reasons = {m["reason"] for m in recorder.marks}
+    assert {"drain", "preempt"} <= reasons
+    assert recorder.dumps
+    files = list((tmp_path / "flightrec").iterdir())
+    assert files, "dump_dir never written"
+    # the forensic payload reconstructs the whole story: spans, the
+    # bus journal, and the metric snapshot agree with the live state
+    d = recorder.debug_payload()
+    json.dumps(d)                               # JSON-safe
+    got = {(r["trace"], r["name"]) for r in d["spans"]}
+    assert (f"t-{requeued[0].uid}", "requeue") in got
+    assert ("t-reconciler", "reconcile") in got
+    assert ("t-gang", "gang") in got
+    assert ("t-gw-pool", "drain") in got
+    assert any(e["topic"] == "spans" for e in d["bus"])
+    assert "tpu_gateway_requeued_total" in d["metrics"]
+    assert "tpu_fleet_scale_events_total" in d["metrics"]
+    assert "tpu_train_restarts_total" in d["metrics"]
+    ckpt.close()

@@ -1,0 +1,103 @@
+"""Static lint: the metrics docs and the live registries must agree.
+
+Sibling of tools/lint_perf_claims.py, same mechanical-rule shape: a
+doc that drifts from the code is worse than no doc, because an
+operator grepping a dashboard for a renamed series trusts the page
+that still spells the old name.  docs/OBSERVABILITY.md is the
+single reference page for every metric family this repo exports; the
+lint makes its completeness bidirectional:
+
+- **live → docs**: every series name exported by instantiating the
+  four registries (DriverMetrics, GatewayMetrics, RecoveryMetrics,
+  FleetMetrics — utils/metrics.py) and rendering them through
+  ``render_all`` must appear verbatim in docs/OBSERVABILITY.md;
+- **docs → live**: every ``tpu_*``-shaped token in the doc must be a
+  live series (or a live series' ``_bucket``/``_sum``/``_count``
+  histogram view) — a documented-but-gone name is a stale pointer.
+
+prometheus_client's auto ``*_created`` timestamp gauges are excluded:
+they are exposition-format noise, not families anyone documents.
+
+Run from the repo root (CI runs it in the fast tier,
+tests/test_metrics_docs.py)::
+
+    python tools/lint_metrics_docs.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+DOC = REPO / "docs" / "OBSERVABILITY.md"
+
+#: metric-name-shaped tokens in the doc; every exported family uses a
+#: tpu_ prefix (utils/metrics.py), so the doc regex can too
+NAME_RE = re.compile(r"\btpu_[a-z0-9_]*[a-z0-9]\b")
+
+#: per-series suffixes a histogram family fans out to in PromQL —
+#: the doc may name these views without the lint calling them stale
+_HIST_VIEWS = ("_bucket", "_sum", "_count")
+
+
+def live_series() -> dict[str, str]:
+    """name → kind for every series the four registries export,
+    ``*_created`` noise excluded."""
+    sys.path.insert(0, str(REPO))
+    from k8s_dra_driver_tpu.utils.metrics import (DriverMetrics,
+                                                  FleetMetrics,
+                                                  GatewayMetrics,
+                                                  RecoveryMetrics,
+                                                  render_all)
+    text = render_all(DriverMetrics(), GatewayMetrics(),
+                      RecoveryMetrics(), FleetMetrics()).decode()
+    return {name: kind
+            for name, kind in re.findall(r"^# TYPE (\S+) (\S+)",
+                                         text, re.M)
+            if not name.endswith("_created")}
+
+
+def doc_names(doc: pathlib.Path = DOC) -> set[str]:
+    if not doc.exists():
+        return set()
+    return set(NAME_RE.findall(doc.read_text()))
+
+
+def lint(doc: pathlib.Path = DOC) -> list[str]:
+    problems: list[str] = []
+    label = (str(doc.relative_to(REPO))
+             if doc.is_relative_to(REPO) else doc.name)
+    if not doc.exists():
+        return [f"{label} is missing"]
+    live = live_series()
+    documented = doc_names(doc)
+    for name in sorted(set(live) - documented):
+        problems.append(
+            f"exported series {name} ({live[name]}) is not documented "
+            f"in {label}")
+    resolvable = set(live)
+    for name in live:
+        if live[name] == "histogram":
+            resolvable.update(name + v for v in _HIST_VIEWS)
+    for name in sorted(documented - resolvable):
+        problems.append(
+            f"{label} documents {name} which no "
+            "registry exports (stale pointer)")
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} metrics-docs lint problem(s)")
+        return 1
+    print("metrics-docs lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
