@@ -105,6 +105,12 @@ TINY_MT_KWARGS = dict(tp=1, train_dp=2, batch=4, seq_len=16,
                       d_model=32, n_layers=2, heads=4, d_ff=64,
                       vocab=64)
 
+#: hermetic shape for the compound-fault crucible probe
+#: (cluster/chaosprobe.py): the default_schedule soak at a reduced
+#: cycle count (~106 s on the 8-device CPU mesh) — still long enough
+#: to fire every fault kind and land window-triggered overlaps
+CRUCIBLE_KWARGS = dict(seed=7, cycles=90)
+
 #: control-plane ceiling probe (gateway/ctlprobe.py): NO-OP engines +
 #: open-loop trace replay, so the scalars isolate admission/routing
 #: decisions per second from model compute.  Always CPU-meaningful
@@ -539,6 +545,44 @@ def _fleet_multitenant_probe(timeout_s: float = 300.0) -> dict:
         f"r = multitenant_probe(**json.loads({kwargs!r}))\n"
         "r.pop('frag', None)\n"
         "print(json.dumps(r))\n")
+    env = cpu_jax_env(8)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = ("8-virtual-device CPU mesh; " +
+                       payload.get("note", ""))
+    return payload
+
+
+def _crucible_probe(timeout_s: float = 300.0) -> dict:
+    """Compound-fault crucible probe (cluster/chaosprobe.py) in a
+    CPU-pinned subprocess: the seeded whole-fleet soak —
+    gateway + disagg pool + two gangs + multi-tenant reconciler under
+    a schedule that lands faults inside other faults' recovery
+    windows.  The scalars are robustness evidence per round: survived
+    cycles, invariant violations (must be 0), and mean gang-recovery
+    MTTR under overlapping faults."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    kwargs = json.dumps(CRUCIBLE_KWARGS)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.cluster.chaosprobe import "
+        "crucible_probe\n"
+        f"print(json.dumps(crucible_probe(**json.loads({kwargs!r}))))\n")
     env = cpu_jax_env(8)
     try:
         res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
@@ -1047,6 +1091,11 @@ _PROBE_SCALARS = (
      "preempt_cascade_ms"),
     ("fleet_multitenant", "mt_frag_win_x", "frag_win_x"),
     ("fleet_multitenant", "mt_fairshare_err", "fairshare_err"),
+    ("crucible", "cru_survived_cycles", "cru_survived_cycles"),
+    ("crucible", "cru_compound_mttr_ms", "cru_compound_mttr_ms"),
+    ("crucible", "cru_invariant_violations",
+     "cru_invariant_violations"),
+    ("crucible", "cru_overlap_hits", "cru_overlap_hits"),
     ("control_plane", "ctl_admissions_per_s", "admissions_per_s"),
     ("control_plane", "ctl_routes_per_s", "routes_per_s"),
     ("control_plane", "ctl_goodput_flat_x", "goodput_flat_x"),
@@ -1273,6 +1322,15 @@ def main() -> None:
                 timeout_s=min(300.0, _remaining() - 60.0))
         else:
             fleet_mt = {"error": "skipped: wall budget"}
+        # 3c3. Compound-fault crucible probe (hermetic, CPU
+        #      subprocess): the seeded whole-fleet soak — survived
+        #      cycles, overlap hits, compound-recovery MTTR, and the
+        #      invariant-violation count (must be 0).
+        if _remaining() > 180:
+            crucible = _crucible_probe(
+                timeout_s=min(300.0, _remaining() - 60.0))
+        else:
+            crucible = {"error": "skipped: wall budget"}
         # 3d. Control-plane ceiling probe (hermetic, CPU subprocess):
         #     admissions/s + routes/s over no-op engines under
         #     open-loop trace replay, swept over pump counts.
@@ -1291,6 +1349,7 @@ def main() -> None:
         compute["supervisor_recovery"] = recovery
         compute["fleet"] = fleet
         compute["fleet_multitenant"] = fleet_mt
+        compute["crucible"] = crucible
         compute["control_plane"] = ctl
         detail["tpu"] = compute
         detail["baseline_note"] = (

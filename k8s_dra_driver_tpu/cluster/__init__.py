@@ -1,17 +1,29 @@
 """Cluster access: client interface, fake API server, object kinds,
-fault injection."""
+fault injection, fleet invariants, and the compound-fault crucible."""
 
 from .client import (ApiServerError, ApiUnavailableError, ClusterClient,
                      ConflictError, EVENT_ADDED, EVENT_DELETED,
                      EVENT_MODIFIED, FakeCluster, NotFoundError, match_labels)
 from .faults import (FaultPlan, FaultRule, FaultyClusterClient,
                      ScriptedChipHealth)
+from .invariants import check_cycle
 from .objects import Deployment, Node, Pod
 
 __all__ = [
     "ApiServerError", "ApiUnavailableError", "ClusterClient",
     "ConflictError", "Deployment", "EVENT_ADDED", "EVENT_DELETED",
-    "EVENT_MODIFIED", "FakeCluster", "FaultPlan", "FaultRule",
-    "FaultyClusterClient", "Node", "NotFoundError", "Pod", "match_labels",
-    "ScriptedChipHealth",
+    "EVENT_MODIFIED", "FakeCluster", "FaultEvent", "FaultPlan",
+    "FaultRule", "FaultyClusterClient", "Node", "NotFoundError", "Pod",
+    "Schedule", "check_cycle", "default_schedule", "match_labels",
+    "run_soak", "ScriptedChipHealth",
 ]
+
+
+def __getattr__(name):
+    # the crucible pulls in the whole workload stack — loaded on
+    # demand so `import ...cluster` stays light (the fleet/ pattern)
+    if name in ("FaultEvent", "Schedule", "default_schedule",
+                "run_soak"):
+        from . import crucible
+        return getattr(crucible, name)
+    raise AttributeError(name)

@@ -1,0 +1,822 @@
+"""Compound-fault crucible: a seeded whole-fleet chaos soak.
+
+Every chaos test in the tree so far injects ONE fault kind into ONE
+subsystem and asserts recovery.  Real incidents are compound: the
+second fault lands inside the first one's recovery window — a chip
+dies while a gang is mid-REFORM, a decode replica drains while a KV
+handoff is in flight, a heal arrives mid-preemption-cascade, a resize
+applies to a gang that parked and lost a chip nobody was polling.
+The reference driver's resilience story is exercised only by hand on
+kind clusters (reference cmd/nvidia-dra-plugin/device_state.go:94-190
+recovers prepared-claim state after restarts, but nothing there can
+compose two failures on demand); this module is the missing
+instrument at fleet scope.
+
+One :class:`CrucibleRig` composes the FULL workload stack in a single
+deterministic co-loop — a ShardedGateway over a disaggregated
+prefill/decode pool, two elastic training gangs, and the multi-tenant
+reconciler arbitrating one chip ledger — while a :class:`Schedule` of
+:class:`FaultEvent`\\ s drives every fault primitive cluster/faults.py
+exposes: chip kill/heal (ScriptedChipHealth), gang-worker crash and
+hang, replica kills, and tenant load bursts.  Events fire either at a
+fixed cycle or when a named RECOVERY WINDOW opens (``window=``,
+matched by glob against the windows the rig observes every cycle:
+``reform:<gang>``, ``resize_queued:<gang>``, ``parked:<gang>``,
+``drain:hi``, ``handoff:hi``, ``cascade``) — which is exactly how a
+schedule composes a second fault inside the first one's recovery arc.
+
+The always-on checkers (cluster/invariants.py) run after EVERY cycle;
+end-of-run adds exactly-once terminal outcomes and byte-equality
+against single-engine oracles.  On violation, :func:`minimize`
+delta-debugs (ddmin) the schedule down to a minimal failing event
+set, :func:`write_repro` persists a replayable repro (seed + schedule
+JSON + the violation log), and :func:`replay` re-runs it — with the
+flight recorder (cluster/flightrec.py) dumping into the repro
+directory so the confirmed failure ships its own forensics.
+
+Determinism contract: a run is a pure function of the schedule.
+Fault plans are armed at event fire time (FaultPlan.arm), fire times
+are a function of (cycle, observed windows), windows are a function
+of prior cycles, and every RNG in the stack (EventBus shuffle, plan
+probability draws) is seeded from the schedule — so replaying a repro
+reproduces the identical injection log and the identical violation.
+Wall-clock only enters through recovery MTTR statistics and the
+watchdog deadline that converts a scripted hang into an eviction;
+neither feeds back into scheduling.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import json
+import logging
+import math
+from collections import deque
+from pathlib import Path
+
+from . import invariants
+from .faults import (CHIP_KIND, GANG_VERB, GANG_WORKER_KIND, HEAL,
+                     HEALTH_VERB, FaultPlan, FaultRule,
+                     ScriptedChipHealth)
+
+log = logging.getLogger(__name__)
+
+#: fault kinds a schedule may compose (FaultEvent.kind)
+EVENT_KINDS = ("chip_kill", "worker_crash", "worker_hang",
+               "replica_kill", "burst")
+
+#: reconciler event kinds that open the "cascade" window
+CASCADE_KINDS = frozenset({"grant", "reclaim_park", "reclaim_shrink",
+                           "reclaim_drain", "release", "regrow"})
+
+#: how long (in clock units = cycles) a reconciler action keeps the
+#: "cascade" window open
+CASCADE_WINDOW_S = 5.0
+
+#: repro file format tag (versioned so a future schema change fails
+#: loudly instead of replaying garbage)
+REPRO_FORMAT = "tpu-dra-crucible-repro/1"
+
+# -- the tiny shared model (same shape as the chaos twins) -------------
+
+_CFG = None
+_PARAMS = None
+_ORACLES: dict = {}
+
+
+def _cfg():
+    global _CFG
+    if _CFG is None:
+        import jax.numpy as jnp
+
+        from ..models import TransformerConfig
+        _CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2,
+                                 n_heads=4, d_head=8, d_ff=64,
+                                 max_seq=48, n_kv_heads=2,
+                                 dtype=jnp.float32)
+    return _CFG
+
+
+def _params():
+    global _PARAMS
+    if _PARAMS is None:
+        import jax
+
+        from ..models import init_params
+        _PARAMS = init_params(_cfg(), jax.random.PRNGKey(0))
+    return _PARAMS
+
+
+def _prompt(seed: int, n: int):
+    import jax
+    import numpy as np
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, _cfg().vocab), np.int32)
+
+
+def _oracle(seed: int, n: int, max_new: int):
+    """Single-engine greedy oracle, cached by (seed, n, max_new) —
+    ddmin re-runs the rig a dozen times and must not recompute the
+    reference output per probe run."""
+    key = (seed, n, max_new)
+    if key not in _ORACLES:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from ..models import greedy_generate
+        out = greedy_generate(_params(),
+                              jnp.asarray(_prompt(seed, n))[None, :],
+                              _cfg(), n_tokens=max_new)
+        _ORACLES[key] = np.asarray(out[0], np.int32)
+    return _ORACLES[key]
+
+
+class Clock:
+    """The co-loop's virtual clock: one unit per cycle, injected into
+    the gateway and the reconciler so SLO math and cascade windows
+    are cycle-deterministic, never wall-clock."""
+
+    def __init__(self, t0: float = 0.0):
+        self.t = float(t0)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float = 1.0) -> None:
+        self.t += dt
+
+
+# -- the schedule ------------------------------------------------------
+
+
+@dataclasses.dataclass
+class FaultEvent:
+    """One scheduled fault (or load burst).
+
+    Fires once, at the first cycle ``>= at_cycle`` — or, when
+    ``window`` is set instead, at the first cycle ``>= after_cycle``
+    where an open recovery window matches the ``window`` glob (which
+    makes the event an overlap hit BY CONSTRUCTION: it cannot fire
+    outside the arc it targets).  ``fired_cycle``/``hit_windows`` are
+    runtime records; :meth:`fresh` strips them for re-runs.
+    """
+
+    id: str
+    kind: str
+    at_cycle: int | None = None
+    window: str | None = None       # glob over open windows
+    after_cycle: int = 0            # window events wait at least this
+    chip: int | None = None         # chip_kill target
+    heal_after: int | None = None   # chip_kill: polls until the heal
+    gang: str | None = None         # worker_* target gang name
+    row: int | None = None          # worker_* target dp row
+    replica_glob: str | None = None  # replica_kill name glob
+    n: int = 0                      # burst size
+    prompt_seed: int = 0            # burst prompt family
+    fired_cycle: int | None = None
+    hit_windows: tuple = ()
+
+    def __post_init__(self):
+        if self.kind not in EVENT_KINDS:
+            raise ValueError(f"unknown event kind {self.kind!r}; "
+                             f"one of {EVENT_KINDS}")
+        if self.at_cycle is None and self.window is None:
+            raise ValueError(f"event {self.id}: needs at_cycle or "
+                             f"window")
+
+    def to_json(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["hit_windows"] = list(self.hit_windows)
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "FaultEvent":
+        d = dict(d)
+        d["hit_windows"] = tuple(d.get("hit_windows", ()))
+        return cls(**d)
+
+    def fresh(self) -> "FaultEvent":
+        """A copy with the runtime firing record cleared."""
+        d = self.to_json()
+        d["fired_cycle"] = None
+        d["hit_windows"] = []
+        return FaultEvent.from_json(d)
+
+
+@dataclasses.dataclass
+class Schedule:
+    """A seeded, replayable fault schedule: the crucible's entire
+    input.  ``seed`` feeds every RNG in the rig (EventBus, fault
+    plans); ``cycles`` is the injection phase length (the drain phase
+    that follows injects nothing)."""
+
+    seed: int
+    cycles: int
+    events: list
+
+    def to_json(self) -> dict:
+        return {"seed": self.seed, "cycles": self.cycles,
+                "events": [e.to_json() for e in self.events]}
+
+    @classmethod
+    def from_json(cls, data: dict | str) -> "Schedule":
+        if isinstance(data, str):
+            data = json.loads(data)
+        return cls(seed=int(data["seed"]), cycles=int(data["cycles"]),
+                   events=[FaultEvent.from_json(e)
+                           for e in data.get("events", [])])
+
+    def fresh(self) -> "Schedule":
+        return Schedule(seed=self.seed, cycles=self.cycles,
+                        events=[e.fresh() for e in self.events])
+
+
+def default_schedule(seed: int = 7, cycles: int = 220) -> Schedule:
+    """The canonical compound-fault composition: every fault kind,
+    with the second faults aimed (by window trigger) into the first
+    faults' recovery arcs.  Offsets scale with ``cycles`` so short
+    probe runs and the full soak share one shape; ``cycles`` below
+    ~60 leaves too little room between arcs to be interesting."""
+    import random
+    rng = random.Random(seed)
+    u = max(cycles // 11, 5)        # one "act" of the run
+    ps = lambda: rng.randrange(10_000)
+    events = [
+        # act 1: warm the serving pool so handoff windows exist
+        FaultEvent(id="warm-burst", kind="burst", at_cycle=1,
+                   n=6, prompt_seed=ps()),
+        # act 2: chip death evicts a mid-gang worker; a SECOND chip
+        # dies inside the resulting REFORM window (the classic
+        # chip-death-mid-REFORM double fault)
+        FaultEvent(id="mid-chip3", kind="chip_kill", at_cycle=u,
+                   chip=3, heal_after=2 * u),
+        FaultEvent(id="mid-chip4-in-reform", kind="chip_kill",
+                   window="reform:mid", after_cycle=u, chip=4,
+                   heal_after=2 * u),
+        # act 3: sustained pressure on hi — three back-to-back waves
+        # hold the queue above MtConfig.queue_high across consecutive
+        # reconciler ticks (one wave drains before up_after trips),
+        # forcing the preemption cascade (park lo, shrink mid, grants
+        # onto freed chips)
+        FaultEvent(id="pressure-burst", kind="burst",
+                   at_cycle=3 * u, n=12, prompt_seed=ps()),
+        FaultEvent(id="pressure-burst-2", kind="burst",
+                   at_cycle=3 * u + 1, n=12, prompt_seed=ps()),
+        FaultEvent(id="pressure-burst-3", kind="burst",
+                   at_cycle=3 * u + 2, n=12, prompt_seed=ps()),
+        # ...and a decode replica is killed while prefill->decode
+        # handoffs are in flight (drain-mid-KV-handoff)
+        FaultEvent(id="decode-kill-in-handoff", kind="replica_kill",
+                   window="handoff:hi", after_cycle=3 * u + 2,
+                   replica_glob="d*"),
+        # ...and a chip dies MID-CASCADE; its later heal lands while
+        # grants/fences from the cascade are still live
+        # (heal-mid-cascade)
+        FaultEvent(id="chip0-in-cascade", kind="chip_kill",
+                   window="cascade", after_cycle=3 * u, chip=0,
+                   heal_after=u),
+        # ...and a chip dies while lo is PARKED with nobody polling
+        # it, so the eventual unpark resize must re-poll or form over
+        # a corpse (resize-while-PARKED)
+        FaultEvent(id="chip1-while-parked", kind="chip_kill",
+                   window="parked:lo", after_cycle=3 * u, chip=1,
+                   heal_after=u),
+        # act 4: in-band gang faults on their own arcs
+        FaultEvent(id="mid-crash-w1", kind="worker_crash",
+                   at_cycle=6 * u, gang="mid", row=1),
+        FaultEvent(id="mid-hang-w0", kind="worker_hang",
+                   at_cycle=7 * u, gang="mid", row=0),
+        # ...a crash aimed into lo's unpark/EXPAND recovery window.
+        # Row 1 only exists at dp>=2, so the armed rule waits out any
+        # dp=1 interlude and fires on the regrown formation's first
+        # steps — a shrink lo can survive, never a full wipeout.
+        FaultEvent(id="lo-crash-in-reform", kind="worker_crash",
+                   window="reform:lo", after_cycle=4 * u, gang="lo",
+                   row=1),
+        # act 5: a tail burst exercises granted replicas + regrow
+        # contention on the way back to steady state
+        FaultEvent(id="tail-burst", kind="burst", at_cycle=8 * u,
+                   n=8, prompt_seed=ps()),
+    ]
+    return Schedule(seed=seed, cycles=cycles, events=events)
+
+
+# -- the rig -----------------------------------------------------------
+
+
+@dataclasses.dataclass
+class CrucibleResult:
+    """One soak's verdict + evidence summary."""
+
+    cycles: int
+    survived_cycles: int        # cycles before the first violation
+    violations: list            # (cycle, [messages]); cycle -1 = end
+    overlap_hits: int           # non-burst faults fired in a window
+    fault_kinds_fired: list
+    compound_mttr_ms: float     # mean gang recovery MTTR
+    submitted: int
+    finished: int
+    operator_repairs: int
+    gang_failures: list
+
+    def ok(self) -> bool:
+        return not self.violations and not self.gang_failures
+
+
+class CrucibleRig:
+    """The full stack under one co-loop (module docstring).
+
+    8-chip board, carved exactly full: gang ``lo`` on {0,1} (dp=2),
+    gang ``mid`` on {2,3,4,5} (dp=4), serving tenant ``hi`` runs a
+    disaggregated pool with prefill p0 on 6 and decode d1 on 7.
+    Specs hi(prio 3, quota 6, floor 2) / mid(2, 4, 2) / lo(1, 2, 0)
+    reproduce the ISSUE 9 cascade shape, so pressure bursts park lo
+    and shrink mid — the arcs the window-triggered faults aim into.
+    """
+
+    GANGS = (("lo", dict(dp=2, batch=4, chips=(0, 1))),
+             ("mid", dict(dp=4, batch=8, chips=(2, 3, 4, 5))))
+
+    def __init__(self, schedule: Schedule, workdir,
+                 *, dump_dir=None, step_deadline_s: float = 5.0,
+                 hang_stall_s: float = 20.0):
+        self.schedule = schedule
+        self.workdir = Path(workdir)
+        self.workdir.mkdir(parents=True, exist_ok=True)
+        self.dump_dir = dump_dir
+        self.step_deadline_s = step_deadline_s
+        # the scripted wedge outlives the watchdog deadline (the
+        # eviction's abort event releases it) but never a warmed-up
+        # first-step allowance, so a hang landing during compile
+        # warmup degrades to one slow step instead of a stuck soak
+        self.hang_stall_s = hang_stall_s
+        self.clock = Clock()
+        self.cycle = 0
+        self.violations: list = []
+        self.gang_failures: list = []
+        self.operator_repairs = 0
+        self.submitted: dict = {}     # uid -> (seed, n, max_new)
+        self._win_hist: deque = deque(maxlen=4)   # 2 cycles x 2 samples
+        self._build()
+
+    # -- construction ----------------------------------------------------
+
+    def _build(self) -> None:
+        from ..fleet.binpack import TopologyBinPacker
+        from ..fleet.supply import ChipLedger
+        from ..fleet.tenancy import (MtConfig, MultiTenantReconciler,
+                                     ServingTenant, TenantRegistry,
+                                     TenantSpec, TrainingTenant)
+        from ..gateway.sharded import ShardedGateway
+        from ..models.checkpoint import TrainCheckpointer
+        from ..models.serving import ServingEngine
+        from ..parallel.supervisor import (ElasticTrainJob,
+                                           GangSupervisor)
+        from ..serving_disagg import DisaggReplicaManager, DisaggRouter
+        from ..utils.tracing import Tracer, attach_supervisor
+        from .bus import EventBus
+        from .flightrec import FlightRecorder
+        import numpy as np
+
+        seed = self.schedule.seed
+        self.chip_plan = FaultPlan(seed=seed)
+        self.replica_plan = FaultPlan(seed=seed + 1)
+        self.gang_plans = {name: FaultPlan(seed=10 * seed + i + 2)
+                           for i, (name, _) in enumerate(self.GANGS)}
+        self.bus = EventBus(seed=seed)
+        self.tracer = Tracer(bus=self.bus, clock=self.clock)
+        self.ledger = ChipLedger(
+            range(8), health_source=ScriptedChipHealth(
+                self.chip_plan, chips=range(8)))
+
+        self.sups = {}
+        self._ckpts = []
+        motif = np.random.default_rng(seed).integers(0, 64, 32)
+        for name, spec in self.GANGS:
+            job = ElasticTrainJob(_cfg(), np.tile(motif, 64),
+                                  batch=spec["batch"], seq_len=16,
+                                  tp=1)
+            ckpt = TrainCheckpointer(self.workdir / f"ckpt-{name}")
+            self._ckpts.append(ckpt)
+            self.sups[name] = GangSupervisor(
+                job, ckpt,
+                coordination_dir=self.workdir / f"coord-{name}",
+                dp=spec["dp"], checkpoint_every=2,
+                step_deadline_s=self.step_deadline_s,
+                first_step_deadline_s=600.0, max_recoveries=8,
+                fault_plan=self.gang_plans[name],
+                health_source=self.ledger.current_unhealthy,
+                placement_exclude=[c for c in range(8)
+                                   if c not in spec["chips"]])
+
+        chip_map = {"p0": 6, "d1": 7}
+        self.mgr = DisaggReplicaManager(
+            lambda name: ServingEngine(_params(), _cfg(), slots=2,
+                                       prefix_cache=2),
+            prefill_replicas=1, decode_replicas=1,
+            chip_of=chip_map.get,
+            health_source=self.ledger.current_unhealthy,
+            fault_plan=self.replica_plan, depth_bound=2)
+        self.gw = ShardedGateway(
+            self.mgr, pumps=2,
+            router_factory=lambda: DisaggRouter(self.mgr.index),
+            queue_capacity=64, clock=self.clock, bus=self.bus,
+            auto_replace=False, seed=seed, tenant="hi",
+            tracer=self.tracer)
+
+        registry = TenantRegistry(capacity=8)
+        registry.add(TenantSpec("hi", priority=3, quota=6, floor=2),
+                     ServingTenant(self.gw))
+        registry.add(TenantSpec("mid", priority=2, quota=4, floor=2),
+                     TrainingTenant(self.sups["mid"], target_dp=4))
+        registry.add(TenantSpec("lo", priority=1, quota=2, floor=0),
+                     TrainingTenant(self.sups["lo"], target_dp=2))
+        self.registry = registry
+        self.rec = MultiTenantReconciler(
+            registry, ledger=self.ledger,
+            packer=TopologyBinPacker(self.ledger, domain_size=2),
+            config=MtConfig(queue_high=4, up_after=2, down_after=3,
+                            regrow_after=3, arrival_low_rps=0.5),
+            clock=self.clock, bus=self.bus, tracer=self.tracer)
+        self.flightrec = FlightRecorder(
+            self.tracer, bus=self.bus,
+            metrics=(self.gw.metrics, self.rec.metrics),
+            dump_dir=self.dump_dir)
+        for name, sup in self.sups.items():
+            attach_supervisor(self.tracer, sup, name=f"gang-{name}")
+            sup.begin(10_000)       # never completes within a soak
+        self.live = {name: True for name in self.sups}
+
+    def close(self) -> None:
+        for ckpt in self._ckpts:
+            ckpt.close()
+
+    # -- windows ---------------------------------------------------------
+
+    def _sample_windows(self) -> None:
+        """One instantaneous observation of every open recovery-arc
+        window.  Sampled twice per cycle (pre- and post-reconcile:
+        dead replicas are reaped AT the tick, so drain windows are
+        only visible before it) and kept sticky over the last two
+        cycles, because an arc that was open a moment ago is still
+        the arc a second fault lands in."""
+        from ..serving_disagg import PrefillReplica
+        w = set()
+        for name, sup in self.sups.items():
+            # _pending spans REFORM/EXPAND until the first completed
+            # post-restore step — the recovery window proper
+            if getattr(sup, "_pending", None) is not None:
+                w.add(f"reform:{name}")
+            if sup._requested is not None:
+                w.add(f"resize_queued:{name}")
+            if sup.state == "parked":
+                w.add(f"parked:{name}")
+        for r in self.mgr.replicas:
+            if r.state == "dead":
+                w.add("drain:hi")
+            elif isinstance(r, PrefillReplica) and (r.blocks
+                                                   or r.pending):
+                w.add("handoff:hi")
+        horizon = self.clock.t - CASCADE_WINDOW_S
+        if any(t >= horizon and k in CASCADE_KINDS
+               for t, k, _ in self.rec.events):
+            w.add("cascade")
+        self._win_hist.append(frozenset(w))
+
+    def _sticky_windows(self) -> set:
+        out: set = set()
+        for s in self._win_hist:
+            out |= s
+        return out
+
+    # -- event firing ----------------------------------------------------
+
+    def _due(self, ev: FaultEvent, cycle: int) -> bool:
+        if ev.fired_cycle is not None:
+            return False
+        if ev.at_cycle is not None:
+            return cycle >= ev.at_cycle
+        if cycle < ev.after_cycle:
+            return False
+        return any(fnmatch.fnmatchcase(w, ev.window)
+                   for w in self._sticky_windows())
+
+    def _fire(self, ev: FaultEvent, cycle: int) -> None:
+        ev.fired_cycle = cycle
+        ev.hit_windows = tuple(sorted(self._sticky_windows()))
+        log.info("crucible: firing %s (%s) at cycle %d, windows %s",
+                 ev.id, ev.kind, cycle, list(ev.hit_windows))
+        if ev.kind == "chip_kill":
+            rules = [FaultRule(verb=HEALTH_VERB, kind=CHIP_KIND,
+                               name=str(ev.chip), times=1,
+                               error="drop")]
+            if ev.heal_after:
+                rules.append(FaultRule(
+                    verb=HEALTH_VERB, kind=CHIP_KIND,
+                    name=str(ev.chip), skip=ev.heal_after, times=1,
+                    error=HEAL))
+            self.chip_plan.arm(*rules)
+        elif ev.kind == "worker_crash":
+            # g*w<row> matches the row across formation generations
+            self.gang_plans[ev.gang].arm(FaultRule(
+                verb=GANG_VERB, kind=GANG_WORKER_KIND,
+                name=f"g*w{ev.row}", times=1, error="crash"))
+        elif ev.kind == "worker_hang":
+            self.gang_plans[ev.gang].arm(FaultRule(
+                verb=GANG_VERB, kind=GANG_WORKER_KIND,
+                name=f"g*w{ev.row}", times=1, error="hang",
+                latency_s=self.hang_stall_s))
+        elif ev.kind == "replica_kill":
+            self.replica_plan.arm(FaultRule(
+                verb=HEALTH_VERB, kind="Replica",
+                name=ev.replica_glob or "d*", times=1, error="drop"))
+        elif ev.kind == "burst":
+            from ..models.serving import Request
+            for i in range(ev.n):
+                uid = f"{ev.id}-r{i}"
+                n_tok = 4 + (i % 5)
+                self.gw.submit(Request(
+                    uid=uid, prompt=_prompt(ev.prompt_seed + i, n_tok),
+                    max_new=3), slo_s=900.0)
+                self.submitted[uid] = (ev.prompt_seed + i, n_tok, 3)
+
+    # -- the co-loop -----------------------------------------------------
+
+    def run_cycle(self, inject: bool = True) -> list:
+        """One full co-loop cycle: fire due events, step the gateway,
+        every live gang, and the reconciler, then run the per-cycle
+        invariant sweep.  Returns this cycle's violations."""
+        from ..parallel.supervisor import SupervisorError
+        cycle = self.cycle
+        if inject:
+            for ev in self.schedule.events:
+                if self._due(ev, cycle):
+                    self._fire(ev, cycle)
+        self.gw.step()
+        for name, sup in self.sups.items():
+            if not self.live[name]:
+                continue
+            try:
+                self.live[name] = sup.step_once()
+            except SupervisorError as e:
+                self.live[name] = False
+                self.gang_failures.append(f"{name}: {e}")
+        self._sample_windows()          # pre-tick: drains visible
+        self.rec.tick()
+        self.clock.advance(1.0)
+        self._sample_windows()          # post-tick: cascade visible
+        v = invariants.check_cycle(
+            gateways=[("hi", self.gw)],
+            supervisors=list(self.sups.items()),
+            ledger=self.ledger, records=self._records(),
+            specs=list(self.registry), events=self.rec.events)
+        if v:
+            self.violations.append((cycle, v))
+        self.cycle += 1
+        return v
+
+    def _records(self) -> list:
+        out = []
+        for spec in self.registry:
+            w = self.registry.workload(spec.name)
+            out.append((spec.name,
+                        getattr(w, "manager", None),
+                        getattr(w, "supervisor", None)))
+        return out
+
+    def drain(self, max_cycles: int = 300) -> bool:
+        """Pump injection-free cycles until the gateway is idle (the
+        deadline is ``max_cycles`` — the crucible never waits
+        unbounded).  Last-resort operator repair: ddmin probes run
+        arbitrary event SUBSETS, and a subset can orphan the pool
+        (decode capacity dead, queue too shallow to trip the
+        pressure-grant path); after a stall with zero ready decode
+        replicas, one replica is added on a free healthy chip so
+        every probe run terminates and gets judged on its invariants.
+        Repairs are counted — a default-schedule run needs none."""
+        stall = 0
+        last_terminal = -1
+        for _ in range(max_cycles):
+            if (self.gw.pending() == 0
+                    and not any(r.in_flight
+                                for r in self.mgr.replicas)):
+                return True
+            self.run_cycle(inject=False)
+            terminal = len(self.gw.outcomes) + len(self.gw.refused)
+            stall = 0 if terminal != last_terminal else stall + 1
+            last_terminal = terminal
+            if stall >= 25:
+                stall = 0
+                ready_decode = [
+                    r for r in self.mgr.replicas
+                    if r.ready and r.role in ("decode", "unified")]
+                free = self.ledger.healthy_free()
+                if not ready_decode and free:
+                    self.mgr.add_replica(chip=free[-1])
+                    self.operator_repairs += 1
+                    log.warning("crucible: operator repair — decode "
+                                "replica added on chip %d", free[-1])
+        return False
+
+    # -- verdicts --------------------------------------------------------
+
+    def final_violations(self) -> list:
+        """End-of-run checkers: exactly-once terminal outcomes over
+        every submitted uid, byte-equality of every finished result
+        against its single-engine oracle, and the full-run loss
+        trajectory of both gangs."""
+        out = invariants.exactly_once_terminal(
+            self.gw, list(self.submitted))
+        oracles = {}
+        for uid, (seed, n, max_new) in self.submitted.items():
+            g = self.gw.outcomes.get(uid)
+            if g is not None and g.status == "finished":
+                oracles[uid] = _oracle(seed, n, max_new)
+        out += invariants.byte_equal(self.gw.results, oracles)
+        for name, sup in self.sups.items():
+            out += [f"[{name}] {v}"
+                    for v in invariants.losses_exactly_once(
+                        sup.losses, sup.recoveries)]
+        return out
+
+    def result(self) -> CrucibleResult:
+        fired = [e for e in self.schedule.events
+                 if e.fired_cycle is not None]
+        mttrs = [r.mttr_s for sup in self.sups.values()
+                 for r in sup.recoveries
+                 if getattr(r, "mttr_s", -1.0) >= 0.0]
+        first_bad = self.violations[0][0] if self.violations else None
+        finished = sum(
+            1 for uid in self.submitted
+            if (g := self.gw.outcomes.get(uid)) is not None
+            and g.status == "finished")
+        return CrucibleResult(
+            cycles=self.cycle,
+            survived_cycles=(self.cycle if first_bad is None
+                             else max(first_bad, 0)),
+            violations=list(self.violations),
+            overlap_hits=sum(1 for e in fired
+                             if e.kind != "burst" and e.hit_windows),
+            fault_kinds_fired=sorted({e.kind for e in fired}),
+            compound_mttr_ms=(sum(mttrs) / len(mttrs) * 1000.0
+                              if mttrs else 0.0),
+            submitted=len(self.submitted), finished=finished,
+            operator_repairs=self.operator_repairs,
+            gang_failures=list(self.gang_failures))
+
+
+def run_soak(schedule: Schedule, workdir, *, dump_dir=None,
+             drain_cycles: int = 300):
+    """One full soak: injection phase (``schedule.cycles`` co-loop
+    cycles), drain phase, end-of-run checkers.  Returns ``(result,
+    rig)`` — the rig is closed but readable, so tests can inspect
+    recoveries, events, and flight-recorder dumps."""
+    rig = CrucibleRig(schedule, workdir, dump_dir=dump_dir)
+    try:
+        for _ in range(schedule.cycles):
+            rig.run_cycle()
+        if not rig.drain(max_cycles=drain_cycles):
+            rig.violations.append(
+                (-1, [f"gateway not idle after {drain_cycles} drain "
+                      f"cycles: {rig.gw.pending()} queued, "
+                      f"{sum(len(r.in_flight) for r in rig.mgr.replicas)}"
+                      f" in flight"]))
+        end = rig.final_violations()
+        if end:
+            rig.violations.append((-1, end))
+        if rig.violations and rig.dump_dir is not None:
+            # a failing run with a dump dir ALWAYS ships forensics,
+            # even when no individual span tripped a trigger
+            rig.flightrec.record("failed")
+        return rig.result(), rig
+    finally:
+        rig.close()
+
+
+# -- schedule minimization (ddmin) -------------------------------------
+
+
+def minimize(schedule: Schedule, workdir, *, max_runs: int = 16,
+             check=None):
+    """Delta-debug (Zeller's ddmin, complement-reduction form) the
+    schedule's event list down to a minimal set that still fails.
+    ``check(result) -> bool`` decides failure (default: any invariant
+    violation).  ``max_runs`` bounds the probe budget — each probe is
+    a full soak in a fresh workdir subdirectory.  Returns
+    ``(minimized_schedule, runs_used)``; the caller re-runs the
+    minimized schedule to capture its violation log for the repro."""
+    check = check or (lambda res: bool(res.violations))
+    workdir = Path(workdir)
+    events = [e.fresh() for e in schedule.events]
+    runs = 0
+
+    def failing(subset) -> bool:
+        nonlocal runs
+        runs += 1
+        sub = Schedule(seed=schedule.seed, cycles=schedule.cycles,
+                       events=[e.fresh() for e in subset])
+        res, _ = run_soak(sub, workdir / f"probe-{runs:03d}")
+        log.info("ddmin probe %d: %d event(s) -> %s", runs,
+                 len(subset), "FAIL" if check(res) else "pass")
+        return check(res)
+
+    n = 2
+    while len(events) >= 2 and runs < max_runs:
+        size = math.ceil(len(events) / n)
+        chunks = [events[i:i + size]
+                  for i in range(0, len(events), size)]
+        reduced = False
+        for i in range(len(chunks)):
+            if runs >= max_runs:
+                break
+            complement = [e for j, ch in enumerate(chunks)
+                          if j != i for e in ch]
+            if complement and failing(complement):
+                events = complement
+                n = max(n - 1, 2)
+                reduced = True
+                break
+        if not reduced:
+            if n >= len(events):
+                break
+            n = min(n * 2, len(events))
+    return (Schedule(seed=schedule.seed, cycles=schedule.cycles,
+                     events=[e.fresh() for e in events]), runs)
+
+
+# -- repro files -------------------------------------------------------
+
+
+def write_repro(path, schedule: Schedule,
+                result: CrucibleResult) -> Path:
+    """Persist a replayable repro: the (minimized) schedule plus the
+    violation log it produced.  JSON, sorted keys — diffs of two
+    repro files are meaningful."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = {
+        "format": REPRO_FORMAT,
+        "schedule": schedule.to_json(),
+        "violations": [[c, list(v)] for c, v in result.violations],
+        "first_violation_cycle": (result.violations[0][0]
+                                  if result.violations else None),
+        "fault_kinds_fired": result.fault_kinds_fired,
+        "overlap_hits": result.overlap_hits,
+    }
+    path.write_text(json.dumps(payload, indent=1, sort_keys=True))
+    return path
+
+
+def replay(path, workdir, *, dump_dir=None, drain_cycles: int = 300):
+    """Re-run a repro file.  ``dump_dir`` hands the flight recorder a
+    directory, so the confirming run ships forensic dumps next to the
+    repro.  Returns ``(result, rig)``."""
+    payload = json.loads(Path(path).read_text())
+    if payload.get("format") != REPRO_FORMAT:
+        raise ValueError(
+            f"not a crucible repro (format={payload.get('format')!r},"
+            f" want {REPRO_FORMAT!r})")
+    # the repro records fired_cycle/hit_windows as evidence of where
+    # each event landed; fresh() strips that runtime state, else
+    # _due() would see every event as already fired and replay a
+    # fault-free run
+    sched = Schedule.from_json(payload["schedule"]).fresh()
+    return run_soak(sched, workdir, dump_dir=dump_dir,
+                    drain_cycles=drain_cycles)
+
+
+def investigate(schedule: Schedule, workdir, *,
+                max_runs: int = 16) -> dict:
+    """The whole violation workflow in one call: soak; on violation,
+    ddmin-minimize the schedule, write ``repro.json``, and REPLAY it
+    (flight recorder dumping alongside) to confirm the repro fails
+    deterministically.  Returns a dict with the soak ``result`` and —
+    when a violation was found — ``minimized`` (Schedule), ``repro``
+    (path), ``confirm_result``, and ``confirmed`` (bool)."""
+    workdir = Path(workdir)
+    res, _rig = run_soak(schedule, workdir / "soak")
+    out = {"result": res, "minimized": None, "repro": None,
+           "confirm_result": None, "confirmed": None}
+    if not res.violations:
+        return out
+    minimized, _runs = minimize(schedule, workdir / "ddmin",
+                                max_runs=max_runs)
+    min_res, _ = run_soak(minimized, workdir / "minimized")
+    if not min_res.violations:
+        # the budget ran out mid-reduction on a flaky boundary; the
+        # full schedule is the (non-minimal but honest) repro
+        minimized, min_res = schedule.fresh(), res
+    repro = write_repro(workdir / "repro.json", minimized, min_res)
+    confirm_res, _ = replay(repro, workdir / "confirm",
+                            dump_dir=workdir / "confirm" / "flightrec")
+    out.update(minimized=minimized, repro=repro,
+               confirm_result=confirm_res,
+               confirmed=bool(confirm_res.violations))
+    return out
+
+
+__all__ = ["CASCADE_KINDS", "Clock", "CrucibleResult", "CrucibleRig",
+           "EVENT_KINDS", "FaultEvent", "REPRO_FORMAT", "Schedule",
+           "default_schedule", "investigate", "minimize", "replay",
+           "run_soak", "write_repro"]

@@ -157,6 +157,20 @@ class FaultPlan:
         return {"seed": self.seed,
                 "rules": [r.to_json() for r in self.rules]}
 
+    def arm(self, *rules: FaultRule) -> None:
+        """Append rules to a LIVE plan, serialized with ``decide``.
+
+        The crucible (cluster/crucible.py) schedules faults against
+        windows it can only observe at runtime (a gang mid-REFORM, a
+        KV handoff in flight), so rules must be armable after the
+        plan is already wired into the stack.  Appending keeps every
+        existing rule's ``seen`` counter untouched — determinism is
+        now a function of (seed, rules, ARM points, call sequence),
+        which the crucible's schedule replay reproduces exactly.
+        """
+        with self._lock:
+            self.rules.extend(rules)
+
     # -- the decision point ----------------------------------------------
 
     def decide(self, verb: str, kind: str = "",
@@ -329,6 +343,7 @@ class ScriptedChipHealth:
 # Named crash points the tree currently exposes (callers pass free-form
 # names; these constants keep tests and call sites in sync).
 CRASH_CHECKPOINT_TMP_WRITTEN = "checkpoint.tmp-written"
+CRASH_CHECKPOINT_ROTATED = "checkpoint.rotated"
 CRASH_CHECKPOINT_SAVED = "checkpoint.saved"
 
 FAULT_PLAN_ENV = "TPU_DRA_FAULT_PLAN"

@@ -101,11 +101,21 @@ class FlightRecorder:
     def record(self, reason: str) -> dict:
         """Store a dump for ``reason`` (or coalesce into the previous
         one when the window has barely moved).  Returns the dump the
-        reason landed in."""
+        reason landed in.
+
+        Coalescing is SAME-KIND only: a preemption cascade is one
+        incident and its repeated ``preempt`` marks annotate one
+        dump, but a mark of a DIFFERENT kind arriving inside the
+        window is a second incident overlapping the first (a drain
+        landing mid-cascade, an SLO shed during an eviction — the
+        compound faults the crucible composes) and always forces a
+        fresh dump, so neither incident's evidence is buried in the
+        other's annotation list."""
         self.marks.append({"t": self.tracer.clock(),
                            "reason": reason})
         fresh = self.tracer.emitted_total - self._dumped_at
-        if self.dumps and fresh < self.min_new_spans:
+        if (self.dumps and fresh < self.min_new_spans
+                and reason in self.dumps[-1]["reasons"]):
             self.dumps[-1]["reasons"].append(reason)
             return self.dumps[-1]
         d = self.build(reason)

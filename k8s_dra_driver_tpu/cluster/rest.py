@@ -531,6 +531,9 @@ class RestClusterClient(ClusterClient):
 
     def _request_once(self, method: str, url: str, body: dict | None,
                       stream: bool, timeout: float | None):
+        # deadline: TokenBucket.acquire self-bounds every sleep to
+        # one token interval (utils/flags.py:157-167) and returns
+        # immediately when qps<=0 — bounded by rate, not wall time.
         self.limiter.acquire()
         data = json.dumps(body).encode() if body is not None else None
         req = urllib.request.Request(url, data=data, method=method)

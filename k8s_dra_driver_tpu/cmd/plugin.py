@@ -242,6 +242,8 @@ def run(args: argparse.Namespace, client=None, backend=None,
     if ready_event is not None:
         ready_event.set()
     try:
+        # deadline: process-lifetime wait; SIGTERM/SIGINT set the
+        # event (the reference blocks the same way, main.go run()).
         stop.wait()
     finally:
         log.info("shutting down")

@@ -97,6 +97,8 @@ class TimeshareGate:
         """
         end = time.time() + duration_s if duration_s else None
         while end is None or time.time() < end:
+            # deadline: waiting for our flock turn is the gate's
+            # contract; the holder's quantum bounds it in practice.
             self.acquire()
             try:
                 yield time.time() + self.quantum_ms / 1000.0
@@ -164,7 +166,7 @@ def _teardown(proc: subprocess.Popen) -> None:
                 os.killpg(proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 proc.kill()
-            proc.wait()
+            proc.wait()  # deadline: post-SIGKILL reap cannot hang
 
 
 def _run_coordinated(args, cmd: list[str]) -> int:
@@ -206,6 +208,8 @@ def _run_timeshared(gate: TimeshareGate, cmd: list[str]) -> int:
     child.allow(False)
     try:
         while proc.poll() is None:
+            # deadline: turn-taking is the point; peers' quanta
+            # bound the wait, and a dead peer drops its flock.
             gate.acquire()
             try:
                 child.allow(True)

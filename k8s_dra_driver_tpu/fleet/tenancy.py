@@ -54,7 +54,8 @@ from ..utils.metrics import FleetMetrics
 from .binpack import TopologyBinPacker
 from .policy import DemandSignals, Streaks, is_calm, pressured
 from .reconciler import read_demand
-from .supply import ChipLedger, serving_tag, training_tag
+from .supply import (ChipLedger, owner_tenant, serving_tag,
+                     training_tag)
 
 log = logging.getLogger(__name__)
 
@@ -442,14 +443,27 @@ class MultiTenantReconciler:
         applied: list[str] = []
         # 1. observe: health first, then forward heals to EVERY
         #    gang's exclusion set exactly once (readmit is a no-op
-        #    for chips a gang never lost)
+        #    for chips a gang never lost).  A heal landing MID-
+        #    CASCADE is the double-fault trap: a healed chip the
+        #    arbiter has since granted to another tenant must not
+        #    rejoin a gang's buildable set just because its health
+        #    came back — readmit clears the HEALTH fence (dead set),
+        #    so foreign-owned chips are simultaneously added to the
+        #    PLACEMENT fence, which the next arbiter-issued resize
+        #    replaces wholesale once ownership genuinely moves.
         self.ledger.observe_health()
         healed = self.ledger.take_healed()
         if healed:
             for spec in self.registry:
                 w = self.registry.workload(spec.name)
-                if w.kind == TRAINING:
-                    w.supervisor.readmit(healed)
+                if w.kind != TRAINING:
+                    continue
+                foreign = {c for c in healed
+                           if (owner_tenant(self.ledger.owners.get(c))
+                               or spec.name) != spec.name}
+                if foreign:
+                    w.supervisor.update_fence(add=foreign)
+                w.supervisor.readmit(healed)
             self._event(now, "readmit", chips=sorted(healed))
         # 2. lifecycle housekeeping per serving pool (fleet mode:
         #    auto_replace off, replacement is an allocation decision)
@@ -494,6 +508,15 @@ class MultiTenantReconciler:
         w = self.registry.workload(a.tenant)
         if a.kind == GRANT:
             self.ledger.claim(a.chip, serving_tag(a.tenant, "pending"))
+            # fence the chip out of every gang IMMEDIATELY: a gang
+            # recovery re-forming this very cycle rebuilds from the
+            # unfenced device set, and the granted chip is no longer
+            # in it — the next packer-chosen resize replaces the
+            # fence wholesale when ownership moves again
+            for spec in self.registry:
+                other = self.registry.workload(spec.name)
+                if other.kind == TRAINING:
+                    other.supervisor.update_fence(add=[a.chip])
             fresh = w.manager.add_replica(chip=a.chip)
             self._mt_event(now, a, replica=fresh.name, chip=a.chip)
             log.info("mt: grant %s -> chip %d (%s)", a.tenant, a.chip,

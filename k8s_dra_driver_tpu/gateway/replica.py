@@ -230,6 +230,8 @@ class ReplicaManager:
         name = f"r{next(self._gen)}"
         lease = self.lease_factory(name) if self.lease_factory else None
         if lease is not None:
+            # deadline: lease protocol is caller-owned; the factory
+            # decides blocking semantics (tests use instant fakes).
             lease.acquire()
         replica = EngineReplica(
             name, self.engine_factory(name),

@@ -26,6 +26,8 @@ from typing import Any
 import jax
 import orbax.checkpoint as ocp
 
+from ..utils import atomicio
+
 log = logging.getLogger(__name__)
 
 
@@ -51,6 +53,11 @@ class TrainCheckpointer:
             extra=ocp.args.JsonSave(extra or {})))
         if wait:
             self._mgr.wait_until_finished()
+            # orbax commits the generation with a tmp-dir rename but
+            # leaves the parent directory unsynced; without this a
+            # power loss can drop the rename AND keep the data blocks,
+            # tearing the newest generation out of latest_step()
+            atomicio.fsync_dir(self.directory)
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()

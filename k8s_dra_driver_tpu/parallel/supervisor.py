@@ -87,7 +87,7 @@ from pathlib import Path
 from typing import Callable
 
 from ..cluster import faults
-from ..utils import watchdog
+from ..utils import atomicio, watchdog
 from ..utils.metrics import RecoveryMetrics
 from ..utils.watchdog import (HeartbeatMonitor, WatchdogTimeout,
                               WorkerHeartbeat, run_with_deadline)
@@ -275,6 +275,12 @@ class GangSupervisor:
         self._placement_excluded: set = set(
             int(c) for c in placement_exclude)
         self._unhealthy: dict = {}
+        # last merged (push + poll) unhealthy view, refreshed by
+        # _poll_down and consumed by _form: a chip that is down RIGHT
+        # NOW must not join a formation even if no current worker
+        # owns it (the chip-death-mid-REFORM double fault: the victim
+        # set was counted before the second chip died)
+        self._last_unhealthy: dict = {}
         self._unhealthy_lock = threading.Lock()
         # externally requested operation (request_width / park),
         # consumed at the next step boundary by step_once.  A single
@@ -362,6 +368,22 @@ class GangSupervisor:
             self._dead_chips -= chips
             for c in chips:
                 self._unhealthy.pop(c, None)
+                self._last_unhealthy.pop(c, None)
+
+    def update_fence(self, add=(), discard=()) -> None:
+        """Incremental placement-fence maintenance between resizes.
+
+        ``request_width(exclude=...)`` REPLACES the fence wholesale
+        (the packer chose a run); this verb lets the arbiter keep the
+        fence truthful BETWEEN formations — e.g. a chip just granted
+        to a serving tenant must stop being buildable for every gang
+        immediately, or a heal landing mid-cascade hands the gang a
+        chip someone else now owns (the heal-mid-preemption double
+        fault).  Thread-safe; takes effect at the next formation and
+        never triggers one."""
+        with self._unhealthy_lock:
+            self._placement_excluded |= {int(c) for c in add}
+            self._placement_excluded -= {int(c) for c in discard}
 
     def _poll_down(self):
         """(victims, cause) from push/poll health plus tombstones an
@@ -377,6 +399,7 @@ class GangSupervisor:
                 # plugin/health.py contract: a failed probe keeps the
                 # last observed state
                 log.exception("health source failed; keeping last")
+        self._last_unhealthy = dict(unhealthy)
         victims, cause = [], None
         for w in self.workers:
             if not w.alive:
@@ -396,12 +419,24 @@ class GangSupervisor:
         the mesh/step program up over the surviving chips.  The build
         runs BEFORE any state mutates, so a failed formation (not
         enough healthy devices) leaves the current gang intact — the
-        property the apply-time resize fallback relies on."""
+        property the apply-time resize fallback relies on.
+
+        The exclusion set folds in the last observed unhealthy view
+        (push + poll), not just the dead and fenced chips: a chip can
+        go down AFTER the victim set was counted (chip death mid-
+        REFORM/EXPAND, the classic double fault) or while the gang is
+        PARKED with nobody polling, and forming over it would only
+        buy an immediate second eviction — or a formation on a chip
+        another tenant's replica is actively using."""
         import numpy as np
 
+        with self._unhealthy_lock:
+            down = (set(self._last_unhealthy)
+                    | set(self._unhealthy)) - set(self._dead_chips)
         mesh, step_fn, init_state = self.job.build(
             dp, exclude_chips=frozenset(self._dead_chips
-                                        | self._placement_excluded))
+                                        | self._placement_excluded
+                                        | down))
         self.dp = dp
         self.mesh, self.step_fn, self.init_state = (mesh, step_fn,
                                                     init_state)
@@ -422,8 +457,11 @@ class GangSupervisor:
             "excluded_chips": sorted(self._dead_chips),
             "placement_excluded": sorted(self._placement_excluded),
         }
-        (self.dir / CONTRACT_FILENAME).write_text(
-            json.dumps(self.contract, indent=1))
+        # the contract is the checkpoint's manifest: restore reads it
+        # to find the generation, so it gets the same tmp+fsync+rename
+        # discipline as the generations themselves
+        atomicio.write_atomic(self.dir / CONTRACT_FILENAME,
+                              json.dumps(self.contract, indent=1))
         self._gen += 1
         self._formation_steps = 0
         self.metrics.dp_width.set(dp)
@@ -497,16 +535,20 @@ class GangSupervisor:
                 victims.append(w)
         return victims, cause
 
+    def _fit_dp(self, max_dp: int) -> int:
+        """Largest power-of-two dp width ``<= max_dp`` that divides
+        the global batch; 0 when nothing fits."""
+        dp = 1
+        while dp * 2 <= max_dp and self.job.batch % (dp * 2) == 0:
+            dp *= 2
+        if max_dp < 1 or self.job.batch % dp:
+            return 0
+        return dp
+
     def _shrunk_dp(self, n_victims: int) -> int:
         """Largest power-of-two dp width that fits the survivors and
         divides the global batch; 0 when nothing fits."""
-        dp = 1
-        while (dp * 2 <= self.dp - n_victims
-               and self.job.batch % (dp * 2) == 0):
-            dp *= 2
-        if self.dp - n_victims < 1 or self.job.batch % dp:
-            return 0
-        return dp
+        return self._fit_dp(self.dp - n_victims)
 
     def _transition(self, state: str) -> None:
         prev = self.state
@@ -556,7 +598,26 @@ class GangSupervisor:
                 f"no dp width that divides batch {self.job.batch}")
         from_dp = self.dp
         self._transition(REFORM)
-        self._form(new_dp)
+        while True:
+            try:
+                self._form(new_dp)
+                break
+            except SupervisorError as e:
+                # a second fault landed mid-REFORM: the buildable set
+                # shrank after the victims were counted (a chip died
+                # between eviction and build).  Shrink to the next
+                # width that fits what actually survives instead of
+                # letting the recovery itself die.
+                smaller = self._fit_dp(new_dp - 1)
+                log.warning("reform at dp=%d infeasible (%s); "
+                            "retrying at dp=%d", new_dp, e, smaller)
+                if smaller < 1:
+                    self._transition(FAILED)
+                    raise SupervisorError(
+                        f"gang unrecoverable: no dp width survives "
+                        f"the compound fault (last tried {new_dp})"
+                    ) from e
+                new_dp = smaller
         self._transition(RESUME)
         params, opt = self.init_state(self._key())
         self.params, self.opt, at = self.ckpt.restore(params, opt)
@@ -590,6 +651,13 @@ class GangSupervisor:
         parked = self.state == PARKED
         cause = "expand" if (parked or target > self.dp) else "preempt"
         t0 = time.perf_counter()
+        # refresh the health view before forming: the op slot is
+        # consumed BEFORE this cycle's down-poll, and a PARKED gang
+        # has not polled since it parked — without this, an unpark
+        # resize forms over a chip that died while the request was
+        # queued (the resize-while-PARKED double fault) and buys an
+        # immediate second eviction instead of staying parked
+        self._poll_down()
         if not parked:
             self.ckpt.save(self._step, self.params, self.opt,
                            extra=self.loader.state_dict())
@@ -655,8 +723,11 @@ class GangSupervisor:
             "excluded_chips": sorted(self._dead_chips),
             "placement_excluded": sorted(self._placement_excluded),
         }
-        (self.dir / CONTRACT_FILENAME).write_text(
-            json.dumps(self.contract, indent=1))
+        # the contract is the checkpoint's manifest: restore reads it
+        # to find the generation, so it gets the same tmp+fsync+rename
+        # discipline as the generations themselves
+        atomicio.write_atomic(self.dir / CONTRACT_FILENAME,
+                              json.dumps(self.contract, indent=1))
         self._gen += 1
         self.metrics.dp_width.set(0)
         self.recoveries.append(Recovery(

@@ -26,6 +26,7 @@ from pathlib import Path
 
 from ..cluster import faults
 from ..devicemodel import PreparedClaim
+from ..utils import atomicio
 
 log = logging.getLogger(__name__)
 
@@ -80,12 +81,18 @@ class CheckpointManager:
                                       for uid, pc in sorted(prepared.items())}}
         data = {"checksum": _checksum(payload), "v1": payload}
         tmp = self.path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(data, indent=1, sort_keys=True))
+        # fsync'd tmp write: without it the final rename can be
+        # durably ordered before the data blocks, tearing BOTH
+        # generations at once after power loss
+        atomicio.write_durable(tmp, json.dumps(data, indent=1,
+                                               sort_keys=True))
         faults.crashpoint(faults.CRASH_CHECKPOINT_TMP_WRITTEN)
         # rotate current -> .prev, then tmp -> current: a crash between
         # the two renames leaves no checkpoint.json, and load() falls
         # back to the .prev generation
         if self.path.exists():
             os.replace(self.path, self.prev_path)
+        faults.crashpoint(faults.CRASH_CHECKPOINT_ROTATED)
         os.replace(tmp, self.path)
+        atomicio.fsync_dir(self.path.parent)
         faults.crashpoint(faults.CRASH_CHECKPOINT_SAVED)

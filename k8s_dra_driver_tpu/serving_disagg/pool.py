@@ -174,6 +174,9 @@ class DisaggReplicaManager(ReplicaManager):
         self.dest_device_of = dest_device_of or (lambda replica: None)
         self.max_exports_per_step = max_exports_per_step
         self.prefill_depth_bound = prefill_depth_bound
+        # handoffs aborted mid-move (target died / slot race): each
+        # left the block safely with its prefill replica for retry
+        self.handoff_failures = 0
         super().__init__(engine_factory, replicas=0, **kw)
         self.default_scale_role = ROLE_DECODE
         for _ in range(prefill_replicas):
@@ -187,6 +190,8 @@ class DisaggReplicaManager(ReplicaManager):
         name = f"{role[0]}{next(self._gen)}"
         lease = self.lease_factory(name) if self.lease_factory else None
         if lease is not None:
+            # deadline: lease protocol is caller-owned; the factory
+            # decides blocking semantics (tests use instant fakes).
             lease.acquire()
         if role == ROLE_PREFILL:
             replica = PrefillReplica(
@@ -214,7 +219,18 @@ class DisaggReplicaManager(ReplicaManager):
         genuinely free slot (free slots minus its own queued fills —
         those will claim slots first); returns the target or None.
         The KV rides the migrator: fresh buffers on the target's
-        devices, zero recompute."""
+        devices, zero recompute.
+
+        FAILURE-ATOMIC: the move is transfer + adopt, and a fault can
+        land between them (the target drained this very cycle, a slot
+        race, a migrator error — the drain-mid-handoff double fault).
+        Any failure before the adopt COMPLETES returns None: the
+        block stays with the prefill replica, exactly as if no slot
+        had been free, and is retried next cycle — or dies with its
+        replica and rides the standard drain-requeue path.  The
+        caller only moves the gateway record after a non-None return,
+        so the request is never in two in-flight maps and never in
+        none."""
         best, best_key = None, None
         for r in self.replicas:
             if r.role != ROLE_DECODE or not r.ready:
@@ -228,9 +244,13 @@ class DisaggReplicaManager(ReplicaManager):
         if best is None:
             return None
         t0 = self.tracer.clock() if self.tracer is not None else 0.0
-        moved = self.migrator.migrate_block(
-            block, self.dest_device_of(best))
-        best.engine.adopt_block(moved)
+        try:
+            moved = self.migrator.migrate_block(
+                block, self.dest_device_of(best))
+            best.engine.adopt_block(moved)
+        except Exception:
+            self.handoff_failures += 1
+            return None
         if self.tracer is not None:
             # the migrate span covers transfer + adopt — the whole
             # prefill→decode handoff the request waited on; bytes

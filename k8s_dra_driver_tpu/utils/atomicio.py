@@ -1,0 +1,54 @@
+"""Durable small-file writes shared by every checkpoint tier.
+
+The reference's checkpointmanager delegates durability to the kubelet
+filestore (checkpoint.go:9-53 never touches fsync itself); this port
+writes its records with plain files, so the write discipline lives
+here: tmp file in the same directory, fsync the data, ``os.replace``
+over the target, fsync the parent directory.  Without the two fsyncs
+a crash can tear BOTH generations at once — the rename is metadata
+and may be durably ordered *before* the tmp file's data blocks, so
+after power loss ``checkpoint.json`` is garbage while ``.prev`` was
+already rotated away.
+
+Used by plugin/checkpoint.py (prepared-claims record),
+parallel/supervisor.py (the gang contract manifest), and
+models/checkpoint.py (committing orbax generation renames).
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+def fsync_dir(path) -> None:
+    """fsync a DIRECTORY so a completed rename inside it survives
+    power loss (POSIX orders the rename's metadata only when the
+    parent directory itself is synced)."""
+    fd = os.open(str(path), os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def write_durable(path, text: str) -> None:
+    """Write ``text`` to ``path`` and fsync the data (no rename —
+    callers that need a crashpoint between write and commit do their
+    own ``os.replace``)."""
+    with open(path, "w") as f:
+        f.write(text)
+        f.flush()
+        os.fsync(f.fileno())
+
+
+def write_atomic(path, text: str) -> None:
+    """The full discipline in one call: sibling tmp + fsync +
+    ``os.replace`` + parent-directory fsync.  After return the new
+    content is durable; a crash at any interior point leaves the old
+    content intact."""
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    write_durable(tmp, text)
+    os.replace(tmp, path)
+    fsync_dir(path.parent)

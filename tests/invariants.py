@@ -1,0 +1,80 @@
+"""Shared chaos-test assertion helpers (ISSUE 12 satellite).
+
+The five ``-m faults`` chaos twins (test_gateway / test_supervisor /
+test_fleet / test_disagg / test_tenancy, plus test_tracing's
+acceptance) each re-stated the same promises inline: exactly-once
+terminal outcomes, byte-equal results vs the single-engine oracle,
+rewind-tolerant loss trajectories.  These wrappers put ONE pytest
+face on the package's own checker set
+(k8s_dra_driver_tpu/cluster/invariants.py) — the same functions the
+compound-fault crucible evaluates every cycle — so tightening an
+invariant lands in one place and the tests and the soak can never
+drift apart on what "survived" means.
+"""
+
+from __future__ import annotations
+
+from k8s_dra_driver_tpu.cluster import invariants as inv
+
+
+def assert_no_violations(violations, label: str = "invariants"):
+    """Fail with EVERY violation in the message, not just the first —
+    a compound fault usually breaks several promises at once and the
+    full list is the debugging artifact."""
+    assert not violations, (
+        f"{label}: {len(violations)} violation(s):\n  "
+        + "\n  ".join(violations))
+
+
+def assert_exactly_once(gw, submitted, status: str = "finished"):
+    """Every submitted request reached exactly one terminal outcome,
+    and (by default) all of them FINISHED — a chaos run that sheds or
+    rejects is a different test's business and must opt in via
+    ``status=None``."""
+    uids = [r.uid for r in submitted]
+    assert_no_violations(inv.exactly_once_terminal(gw, uids),
+                         label="exactly-once")
+    assert len(gw.outcomes) == len(submitted), (
+        f"{len(gw.outcomes)} outcomes for {len(submitted)} submits")
+    if status is not None:
+        off = {u: g.status for u, g in gw.outcomes.items()
+               if g.status != status}
+        assert not off, f"non-{status} outcomes: {off}"
+
+
+def assert_byte_equal(gw, submitted, oracle):
+    """Every request's tokens equal its single-engine oracle bit for
+    bit.  ``oracle`` is either a dict ``uid -> tokens`` (precomputed
+    before the chaos, the test_disagg idiom) or a callable
+    ``(prompt, max_new) -> tokens`` (the test_fleet idiom)."""
+    if callable(oracle):
+        oracles = {r.uid: oracle(r.prompt, r.max_new)
+                   for r in submitted}
+    else:
+        oracles = {r.uid: oracle[r.uid] for r in submitted}
+    assert_no_violations(inv.byte_equal(gw.results, oracles),
+                         label="byte-equal")
+
+
+def assert_losses_exactly_once(sup, label: str = "gang"):
+    """The loss trajectory is contiguous except at declared
+    checkpoint rewinds (the test_tenancy rewind-tolerant pattern,
+    now shared)."""
+    assert_no_violations(
+        inv.losses_exactly_once(sup.losses, sup.recoveries),
+        label=f"losses-exactly-once[{label}]")
+
+
+def assert_requeue_observed(gw):
+    """The fault actually hit in-flight work: at least one terminal
+    request survived a drain (``requeues > 0``).  Guards every chaos
+    twin against a fault that fired before anything was dispatched —
+    a silently-too-early fault makes the whole test vacuous."""
+    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
+    assert requeued, "fault fired before anything was in flight"
+    return requeued
+
+
+__all__ = ["assert_no_violations", "assert_exactly_once",
+           "assert_byte_equal", "assert_losses_exactly_once",
+           "assert_requeue_observed"]

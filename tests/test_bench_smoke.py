@@ -235,6 +235,38 @@ def test_probe_roster_pins_multitenant_scalars():
     assert keys["mt_fairshare_err"] == "fairshare_err"
 
 
+def test_crucible_probe_streams_zero_violations(tmp_path):
+    """The compound-fault crucible probe at the hermetic shape
+    bench.py streams (same kwargs object, so this pins what actually
+    streams): the seeded soak survives every cycle, fires all five
+    fault kinds, lands window-triggered overlaps, and — the scalar
+    the whole subsystem exists for — reports ZERO invariant
+    violations."""
+    from k8s_dra_driver_tpu.cluster.chaosprobe import crucible_probe
+    out = crucible_probe(**bench.CRUCIBLE_KWARGS,
+                         workdir=str(tmp_path))
+    assert out["cru_survived_cycles"] == bench.CRUCIBLE_KWARGS["cycles"]
+    assert out["cru_invariant_violations"] == 0
+    assert out["cru_fault_kinds"] == 5
+    assert out["cru_overlap_hits"] >= 3
+    assert out["cru_compound_mttr_ms"] > 0
+    assert out["cru_finished"] == out["cru_submitted"] > 0
+    assert out["cru_operator_repairs"] == 0
+
+
+def test_probe_roster_pins_crucible_scalars():
+    """Bench-line schema: the crucible's robustness scalars (survived
+    cycles, compound-recovery MTTR, the must-be-zero violation count,
+    overlap hits) are IN the compact line roster."""
+    probes = [p for p, _, _ in bench._PROBE_SCALARS]
+    assert "crucible" in probes
+    keys = {k: f for _, k, f in bench._PROBE_SCALARS}
+    assert keys["cru_survived_cycles"] == "cru_survived_cycles"
+    assert keys["cru_compound_mttr_ms"] == "cru_compound_mttr_ms"
+    assert keys["cru_invariant_violations"] == "cru_invariant_violations"
+    assert keys["cru_overlap_hits"] == "cru_overlap_hits"
+
+
 def test_control_plane_probe_tiny():
     """The control-plane ceiling probe at the hermetic shape bench.py
     pins (TINY_CTL_KWARGS): no-op engines, open-loop trace replay,

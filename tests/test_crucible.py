@@ -1,0 +1,403 @@
+"""Compound-fault crucible acceptance (ISSUE 12).
+
+THE acceptance invariants: (1) a fixed-seed soak of 200+ co-loop
+cycles composes every fault kind with several of them landing INSIDE
+another fault's recovery window, and the always-on checker sweep
+(cluster/invariants.py) stays silent the whole way; (2) each hardened
+double-fault arc — chip-death-mid-REFORM, late down-push mid-REFORM,
+drain-mid-KV-handoff, heal-mid-cascade, resize-while-PARKED — has a
+targeted test that ends exactly-once and byte-equal/lossless;
+(3) a deliberately-broken invariant (test-only monkeypatch) produces
+a ddmin-minimized, replayable repro file that re-fails
+deterministically under replay, with flight-recorder forensics
+alongside.
+
+The soak runs first so its jit compilations warm the process for
+every later rig (they all share the crucible's cached params/config).
+"""
+
+import json
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from invariants import (assert_losses_exactly_once,
+                        assert_no_violations)
+from k8s_dra_driver_tpu.cluster import crucible as cru
+from k8s_dra_driver_tpu.cluster import invariants as inv
+from k8s_dra_driver_tpu.cluster.crucible import (FaultEvent, Schedule,
+                                                 _cfg, _oracle,
+                                                 _params, _prompt)
+from k8s_dra_driver_tpu.cluster.faults import (FaultPlan, FaultRule,
+                                               ScriptedChipHealth)
+
+# The module deliberately injects hangs and chip deaths; a recovery
+# regression must cost seconds, not the tier budget.
+pytestmark = pytest.mark.timeout_s(600)
+
+
+# -- schedule plumbing (no jax) -------------------------------------------
+
+def test_fault_plan_arm_appends_live():
+    """arm() extends a LIVE plan — the crucible's whole injection
+    model — and the armed rule follows normal skip/times windows."""
+    plan = FaultPlan(seed=3)
+    assert plan.decide("health", "Chip", "0") is None
+    plan.arm(FaultRule(verb="health", kind="Chip", name="0",
+                       skip=1, times=1, error="drop"))
+    assert plan.decide("health", "Chip", "0") is None      # skip
+    d = plan.decide("health", "Chip", "0")
+    assert d is not None and d.error == "drop"
+    assert plan.decide("health", "Chip", "0") is None      # exhausted
+
+
+def test_schedule_json_roundtrip_and_fresh():
+    sched = cru.default_schedule(7, cycles=220)
+    back = Schedule.from_json(json.dumps(sched.to_json()))
+    assert back.seed == sched.seed and back.cycles == sched.cycles
+    assert [e.id for e in back.events] == [e.id for e in sched.events]
+    ev = back.events[0]
+    ev.fired_cycle, ev.hit_windows = 9, ("reform:mid",)
+    fr = ev.fresh()
+    assert fr.fired_cycle is None and fr.hit_windows == ()
+    assert fr.id == ev.id and fr.kind == ev.kind
+    with pytest.raises(ValueError):
+        FaultEvent(id="x", kind="nope", at_cycle=1)
+    with pytest.raises(ValueError):
+        FaultEvent(id="x", kind="burst")        # no trigger at all
+
+
+def test_default_schedule_composes_every_kind():
+    sched = cru.default_schedule(7, cycles=220)
+    assert {e.kind for e in sched.events} == set(cru.EVENT_KINDS)
+    # the four targeted double-fault arcs are window-triggered
+    windows = {e.window for e in sched.events if e.window}
+    assert {"reform:mid", "handoff:hi", "cascade",
+            "parked:lo"} <= windows
+    # every chip kill heals — a schedule must hand the board back
+    assert all(e.heal_after for e in sched.events
+               if e.kind == "chip_kill")
+
+
+# -- THE soak -------------------------------------------------------------
+
+@pytest.mark.faults
+def test_compound_soak_zero_violations(tmp_path):
+    """220 co-loop cycles of the default schedule: all five fault
+    kinds fire, at least three land inside another fault's recovery
+    window, and every checker stays silent from warmup to drain."""
+    sched = cru.default_schedule(7, cycles=220)
+    res, rig = cru.run_soak(sched, tmp_path / "soak")
+    assert_no_violations(
+        [f"cycle {c}: {m}" for c, v in res.violations for m in v],
+        label="soak")
+    assert res.cycles >= 220 and res.survived_cycles == res.cycles
+    assert set(res.fault_kinds_fired) == set(cru.EVENT_KINDS)
+    assert res.overlap_hits >= 3
+    assert res.gang_failures == [] and res.operator_repairs == 0
+    # serving: everything admitted finished, byte-equal (checked by
+    # final_violations inside run_soak — finished==submitted pins it)
+    assert res.submitted > 0 and res.finished == res.submitted
+    # training: both gangs actually recovered (MTTR measured) and
+    # their loss trajectories rewound only at declared checkpoints
+    assert res.compound_mttr_ms > 0
+    for name, sup in rig.sups.items():
+        assert sup.recoveries, f"{name}: no recovery exercised"
+        assert_losses_exactly_once(sup, name)
+    # the window-triggered arcs really fired as overlaps
+    by_id = {e.id: e for e in sched.events}
+    for eid in ("mid-chip4-in-reform", "decode-kill-in-handoff",
+                "chip0-in-cascade", "chip1-while-parked"):
+        assert by_id[eid].fired_cycle is not None, f"{eid} never fired"
+        assert by_id[eid].hit_windows, f"{eid} fired outside a window"
+
+
+# -- the hardened double-fault arcs, one targeted test each ---------------
+
+def _sup(tmp_path, *, dp, batch, plan=None, health_source=None,
+         allowed, **kw):
+    from k8s_dra_driver_tpu.models.checkpoint import TrainCheckpointer
+    from k8s_dra_driver_tpu.parallel.supervisor import (
+        ElasticTrainJob, GangSupervisor)
+    motif = np.random.default_rng(0).integers(0, 64, 32)
+    job = ElasticTrainJob(_cfg(), np.tile(motif, 64), batch=batch,
+                          seq_len=16, tp=1)
+    ckpt = TrainCheckpointer(tmp_path / "ckpt")
+    sup = GangSupervisor(
+        job, ckpt, coordination_dir=tmp_path / "coord", dp=dp,
+        fault_plan=plan, health_source=health_source,
+        checkpoint_every=2, step_deadline_s=30.0,
+        first_step_deadline_s=240.0,
+        placement_exclude=[c for c in range(8) if c not in allowed],
+        **kw)
+    return sup, ckpt
+
+
+def _chips(sup):
+    return {c for w in sup.workers if w.alive for c in w.chips}
+
+
+@pytest.mark.faults
+def test_chip_death_mid_reform_excludes_unowned_down_chip(tmp_path):
+    """Double fault #1: a second chip dies in the same health
+    observation that evicts a worker.  The second chip is ALLOWED but
+    not owned by any victim, so pre-hardening the reform could land
+    the replacement straight onto the fresh corpse; now `_form`
+    excludes every currently-down chip, owned or not."""
+    down = {}
+    sup, ckpt = _sup(tmp_path, dp=2, batch=4, allowed=(0, 1, 2),
+                     health_source=lambda: dict(down))
+    sup.begin(12)
+    for _ in range(3):
+        sup.step_once()
+    assert _chips(sup) == {0, 1}
+    down.update({0: "injected dead", 2: "injected dead"})
+    while sup.step_once():
+        pass
+    report = sup.report()
+    ckpt.close()
+    assert sup.state == "running" or sup._step >= 12
+    rec = report.recoveries[-1]
+    assert (rec.from_dp, rec.to_dp) == (2, 1)
+    assert _chips(sup) == {1}, "reform landed on a just-downed chip"
+    assert_losses_exactly_once(sup, "gang")
+
+
+@pytest.mark.faults
+def test_late_down_push_mid_reform_retries_narrower(tmp_path):
+    """Double fault #1b: down-pushes land AFTER victim counting (the
+    async on_health race), so the planned width is infeasible at form
+    time.  `_recover` now retries at the next narrower feasible width
+    instead of dying with max_recoveries budget left."""
+    plan = FaultPlan([FaultRule(verb="gang", kind="Worker",
+                                name="g0w0", skip=3, times=1,
+                                error="crash")])
+    sup, ckpt = _sup(tmp_path, dp=4, batch=8, allowed=(0, 1, 2, 3),
+                     plan=plan)
+    pushed = []
+
+    def late_push(state, info):
+        if state == "evict" and not pushed:
+            pushed.append(True)
+            sup.on_health({1: "late push", 2: "late push"})
+
+    sup.listeners.append(late_push)
+    report = sup.run(10)
+    ckpt.close()
+    assert pushed, "eviction never happened — fault did not fire"
+    rec = report.recoveries[-1]
+    assert rec.from_dp == 4 and rec.to_dp == 1, (
+        "late pushes should force the dp=2 retry down to dp=1")
+    assert _chips(sup) == {3}
+    assert sup.state == "running" or sup._step >= 10
+    assert_losses_exactly_once(sup, "gang")
+
+
+@pytest.mark.faults
+def test_drain_mid_kv_handoff_is_failure_atomic(tmp_path):
+    """Double fault #2: the handoff target fails between KV transfer
+    and adopt (the drain-mid-handoff race, forced deterministically
+    via a once-failing migrator).  The block must stay with the
+    prefill replica and retry — never be half-adopted or lost — and
+    every request still finishes byte-equal to the oracle."""
+    from k8s_dra_driver_tpu.gateway.sharded import ShardedGateway
+    from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
+    from k8s_dra_driver_tpu.serving_disagg import (DisaggReplicaManager,
+                                                   DisaggRouter,
+                                                   KVMigrator)
+
+    class FlakyMigrator(KVMigrator):
+        def __init__(self):
+            super().__init__()
+            self.failures_left = 1
+
+        def migrate_block(self, block, dest):
+            if self.failures_left:
+                self.failures_left -= 1
+                raise RuntimeError("target drained mid-handoff")
+            return super().migrate_block(block, dest)
+
+    mig = FlakyMigrator()
+    mgr = DisaggReplicaManager(
+        lambda name: ServingEngine(_params(), _cfg(), slots=2,
+                                   prefix_cache=2),
+        prefill_replicas=1, decode_replicas=2, migrator=mig,
+        depth_bound=2)
+    gw = ShardedGateway(mgr, pumps=1,
+                        router_factory=lambda: DisaggRouter(mgr.index),
+                        queue_capacity=16)
+    subs = []
+    for i in range(4):
+        req = Request(uid=f"h{i}", prompt=_prompt(50 + i, 4 + i),
+                      max_new=3)
+        gw.submit(req)
+        subs.append((f"h{i}", 50 + i, 4 + i))
+    gw.run_until_idle(400)
+    assert mgr.handoff_failures == 1, (
+        "the injected mid-handoff failure never hit the atomic path")
+    assert_no_violations(
+        inv.exactly_once_terminal(gw, [u for u, _, _ in subs]),
+        label="exactly-once")
+    oracles = {u: _oracle(s, n, 3) for u, s, n in subs}
+    assert_no_violations(inv.byte_equal(gw.results, oracles),
+                         label="byte-equal")
+
+
+@pytest.mark.faults
+def test_heal_mid_cascade_fences_foreign_owned_chip(tmp_path):
+    """Double fault #3: a chip heals while a preemption cascade has
+    granted it to ANOTHER tenant.  The reconciler must readmit the
+    heal (clear health exclusion) but simultaneously placement-fence
+    the chip for every training gang that does not own it — otherwise
+    the original gang's next reform double-owns it."""
+    from k8s_dra_driver_tpu.fleet.binpack import TopologyBinPacker
+    from k8s_dra_driver_tpu.fleet.supply import ChipLedger
+    from k8s_dra_driver_tpu.fleet.tenancy import (
+        MtConfig, MultiTenantReconciler, ServingTenant, TenantRegistry,
+        TenantSpec, TrainingTenant)
+    from k8s_dra_driver_tpu.gateway.sharded import ShardedGateway
+    from k8s_dra_driver_tpu.models.serving import ServingEngine
+
+    plan = FaultPlan(seed=2)
+    ledger = ChipLedger(range(6), health_source=ScriptedChipHealth(
+        plan, chips=range(6)))
+    from k8s_dra_driver_tpu.gateway.replica import ReplicaManager
+    mgr = ReplicaManager(
+        lambda name: ServingEngine(_params(), _cfg(), slots=2),
+        replicas=1, chip_of=lambda name: 4,
+        health_source=ledger.current_unhealthy)
+    gw = ShardedGateway(mgr, pumps=1, queue_capacity=16,
+                        auto_replace=False, tenant="hi")
+    sup, ckpt = _sup(tmp_path, dp=2, batch=4, allowed=(0, 1, 2),
+                     health_source=ledger.current_unhealthy)
+    registry = TenantRegistry(capacity=6)
+    # floor=2: the granted replica is entitlement, not idle excess —
+    # otherwise the arbiter releases it before the heal lands and the
+    # chip is free (not foreign) at readmit time
+    registry.add(TenantSpec("hi", priority=2, quota=4, floor=2),
+                 ServingTenant(gw))
+    registry.add(TenantSpec("lo", priority=1, quota=3, floor=0),
+                 TrainingTenant(sup, target_dp=2))
+    rec = MultiTenantReconciler(
+        registry, ledger=ledger,
+        packer=TopologyBinPacker(ledger, domain_size=2),
+        config=MtConfig())
+    sup.begin(500)
+
+    def tick():
+        rec.tick()
+        sup.step_once()
+        v = inv.check_cycle(
+            supervisors=[("lo", sup)], ledger=ledger,
+            records=[("hi", mgr, None), ("lo", None, sup)],
+            specs=list(registry), events=rec.events)
+        assert_no_violations(v, label="cycle")
+
+    for _ in range(4):
+        tick()
+    assert _chips(sup) == {0, 1}
+    # chip 0 dies; heal arrives 3 polls later — after the "cascade"
+    # has granted it to hi (stood in for deterministically below)
+    plan.arm(FaultRule(verb="health", kind="Chip", name="0", times=1,
+                       error="drop"),
+             FaultRule(verb="health", kind="Chip", name="0", skip=3,
+                       times=1, error="heal"))
+    tick()                                  # eviction + shrink begins
+    mgr.add_replica(chip=0)                 # the cascade's grant
+    for _ in range(8):
+        tick()
+    assert 0 not in _chips(sup)
+    assert 0 in sup._placement_excluded, (
+        "healed-but-foreign chip was readmitted without a fence")
+    # a later reform (second kill) must still avoid the granted chip
+    victim = sorted(_chips(sup))[0]
+    plan.arm(FaultRule(verb="health", kind="Chip", name=str(victim),
+                       times=1, error="drop"))
+    for _ in range(8):
+        tick()
+    assert 0 not in _chips(sup) and _chips(sup), (
+        f"gang reformed onto foreign-owned chip 0: {_chips(sup)}")
+    ckpt.close()
+    assert_losses_exactly_once(sup, "lo")
+
+
+@pytest.mark.faults
+def test_resize_while_parked_polls_health_first(tmp_path):
+    """Double fault #4: a chip dies while its gang is PARKED — parked
+    gangs poll nothing, so pre-hardening the unpark resize formed on
+    the stale (all-healthy) view and landed on the corpse.  `_resize`
+    now polls health first: the infeasible full-width unpark stays
+    PARKED instead of forming, and a feasible narrower one lands only
+    on live chips."""
+    down = {}
+    sup, ckpt = _sup(tmp_path, dp=2, batch=4, allowed=(0, 1),
+                     health_source=lambda: dict(down))
+    sup.begin(12)
+    for _ in range(3):
+        sup.step_once()
+    sup.park()
+    sup.step_once()
+    assert sup.state == "parked"
+    down[0] = "died while parked"           # nobody is polling
+    sup.request_width(2)                    # arbiter unparks blind
+    sup.step_once()
+    assert sup.state == "parked", (
+        "infeasible unpark must stay parked, not form on a dead chip")
+    sup.request_width(1)
+    sup.step_once()
+    assert sup.state == "running" and _chips(sup) == {1}
+    while sup.step_once():
+        pass
+    report = sup.report()
+    ckpt.close()
+    assert [s for s, _ in report.losses] == list(range(1, 13)), (
+        "park/unpark through the chip death must stay lossless")
+    assert_losses_exactly_once(sup, "gang")
+
+
+# -- the violation workflow: minimize -> repro -> replay ------------------
+
+@pytest.mark.faults
+def test_broken_invariant_minimizes_and_replays(tmp_path,
+                                                monkeypatch):
+    """Break a real invariant on purpose (drain victims silently
+    dropped instead of requeued) and run the whole forensic
+    workflow: the soak flags it, ddmin strips the two decoy events,
+    the repro file replays to the same failure, and the confirming
+    replay ships flight-recorder dumps."""
+    from k8s_dra_driver_tpu.gateway.admission import AdmissionQueue
+    monkeypatch.setattr(AdmissionQueue, "requeue",
+                        lambda self, g: None)
+    events = [
+        FaultEvent(id="warm", kind="burst", at_cycle=1, n=4,
+                   prompt_seed=41),
+        FaultEvent(id="kill-decode", kind="replica_kill", at_cycle=3,
+                   replica_glob="d*"),
+        FaultEvent(id="decoy-kill-nothing", kind="replica_kill",
+                   at_cycle=5, replica_glob="zz*"),
+        FaultEvent(id="decoy-burst", kind="burst", at_cycle=6, n=2,
+                   prompt_seed=77),
+    ]
+    sched = Schedule(seed=11, cycles=14, events=events)
+    out = cru.investigate(sched, tmp_path, max_runs=10)
+    assert out["result"].violations, (
+        "dropped requeues must violate conservation/exactly-once")
+    # ddmin: only the fault that needs in-flight work plus the burst
+    # that supplies it survive minimization
+    assert {e.id for e in out["minimized"].events} \
+        == {"warm", "kill-decode"}
+    repro = Path(out["repro"])
+    assert repro.exists()
+    payload = json.loads(repro.read_text())
+    assert payload["format"] == cru.REPRO_FORMAT
+    assert payload["violations"]
+    assert out["confirmed"] is True, "repro did not re-fail on replay"
+    # the confirming replay carried its own forensics
+    dumps = list((tmp_path / "confirm" / "flightrec").glob(
+        "flightrec-*.json"))
+    assert dumps, "confirming replay shipped no flight-recorder dump"
+    # and an untouched stack does NOT fail this schedule
+    monkeypatch.undo()
+    clean, _ = cru.replay(repro, tmp_path / "clean")
+    assert not clean.violations

@@ -1,0 +1,121 @@
+"""Deadlines lint (tools/lint_deadlines.py) in the fast tier.
+
+ISSUE 12 satellite: the crucible proves the fleet survives compound
+faults, but an unbounded ``Event.wait()`` / bare ``lock.acquire()``
+hangs the process in a way no invariant checker can see.  This gate
+makes the rule mechanical: every blocking wait in the package either
+passes a deadline or carries a ``# deadline:`` comment saying why it
+must block unboundedly (process-lifetime waits, post-SIGKILL reaps,
+caller-owned lease protocols).
+"""
+
+import sys
+import textwrap
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+
+import lint_deadlines  # noqa: E402
+
+
+def test_repo_blocking_waits_all_carry_deadlines():
+    """THE gate: no blocking call in k8s_dra_driver_tpu/ lacks both a
+    deadline and a '# deadline:' justification."""
+    problems = lint_deadlines.lint()
+    assert problems == [], "\n".join(problems)
+
+
+def _scratch_repo(tmp_path, body):
+    mod_dir = tmp_path / "k8s_dra_driver_tpu"
+    mod_dir.mkdir(parents=True)
+    (mod_dir / "fake.py").write_text(textwrap.dedent(body))
+    return tmp_path
+
+
+def test_unbounded_event_wait_is_flagged(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        def f(ev):
+            ev.wait()
+    ''')
+    problems = lint_deadlines.lint(repo)
+    assert len(problems) == 1
+    assert ".wait()" in problems[0] and "fake.py:3" in problems[0]
+
+
+def test_wait_with_timeout_passes(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        def f(ev, proc):
+            ev.wait(0.2)
+            proc.wait(timeout=5.0)
+    ''')
+    assert lint_deadlines.lint(repo) == []
+
+
+def test_zero_arg_join_flagged_str_join_not(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        def f(thread, parts):
+            thread.join()
+            return ", ".join(parts)
+    ''')
+    problems = lint_deadlines.lint(repo)
+    assert len(problems) == 1 and ".join()" in problems[0]
+
+
+def test_bare_acquire_flagged_bounded_forms_pass(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        def f(lock):
+            lock.acquire()
+            lock.acquire(timeout=1.0)
+            lock.acquire(blocking=False)
+            lock.acquire(True, 1.0)
+    ''')
+    problems = lint_deadlines.lint(repo)
+    assert len(problems) == 1 and "fake.py:3" in problems[0]
+
+
+def test_zero_arg_queue_get_flagged_dict_get_not(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        def f(q, d):
+            q.get()
+            q.get(timeout=0.5)
+            return d.get("key")
+    ''')
+    problems = lint_deadlines.lint(repo)
+    assert len(problems) == 1 and "fake.py:3" in problems[0]
+
+
+def test_subprocess_without_timeout_flagged(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        import subprocess
+        def f(proc):
+            subprocess.run(["ls"])
+            subprocess.run(["ls"], timeout=5)
+            proc.communicate()
+            proc.communicate(timeout=5)
+    ''')
+    problems = lint_deadlines.lint(repo)
+    assert len(problems) == 2
+    assert "subprocess.run" in problems[0]
+    assert ".communicate" in problems[1]
+
+
+def test_deadline_comment_exempts(tmp_path):
+    """Inline on a call line, or in the comment block directly above
+    the call — both repo idioms exempt the site."""
+    repo = _scratch_repo(tmp_path, '''
+        def f(ev, lock):
+            ev.wait()  # deadline: process-lifetime wait by design
+            # deadline: turn-taking gate; peers' quanta bound this
+            lock.acquire()
+    ''')
+    assert lint_deadlines.lint(repo) == []
+
+
+def test_unrelated_comment_above_does_not_exempt(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        def f(ev):
+            # take the barrier
+            ev.wait()
+    ''')
+    problems = lint_deadlines.lint(repo)
+    assert len(problems) == 1

@@ -36,6 +36,9 @@ from k8s_dra_driver_tpu.serving_disagg import (DisaggReplicaManager,
                                                PrefillReplica)
 from k8s_dra_driver_tpu.utils import dispatch
 
+from invariants import (assert_byte_equal, assert_exactly_once,
+                        assert_requeue_observed)
+
 # Stall guard (tests/conftest.py, the gateway/supervisor precedent):
 # the chaos twin deliberately kills a replica mid-transfer — a
 # regression that turns the drain into a hang must fail in seconds,
@@ -315,13 +318,9 @@ def test_two_role_pool_exactly_once_byte_equal_zero_decode_prefill():
         done.extend(gw.step())
     done.extend(gw.run_until_idle())
 
-    assert len(gw.outcomes) == len(submitted)
+    assert_exactly_once(gw, submitted)
     assert {g.uid for g in done} == {r.uid for r in submitted}
-    assert all(g.status == "finished" for g in gw.outcomes.values())
-    for req in submitted:
-        np.testing.assert_array_equal(
-            gw.results[req.uid].tokens, oracles[req.uid],
-            err_msg=f"{req.uid} diverged from the oracle")
+    assert_byte_equal(gw, submitted, oracles)
     # every request's KV moved prefill->decode exactly once
     assert mgr.migration_stats()["migrations"] == len(submitted)
     # the role split held: decode replicas launched NO prefill
@@ -373,17 +372,12 @@ def test_prefill_replica_killed_mid_transfer_falls_back_local():
         gw.step()
     gw.run_until_idle()
 
-    assert len(gw.outcomes) == len(submitted)
-    assert all(g.status == "finished" for g in gw.outcomes.values())
-    for req in submitted:
-        np.testing.assert_array_equal(
-            gw.results[req.uid].tokens, oracles[req.uid],
-            err_msg=f"{req.uid} diverged through the kill")
+    assert_exactly_once(gw, submitted)
+    assert_byte_equal(gw, submitted, oracles)
     st = gw.stats()
     assert st["replicas"]["dead"] == 1
     assert st["replicas"]["roles"] == {ROLE_DECODE: 2}
-    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
-    assert requeued, "fault fired before anything was in flight"
+    assert_requeue_observed(gw)
     text = gw.metrics.render().decode()
     assert re.search(r"tpu_gateway_drains_total 1\.0", text)
     # the fallback actually happened: decode replicas prefilled

@@ -648,6 +648,48 @@ class TestCheckpointRecovery:
         assert prepared.devices[0].device_name == "chip-0"
         state2.unprepare(claim.metadata.uid)
 
+    def test_crashpoint_mid_rename_tears_and_recovers(self, tmp_path):
+        """The torn state INJECTED, not hand-simulated: a subprocess
+        arms the new ``checkpoint.rotated`` crashpoint and dies by
+        ``os._exit`` between the two renames — after the current file
+        rotated to ``.prev``, before the fsync'd tmp landed.  The
+        survivor directory has no checkpoint.json, and a fresh manager
+        recovers the previous generation."""
+        import subprocess
+        import sys
+        import textwrap
+        from k8s_dra_driver_tpu.cluster import faults as f
+        child = textwrap.dedent(f"""
+            import sys
+            from k8s_dra_driver_tpu.cluster import faults
+            from k8s_dra_driver_tpu.cluster.faults import (FaultPlan,
+                                                           FaultRule)
+            from k8s_dra_driver_tpu.devicemodel import PreparedClaim
+            from k8s_dra_driver_tpu.plugin import CheckpointManager
+            mgr = CheckpointManager(sys.argv[1])
+            mgr.save({{"u1": PreparedClaim(
+                claim_uid="u1", claim_namespace="d",
+                claim_name="claim-u1")}})
+            faults.install_process_plan(FaultPlan([FaultRule(
+                verb={f.CRASH_CHECKPOINT_ROTATED!r}, times=1,
+                error="crash")]))
+            mgr.save({{"u1": PreparedClaim(
+                claim_uid="u1", claim_namespace="d",
+                claim_name="claim-u1"),
+                "u2": PreparedClaim(
+                claim_uid="u2", claim_namespace="d",
+                claim_name="claim-u2")}})
+            raise SystemExit("crashpoint never fired")
+        """)
+        proc = subprocess.run(
+            [sys.executable, "-c", child, str(tmp_path)],
+            capture_output=True, text=True, timeout=120)
+        assert proc.returncode == f.CRASH_EXIT_CODE, proc.stderr
+        mgr = CheckpointManager(str(tmp_path))
+        assert not mgr.path.exists(), "rename half-done, yet current"
+        assert mgr.prev_path.exists()
+        assert set(mgr.load()) == {"u1"}        # previous generation
+
 
 # --------------------------------------------------------------------------
 # scripted chip health: the down/heal up-signal twin (fleet satellite)

@@ -33,6 +33,9 @@ from k8s_dra_driver_tpu.models import (TransformerConfig,
                                        greedy_generate, init_params)
 from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
 
+from invariants import (assert_byte_equal, assert_exactly_once,
+                        assert_requeue_observed)
+
 pytestmark = pytest.mark.timeout_s(300)
 
 CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
@@ -592,18 +595,13 @@ def test_chaos_kill_burst_preempt_then_heal_regrow(tmp_path):
     # dead replica reaped by the reconciler, not auto-replaced
     text = gw.metrics.render().decode()
     assert "tpu_gateway_drains_total 1.0" in text
-    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
-    assert requeued, "fault fired before anything was in flight"
+    assert_requeue_observed(gw)
     assert any(k == "reap_dead" for _, k, _ in rec.events)
 
-    # exactly-once, byte-equal: every request finished once, tokens
-    # equal the single-engine oracle through kill/requeue/preempt
-    assert len(gw.outcomes) == len(reqs)
-    for r in reqs:
-        assert gw.outcomes[r.uid].status == "finished"
-        np.testing.assert_array_equal(
-            gw.results[r.uid].tokens, oracle(r.prompt, r.max_new),
-            err_msg=f"{r.uid} diverged from the oracle")
+    # exactly-once, byte-equal through kill/requeue/preempt (shared
+    # checkers — the same ones the crucible runs every cycle)
+    assert_exactly_once(gw, reqs)
+    assert_byte_equal(gw, reqs, oracle)
 
     # arbitration: preempt 2→1 while chip 4 was down, EXPAND back to
     # 2 after the scripted heal freed supply again

@@ -31,6 +31,9 @@ from k8s_dra_driver_tpu.gateway.admission import (AdmissionError,
 from k8s_dra_driver_tpu.models import (TransformerConfig,
                                        greedy_generate, init_params)
 from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
+
+from invariants import (assert_byte_equal, assert_exactly_once,
+                        assert_requeue_observed)
 from k8s_dra_driver_tpu.utils import dispatch
 
 # Stall guard (tests/conftest.py): drain/requeue tests exercise
@@ -283,22 +286,16 @@ def test_kill_replica_mid_stream_exactly_once_byte_equal():
         done.extend(gw.step())
     done.extend(gw.run_until_idle())
 
-    # exactly once: every admitted uid has ONE terminal record
-    assert len(gw.outcomes) == len(submitted)
+    # exactly once + byte-equal through the kill (shared checkers —
+    # the same ones the crucible runs every cycle)
+    assert_exactly_once(gw, submitted)
     assert {g.uid for g in done} == {r.uid for r in submitted}
-    assert all(g.status == "finished" for g in gw.outcomes.values())
-    # byte-equal to the single-engine oracle, through the kill
-    for req in submitted:
-        np.testing.assert_array_equal(
-            gw.results[req.uid].tokens,
-            oracle(req.prompt, req.max_new),
-            err_msg=f"{req.uid} diverged from the oracle")
+    assert_byte_equal(gw, submitted, oracle)
     # the kill actually happened and is observable
     st = gw.stats()
     assert st["replicas"]["dead"] == 1
     assert st["replicas"]["ready"] == 2          # replacement arrived
-    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
-    assert requeued, "fault fired before anything was in flight"
+    requeued = assert_requeue_observed(gw)
     text = gw.metrics.render().decode()
     assert re.search(r"tpu_gateway_drains_total 1\.0", text)
     m = re.search(r"tpu_gateway_requeued_total (\d+)\.0", text)

@@ -25,6 +25,7 @@ from pathlib import Path
 
 import numpy as np
 import pytest
+from invariants import assert_losses_exactly_once
 
 from k8s_dra_driver_tpu.cluster.faults import FaultPlan, FaultRule
 from k8s_dra_driver_tpu.utils import watchdog
@@ -137,10 +138,10 @@ def test_elastic_resume_after_worker_kill(tmp_path):
 
     # every step completed exactly once; the trajectory CONTINUES —
     # it ends below the best loss the gang reached before the kill
+    assert_losses_exactly_once(report)
     steps = [s for s, _ in report.losses]
     assert steps == list(range(1, 9))
     losses = [l for _, l in report.losses]
-    assert np.isfinite(losses).all()
     assert losses[-1] < min(losses[:4])
 
     # the reformed contract was re-issued at the smaller world size,
@@ -309,6 +310,7 @@ import os
 import jax
 jax.config.update('jax_platforms', 'cpu')
 from k8s_dra_driver_tpu.parallel import rendezvous as r
+
 spec = r.RendezvousSpec(coordinator_address='127.0.0.1:{port}',
                         worker_id=0, num_workers=2,
                         barrier_timeout_s=2)
@@ -468,9 +470,8 @@ def test_external_resize_preempt_then_expand(tmp_path):
     assert [(r.from_dp, r.to_dp) for r in report.recoveries] \
         == [(4, 2), (2, 4)]
     assert all(r.steps_lost == 0 for r in report.recoveries)
+    assert_losses_exactly_once(report)
     assert [s for s, _ in report.losses] == list(range(1, 9))
-    losses = [l for _, l in report.losses]
-    assert np.isfinite(losses).all()
     reg = sup.metrics.registry
     assert reg.get_sample_value("tpu_train_restarts_total",
                                 {"cause": "preempt"}) == 1
@@ -540,8 +541,7 @@ def test_concurrent_resize_queues_and_coalesces(tmp_path):
     ckpt.close()
     # controlled resizes throughout: zero steps lost, exactly-once
     assert all(r.steps_lost == 0 for r in report.recoveries)
-    steps = [s for s, _ in report.losses]
-    assert steps == list(range(1, len(steps) + 1))
+    assert_losses_exactly_once(report)
 
 
 def test_park_releases_chips_and_unparks_losslessly(tmp_path):
@@ -582,6 +582,7 @@ def test_park_releases_chips_and_unparks_losslessly(tmp_path):
     assert [(r.from_dp, r.to_dp) for r in report.recoveries] \
         == [(2, 0), (0, 2)]
     assert all(r.steps_lost == 0 for r in report.recoveries)
+    assert_losses_exactly_once(report)
     steps = [s for s, _ in report.losses]
     assert steps == list(range(1, 11))       # lossless through the gap
 

@@ -34,6 +34,9 @@ from k8s_dra_driver_tpu.models import (TransformerConfig,
                                        greedy_generate, init_params)
 from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
 
+from invariants import (assert_byte_equal, assert_exactly_once,
+                        assert_losses_exactly_once)
+
 pytestmark = pytest.mark.timeout_s(300)
 
 CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
@@ -527,8 +530,7 @@ def test_acceptance_cascade_across_two_tenants(tmp_path):
                for g in gw.outcomes.values()), \
         "no granted replica ever served"
     # every burst request reached exactly one terminal FINISHED
-    assert len(gw.outcomes) == len(wave)
-    assert all(g.status == "finished" for g in gw.outcomes.values())
+    assert_exactly_once(gw, wave)
 
     # -- calm: releases, then regrow BOTH victims in priority order --
     for _ in range(120):
@@ -560,10 +562,10 @@ def test_acceptance_cascade_across_two_tenants(tmp_path):
     assert quota_ok, "hi exceeded its quota"
 
     # exactly-once training on BOTH gangs, through park and regrow
-    for sup in (sup_lo, sup_mid):
-        steps = [s for s, _ in sup.losses]
-        assert steps == list(range(1, len(steps) + 1))
-        assert np.isfinite([l for _, l in sup.losses]).all()
+    # (shared checker + zero declared losses => strictly contiguous)
+    for name, sup in (("lo", sup_lo), ("mid", sup_mid)):
+        assert_losses_exactly_once(sup, name)
+        assert all(r.steps_lost == 0 for r in sup.recoveries), name
 
     # the cascade is visible in the mt metrics + per-tenant series
     freg = rec.metrics.registry
@@ -710,31 +712,15 @@ def test_chaos_chip_death_in_high_gang_mid_cascade(tmp_path):
     exp_mid = [r for r in sup_mid.recoveries if r.cause == "expand"]
     assert exp_mid and exp_mid[0].to_dp == 2
     # losses exactly-once on both gangs THROUGH the health eviction:
-    # lo's park/unpark is lossless (plain contiguous); mid's FAILURE
-    # eviction may rewind, but only to a recovery's restored step —
-    # replayed steps re-run in the restored trajectory (applied
-    # once), and nothing is ever skipped or silently doubled
-    lo_steps = [s for s, _ in sup_lo.losses]
-    assert lo_steps == list(range(1, len(lo_steps) + 1))
-    mid_steps = [s for s, _ in sup_mid.losses]
-    rewind_starts = [r.restored_step + 1 for r in sup_mid.recoveries
-                     if r.steps_lost > 0]
-    prev = 0
-    for s in mid_steps:
-        if s == prev + 1:
-            prev = s
-            continue
-        assert s <= prev and s in rewind_starts, \
-            f"loss step {s} after {prev} is not a recovery replay"
-        rewind_starts.remove(s)
-        prev = s
+    # lo's park/unpark is lossless (zero declared losses => strictly
+    # contiguous); mid's FAILURE eviction may rewind, but only to a
+    # recovery's restored step — the shared checker consumes each
+    # declared rewind at most once, so nothing is skipped or doubled
+    assert_losses_exactly_once(sup_lo, "lo")
     assert all(r.steps_lost == 0 for r in sup_lo.recoveries)
+    assert_losses_exactly_once(sup_mid, "mid")
     # byte-equal serving end to end
-    assert len(gw.outcomes) == len(reqs)
-    for r in reqs:
-        assert gw.outcomes[r.uid].status == "finished"
-        np.testing.assert_array_equal(
-            gw.results[r.uid].tokens, oracle(r.prompt, r.max_new),
-            err_msg=f"{r.uid} diverged from the oracle")
+    assert_exactly_once(gw, reqs)
+    assert_byte_equal(gw, reqs, oracle)
     ckpt_lo.close()
     ckpt_mid.close()

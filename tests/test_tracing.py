@@ -42,6 +42,9 @@ from k8s_dra_driver_tpu.gateway.loadgen import (VirtualClock,
                                                 load_trace, replay)
 from k8s_dra_driver_tpu.models import TransformerConfig, init_params
 from k8s_dra_driver_tpu.models.serving import Request, ServingEngine
+from invariants import (assert_exactly_once,
+                        assert_requeue_observed)
+
 from k8s_dra_driver_tpu.utils.httpendpoint import HTTPEndpoint
 from k8s_dra_driver_tpu.utils.metrics import DriverMetrics
 from k8s_dra_driver_tpu.utils.tracing import (Tracer,
@@ -245,9 +248,8 @@ def test_exactly_once_span_accounting_through_a_kill():
     chain; no span belongs to an unknown trace."""
     gw, tracer, reqs = _run_killed(seed=7)
     assert len(gw.refused) == 0
-    assert len(gw.outcomes) == len(reqs)
-    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
-    assert requeued, "fault fired before anything was in flight"
+    assert_exactly_once(gw, reqs)
+    requeued = assert_requeue_observed(gw)
 
     spans = list(tracer.spans)
     per = spans_by_trace(spans)
@@ -461,6 +463,31 @@ class TestFlightRecorder:
         assert len(rec.dumps) == 2
         assert rec.dumps[1]["reasons"] == ["drain"]
 
+    def test_cross_kind_trigger_forces_fresh_dump(self):
+        """ISSUE 12 satellite: two OVERLAPPING faults of different
+        kinds inside one coalescing window — a drain landing
+        mid-cascade — are two incidents and must produce two dumps,
+        so neither's evidence is buried in the other's annotation
+        list; a same-kind mark in the same window still coalesces."""
+        tr = Tracer(clock=VirtualClock())
+        rec = FlightRecorder(tr, min_new_spans=8)
+        ctx = tr.begin("gw-pool")
+        # incident 1: a preemption cascade begins
+        tr.emit(ctx, "reconcile", 0.0, kind="reclaim_park")
+        assert len(rec.dumps) == 1
+        # one span later — far inside the coalescing window — a
+        # DIFFERENT trigger kind lands: a second, overlapping incident
+        tr.emit(ctx, "drain", 0.5, track="gateway", replica="r0")
+        assert len(rec.dumps) == 2
+        assert rec.dumps[0]["reasons"] == ["preempt"]
+        assert rec.dumps[1]["reasons"] == ["drain"]
+        # while a SAME-kind mark inside the window still annotates
+        tr.emit(ctx, "drain", 0.6, track="gateway", replica="r1")
+        assert len(rec.dumps) == 2
+        assert rec.dumps[1]["reasons"] == ["drain", "drain"]
+        assert [m["reason"] for m in rec.marks] \
+            == ["preempt", "drain", "drain"]
+
     def test_dump_dir_writes_numbered_files(self, tmp_path):
         tr = Tracer(clock=VirtualClock())
         rec = FlightRecorder(tr, min_new_spans=1,
@@ -596,10 +623,8 @@ def test_acceptance_kill_plus_preemption_reconstructed_in_dump(tmp_path):
 
     # the incident happened as scripted: drain + requeue, one
     # preempt recovery with zero steps lost, one scale-up grant
-    requeued = [g for g in gw.outcomes.values() if g.requeues > 0]
-    assert requeued, "fault fired before anything was in flight"
-    assert len(gw.outcomes) == len(reqs)
-    assert all(g.status == "finished" for g in gw.outcomes.values())
+    requeued = assert_requeue_observed(gw)
+    assert_exactly_once(gw, reqs)
     pre = [r for r in sup.recoveries if r.cause == "preempt"]
     assert len(pre) == 1 and pre[0].steps_lost == 0
     assert (pre[0].from_dp, pre[0].to_dp) == (2, 1)

@@ -1,0 +1,146 @@
+"""Static lint: every blocking wait in the package takes a deadline.
+
+The crucible's whole premise is that the fleet keeps making progress
+under compound faults — but one forgotten ``Event.wait()`` or bare
+``lock.acquire()`` turns a recoverable fault into a silent hang that
+no invariant checker can see (the process just stops ticking).  The
+reference driver is strict about this — every informer wait runs
+under a context with a deadline (cmd/nvidia-dra-plugin/main.go
+wires cancellation through every controller) — so this lint makes
+the rule mechanical for the Python port:
+
+- scope: every module in ``k8s_dra_driver_tpu/`` (recursively);
+- a **blocking call** is one of:
+
+  - ``.wait()`` with no positional timeout and no ``timeout=`` kw
+    (``Event.wait``, ``Condition.wait``, ``Popen.wait`` all block
+    forever without one);
+  - ``.join()`` with no arguments at all (``Thread.join``;
+    ``str.join`` always has an argument so it never matches);
+  - ``.acquire()`` with no arguments, no ``timeout=`` kw, and no
+    ``blocking=False`` (``Lock``/``Semaphore`` semantics);
+  - ``.get()`` with no arguments at all (``queue.Queue.get``;
+    ``dict.get(key)`` has an argument so it never matches);
+  - ``subprocess.run(...)`` or ``.communicate(...)`` without a
+    ``timeout=`` kw;
+
+- a site that must block unboundedly by design (process-lifetime
+  waits, post-SIGKILL reaps, caller-owned lease protocols) carries a
+  ``# deadline:`` comment on one of the call's source lines stating
+  why, which exempts it.
+
+Run from the repo root (CI gates it in the fast tier,
+tests/test_deadlines_lint.py)::
+
+    python tools/lint_deadlines.py
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SCOPES = ("k8s_dra_driver_tpu",)
+
+#: methods that block forever when called with no timeout at all
+_NO_ARG_BLOCKERS = ("join", "get")
+#: methods where a positional arg is the timeout
+_WAITLIKE = ("wait",)
+
+
+def _kw(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw
+    return None
+
+
+def _is_false(node) -> bool:
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+def _blocking_problem(call: ast.Call) -> str | None:
+    """Return a message if ``call`` blocks without a deadline."""
+    func = call.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    name = func.attr
+    if name in _WAITLIKE:
+        if not call.args and _kw(call, "timeout") is None:
+            return (f".{name}() without a timeout blocks forever")
+    elif name in _NO_ARG_BLOCKERS:
+        if not call.args and not call.keywords:
+            return (f".{name}() without a timeout blocks forever")
+    elif name == "acquire":
+        blocking = _kw(call, "blocking")
+        if (not call.args and _kw(call, "timeout") is None
+                and not (blocking and _is_false(blocking.value))):
+            return (".acquire() without timeout= or blocking=False "
+                    "blocks forever")
+    elif name == "communicate":
+        if _kw(call, "timeout") is None:
+            return ".communicate() without timeout= blocks forever"
+    elif name == "run":
+        if (isinstance(func.value, ast.Name)
+                and func.value.id == "subprocess"
+                and _kw(call, "timeout") is None):
+            return "subprocess.run() without timeout= blocks forever"
+    return None
+
+
+def _exempt(call: ast.Call, lines: list[str]) -> bool:
+    """True when a ``# deadline:`` comment explains why the unbounded
+    block is intentional — on any of the call's own source lines, or
+    in the contiguous comment block immediately above it."""
+    end = getattr(call, "end_lineno", call.lineno) or call.lineno
+    for lineno in range(call.lineno, end + 1):
+        if lineno <= len(lines) and "# deadline:" in lines[lineno - 1]:
+            return True
+    lineno = call.lineno - 1
+    while lineno >= 1 and lines[lineno - 1].lstrip().startswith("#"):
+        if "# deadline:" in lines[lineno - 1]:
+            return True
+        lineno -= 1
+    return False
+
+
+def lint_file(path: pathlib.Path,
+              repo: pathlib.Path = REPO) -> list[str]:
+    rel = path.relative_to(repo)
+    src = path.read_text()
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        msg = _blocking_problem(node)
+        if msg and not _exempt(node, lines):
+            problems.append(f"{rel}:{node.lineno} {msg} — pass a "
+                            "deadline or add a '# deadline:' comment")
+    return problems
+
+
+def lint(repo: pathlib.Path = REPO) -> list[str]:
+    problems = []
+    for scope in SCOPES:
+        for path in sorted((repo / scope).rglob("*.py")):
+            problems.extend(lint_file(path, repo))
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} deadline lint problem(s)")
+        return 1
+    print("deadlines lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
