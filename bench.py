@@ -601,6 +601,44 @@ def _crucible_probe(timeout_s: float = 300.0) -> dict:
     return payload
 
 
+def _resharding_probe(timeout_s: float = 240.0) -> dict:
+    """Streaming sharded-restore probe (parallel/probe.py) in a
+    CPU-pinned subprocess: worst-host restore read time at widths 2
+    and 4 over one checksummed sharded generation vs the monolithic-
+    equivalent full read, the crc32 verify overhead, and proof that a
+    bit-flipped shard is detected at read time.  Always CPU — the
+    cost being measured is host file I/O + checksum, and the save
+    side needs the 8-device virtual mesh for the dp=2 x tp=4
+    layout."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.parallel.probe import "
+        "resharding_probe\n"
+        "print(json.dumps(resharding_probe()))\n")
+    env = cpu_jax_env(8)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = ("8-virtual-device CPU mesh; " +
+                       payload.get("note", ""))
+    return payload
+
+
 def _control_plane_probe(timeout_s: float = 240.0) -> dict:
     """Control-plane ceiling probe (gateway/ctlprobe.py) in a
     CPU-pinned subprocess: admissions/s + route decisions/s through
@@ -1096,6 +1134,10 @@ _PROBE_SCALARS = (
     ("crucible", "cru_invariant_violations",
      "cru_invariant_violations"),
     ("crucible", "cru_overlap_hits", "cru_overlap_hits"),
+    ("resharding", "rs_restore_ms_w2", "restore_ms_w2"),
+    ("resharding", "rs_restore_ms_w4", "restore_ms_w4"),
+    ("resharding", "rs_verify_overhead_x", "verify_overhead_x"),
+    ("resharding", "rs_corrupt_detected", "corrupt_detected"),
     ("control_plane", "ctl_admissions_per_s", "admissions_per_s"),
     ("control_plane", "ctl_routes_per_s", "routes_per_s"),
     ("control_plane", "ctl_goodput_flat_x", "goodput_flat_x"),
@@ -1331,6 +1373,15 @@ def main() -> None:
                 timeout_s=min(300.0, _remaining() - 60.0))
         else:
             crucible = {"error": "skipped: wall budget"}
+        # 3c4. Streaming sharded-restore probe (hermetic, CPU
+        #      subprocess): restore read cost vs restore width over a
+        #      checksummed sharded generation, verify overhead, and
+        #      corrupt-shard detection (must be 1).
+        if _remaining() > 90:
+            resharding = _resharding_probe(
+                timeout_s=min(240.0, _remaining() - 45.0))
+        else:
+            resharding = {"error": "skipped: wall budget"}
         # 3d. Control-plane ceiling probe (hermetic, CPU subprocess):
         #     admissions/s + routes/s over no-op engines under
         #     open-loop trace replay, swept over pump counts.
@@ -1350,6 +1401,7 @@ def main() -> None:
         compute["fleet"] = fleet
         compute["fleet_multitenant"] = fleet_mt
         compute["crucible"] = crucible
+        compute["resharding"] = resharding
         compute["control_plane"] = ctl
         detail["tpu"] = compute
         detail["baseline_note"] = (

@@ -62,9 +62,17 @@ from .faults import (CHIP_KIND, GANG_VERB, GANG_WORKER_KIND, HEAL,
 
 log = logging.getLogger(__name__)
 
-#: fault kinds a schedule may compose (FaultEvent.kind)
+#: fault kinds a schedule may compose (FaultEvent.kind).  The three
+#: corruption kinds damage a gang's NEWEST committed checkpoint
+#: generation on disk: ``shard_bitflip``/``shard_truncate`` tamper
+#: with a shard file (silent media corruption / a torn write), and
+#: ``gen_tear`` deletes the generation's manifest — the exact on-disk
+#: state a crash between the shard writes and the manifest commit
+#: leaves behind (parallel/resharding.py two-phase discipline).
 EVENT_KINDS = ("chip_kill", "worker_crash", "worker_hang",
-               "replica_kill", "burst")
+               "replica_kill", "burst", "shard_bitflip",
+               "shard_truncate", "gen_tear")
+CORRUPTION_KINDS = ("shard_bitflip", "shard_truncate", "gen_tear")
 
 #: reconciler event kinds that open the "cascade" window
 CASCADE_KINDS = frozenset({"grant", "reclaim_park", "reclaim_shrink",
@@ -169,9 +177,11 @@ class FaultEvent:
     after_cycle: int = 0            # window events wait at least this
     chip: int | None = None         # chip_kill target
     heal_after: int | None = None   # chip_kill: polls until the heal
-    gang: str | None = None         # worker_* target gang name
+    gang: str | None = None         # worker_*/corruption target gang
     row: int | None = None          # worker_* target dp row
     replica_glob: str | None = None  # replica_kill name glob
+    shard: str | None = None        # corruption: shard-file glob
+    #                                 (None = largest shard)
     n: int = 0                      # burst size
     prompt_seed: int = 0            # burst prompt family
     fired_cycle: int | None = None
@@ -294,7 +304,26 @@ def default_schedule(seed: int = 7, cycles: int = 220) -> Schedule:
         FaultEvent(id="lo-crash-in-reform", kind="worker_crash",
                    window="reform:lo", after_cycle=4 * u, gang="lo",
                    row=1),
-        # act 5: a tail burst exercises granted replicas + regrow
+        # act 5: checkpoint corruption aimed into recovery arcs — the
+        # generation a recovery is ABOUT to restore gets damaged, so
+        # verify-on-restore must detect it and fall back (never a
+        # silent wrong-weights resume)
+        FaultEvent(id="lo-shard-bitflip-parked", kind="shard_bitflip",
+                   window="parked:lo", after_cycle=3 * u, gang="lo"),
+        FaultEvent(id="mid-shard-truncate-reform",
+                   kind="shard_truncate", window="reform:mid",
+                   after_cycle=6 * u, gang="mid"),
+        # ...a worker dies mid-streaming-restore (the restore: window
+        # opens at the RESUME transition and stays sticky across the
+        # first post-restore steps)
+        FaultEvent(id="mid-crash-in-restore", kind="worker_crash",
+                   window="restore:mid", after_cycle=6 * u,
+                   gang="mid", row=1),
+        # ...and a crash between shard writes and manifest commit
+        # (replayed as its on-disk aftermath: manifest gone)
+        FaultEvent(id="mid-gen-tear", kind="gen_tear",
+                   at_cycle=7 * u + 2, gang="mid"),
+        # act 6: a tail burst exercises granted replicas + regrow
         # contention on the way back to steady state
         FaultEvent(id="tail-burst", kind="burst", at_cycle=8 * u,
                    n=8, prompt_seed=ps()),
@@ -358,6 +387,12 @@ class CrucibleRig:
         self.operator_repairs = 0
         self.submitted: dict = {}     # uid -> (seed, n, max_new)
         self._win_hist: deque = deque(maxlen=4)   # 2 cycles x 2 samples
+        # gang -> clock time of its last RESUME transition (opens the
+        # restore:<gang> window); gang -> {tampered step -> recovery
+        # count at tampering time} (the untainted_restores
+        # invariant's ground truth)
+        self._resume_at: dict = {}
+        self.tampered: dict = {}
         self._build()
 
     # -- construction ----------------------------------------------------
@@ -369,8 +404,8 @@ class CrucibleRig:
                                      ServingTenant, TenantRegistry,
                                      TenantSpec, TrainingTenant)
         from ..gateway.sharded import ShardedGateway
-        from ..models.checkpoint import TrainCheckpointer
         from ..models.serving import ServingEngine
+        from ..parallel.resharding import ShardedCheckpointer
         from ..parallel.supervisor import (ElasticTrainJob,
                                            GangSupervisor)
         from ..serving_disagg import DisaggReplicaManager, DisaggRouter
@@ -391,14 +426,17 @@ class CrucibleRig:
                 self.chip_plan, chips=range(8)))
 
         self.sups = {}
-        self._ckpts = []
+        # gangs run the sharded, checksummed format — the corruption
+        # events need real shard files + manifests to damage, and the
+        # soak proves the whole fleet survives on verify-on-restore
+        self._ckpts = {}
         motif = np.random.default_rng(seed).integers(0, 64, 32)
         for name, spec in self.GANGS:
             job = ElasticTrainJob(_cfg(), np.tile(motif, 64),
                                   batch=spec["batch"], seq_len=16,
                                   tp=1)
-            ckpt = TrainCheckpointer(self.workdir / f"ckpt-{name}")
-            self._ckpts.append(ckpt)
+            ckpt = ShardedCheckpointer(self.workdir / f"ckpt-{name}")
+            self._ckpts[name] = ckpt
             self.sups[name] = GangSupervisor(
                 job, ckpt,
                 coordination_dir=self.workdir / f"coord-{name}",
@@ -445,11 +483,18 @@ class CrucibleRig:
             dump_dir=self.dump_dir)
         for name, sup in self.sups.items():
             attach_supervisor(self.tracer, sup, name=f"gang-{name}")
+            sup.listeners.append(self._mk_resume_listener(name))
             sup.begin(10_000)       # never completes within a soak
         self.live = {name: True for name in self.sups}
 
+    def _mk_resume_listener(self, name: str):
+        def on_state(state, info):
+            if state == "resume":
+                self._resume_at[name] = self.clock.t
+        return on_state
+
     def close(self) -> None:
-        for ckpt in self._ckpts:
+        for ckpt in self._ckpts.values():
             ckpt.close()
 
     # -- windows ---------------------------------------------------------
@@ -472,6 +517,12 @@ class CrucibleRig:
                 w.add(f"resize_queued:{name}")
             if sup.state == "parked":
                 w.add(f"parked:{name}")
+            # restore:<gang> — open from the RESUME transition through
+            # the first post-restore steps (the streaming-restore
+            # span, where a worker death or corruption lands hardest)
+            if self.clock.t - self._resume_at.get(name,
+                                                  float("-inf")) <= 2.0:
+                w.add(f"restore:{name}")
         for r in self.mgr.replicas:
             if r.state == "dead":
                 w.add("drain:hi")
@@ -531,6 +582,8 @@ class CrucibleRig:
             self.replica_plan.arm(FaultRule(
                 verb=HEALTH_VERB, kind="Replica",
                 name=ev.replica_glob or "d*", times=1, error="drop"))
+        elif ev.kind in CORRUPTION_KINDS:
+            self._corrupt(ev)
         elif ev.kind == "burst":
             from ..models.serving import Request
             for i in range(ev.n):
@@ -540,6 +593,53 @@ class CrucibleRig:
                     uid=uid, prompt=_prompt(ev.prompt_seed + i, n_tok),
                     max_new=3), slo_s=900.0)
                 self.submitted[uid] = (ev.prompt_seed + i, n_tok, 3)
+
+    def _corrupt(self, ev: FaultEvent) -> None:
+        """Damage the target gang's NEWEST committed generation on
+        disk.  ``gen_tear`` deletes the manifest (the on-disk
+        aftermath of a crash between shard writes and commit) — the
+        step is NOT recorded as tampered, because the supervisor
+        legitimately rewrites that now-uncommitted step during
+        post-rewind replay.  ``shard_bitflip``/``shard_truncate``
+        damage shard bytes under an intact manifest; save() skips
+        committed steps, so the damage is permanent and the step
+        lands in ``tampered`` (the untainted_restores invariant's
+        ground truth) together with the gang's recovery count at
+        tampering time — earlier recoveries read the bytes while
+        they were still good, only a LATER restore of this step
+        proves detection failed."""
+        from ..parallel import resharding
+        from .faults import (CORRUPT_BITFLIP, CORRUPT_TRUNCATE,
+                             corrupt_file)
+        ckpt = self._ckpts[ev.gang]
+        steps = ckpt.all_steps()
+        if not steps:
+            log.info("crucible: %s found no committed generation for "
+                     "gang %s; no-op", ev.id, ev.gang)
+            return
+        step = steps[-1]
+        sd = ckpt.step_path(step)
+        if ev.kind == "gen_tear":
+            (sd / resharding.MANIFEST).unlink(missing_ok=True)
+            log.info("crucible: tore generation %d of gang %s "
+                     "(manifest deleted)", step, ev.gang)
+            return
+        files = sorted(sd.glob("*.bin"))
+        if ev.shard:
+            files = [p for p in files
+                     if fnmatch.fnmatchcase(p.name, ev.shard)]
+        if not files:
+            log.info("crucible: %s matched no shard files in step %d "
+                     "of gang %s; no-op", ev.id, step, ev.gang)
+            return
+        target = max(files, key=lambda p: (p.stat().st_size, p.name))
+        kind = (CORRUPT_BITFLIP if ev.kind == "shard_bitflip"
+                else CORRUPT_TRUNCATE)
+        desc = corrupt_file(target, kind, seed=self.schedule.seed)
+        self.tampered.setdefault(ev.gang, {})[step] = len(
+            self.sups[ev.gang].recoveries)
+        log.info("crucible: %s on gang %s step %d: %s", ev.id,
+                 ev.gang, step, desc)
 
     # -- the co-loop -----------------------------------------------------
 
@@ -570,7 +670,8 @@ class CrucibleRig:
             gateways=[("hi", self.gw)],
             supervisors=list(self.sups.items()),
             ledger=self.ledger, records=self._records(),
-            specs=list(self.registry), events=self.rec.events)
+            specs=list(self.registry), events=self.rec.events,
+            tainted=self.tampered)
         if v:
             self.violations.append((cycle, v))
         self.cycle += 1
@@ -816,7 +917,8 @@ def investigate(schedule: Schedule, workdir, *,
     return out
 
 
-__all__ = ["CASCADE_KINDS", "Clock", "CrucibleResult", "CrucibleRig",
+__all__ = ["CASCADE_KINDS", "CORRUPTION_KINDS", "Clock",
+           "CrucibleResult", "CrucibleRig",
            "EVENT_KINDS", "FaultEvent", "REPRO_FORMAT", "Schedule",
            "default_schedule", "investigate", "minimize", "replay",
            "run_soak", "write_repro"]

@@ -345,6 +345,15 @@ class ScriptedChipHealth:
 CRASH_CHECKPOINT_TMP_WRITTEN = "checkpoint.tmp-written"
 CRASH_CHECKPOINT_ROTATED = "checkpoint.rotated"
 CRASH_CHECKPOINT_SAVED = "checkpoint.saved"
+# sharded workload checkpoints (parallel/resharding.py): between the
+# last shard file and the manifest commit, and just after commit
+CRASH_RESHARD_SHARDS_WRITTEN = "reshard.shards-written"
+CRASH_RESHARD_COMMITTED = "reshard.manifest-committed"
+# monolithic workload checkpoints (models/checkpoint.py): mid-orbax
+# write (generation may be torn/uncommitted) and after orbax commit
+# but before the integrity sidecar lands
+CRASH_TRAIN_CKPT_SAVING = "train_ckpt.saving"
+CRASH_TRAIN_CKPT_COMMITTED = "train_ckpt.committed"
 
 FAULT_PLAN_ENV = "TPU_DRA_FAULT_PLAN"
 
@@ -385,3 +394,40 @@ def crashpoint(point: str) -> None:
     if decision.error == "crash":
         log.warning("fault plan: crashing process at crashpoint %s", point)
         os._exit(CRASH_EXIT_CODE)
+
+
+# --------------------------------------------------------------------------
+# disk corruption: deterministic damage to checkpoint bytes on disk
+# --------------------------------------------------------------------------
+
+# What the crucible's shard-corruption events do to a named file: the
+# injected analogs of silent media corruption (bitflip) and a torn or
+# short write that slipped past the commit discipline (truncate).
+CORRUPT_BITFLIP = "bitflip"
+CORRUPT_TRUNCATE = "truncate"
+CORRUPT_KINDS = (CORRUPT_BITFLIP, CORRUPT_TRUNCATE)
+
+
+def corrupt_file(path, kind: str, seed: int = 0) -> str:
+    """Deterministically damage ``path`` in place; returns a one-line
+    description for repro logs.  ``bitflip`` flips one seeded bit;
+    ``truncate`` cuts the file to half its length (min 1 byte so the
+    damage is a SHORT file, not an absent one — absence is a
+    different failure class the restore path detects separately)."""
+    from pathlib import Path
+
+    p = Path(path)
+    data = bytearray(p.read_bytes())
+    if not data:
+        raise ValueError(f"refusing to corrupt empty file {p}")
+    if kind == CORRUPT_BITFLIP:
+        rng = random.Random(seed)
+        i = rng.randrange(len(data))
+        data[i] ^= 1 << rng.randrange(8)
+        p.write_bytes(bytes(data))
+        return f"bitflip byte {i} of {p.name}"
+    if kind == CORRUPT_TRUNCATE:
+        keep = max(len(data) // 2, 1)
+        p.write_bytes(bytes(data[:keep]))
+        return f"truncate {p.name} {len(data)}->{keep} bytes"
+    raise ValueError(f"unknown corruption kind {kind!r}")

@@ -192,6 +192,36 @@ def losses_exactly_once(losses: Sequence, recoveries: Sequence
     return violations
 
 
+def untainted_restores(sup, tainted_steps, gang: str = "gang"
+                       ) -> list[str]:
+    """No recovery AFTER a corruption event restored the generation
+    it tampered with: verify-on-restore (parallel/resharding.py) must
+    classify a damaged generation unreadable and fall back, so a
+    tampered step appearing as a later recovery's ``restored_step``
+    means corrupted bytes reached the training math — the silent-
+    wrong-weights resume the checksums exist to prevent.
+
+    ``tainted_steps`` is the injector's ground truth (crucible
+    ``tampered``): a mapping of step -> index into ``recoveries`` at
+    tampering time (a plain iterable of steps means "tainted from the
+    start").  Recoveries BELOW that index restored the generation
+    while its bytes were still good — only later ones prove a
+    detection failure.  Torn-manifest generations are excluded by the
+    injector itself (the supervisor legitimately rewrites them)."""
+    violations: list[str] = []
+    items = (dict(tainted_steps) if isinstance(tainted_steps, Mapping)
+             else {s: 0 for s in tainted_steps})
+    recs = list(getattr(sup, "recoveries", []))
+    for step, since in items.items():
+        for r in recs[since:]:
+            if r.restored_step == step:
+                violations.append(
+                    f"{gang}: recovery ({r.cause!r}) restored "
+                    f"tampered generation {step} — corruption went "
+                    f"undetected at restore")
+    return violations
+
+
 def placement_fence(sup, gang: str = "gang") -> list[str]:
     """No alive worker runs on a chip the supervisor itself fenced
     off: the dead set and the placement-exclusion set must be
@@ -301,12 +331,15 @@ def reclaim_priority_order(specs, events) -> list[str]:
 
 def check_cycle(*, gateways=(), supervisors=(), ledger=None,
                 records=None, specs=None, events=(),
-                submitted: Mapping | None = None) -> list[str]:
+                submitted: Mapping | None = None,
+                tainted: Mapping | None = None) -> list[str]:
     """One cycle's full sweep: every per-cycle checker over every
     subsystem the rig composes.  ``gateways``/``supervisors`` are
     ``(name, obj)`` pairs so violations say WHO broke; ``submitted``
     maps gateway name -> submit count (see
-    :func:`gateway_conservation`).  End-of-run checkers
+    :func:`gateway_conservation`); ``tainted`` maps gang name -> the
+    steps a corruption injector tampered with
+    (:func:`untainted_restores`).  End-of-run checkers
     (exactly-once, byte-equal) are deliberately absent — the crucible
     runs those once at the end, when completion is actually owed."""
     violations: list[str] = []
@@ -319,6 +352,9 @@ def check_cycle(*, gateways=(), supervisors=(), ledger=None,
         violations += placement_fence(sup, gang=name)
         violations += [f"[{name}] {v}" for v in losses_exactly_once(
             sup.losses, sup.recoveries)]
+        if tainted is not None:
+            violations += untainted_restores(
+                sup, tainted.get(name, ()), gang=name)
     if ledger is not None and records is not None:
         violations += ledger_conservation(ledger, records)
     if ledger is not None and specs is not None:
@@ -332,5 +368,6 @@ __all__ = ["TERMINAL_STATUSES", "RECLAIM_KINDS",
            "gateway_conservation", "terminal_is_final",
            "exactly_once_terminal", "byte_equal",
            "losses_exactly_once", "placement_fence",
+           "untainted_restores",
            "ledger_conservation", "quota_respected",
            "reclaim_priority_order", "check_cycle"]

@@ -5,6 +5,7 @@ from .data import (BatchLoader, as_global, load_token_file, local_rows,
                    write_token_file)
 from .decode import (KVCache, decode_step, greedy_generate, init_cache,
                      prefill, sample_generate)
+from .layouts import transformer_rules
 from .quant import QTensor, quantize_params, quantized_bytes
 from .serving import Finished, Request, ServingEngine
 from .speculative import speculative_generate
@@ -21,4 +22,4 @@ __all__ = ["BatchLoader", "Finished", "KVCache", "QTensor",
            "make_optimizer", "make_train_step", "param_specs", "prefill",
            "quantize_params", "quantized_bytes",
            "sample_generate", "shard_params", "speculative_generate",
-           "stage_params", "unstage_params"]
+           "stage_params", "transformer_rules", "unstage_params"]

@@ -19,23 +19,50 @@ stopped.  TPU-first specifics:
 
 from __future__ import annotations
 
+import json
 import logging
+import zlib
 from pathlib import Path
 from typing import Any
 
 import jax
 import orbax.checkpoint as ocp
 
+from ..cluster import faults
 from ..utils import atomicio
 
 log = logging.getLogger(__name__)
 
+INTEGRITY_FORMAT = "tpu-dra-ckpt-integrity/1"
+
+
+def _crc32_file(path: Path) -> int:
+    crc = 0
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
+
 
 class TrainCheckpointer:
-    """Save/restore (params, opt_state, step) under one directory."""
+    """Save/restore (params, opt_state, step) under one directory.
 
-    def __init__(self, directory: str | Path, keep: int = 3):
+    ``verify=True`` (default) gives the monolithic orbax format the
+    same verify-on-restore contract as the sharded format
+    (parallel/resharding.py): each committed generation gets a crc32
+    sidecar (written atomically NEXT TO the orbax root, so orbax's
+    step scan never sees it), and restore checks every recorded file
+    before orbax parses it — a flipped bit or truncated array file
+    classifies the generation unreadable and the newest-first
+    fallback below skips it.  Generations predating the sidecar
+    verify trivially (legacy data has no detection baseline)."""
+
+    def __init__(self, directory: str | Path, keep: int = 3,
+                 verify: bool = True):
         self.directory = Path(directory).absolute()
+        self.verify = verify
+        self._integrity = self.directory.with_name(
+            self.directory.name + "-integrity")
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -51,6 +78,10 @@ class TrainCheckpointer:
             params=ocp.args.StandardSave(params),
             opt_state=ocp.args.StandardSave(opt_state),
             extra=ocp.args.JsonSave(extra or {})))
+        # async write in flight: a crash in this window may leave a
+        # torn, uncommitted generation (orbax tmp dir) that restore
+        # must degrade past — pinned by tests/test_resharding.py
+        faults.crashpoint(faults.CRASH_TRAIN_CKPT_SAVING)
         if wait:
             self._mgr.wait_until_finished()
             # orbax commits the generation with a tmp-dir rename but
@@ -58,6 +89,62 @@ class TrainCheckpointer:
             # power loss can drop the rename AND keep the data blocks,
             # tearing the newest generation out of latest_step()
             atomicio.fsync_dir(self.directory)
+            faults.crashpoint(faults.CRASH_TRAIN_CKPT_COMMITTED)
+            self._write_integrity(step)
+
+    def _write_integrity(self, step: int) -> None:
+        """crc32-per-file sidecar for a committed generation; a crash
+        between commit and sidecar leaves a generation that verifies
+        trivially (legacy path) — never one that false-positives."""
+        # plain pathlib on purpose: orbax hands back an epath.Path
+        # whose recursive glob is disabled
+        step_dir = Path(str(self._mgr.directory)) / str(step)
+        if not step_dir.exists():
+            return
+        files = {
+            str(p.relative_to(step_dir)): [_crc32_file(p),
+                                           p.stat().st_size]
+            for p in sorted(step_dir.glob("**/*")) if p.is_file()
+        }
+        self._integrity.mkdir(parents=True, exist_ok=True)
+        atomicio.write_atomic(
+            self._integrity / f"{step}.json",
+            json.dumps({"format": INTEGRITY_FORMAT, "step": step,
+                        "files": files}, sort_keys=True))
+        retained = {str(s) for s in self._mgr.all_steps()}
+        for f in self._integrity.glob("*.json"):
+            if f.stem not in retained:
+                f.unlink(missing_ok=True)
+
+    def _verify_step(self, step: int) -> None:
+        """Raise ``ShardCorruption`` when the generation's bytes no
+        longer match its sidecar; silently pass for pre-sidecar
+        generations.  Runs BEFORE orbax parses anything, so garbage
+        never reaches the restore math."""
+        from ..parallel.resharding import ShardCorruption
+
+        sidecar = self._integrity / f"{step}.json"
+        if not self.verify or not sidecar.exists():
+            return
+        try:
+            recorded = json.loads(sidecar.read_text())["files"]
+        except Exception as e:
+            raise ShardCorruption(
+                f"garbled integrity sidecar for step {step}: "
+                f"{e}") from e
+        step_dir = Path(str(self._mgr.directory)) / str(step)
+        for rel, (crc, size) in recorded.items():
+            p = step_dir / rel
+            if not p.exists():
+                raise ShardCorruption(
+                    f"step {step}: missing file {rel}")
+            if p.stat().st_size != size:
+                raise ShardCorruption(
+                    f"step {step}: {rel} truncated "
+                    f"({p.stat().st_size} != {size} bytes)")
+            if _crc32_file(p) != crc:
+                raise ShardCorruption(
+                    f"step {step}: {rel} checksum mismatch")
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
@@ -101,6 +188,7 @@ class TrainCheckpointer:
         torn: list[str] = []
         for s in candidates:
             try:
+                self._verify_step(s)
                 out = self._mgr.restore(s, args=args)
             except Exception as e:
                 if explicit:

@@ -1,0 +1,72 @@
+"""Rules-driven parameter layouts: per-model partition-rule tables.
+
+Layout here is declarative data — an ordered table of
+``(regex, PartitionSpec)`` matched against '/'-joined pytree leaf
+names by ``parallel/resharding.py: match_partition_rules`` — the same
+move the reference driver makes when MIG placement is selected by CEL
+expression over declared profiles instead of enumerated in code
+(deviceclass.go:31-47).  One table lays a model out on ANY
+dp×ep×sp×tp×pp mesh: axes a mesh lacks are size-1, so the same spec
+degrades gracefully (parallel/mesh.py ``make_mesh``).
+
+First match wins, so order encodes precedence; an unmatched leaf is a
+hard error (a new parameter must be placed deliberately).  This is
+the ONE module in models/ allowed to construct naked PartitionSpecs —
+``tools/lint_shardings.py`` gates every other site behind a
+``# layout:`` justification.
+"""
+
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+Rule = tuple[str, P]
+
+
+def _layer_rules(cfg) -> list[Rule]:
+    rules: list[Rule] = [
+        # norm gains replicate; attention projections split heads on
+        # tp (wq/wk/wv head axis is dim 1, wo's is dim 0)
+        (r"ln[12]$", P(None)),
+        (r"w[qkv]$", P(None, "tp", None)),
+        (r"wo$", P("tp", None, None)),
+    ]
+    if cfg.is_moe:
+        rules += [
+            # router replicates (every token scores every expert);
+            # expert weights split experts on ep and d_ff on tp
+            (r"router$", P(None, None)),
+            (r"w_in$", P("ep", None, "tp")),
+            (r"w_out$", P("ep", "tp", None)),
+        ]
+    else:
+        rules += [
+            (r"w_in$", P(None, "tp")),
+            (r"w_out$", P("tp", None)),
+        ]
+    return rules
+
+
+def transformer_rules(cfg) -> tuple[Rule, ...]:
+    """The transformer's full layout table for ``cfg``.
+
+    Matches every leaf of ``init_params``' tree (and the staged tree
+    ``stage_params`` produces when ``cfg.pp_stages > 1``: those
+    leaves are ``stages/<name>`` with shape [S, L/S, ...], stage axis
+    on pp and the per-layer spec shifted right two dims).  Pinned
+    against the hand-placed table it replaced by
+    tests/test_resharding.py.
+    """
+    rules: list[Rule] = [
+        (r"^embed$", P(None, "tp")),
+        (r"^unembed$", P("tp", None)),
+        (r"^ln_f$", P(None)),
+    ]
+    layer = _layer_rules(cfg)
+    if cfg.pp_stages > 1:
+        layer = [(rf"^stages/{pat}", P("pp", None, *tuple(spec)))
+                 for pat, spec in layer]
+    return tuple(rules + layer)
+
+
+__all__ = ["Rule", "transformer_rules"]

@@ -206,41 +206,43 @@ def _layer_shapes(cfg: TransformerConfig) -> dict[str, tuple[int, ...]]:
     return shapes
 
 
-def _layer_specs(cfg: TransformerConfig) -> dict[str, P]:
-    specs = {
-        "ln1": P(None), "ln2": P(None),
-        "wq": P(None, "tp", None), "wk": P(None, "tp", None),
-        "wv": P(None, "tp", None), "wo": P("tp", None, None),
+def _param_skeleton(cfg: TransformerConfig) -> Params:
+    """ShapeDtypeStruct pytree mirroring ``init_params``' structure
+    (staged per ``stage_params`` when pp > 1) without materializing
+    arrays — what the layout rule table matches against."""
+    def sds(shape):
+        return jax.ShapeDtypeStruct(tuple(shape), cfg.dtype)
+
+    head: Params = {
+        "embed": sds((cfg.vocab, cfg.d_model)),
+        "unembed": sds((cfg.d_model, cfg.vocab)),
+        "ln_f": sds((cfg.d_model,)),
     }
-    if cfg.is_moe:
-        specs.update({
-            "router": P(None, None),
-            "w_in": P("ep", None, "tp"),
-            "w_out": P("ep", "tp", None),
-        })
-    else:
-        specs.update({"w_in": P(None, "tp"), "w_out": P("tp", None)})
-    return specs
+    shapes = _layer_shapes(cfg)
+    if cfg.pp_stages > 1:
+        from ..parallel.pipeline import split_layers
+        lps = split_layers(cfg.n_layers, cfg.pp_stages)
+        head["stages"] = {
+            name: sds((cfg.pp_stages, lps) + shape)
+            for name, shape in shapes.items()
+        }
+        return head
+    head["layers"] = [
+        {name: sds(shape) for name, shape in shapes.items()}
+        for _ in range(cfg.n_layers)
+    ]
+    return head
 
 
 def param_specs(cfg: TransformerConfig) -> Params:
-    layer = _layer_specs(cfg)
-    head = {
-        "embed": P(None, "tp"),
-        "unembed": P("tp", None),
-        "ln_f": P(None),
-    }
-    if cfg.pp_stages > 1:
-        # staged layout: leaves [S, L/S, ...] — stage axis on pp, the
-        # per-layer spec shifted right; params LIVE per stage instead
-        # of replicated across the pipeline
-        head["stages"] = {
-            name: P("pp", None, *tuple(spec))
-            for name, spec in layer.items()
-        }
-        return head
-    head["layers"] = [dict(layer) for _ in range(cfg.n_layers)]
-    return head
+    """Per-leaf PartitionSpecs from the model's declarative rule
+    table (models/layouts.py) matched over the shape skeleton —
+    replaces the hand-placed spec dicts this function used to carry,
+    so one table lays the model out on any dp×tp×pp mesh."""
+    from ..parallel.resharding import match_partition_rules
+    from .layouts import transformer_rules
+    return match_partition_rules(
+        transformer_rules(cfg), _param_skeleton(cfg))
 
 
 def stage_params(params: Params, cfg: TransformerConfig) -> Params:
@@ -305,6 +307,7 @@ def shard_params(params: Params, cfg: TransformerConfig,
     if cfg.pp_stages > 1 and "layers" in params:
         params = stage_params(params, cfg)   # pp wants staged residency
     return jax.tree.map(
+        # layout: placement of the rule table's OWN output
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
         params, specs)
 
@@ -596,11 +599,15 @@ def _moe_mlp_gmm_sharded(x, gates, layer, cfg: TransformerConfig,
         return jax.lax.psum_scatter(out, "ep", scatter_dimension=0,
                                     tiled=True)
 
+    # layout: shard_map block signature — the weight in_specs MUST
+    # restate the table's w_in/w_out placement (models/layouts.py) so
+    # the per-shard kernel sees the residency it was written for
     batch_spec = P(BATCH_AXES, "sp", None)
     fn = jax.shard_map(
         block, mesh=mesh,
-        in_specs=(batch_spec, batch_spec, P("ep", None, "tp"),
-                  P("ep", "tp", None)),
+        in_specs=(batch_spec, batch_spec,
+                  P("ep", None, "tp"),   # layout: table's w_in spec
+                  P("ep", "tp", None)),  # layout: table's w_out spec
         out_specs=batch_spec, check_vma=False)
     return fn(x, gates, layer["w_in"], layer["w_out"])
 
@@ -686,6 +693,8 @@ def _pipelined_layers(x, params, cfg: TransformerConfig, mesh: Mesh):
         stages = [stack_stages(layers[s * lps:(s + 1) * lps])
                   for s in range(cfg.pp_stages)]
         stacked = jax.lax.with_sharding_constraint(
+            # layout: activation-path restage of an UNstaged params
+            # tree; the staged layout itself comes from the table
             stack_stages(stages), NamedSharding(mesh, P("pp")))
 
     def stage_fn(stage, x):
@@ -816,6 +825,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
     """Returns (train_step, init_state): one jit-compiled SPMD program
     computing loss, grads and the optimizer update over the mesh."""
     optimizer = optimizer or make_optimizer()
+    # layout: input-batch sharding (data placement, not a parameter)
     batch_spec = NamedSharding(mesh, P(BATCH_AXES, "sp"))
 
     def init_state(key):
@@ -825,6 +835,7 @@ def make_train_step(cfg: TransformerConfig, mesh: Mesh,
         # uncommitted on the default device, which works under jit but
         # conflicts with mesh-committed params once a checkpoint
         # restore pins placements — replicate them on the mesh instead.
+        # layout: optax bookkeeping scalars, replicated by nature
         replicated = NamedSharding(mesh, P())
         opt_state = jax.tree.map(
             lambda x: x if isinstance(getattr(x, "sharding", None),

@@ -6,11 +6,16 @@ from .mesh import (BATCH_AXES, MESH_AXES, MeshSpec, batch_sharding,
 __all__ = ["BATCH_AXES", "MESH_AXES", "MeshSpec", "batch_sharding",
            "make_mesh", "replicated", "visible_chip_count",
            "ElasticTrainJob", "GangSupervisor", "SupervisorError",
-           "SupervisorReport", "recovery_probe"]
+           "SupervisorReport", "recovery_probe", "resharding_probe",
+           "ShardCorruption", "ShardedCheckpointer",
+           "match_partition_rules"]
 
 _LAZY = {"ElasticTrainJob": "supervisor", "GangSupervisor": "supervisor",
          "SupervisorError": "supervisor", "SupervisorReport": "supervisor",
-         "recovery_probe": "probe"}
+         "recovery_probe": "probe", "resharding_probe": "probe",
+         "ShardCorruption": "resharding",
+         "ShardedCheckpointer": "resharding",
+         "match_partition_rules": "resharding"}
 
 
 def __getattr__(name):

@@ -1,0 +1,486 @@
+"""Crash-safe elastic resharding: rules-driven layouts + sharded,
+checksummed, streaming checkpoint I/O.
+
+Two primitives that together make "restore onto a different mesh" a
+first-class, *verifiable* operation instead of a side effect of orbax
+internals:
+
+- ``match_partition_rules``: a regex-over-pytree table maps leaf path
+  names ("layers/3/wq") to ``PartitionSpec``s, so a model's layout is
+  declarative data the same way the reference driver treats MIG
+  placement as declarative profiles rather than hand-placed code
+  (deviceclass.go:31-47 selects by CEL expression, not enumeration).
+  Per-model tables live in ``models/layouts.py``; first match wins,
+  scalar leaves are replicated, an unmatched leaf is an error — a
+  silent default would hand a new parameter a layout nobody chose.
+
+- ``ShardedCheckpointer``: a generation is a directory of raw per-
+  shard files plus ONE ``manifest.json`` (shape / dtype / spec /
+  crc32 / byte-bounds per shard) written LAST via the
+  utils/atomicio.py discipline — manifest presence IS the commit
+  point, the same two-phase rename contract as the driver's own
+  checkpoint tier (checkpoint.go:9-53).  Restore reads only the shard
+  files that intersect each requested slice (``read_slice`` /
+  ``jax.make_array_from_callback``), so per-host restore cost scales
+  with the host's shard bytes, not model bytes; every byte read is
+  checked against the manifest checksum first, so a flipped bit, a
+  truncated file, or a missing shard classifies the generation
+  unreadable and the newest-first fallback (same contract as
+  models/checkpoint.py) resumes from the previous good generation
+  instead of silently training on garbage.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import shutil
+import zlib
+from pathlib import Path
+from typing import Any, Sequence
+
+import numpy as np
+
+from ..cluster import faults
+from ..utils import atomicio
+
+log = logging.getLogger(__name__)
+
+FORMAT = "tpu-dra-sharded-ckpt/1"
+MANIFEST = "manifest.json"
+_STEP_PREFIX = "step_"
+
+
+class ShardCorruption(RuntimeError):
+    """A generation that must not be restored from: missing/garbled
+    manifest, missing shard file, truncation, or checksum mismatch."""
+
+
+# ---------------------------------------------------------------- rules
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):       # DictKey, FlattenedIndexKey
+        return str(k.key)
+    if hasattr(k, "idx"):       # SequenceKey
+        return str(k.idx)
+    if hasattr(k, "name"):      # GetAttrKey
+        return str(k.name)
+    return str(k)
+
+
+def leaf_name(path) -> str:
+    """'/'-joined name of a tree_flatten_with_path key path."""
+    return "/".join(_key_str(k) for k in path)
+
+
+def tree_leaf_names(tree) -> list[str]:
+    import jax
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [leaf_name(p) for p, _ in flat]
+
+
+def match_partition_rules(rules: Sequence[tuple[str, Any]], tree):
+    """Map every leaf of ``tree`` to a PartitionSpec via regex rules.
+
+    ``rules`` is an ordered table of ``(pattern, PartitionSpec)``;
+    the FIRST pattern that ``re.search``-matches the leaf's
+    '/'-joined path name wins.  Leaves with zero or one element are
+    replicated (``P()``) without consulting the table — a scalar has
+    nothing to shard.  A leaf no rule matches raises ``ValueError``
+    naming it: a silent replicate-by-default would let a new
+    parameter ship with a layout nobody reviewed.
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    specs = []
+    for path, leaf in flat:
+        name = leaf_name(path)
+        shape = getattr(leaf, "shape", None)
+        if shape is not None and (
+                len(shape) == 0 or int(np.prod(shape)) == 1):
+            specs.append(P())
+            continue
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                specs.append(spec)
+                break
+        else:
+            raise ValueError(
+                f"no partition rule matches leaf {name!r} "
+                f"(shape {tuple(shape) if shape else None}); add a "
+                f"rule to the model's table in models/layouts.py")
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def encode_spec(spec) -> list:
+    """PartitionSpec -> JSON-able list (axis name, axis tuple, None)."""
+    return [list(e) if isinstance(e, (tuple, list)) else e
+            for e in tuple(spec)]
+
+
+def decode_spec(entries: Sequence):
+    from jax.sharding import PartitionSpec as P
+    return P(*[tuple(e) if isinstance(e, list) else e
+               for e in entries])
+
+
+# ------------------------------------------------------- sharded format
+
+def _np_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency; covers bfloat16 etc.
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _mangle(name: str) -> str:
+    return name.replace("/", "__")
+
+
+def _index_bounds(index, shape) -> list[list[int]]:
+    """Slice-tuple -> concrete [[start, stop], ...] per dimension."""
+    return [[int(s.start or 0),
+             int(s.stop if s.stop is not None else dim)]
+            for s, dim in zip(index, shape)]
+
+
+class ShardedCheckpointer:
+    """Save/restore (params, opt_state, step) as checksummed shards.
+
+    API-compatible with models/checkpoint.py ``TrainCheckpointer``
+    (save / latest_step / restore / restore_extra / close) so the
+    supervisor and crucible swap formats without code changes; the
+    differences are the per-shard manifest, verify-on-restore, and
+    slice-granular reads (``read_slice``).
+
+    ``verify=False`` skips only the crc32 pass (byte-length checks
+    stay — a short file can never be reinterpreted as a full shard);
+    it exists so the bench probe can price verification honestly.
+    """
+
+    def __init__(self, directory: str | Path, keep: int = 3,
+                 verify: bool = True):
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        self.keep = keep
+        self.verify = verify
+        self.last_restore_stats: dict = {}
+        # parsed-manifest cache keyed by (mtime_ns, size) so repeated
+        # read_slice calls (one per shard during a streaming restore)
+        # parse each generation's manifest once, not once per shard;
+        # the stat key keeps a rewritten or tampered-with manifest
+        # from being served stale
+        self._manifest_cache: dict = {}
+
+    # -- layout ---------------------------------------------------
+
+    def step_path(self, step: int) -> Path:
+        return self.directory / f"{_STEP_PREFIX}{step:08d}"
+
+    def all_steps(self) -> list[int]:
+        """Committed generations only (manifest present) — a step dir
+        a crash left without its manifest is invisible, exactly like
+        an unrenamed orbax tmp dir."""
+        out = []
+        for d in self.directory.iterdir():
+            if d.is_dir() and d.name.startswith(_STEP_PREFIX) \
+                    and (d / MANIFEST).exists():
+                try:
+                    out.append(int(d.name[len(_STEP_PREFIX):]))
+                except ValueError:
+                    continue
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.all_steps()
+        return steps[-1] if steps else None
+
+    # -- save -----------------------------------------------------
+
+    def save(self, step: int, params: Any, opt_state: Any,
+             wait: bool = True, extra: dict | None = None) -> None:
+        """Write every addressable shard (replicas deduped by index),
+        then commit by writing the manifest atomically.  Replayed
+        steps after a post-restore rewind are skipped, matching
+        orbax's already-saved semantics — the recomputed state is the
+        saved state, rewriting it would only widen the torn-write
+        window."""
+        import jax
+
+        if step in set(self.all_steps()):
+            return
+        sd = self.step_path(step)
+        if sd.exists():            # uncommitted debris from a crash
+            shutil.rmtree(sd)
+        sd.mkdir(parents=True)
+        tree = {"params": params, "opt_state": opt_state}
+        flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+        leaves = {}
+        for path, leaf in flat:
+            name = leaf_name(path)
+            leaves[name] = self._write_leaf(sd, name, leaf)
+        faults.crashpoint(faults.CRASH_RESHARD_SHARDS_WRITTEN)
+        manifest = {"format": FORMAT, "step": step,
+                    "extra": extra or {}, "leaves": leaves}
+        atomicio.write_atomic(
+            sd / MANIFEST,
+            json.dumps(manifest, sort_keys=True, separators=(",", ":")))
+        atomicio.fsync_dir(self.directory)
+        faults.crashpoint(faults.CRASH_RESHARD_COMMITTED)
+        self._prune()
+
+    def _write_leaf(self, sd: Path, name: str, arr) -> dict:
+        from jax.sharding import NamedSharding
+
+        shape = tuple(int(d) for d in arr.shape)
+        sharding = getattr(arr, "sharding", None)
+        spec = (encode_spec(sharding.spec)
+                if isinstance(sharding, NamedSharding) else None)
+        if getattr(arr, "addressable_shards", None):
+            raw_shards = [(s.index, s.data)
+                          for s in arr.addressable_shards]
+        else:
+            raw_shards = [(tuple(slice(0, d) for d in shape), arr)]
+        shards, seen, dtype = [], set(), None
+        for index, data in raw_shards:
+            bounds = _index_bounds(index, shape)
+            key = tuple(map(tuple, bounds))
+            if key in seen:        # replica of an already-written shard
+                continue
+            seen.add(key)
+            block = np.ascontiguousarray(np.asarray(data))
+            dtype = str(block.dtype)
+            raw = block.tobytes()
+            fname = f"{_mangle(name)}.{len(shards):03d}.bin"
+            atomicio.write_durable_bytes(sd / fname, raw)
+            shards.append({"file": fname, "bounds": bounds,
+                           "crc32": zlib.crc32(raw) & 0xFFFFFFFF,
+                           "nbytes": len(raw)})
+        return {"shape": list(shape), "dtype": dtype,
+                "spec": spec, "shards": shards}
+
+    def _prune(self) -> None:
+        steps = self.all_steps()
+        for s in steps[:-self.keep] if self.keep else []:
+            shutil.rmtree(self.step_path(s), ignore_errors=True)
+        # crash debris: uncommitted dirs older than the newest commit
+        newest = steps[-1] if steps else None
+        for d in self.directory.iterdir():
+            if d.is_dir() and d.name.startswith(_STEP_PREFIX) \
+                    and not (d / MANIFEST).exists():
+                try:
+                    s = int(d.name[len(_STEP_PREFIX):])
+                except ValueError:
+                    continue
+                if newest is not None and s < newest:
+                    shutil.rmtree(d, ignore_errors=True)
+
+    # -- restore --------------------------------------------------
+
+    def restore(self, params_like: Any, opt_state_like: Any,
+                step: int | None = None) -> tuple[Any, Any, int]:
+        """Restore onto the shardings/dtypes of the provided targets;
+        ``step=None`` picks the latest READABLE generation: any
+        verification failure (checksum, truncation, missing shard,
+        torn manifest) falls through newest-first to the previous
+        good one — the models/checkpoint.py contract, now triggered
+        by byte-level verification rather than only parse errors.
+        An explicit ``step=`` stays strict."""
+        import jax  # noqa: F401  (tree utils via _restore_one)
+
+        explicit = step is not None
+        candidates = ([step] if explicit
+                      else sorted(self.all_steps(), reverse=True))
+        if not candidates:
+            raise FileNotFoundError(
+                f"no checkpoint under {self.directory}")
+        target = {"params": params_like, "opt_state": opt_state_like}
+        torn: list[str] = []
+        for s in candidates:
+            try:
+                out = self._restore_one(s, target)
+            except Exception as e:
+                if explicit:
+                    raise
+                torn.append(f"step {s}: {type(e).__name__}: {e}")
+                continue
+            if torn:
+                log.warning(
+                    "sharded generation(s) unreadable, fell back to "
+                    "step %d: %s", s,
+                    "; ".join(t[:200] for t in torn))
+            return out["params"], out["opt_state"], s
+        raise FileNotFoundError(
+            f"no restorable checkpoint under {self.directory}: "
+            f"{'; '.join(torn)}")
+
+    def _restore_one(self, step: int, target) -> Any:
+        import jax
+
+        sd = self.step_path(step)
+        manifest = self._read_manifest(sd)
+        leaves = manifest["leaves"]
+        flat, treedef = jax.tree_util.tree_flatten_with_path(target)
+        cache: dict = {}
+        stats = {"files_read": 0, "bytes_read": 0}
+        out = []
+        for path, like in flat:
+            name = leaf_name(path)
+            if name not in leaves:
+                raise ShardCorruption(
+                    f"manifest at step {step} missing leaf {name!r}")
+            ent = leaves[name]
+            shape = tuple(ent["shape"])
+            if tuple(like.shape) != shape:
+                raise ValueError(
+                    f"leaf {name!r}: checkpoint shape {shape} != "
+                    f"target {tuple(like.shape)}")
+            out.append(self._read_leaf(
+                sd, name, ent, like, cache, stats))
+        self.last_restore_stats = dict(stats)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _read_leaf(self, sd: Path, name: str, ent: dict, like,
+                   cache: dict, stats: dict):
+        import jax
+
+        shape = tuple(ent["shape"])
+        dtype = _np_dtype(ent["dtype"])
+        target_dtype = np.dtype(getattr(like, "dtype", dtype))
+
+        def piece(index):
+            bounds = _index_bounds(index, shape)
+            block = self._assemble(
+                sd, name, ent, dtype, bounds, cache, stats)
+            return (block if block.dtype == target_dtype
+                    else block.astype(target_dtype))
+
+        sharding = getattr(like, "sharding", None)
+        if sharding is not None:
+            # one callback per addressable device -> only the shard
+            # files intersecting THAT device's slice are opened
+            return jax.make_array_from_callback(shape, sharding, piece)
+        return piece(tuple(slice(0, d) for d in shape))
+
+    def read_slice(self, step: int, name: str,
+                   bounds: Sequence[Sequence[int]] | None = None
+                   ) -> np.ndarray:
+        """Verified read of one leaf slice — the per-host streaming
+        primitive: opens only shard files overlapping ``bounds``
+        ([[start, stop], ...]; None = whole leaf).  Read accounting
+        lands in ``last_restore_stats``."""
+        sd = self.step_path(step)
+        ent = self._read_manifest(sd)["leaves"].get(name)
+        if ent is None:
+            raise ShardCorruption(
+                f"manifest at step {step} missing leaf {name!r}")
+        shape = tuple(ent["shape"])
+        dtype = _np_dtype(ent["dtype"])
+        bounds = ([[0, d] for d in shape] if bounds is None
+                  else [list(map(int, b)) for b in bounds])
+        stats = {"files_read": 0, "bytes_read": 0}
+        out = self._assemble(sd, name, ent, dtype, bounds, {}, stats)
+        self.last_restore_stats = stats
+        return out
+
+    def restore_extra(self, step: int | None = None) -> dict:
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint under {self.directory}")
+        return self._read_manifest(self.step_path(step)).get(
+            "extra", {}) or {}
+
+    def close(self) -> None:
+        pass
+
+    # -- verified assembly ----------------------------------------
+
+    def _read_manifest(self, sd: Path) -> dict:
+        p = sd / MANIFEST
+        try:
+            st = p.stat()
+        except FileNotFoundError:
+            self._manifest_cache.pop(sd.name, None)
+            raise ShardCorruption(
+                f"uncommitted generation (no manifest): {sd.name}")
+        key = (st.st_mtime_ns, st.st_size)
+        hit = self._manifest_cache.get(sd.name)
+        if hit is not None and hit[0] == key:
+            return hit[1]
+        try:
+            m = json.loads(p.read_text())
+        except (json.JSONDecodeError, UnicodeDecodeError) as e:
+            raise ShardCorruption(
+                f"garbled manifest in {sd.name}: {e}") from e
+        if m.get("format") != FORMAT:
+            raise ShardCorruption(
+                f"unknown manifest format {m.get('format')!r} "
+                f"in {sd.name}")
+        self._manifest_cache[sd.name] = (key, m)
+        return m
+
+    def _assemble(self, sd: Path, name: str, ent: dict,
+                  dtype: np.dtype, bounds, cache: dict,
+                  stats: dict) -> np.ndarray:
+        lo = [b[0] for b in bounds]
+        hi = [b[1] for b in bounds]
+        out_shape = tuple(h - l for l, h in zip(lo, hi))
+        out = np.empty(out_shape, dtype)
+        want = int(np.prod(out_shape, dtype=np.int64)) \
+            if out_shape else 1
+        covered = 0
+        for sh in ent["shards"]:
+            sb = sh["bounds"]
+            inter = [(max(l, s0), min(h, s1))
+                     for l, h, (s0, s1) in zip(lo, hi, sb)]
+            if any(a >= b for a, b in inter):
+                continue
+            sshape = tuple(s1 - s0 for s0, s1 in sb)
+            data = self._shard_data(sd, sh, dtype, sshape, cache,
+                                    stats, name)
+            src = tuple(slice(a - s0, b - s0)
+                        for (a, b), (s0, _) in zip(inter, sb))
+            dst = tuple(slice(a - l, b - l)
+                        for (a, b), l in zip(inter, lo))
+            out[dst] = data[src]
+            covered += int(np.prod(
+                [b - a for a, b in inter], dtype=np.int64)) \
+                if inter else 1
+        if covered != want:
+            raise ShardCorruption(
+                f"leaf {name!r}: shards cover {covered}/{want} "
+                f"elements of the requested slice")
+        return out
+
+    def _shard_data(self, sd: Path, sh: dict, dtype: np.dtype,
+                    sshape, cache: dict, stats: dict,
+                    name: str) -> np.ndarray:
+        fname = sh["file"]
+        if fname in cache:
+            return cache[fname]
+        path = sd / fname
+        if not path.exists():
+            raise ShardCorruption(
+                f"leaf {name!r}: missing shard file {fname}")
+        raw = path.read_bytes()
+        stats["files_read"] += 1
+        stats["bytes_read"] += len(raw)
+        if len(raw) != sh["nbytes"]:
+            raise ShardCorruption(
+                f"shard {fname}: truncated "
+                f"({len(raw)} != {sh['nbytes']} bytes)")
+        if self.verify and (zlib.crc32(raw) & 0xFFFFFFFF) != sh["crc32"]:
+            raise ShardCorruption(f"shard {fname}: checksum mismatch")
+        arr = np.frombuffer(raw, dtype=dtype).reshape(sshape)
+        cache[fname] = arr
+        return arr
+
+
+__all__ = ["FORMAT", "MANIFEST", "ShardCorruption",
+           "ShardedCheckpointer", "decode_spec", "encode_spec",
+           "leaf_name", "match_partition_rules", "tree_leaf_names"]

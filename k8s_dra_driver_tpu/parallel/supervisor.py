@@ -177,22 +177,30 @@ class ElasticTrainJob:
         self.tp = tp
         self.loader_seed = loader_seed
 
-    def build(self, dp: int, exclude_chips=frozenset()):
+    def build(self, dp: int, exclude_chips=frozenset(),
+              tp: int | None = None):
         """(mesh, train_step, init_state) over dp×tp devices, never
-        touching an excluded (evicted) chip."""
+        touching an excluded (evicted) chip.  ``tp`` re-aims the
+        tensor-parallel width for this and later formations (layouts
+        are rules-driven — models/layouts.py — so the same params
+        restore onto the new tp split); None keeps the current one.
+        The job's width only commits on a successful build, so a
+        failed formation leaves the old tp intact for retries."""
         import jax
 
         from ..models import make_train_step
 
+        tp = self.tp if tp is None else tp
         devs = [d for d in jax.devices()
                 if d.id not in exclude_chips]
-        need = dp * self.tp
+        need = dp * tp
         if len(devs) < need:
             raise SupervisorError(
-                f"cannot form dp={dp} tp={self.tp}: need {need} "
+                f"cannot form dp={dp} tp={tp}: need {need} "
                 f"devices, {len(devs)} survive eviction")
-        mesh = make_mesh(MeshSpec(dp=dp, tp=self.tp), devs[:need])
+        mesh = make_mesh(MeshSpec(dp=dp, tp=tp), devs[:need])
         step_fn, init_state = make_train_step(self.cfg, mesh)
+        self.tp = tp
         return mesh, step_fn, init_state
 
     def make_loader(self):
@@ -313,11 +321,15 @@ class GangSupervisor:
         exactly like the gateway's replica drain wiring."""
         health_monitor.listeners.append(self.on_health)
 
-    def request_width(self, dp: int, *, exclude=None) -> None:
+    def request_width(self, dp: int, *, tp=None, exclude=None) -> None:
         """Ask the gang to re-form at ``dp`` data-parallel rows at the
         next step boundary (the fleet reconciler's resize verb):
         checkpoint-then-shrink preemption when ``dp`` is smaller,
         EXPAND regrow when larger — including regrow out of PARKED.
+        ``tp`` (optional) re-aims the tensor-parallel width in the
+        same boundary: checkpoints are sharded by layout rules, so a
+        dp AND tp change is still restore-onto-a-new-mesh, not a
+        different operation; None keeps the job's current tp.
         ``exclude`` (optional) replaces the placement-exclusion set,
         so a multi-tenant arbiter can pin WHICH chips the formation
         may use (fleet/binpack.py chose them); None keeps the current
@@ -338,8 +350,11 @@ class GangSupervisor:
         if self.job.batch % dp:
             raise ValueError(
                 f"dp {dp} does not divide global batch {self.job.batch}")
+        if tp is not None and tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
         with self._width_lock:
-            self._requested = ("width", dp,
+            self._requested = ("width", dp, None if tp is None
+                               else int(tp),
                                None if exclude is None
                                else frozenset(int(c) for c in exclude))
 
@@ -369,6 +384,27 @@ class GangSupervisor:
             for c in chips:
                 self._unhealthy.pop(c, None)
                 self._last_unhealthy.pop(c, None)
+
+    def _probation_readmit(self) -> set:
+        """Release-valve for the eviction fence: readmit fenced chips
+        the merged health view does NOT currently report down, and
+        return the set released.  A crash eviction fences the
+        victim's chips, but a SOFTWARE crash never produces the heal
+        that :meth:`readmit` forwards for a real chip death — without
+        a release valve every crash permanently burns a chip and a
+        long-lived gang starves out of its own allocation.  Called
+        only from a resize's infeasibility path (the fence must hold
+        through ``_recover`` itself: reforming straight back onto a
+        just-crashed chip would race a lagging health signal)."""
+        with self._unhealthy_lock:
+            down = set(self._last_unhealthy) | set(self._unhealthy)
+            cleared = {c for c in self._dead_chips if c not in down}
+            self._dead_chips -= cleared
+        if cleared:
+            log.warning("probation readmit of fenced chips %s "
+                        "(health view reports them up)",
+                        sorted(cleared))
+        return cleared
 
     def update_fence(self, add=(), discard=()) -> None:
         """Incremental placement-fence maintenance between resizes.
@@ -414,7 +450,7 @@ class GangSupervisor:
 
     # -- formation -------------------------------------------------------
 
-    def _form(self, dp: int) -> None:
+    def _form(self, dp: int, tp: int | None = None) -> None:
         """(Re-)issue the gang contract at world size ``dp`` and stand
         the mesh/step program up over the surviving chips.  The build
         runs BEFORE any state mutates, so a failed formation (not
@@ -433,10 +469,14 @@ class GangSupervisor:
         with self._unhealthy_lock:
             down = (set(self._last_unhealthy)
                     | set(self._unhealthy)) - set(self._dead_chips)
+        # tp rides as a kwarg only when a resize re-aims it, so a
+        # user-supplied job with the documented two-argument ``build``
+        # keeps working for every dp-only arc
+        kwargs = {} if tp is None else {"tp": int(tp)}
         mesh, step_fn, init_state = self.job.build(
             dp, exclude_chips=frozenset(self._dead_chips
                                         | self._placement_excluded
-                                        | down))
+                                        | down), **kwargs)
         self.dp = dp
         self.mesh, self.step_fn, self.init_state = (mesh, step_fn,
                                                     init_state)
@@ -452,6 +492,7 @@ class GangSupervisor:
             "generation": self._gen,
             "num_workers": dp,
             "dp": dp,
+            "tp": getattr(self.job, "tp", None),
             "world_devices": int(grid.size),
             "workers": [w.name for w in self.workers],
             "excluded_chips": sorted(self._dead_chips),
@@ -637,7 +678,8 @@ class GangSupervisor:
         log.warning("resumed at step %d on dp=%d (%d step(s) to "
                     "replay)", at, new_dp, lost)
 
-    def _resize(self, target: int, exclude=None) -> None:
+    def _resize(self, target: int, exclude=None,
+                tp: int | None = None) -> None:
         """Apply an externally requested width change (request_width):
         checkpoint the CURRENT step first — a controlled resize must
         lose nothing — then re-form through the same REFORM path an
@@ -668,18 +710,27 @@ class GangSupervisor:
         if cause == "expand":
             self._transition(EXPAND)
         self._transition(REFORM)
-        try:
-            self._form(target)
-        except SupervisorError as e:
-            # transiently infeasible (chips vanished between request
-            # and apply): keep training at the current width — _form
-            # mutated nothing, and the reconciler sees the unchanged
-            # dp gauge and may re-request when supply returns
-            self._placement_excluded = old_placement
-            log.warning("resize to dp=%d infeasible (%s); staying at "
-                        "dp=%d", target, e, from_dp)
-            self._transition(PARKED if parked else RUNNING)
-            return
+        for retry in (False, True):
+            try:
+                self._form(target, tp=tp)
+                break
+            except SupervisorError as e:
+                # the fence itself may be all that blocks the width
+                # (crash-fenced chips no heal will ever release):
+                # _poll_down() above just refreshed the health view,
+                # so readmit what it reports up and retry once
+                if not retry and self._probation_readmit():
+                    continue
+                # transiently infeasible (chips vanished between
+                # request and apply): keep training at the current
+                # width — _form mutated nothing, and the reconciler
+                # sees the unchanged dp gauge and may re-request when
+                # supply returns
+                self._placement_excluded = old_placement
+                log.warning("resize to dp=%d infeasible (%s); staying"
+                            " at dp=%d", target, e, from_dp)
+                self._transition(PARKED if parked else RUNNING)
+                return
         self._transition(RESUME)
         params, opt = self.init_state(self._key())
         self.params, self.opt, at = self.ckpt.restore(params, opt)
@@ -775,13 +826,15 @@ class GangSupervisor:
                     self._park()
                     return self._step < self._total_steps
             else:
-                _, target, exclude = op
+                _, target, tp, exclude = op
                 same_placement = (
                     exclude is None
                     or set(exclude) == self._placement_excluded)
+                same_tp = tp is None or tp == getattr(
+                    self.job, "tp", tp)
                 if (self.state == PARKED or target != self.dp
-                        or not same_placement):
-                    self._resize(target, exclude)
+                        or not same_tp or not same_placement):
+                    self._resize(target, exclude, tp=tp)
                     return self._step < self._total_steps
                 # coalesced: the gang already matches the request
                 # (same width, same placement) — an idempotent no-op,

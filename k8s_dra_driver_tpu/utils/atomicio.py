@@ -42,6 +42,17 @@ def write_durable(path, text: str) -> None:
         os.fsync(f.fileno())
 
 
+def write_durable_bytes(path, data: bytes) -> None:
+    """``write_durable`` for binary payloads — checkpoint shard files
+    (parallel/resharding.py) are raw array bytes whose commit point
+    is the manifest rename, so they need the data fsync but not the
+    rename half of the discipline."""
+    with open(path, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+
+
 def write_atomic(path, text: str) -> None:
     """The full discipline in one call: sibling tmp + fsync +
     ``os.replace`` + parent-directory fsync.  After return the new

@@ -84,9 +84,10 @@ def test_default_schedule_composes_every_kind():
 
 @pytest.mark.faults
 def test_compound_soak_zero_violations(tmp_path):
-    """220 co-loop cycles of the default schedule: all five fault
-    kinds fire, at least three land inside another fault's recovery
-    window, and every checker stays silent from warmup to drain."""
+    """220 co-loop cycles of the default schedule: all eight fault
+    kinds fire (the shard-corruption trio included), at least three
+    land inside another fault's recovery window, and every checker
+    stays silent from warmup to drain."""
     sched = cru.default_schedule(7, cycles=220)
     res, rig = cru.run_soak(sched, tmp_path / "soak")
     assert_no_violations(

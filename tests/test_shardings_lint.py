@@ -1,0 +1,98 @@
+"""Shardings lint (tools/lint_shardings.py) in the fast tier.
+
+Resharding satellite: the rules tables in models/layouts.py are only
+the single source of layout truth if nothing else in models/ builds a
+``PartitionSpec``/``NamedSharding`` on the side.  This gate makes the
+rule mechanical: every literal sharding outside the rules module
+either moves into a table or carries a ``# layout:`` comment saying
+why it is data placement, not a parameter layout.
+"""
+
+import sys
+import textwrap
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).parent.parent / "tools"))
+
+import lint_shardings  # noqa: E402
+
+
+def test_repo_models_layer_has_no_unjustified_shardings():
+    """THE gate: no naked PartitionSpec/NamedSharding in models/
+    outside layouts.py lacks a '# layout:' justification."""
+    problems = lint_shardings.lint()
+    assert problems == [], "\n".join(problems)
+
+
+def _scratch_repo(tmp_path, body, name="fake.py"):
+    mod_dir = tmp_path / "k8s_dra_driver_tpu" / "models"
+    mod_dir.mkdir(parents=True)
+    (mod_dir / name).write_text(textwrap.dedent(body))
+    return tmp_path
+
+
+def test_aliased_import_is_still_flagged(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        from jax.sharding import PartitionSpec as P
+        def f():
+            return P("tp", None)
+    ''')
+    problems = lint_shardings.lint(repo)
+    assert len(problems) == 1
+    assert "PartitionSpec" in problems[0]
+    assert "fake.py:4" in problems[0]
+
+
+def test_module_attribute_form_is_flagged(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        import jax.sharding
+        import jax.sharding as js
+        def f(mesh):
+            a = jax.sharding.PartitionSpec(None)
+            return js.NamedSharding(mesh, a)
+    ''')
+    problems = lint_shardings.lint(repo)
+    assert len(problems) == 2
+    assert any("PartitionSpec" in p for p in problems)
+    assert any("NamedSharding" in p for p in problems)
+
+
+def test_layout_comment_exempts_inline_and_above(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        def f(mesh):
+            b = P("dp", None)  # layout: input batch, not a parameter
+            # layout: replicated optax counters
+            r = NamedSharding(mesh, P())  # layout: see above
+            return b, r
+    ''')
+    assert lint_shardings.lint(repo) == []
+
+
+def test_unrelated_comment_does_not_exempt(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        from jax.sharding import PartitionSpec as P
+        def f():
+            # shard over tp
+            return P("tp")
+    ''')
+    assert len(lint_shardings.lint(repo)) == 1
+
+
+def test_layouts_module_itself_is_exempt(tmp_path):
+    repo = _scratch_repo(tmp_path, '''
+        from jax.sharding import PartitionSpec as P
+        TABLE = [("wq", P(None, "tp"))]
+    ''', name="layouts.py")
+    assert lint_shardings.lint(repo) == []
+
+
+def test_unrelated_call_named_like_target_not_flagged(tmp_path):
+    # a local helper that merely SHARES the name is not a sharding
+    repo = _scratch_repo(tmp_path, '''
+        def PartitionSpec(x):
+            return x
+        def f():
+            return PartitionSpec(3)
+    ''')
+    assert lint_shardings.lint(repo) == []

@@ -414,13 +414,15 @@ def test_request_width_validates_statically(tmp_path):
         sup.request_width(0)
     with pytest.raises(ValueError, match="does not divide"):
         sup.request_width(3)                # batch 8 % 3 != 0
+    with pytest.raises(ValueError, match="tp must be"):
+        sup.request_width(2, tp=0)
     sup.request_width(2)
     sup.request_width(4)                    # latest request wins
-    assert sup._requested == ("width", 4, None)
+    assert sup._requested == ("width", 4, None, None)
     sup.park()                              # ... including over a park
     assert sup._requested == ("park",)
     sup.request_width(4, exclude=[6, 7])
-    assert sup._requested == ("width", 4, frozenset({6, 7}))
+    assert sup._requested == ("width", 4, None, frozenset({6, 7}))
     ckpt.close()
 
 

@@ -1,0 +1,138 @@
+"""Static lint: model layouts live in the rules table, not in code.
+
+The resharding tentpole moved every parameter placement into the
+declarative per-model tables of ``models/layouts.py``
+(``parallel/resharding.py: match_partition_rules``) — the same move
+the reference driver makes when MIG placement is a declared profile
+selected by CEL rather than enumerated in code (deviceclass.go:31-47).
+A hand-built ``PartitionSpec`` elsewhere in ``models/`` silently
+reintroduces the drift the table exists to kill: a leaf whose layout
+the checkpoint manifest, the lint, and the rule tests never see.
+
+So the rule is mechanical:
+
+- scope: every module in ``k8s_dra_driver_tpu/models/`` EXCEPT
+  ``layouts.py`` (the one module whose whole job is constructing
+  specs);
+- a **naked sharding** is any call that constructs
+  ``PartitionSpec(...)`` or ``NamedSharding(...)`` — through any
+  import alias (``from jax.sharding import PartitionSpec as P``,
+  ``jax.sharding.PartitionSpec``, ...);
+- a site that legitimately needs a literal spec — activation/batch
+  shardings, shard_map in/out specs, device_put of the table's OWN
+  output — carries a ``# layout:`` comment on one of the call's
+  source lines (or the comment block directly above) saying why it is
+  not a parameter layout, which exempts it.
+
+Run from the repo root (CI gates it in the fast tier,
+tests/test_shardings_lint.py)::
+
+    python tools/lint_shardings.py
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SCOPE = pathlib.Path("k8s_dra_driver_tpu") / "models"
+EXEMPT_MODULES = ("layouts.py",)
+_TARGETS = ("PartitionSpec", "NamedSharding")
+
+
+def _alias_table(tree: ast.AST) -> dict[str, str]:
+    """Local name -> sharding-class name, following import aliases."""
+    aliases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name in _TARGETS:
+                    aliases[a.asname or a.name] = a.name
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                # `import jax.sharding [as js]`: attribute calls are
+                # resolved in _constructed against the module alias
+                if a.name in ("jax.sharding", "jax"):
+                    aliases[(a.asname or a.name).split(".")[0]] = \
+                        "@module"
+    return aliases
+
+
+def _constructed(call: ast.Call, aliases: dict[str, str]) -> str | None:
+    """The sharding class ``call`` constructs, or None."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        target = aliases.get(func.id)
+        return target if target in _TARGETS else None
+    # jax.sharding.PartitionSpec(...) / js.NamedSharding(...)
+    if isinstance(func, ast.Attribute) and func.attr in _TARGETS:
+        root = func.value
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name) \
+                and aliases.get(root.id) == "@module":
+            return func.attr
+    return None
+
+
+def _exempt(call: ast.Call, lines: list[str]) -> bool:
+    """True when a ``# layout:`` comment justifies the literal spec —
+    on any of the call's own source lines, or in the contiguous
+    comment block immediately above it."""
+    end = getattr(call, "end_lineno", call.lineno) or call.lineno
+    for lineno in range(call.lineno, end + 1):
+        if lineno <= len(lines) and "# layout:" in lines[lineno - 1]:
+            return True
+    lineno = call.lineno - 1
+    while lineno >= 1 and lines[lineno - 1].lstrip().startswith("#"):
+        if "# layout:" in lines[lineno - 1]:
+            return True
+        lineno -= 1
+    return False
+
+
+def lint_file(path: pathlib.Path,
+              repo: pathlib.Path = REPO) -> list[str]:
+    rel = path.relative_to(repo)
+    src = path.read_text()
+    tree = ast.parse(src)
+    lines = src.splitlines()
+    aliases = _alias_table(tree)
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = _constructed(node, aliases)
+        if target and not _exempt(node, lines):
+            problems.append(
+                f"{rel}:{node.lineno} naked {target}(...) — move the "
+                "layout into models/layouts.py or add a '# layout:' "
+                "comment saying why this is not a parameter layout")
+    return problems
+
+
+def lint(repo: pathlib.Path = REPO) -> list[str]:
+    problems = []
+    scope = repo / SCOPE
+    for path in sorted(scope.rglob("*.py")):
+        if path.name in EXEMPT_MODULES:
+            continue
+        problems.extend(lint_file(path, repo))
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    for p in problems:
+        print(p)
+    if problems:
+        print(f"{len(problems)} shardings lint problem(s)")
+        return 1
+    print("shardings lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
