@@ -111,6 +111,12 @@ TINY_MT_KWARGS = dict(tp=1, train_dp=2, batch=4, seq_len=16,
 #: to fire every fault kind and land window-triggered overlaps
 CRUCIBLE_KWARGS = dict(seed=7, cycles=90)
 
+#: paged-KV probe (serving_kv/probe.py): one fixed-budget wave of
+#: ``wave`` prefix-sharing requests + one best-of-``repeats`` decode
+#: throughput duel against the contiguous layout, byte-equality
+#: checked in the same run
+PAGED_KV_KWARGS = dict(wave=6, repeats=5)
+
 #: control-plane ceiling probe (gateway/ctlprobe.py): NO-OP engines +
 #: open-loop trace replay, so the scalars isolate admission/routing
 #: decisions per second from model compute.  Always CPU-meaningful
@@ -676,6 +682,43 @@ def _control_plane_probe(timeout_s: float = 240.0) -> dict:
     return payload
 
 
+def _paged_kv_probe(timeout_s: float = 300.0) -> dict:
+    """Paged-KV probe (serving_kv/probe.py) in a CPU-pinned
+    subprocess: peak concurrent requests at a fixed synthetic HBM
+    budget (paged block tables + CoW prefix sharing vs contiguous
+    per-slot slabs), the peak CoW-shared fraction of the pool, and
+    the paged/contiguous decode-throughput ratio with outputs
+    verified byte-equal in the same run."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    kwargs = json.dumps(PAGED_KV_KWARGS)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.serving_kv.probe import "
+        "paged_kv_probe\n"
+        f"print(json.dumps(paged_kv_probe("
+        f"**json.loads({kwargs!r}))))\n")
+    env = cpu_jax_env(1)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = "CPU-pinned subprocess; " + payload.get("note", "")
+    return payload
+
+
 def _tpu_probes():
     """Yield (key, result) per probe — most valuable first.
 
@@ -1138,6 +1181,10 @@ _PROBE_SCALARS = (
     ("resharding", "rs_restore_ms_w4", "restore_ms_w4"),
     ("resharding", "rs_verify_overhead_x", "verify_overhead_x"),
     ("resharding", "rs_corrupt_detected", "corrupt_detected"),
+    ("serving_paged", "pg_max_concurrent_x", "pg_max_concurrent_x"),
+    ("serving_paged", "pg_cow_shared_frac", "pg_cow_shared_frac"),
+    ("serving_paged", "pg_decode_tok_s_ratio",
+     "pg_decode_tok_s_ratio"),
     ("control_plane", "ctl_admissions_per_s", "admissions_per_s"),
     ("control_plane", "ctl_routes_per_s", "routes_per_s"),
     ("control_plane", "ctl_goodput_flat_x", "goodput_flat_x"),
@@ -1382,6 +1429,15 @@ def main() -> None:
                 timeout_s=min(240.0, _remaining() - 45.0))
         else:
             resharding = {"error": "skipped: wall budget"}
+        # 3c5. Paged-KV probe (hermetic, CPU subprocess): concurrent
+        #      requests at a fixed HBM budget, peak CoW-shared
+        #      fraction, and the paged/contiguous decode ratio with
+        #      byte-equality checked in-run.
+        if _remaining() > 90:
+            paged = _paged_kv_probe(
+                timeout_s=min(240.0, _remaining() - 45.0))
+        else:
+            paged = {"error": "skipped: wall budget"}
         # 3d. Control-plane ceiling probe (hermetic, CPU subprocess):
         #     admissions/s + routes/s over no-op engines under
         #     open-loop trace replay, swept over pump counts.
@@ -1402,6 +1458,7 @@ def main() -> None:
         compute["fleet_multitenant"] = fleet_mt
         compute["crucible"] = crucible
         compute["resharding"] = resharding
+        compute["serving_paged"] = paged
         compute["control_plane"] = ctl
         detail["tpu"] = compute
         detail["baseline_note"] = (

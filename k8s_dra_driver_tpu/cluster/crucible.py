@@ -69,9 +69,13 @@ log = logging.getLogger(__name__)
 #: ``gen_tear`` deletes the generation's manifest — the exact on-disk
 #: state a crash between the shard writes and the manifest commit
 #: leaves behind (parallel/resharding.py two-phase discipline).
+#: ``kv_exhaust`` (serving_kv/) seizes every free KV block on the
+#: matching paged replicas for ``heal_after`` cycles — the fleet-wide
+#: memory-pressure wave: admission must hold/shed at the gateway and
+#: the starved engines must keep their in-flight rows byte-exact.
 EVENT_KINDS = ("chip_kill", "worker_crash", "worker_hang",
                "replica_kill", "burst", "shard_bitflip",
-               "shard_truncate", "gen_tear")
+               "shard_truncate", "gen_tear", "kv_exhaust")
 CORRUPTION_KINDS = ("shard_bitflip", "shard_truncate", "gen_tear")
 
 #: reconciler event kinds that open the "cascade" window
@@ -176,7 +180,8 @@ class FaultEvent:
     window: str | None = None       # glob over open windows
     after_cycle: int = 0            # window events wait at least this
     chip: int | None = None         # chip_kill target
-    heal_after: int | None = None   # chip_kill: polls until the heal
+    heal_after: int | None = None   # chip_kill: polls until the heal;
+    #                                 kv_exhaust: cycles until release
     gang: str | None = None         # worker_*/corruption target gang
     row: int | None = None          # worker_* target dp row
     replica_glob: str | None = None  # replica_kill name glob
@@ -275,6 +280,12 @@ def default_schedule(seed: int = 7, cycles: int = 220) -> Schedule:
                    at_cycle=3 * u + 1, n=12, prompt_seed=ps()),
         FaultEvent(id="pressure-burst-3", kind="burst",
                    at_cycle=3 * u + 2, n=12, prompt_seed=ps()),
+        # ...and the decode pool's KV blocks are seized at the crest
+        # of the wave (fleet-wide memory pressure: fills hold at the
+        # gateway, in-flight rows stay byte-exact, release recovers)
+        FaultEvent(id="kv-exhaust-in-pressure", kind="kv_exhaust",
+                   at_cycle=3 * u + 3, replica_glob="d*",
+                   heal_after=3),
         # ...and a decode replica is killed while prefill->decode
         # handoffs are in flight (drain-mid-KV-handoff)
         FaultEvent(id="decode-kill-in-handoff", kind="replica_kill",
@@ -369,8 +380,13 @@ class CrucibleRig:
 
     def __init__(self, schedule: Schedule, workdir,
                  *, dump_dir=None, step_deadline_s: float = 5.0,
-                 hang_stall_s: float = 20.0):
+                 hang_stall_s: float = 20.0,
+                 kv_layout: str = "paged"):
         self.schedule = schedule
+        # serving engines run the paged KV layout by default so
+        # kv_exhaust waves starve a REAL block ledger; "contiguous"
+        # opts back into the dense-slab fleet (byte-equal either way)
+        self.kv_layout = kv_layout
         self.workdir = Path(workdir)
         self.workdir.mkdir(parents=True, exist_ok=True)
         self.dump_dir = dump_dir
@@ -393,6 +409,9 @@ class CrucibleRig:
         # invariant's ground truth)
         self._resume_at: dict = {}
         self.tampered: dict = {}
+        # replica name -> cycle at which its seized KV blocks release
+        self._kv_seized: dict = {}
+        self.kv_seizures = 0
         self._build()
 
     # -- construction ----------------------------------------------------
@@ -451,7 +470,8 @@ class CrucibleRig:
         chip_map = {"p0": 6, "d1": 7}
         self.mgr = DisaggReplicaManager(
             lambda name: ServingEngine(_params(), _cfg(), slots=2,
-                                       prefix_cache=2),
+                                       prefix_cache=2,
+                                       kv_layout=self.kv_layout),
             prefill_replicas=1, decode_replicas=1,
             chip_of=chip_map.get,
             health_source=self.ledger.current_unhealthy,
@@ -533,6 +553,8 @@ class CrucibleRig:
         if any(t >= horizon and k in CASCADE_KINDS
                for t, k, _ in self.rec.events):
             w.add("cascade")
+        if self._kv_seized:
+            w.add("kv_pressure:hi")
         self._win_hist.append(frozenset(w))
 
     def _sticky_windows(self) -> set:
@@ -582,6 +604,23 @@ class CrucibleRig:
             self.replica_plan.arm(FaultRule(
                 verb=HEALTH_VERB, kind="Replica",
                 name=ev.replica_glob or "d*", times=1, error="drop"))
+        elif ev.kind == "kv_exhaust":
+            glob = ev.replica_glob or "*"
+            hit = 0
+            for r in self.mgr.replicas:
+                km = getattr(r.engine, "kv_manager", None)
+                if km is None or r.state == "dead":
+                    continue
+                if not fnmatch.fnmatchcase(r.name, glob):
+                    continue
+                km.seize_free()
+                self._kv_seized[r.name] = cycle + (ev.heal_after or 2)
+                hit += 1
+            self.kv_seizures += hit
+            if not hit:
+                log.info("crucible: %s matched no paged replica "
+                         "(glob %s, layout %s); no-op", ev.id, glob,
+                         self.kv_layout)
         elif ev.kind in CORRUPTION_KINDS:
             self._corrupt(ev)
         elif ev.kind == "burst":
@@ -649,6 +688,17 @@ class CrucibleRig:
         invariant sweep.  Returns this cycle's violations."""
         from ..parallel.supervisor import SupervisorError
         cycle = self.cycle
+        # release expired kv_exhaust seizures BEFORE injection, so a
+        # schedule can re-seize the same replica in the same cycle; a
+        # replica drained/compacted mid-wave took its blocks with it
+        for name, until in list(self._kv_seized.items()):
+            if cycle < until:
+                continue
+            del self._kv_seized[name]
+            for r in self.mgr.replicas:
+                if r.name == name and r.state != "dead":
+                    r.engine.kv_manager.release_seized()
+                    break
         if inject:
             for ev in self.schedule.events:
                 if self._due(ev, cycle):

@@ -57,7 +57,8 @@ from .admission import (DISPATCHED, FINISHED, QUEUED,
                         REJECTED_INVALID, SHED_EXPIRED, AdmissionError,
                         AdmissionQueue, GatewayRequest)
 from .replica import DEAD, EngineReplica, ReplicaManager
-from .router import PrefixAffinityRouter, Router
+from .router import (PrefixAffinityRouter, Router, _under_bound,
+                     kv_admits)
 
 # metrics outcome labels
 _FINISHED_ATTAINED = "finished_attained"
@@ -130,6 +131,10 @@ class FleetGateway:
         self.tracer = tracer
         self._trace_ctx = (tracer.begin(f"gw-{tenant or 'pool'}")
                            if tracer is not None else None)
+        # per-replica last-seen eviction totals, so the fleet counter
+        # advances by deltas (a replaced replica's name never recurs
+        # — ReplicaManager names are generation-fresh)
+        self._kv_evictions_seen: dict[str, int] = {}
         if tracer is not None and pool_owner:
             tracing.wire_pool(tracer, manager)
         if pool_owner:
@@ -251,6 +256,7 @@ class FleetGateway:
             self.metrics.replica_roles.labels(role=role).set(n)
         for state, n in counts.items():
             self.metrics.replicas.labels(state=state).set(n)
+        self._fold_kv_occupancy()
         self._drain_migrations()
         self.bus.publish("demand", queue_depth=len(self.queue),
                          arrival_rate_rps=self.arrival_rate_rps,
@@ -285,6 +291,17 @@ class FleetGateway:
                                            self.manager.replicas)
                 route_s = self.clock() - rt0
             if target is None:
+                # distinguish WHY the head is stuck: a depth-bounded
+                # pool is ordinary backpressure, but candidates held
+                # back solely by KV block headroom are fleet-wide
+                # block exhaustion — counted so an operator can tell
+                # "pool busy" from "pool out of KV memory" (the
+                # request itself waits and sheds at its deadline:
+                # shed-not-crash)
+                if any(r.ready and _under_bound(r)
+                       and not kv_admits(r, g.request.prompt)
+                       for r in self.manager.replicas):
+                    self.metrics.kv_exhausted_holds.inc()
                 break
             g = self.queue.pop(now)
             if g is None:
@@ -459,6 +476,35 @@ class FleetGateway:
                 self.metrics.prefix_bytes_reused.inc(p["nbytes"])
         elif p["event"] == "miss":
             self.metrics.prefix_misses.inc()
+
+    def _fold_kv_occupancy(self) -> None:
+        """Fold every paged replica's block-ledger levels into the
+        registry, once per pump step.  Gauges are levels, not events
+        — there is nothing to event-fold — and the walk touches only
+        host-side numpy counters (KVBlockManager.view), so the cost
+        is O(live replicas) with no device sync.  Replicas without
+        the KV signal (contiguous engines, stubs) are skipped
+        entirely — the same degrade contract as the router's
+        ``kv_admits``."""
+        for r in self.manager.replicas:
+            if r.state == DEAD:
+                continue
+            occ = r.occupancy()
+            if "kv_free_blocks" not in occ:
+                continue
+            free = occ["kv_free_blocks"]
+            self.metrics.kv_blocks_free.labels(replica=r.name).set(free)
+            self.metrics.kv_blocks_used.labels(replica=r.name).set(
+                occ["kv_total_blocks"] - free)
+            self.metrics.kv_cow_shared.labels(replica=r.name).set(
+                occ["kv_cow_shared_blocks"])
+            store = getattr(r.engine, "_prefix", None)
+            total = getattr(store, "evictions", None)
+            if total is not None:
+                seen = self._kv_evictions_seen.get(r.name, 0)
+                if total > seen:
+                    self.metrics.kv_block_evictions.inc(total - seen)
+                    self._kv_evictions_seen[r.name] = total
 
     def _drain_migrations(self) -> None:
         """Fold the pool's KV-migration events into the registry —

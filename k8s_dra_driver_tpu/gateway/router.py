@@ -25,6 +25,17 @@ Routers never overfill: a replica at its depth bound is not a
 candidate, and ``route`` returns None when every replica is at bound —
 backpressure stays IN the admission queue where shedding is
 accounted, instead of hiding in per-replica queues.
+
+**KV-memory admission** (serving_kv/): a paged replica's occupancy
+carries ``kv_headroom_blocks`` — free blocks plus cold prefix-store
+entries it can reclaim without touching live requests.  A replica
+whose headroom cannot hold the prompt's blocks is not a candidate
+(:func:`kv_admits`), so block exhaustion surfaces as queueing and
+SLO shedding at the gateway, never as allocation churn inside an
+engine; among candidates, more headroom wins load-spill ties
+(``_spill_key``).  Replicas without the signal (contiguous engines,
+remote stubs) are always admissible — the gate degrades to the old
+behavior, it never invents pressure.
 """
 
 from __future__ import annotations
@@ -63,16 +74,47 @@ def _under_bound(replica) -> bool:
     return occ["active"] + occ["pending"] < replica.depth_bound
 
 
+def kv_admits(replica, prompt) -> bool:
+    """Whether the replica's paged-KV headroom can hold ``prompt``'s
+    fill: ceil((L + 1) / block_size) blocks (the +1 is the first
+    generated token's row — a fill that cannot seed generation is a
+    guaranteed immediate preemption).  True when the replica reports
+    no KV signal (contiguous engine or remote stub)."""
+    occ = replica.occupancy()
+    if "kv_headroom_blocks" not in occ:
+        return True
+    need = -(-(len(prompt) + 1) // occ["kv_block_size"])
+    return occ["kv_headroom_blocks"] >= need
+
+
+def _headroom(replica) -> float:
+    """Reclaimable KV blocks; inf when the replica has no block pool
+    (no memory constraint to prefer against)."""
+    return replica.occupancy().get("kv_headroom_blocks", float("inf"))
+
+
+def _spill_key(replica):
+    """Least depth, then MOST KV headroom, then name order — the
+    memory-pressure-aware tiebreak: at equal load, new work lands
+    where eviction/preemption is least likely."""
+    return (_depth(replica), -_headroom(replica), replica.name)
+
+
+def _candidates(prompt, replicas) -> list:
+    return [r for r in replicas
+            if r.ready and _under_bound(r) and kv_admits(r, prompt)]
+
+
 class LeastLoadedRouter(Router):
     """Pure least-queue-depth spill (also the affinity fallback)."""
 
     last_reason = "least_loaded"
 
     def route(self, prompt, replicas):
-        ready = [r for r in replicas if r.ready and _under_bound(r)]
+        ready = _candidates(prompt, replicas)
         if not ready:
             return None
-        return min(ready, key=lambda r: (_depth(r), r.name))
+        return min(ready, key=_spill_key)
 
 
 class RoundRobinRouter(Router):
@@ -84,7 +126,7 @@ class RoundRobinRouter(Router):
         self._i = 0
 
     def route(self, prompt, replicas):
-        ready = [r for r in replicas if r.ready and _under_bound(r)]
+        ready = _candidates(prompt, replicas)
         if not ready:
             return None
         pick = ready[self._i % len(ready)]
@@ -120,19 +162,19 @@ class PrefixAffinityRouter(Router):
 
     def route(self, prompt, replicas):
         prompt = np.asarray(prompt, np.int32)
-        ready = [r for r in replicas if r.ready and _under_bound(r)]
+        ready = _candidates(prompt, replicas)
         if not ready:
             return None
         scored = [(self._affinity(prompt, r), r) for r in ready]
         best, _ = max(scored, key=lambda s: s[0])
         if best >= self.min_affinity:
-            # deterministic among equals: deepest affinity, then
-            # least depth, then name order
+            # deterministic among equals: deepest affinity, then the
+            # memory-aware spill key (least depth, most KV headroom)
             pick = min((r for a, r in scored if a == best),
-                       key=lambda r: (_depth(r), r.name))
+                       key=_spill_key)
             self.last_reason = "affinity"
         else:
-            pick = min(ready, key=lambda r: (_depth(r), r.name))
+            pick = min(ready, key=_spill_key)
             self.last_reason = "spill"
         hist = self._routed.setdefault(pick.name,
                                        deque(maxlen=self.history))
@@ -147,4 +189,4 @@ class PrefixAffinityRouter(Router):
 
 
 __all__ = ["Router", "LeastLoadedRouter", "RoundRobinRouter",
-           "PrefixAffinityRouter"]
+           "PrefixAffinityRouter", "kv_admits"]

@@ -758,6 +758,217 @@ def spec_accept_rows(logits: jax.Array, proposals: jax.Array,
     return emit, a, new_keys
 
 
+# -- paged KV cache (serving_kv/) ------------------------------------
+#
+# The block-pool twin of the contiguous cache: K/V lives in
+# [n_blocks, block_size, H_kv, D] pools and each request reads its
+# scattered blocks through a per-request block table (PagedAttention,
+# Kwon et al., SOSP 2023; ownership/refcounts live host-side in
+# serving_kv/manager.py).  The decode step shares _project_and_write
+# and _attn_mlp_tail with the contiguous paths — only the write
+# target and the attention read differ — and the non-kernel read is a
+# block gather into a dense [B, max_seq] view fed to the SAME
+# _cached_attention, so the paged engine is BITWISE equal to the
+# contiguous engine on CPU (gathered rows are exact copies; masked
+# tail rows contribute exact softmax zeros).  The pallas kernel
+# (ops/paged_attention.py) is the TPU read path.
+
+
+def init_paged_pool(cfg: TransformerConfig, n_blocks: int,
+                    block_size: int) -> KVCache:
+    """Zero block pool: per-layer [n_blocks, block_size, H_kv, D]
+    (block 0 is the null block dead table rows point at).  ``pos`` is
+    meaningless for a pool (per-request positions live host-side) and
+    rides as 0.  int8 KV is contiguous-only for now — the per-row
+    scale tensors would need their own pool."""
+    if cfg.kv_cache_dtype == "int8":
+        raise ValueError("paged KV does not support the int8 cache")
+    shape = (n_blocks, block_size, cfg.kv_heads, cfg.d_head)
+    return KVCache(
+        k=[jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+        v=[jnp.zeros(shape, cfg.dtype) for _ in range(cfg.n_layers)],
+        pos=jnp.int32(0))
+
+
+def _paged_dense(pool_arr, tables):
+    """[n_blocks, bs, H_kv, D] pool + [B, n] tables -> the gathered
+    dense [B, n*bs, H_kv, D] view (junk in masked tail rows)."""
+    b, n = tables.shape
+    g = pool_arr[tables]
+    return g.reshape(b, n * pool_arr.shape[1], *pool_arr.shape[2:])
+
+
+def _paged_rows_forward(params, tokens, cfg, pool, tables, pos_rows,
+                        use_kernel):
+    """tokens [B, 1] appended at per-row positions into the block
+    pool -> (logits [B, 1, vocab], pool).  The paged twin of
+    ``_rows_forward``: the write lands at (tables[b, pos//bs],
+    pos % bs) and dead rows (table slot = null block) write to block
+    0, which no live row ever reads — so full-batch dispatch stays
+    static-shape with no mask argument."""
+    params = _with_layers(params, cfg)
+    b, t = tokens.shape
+    positions = pos_rows[:, None] + jnp.arange(t)[None]
+    x = take_rows(params["embed"], tokens, cfg.dtype)
+    bs = pool.k[0].shape[1]
+    phys = jnp.take_along_axis(tables, (pos_rows // bs)[:, None],
+                               axis=1)[:, 0]
+    off = pos_rows % bs
+    new_k, new_v = [], []
+
+    def write_pool(dst, new):
+        return dst.at[phys, off].set(new[:, 0])
+
+    for layer, k_pool, v_pool in zip(params["layers"], pool.k,
+                                     pool.v):
+        (q, k, v, k_pool, v_pool, _, _) = _project_and_write(
+            layer, x, positions, cfg, k_pool, v_pool, None, None,
+            write_pool)
+        new_k.append(k_pool)
+        new_v.append(v_pool)
+        if use_kernel:
+            from ..ops.paged_attention import paged_attention
+            o = paged_attention(q[:, 0], k_pool, v_pool, tables,
+                                pos_rows + 1)[:, None]
+        else:
+            o = _cached_attention(q, _paged_dense(k_pool, tables),
+                                  _paged_dense(v_pool, tables),
+                                  pos_rows, t, cfg)
+        x = _attn_mlp_tail(x, o, layer, cfg)
+    x = rms_norm(x, params["ln_f"])
+    logits = ein("btd,dv->btv", x, params["unembed"])
+    return logits, KVCache(k=new_k, v=new_v, pos=pool.pos)
+
+
+@dispatch.counted("paged_decode_step_rows")
+@functools.partial(jax.jit, static_argnames=("cfg", "use_kernel"),
+                   donate_argnums=(3,))
+def paged_decode_step_rows(params: Params, token: jax.Array,
+                           cfg: TransformerConfig, pool: KVCache,
+                           tables: jax.Array, pos_rows: jax.Array,
+                           use_kernel: bool = False
+                           ) -> tuple[jax.Array, KVCache]:
+    """One paged decode step: token [B, 1], tables [B, n_pages]
+    int32, pos_rows [B] -> (logits [B, vocab], pool).  The pool is
+    donated (in-place block writes); ``use_kernel`` (static) selects
+    the pallas read path — False keeps the gather + dense
+    ``_cached_attention`` read that is bitwise-equal to the
+    contiguous engine on CPU."""
+    b, t = token.shape
+    if t != 1:
+        raise ValueError(f"paged_decode_step_rows is one token per "
+                         f"slot, got T={t}")
+    logits, pool = _paged_rows_forward(params, token, cfg, pool,
+                                       tables, pos_rows, use_kernel)
+    return logits[:, 0], pool
+
+
+@dispatch.counted("paged_adopt")
+@functools.partial(jax.jit, static_argnames=("n_blocks",),
+                   donate_argnums=(0,))
+def paged_adopt_blocks(pool: KVCache, one: KVCache, ids: jax.Array,
+                       start_block: jax.Array, n_blocks: int
+                       ) -> KVCache:
+    """Scatter rows [start_block*bs, (start_block+n_blocks)*bs) of a
+    dense [1, S] cache into pool blocks ``ids`` ([n_blocks] int32) —
+    how a fill's transient dense cache lands in the pool.
+    ``start_block`` is traced (prefix hits adopt only the tail), so
+    compilation keys on n_blocks alone."""
+    bs = pool.k[0].shape[1]
+
+    def put(dst, src):
+        rows = jax.lax.dynamic_slice_in_dim(
+            src[0], start_block * bs, n_blocks * bs, axis=0)
+        return dst.at[ids].set(
+            rows.reshape(n_blocks, bs, *rows.shape[1:]))
+
+    return KVCache(
+        k=[put(d, s) for d, s in zip(pool.k, one.k)],
+        v=[put(d, s) for d, s in zip(pool.v, one.v)], pos=pool.pos)
+
+
+@dispatch.counted("paged_gather")
+@jax.jit
+def paged_gather_entry(pool: KVCache, ids: jax.Array, pos
+                       ) -> KVCache:
+    """Gather blocks ``ids`` ([n] int32, padded with the null block
+    to a FIXED table width so all gathers share one program) into a
+    fresh dense [1, n*bs] cache with ``pos`` valid rows — the bridge
+    from shared blocks to the dense prefill/adopt machinery (prefix
+    hits, fleet-index exports).  NOT donated: the pool keeps
+    serving; the entry owns fresh buffers."""
+    def take(lst):
+        out = []
+        for a in lst:
+            g = a[ids]
+            out.append(g.reshape(1, g.shape[0] * g.shape[1],
+                                 *g.shape[2:]))
+        return out
+
+    return KVCache(k=take(pool.k), v=take(pool.v),
+                   pos=jnp.asarray(pos, jnp.int32))
+
+
+@dispatch.counted("paged_cow_copy")
+@functools.partial(jax.jit, donate_argnums=(0,))
+def paged_copy_block(pool: KVCache, src: jax.Array, dst: jax.Array
+                     ) -> KVCache:
+    """Copy-on-write: duplicate physical block ``src`` into ``dst``
+    (traced scalars — one compiled program for every copy) before a
+    writer diverges from the sharers."""
+    def put(lst):
+        return [a.at[dst].set(a[src]) for a in lst]
+
+    return KVCache(k=put(pool.k), v=put(pool.v), pos=pool.pos)
+
+
+@dispatch.counted("paged_slab_export")
+@functools.partial(jax.jit, static_argnames=("n_blocks", "block_size"))
+def paged_slab_from_dense(one: KVCache, n_blocks: int,
+                          block_size: int):
+    """Pack the first n_blocks*block_size rows of a dense [1, S]
+    cache as block-shaped slabs ([n_blocks, bs, H_kv, D] per layer) —
+    the migration payload of a paged prefill export: ships
+    ceil(L/bs) blocks instead of the dense [1, max_seq] slab
+    (serving_disagg/migrate.py)."""
+    def take(lst):
+        return [a[0, :n_blocks * block_size].reshape(
+            n_blocks, block_size, *a.shape[2:]) for a in lst]
+
+    return take(one.k), take(one.v)
+
+
+@dispatch.counted("paged_slab_adopt")
+@functools.partial(jax.jit, donate_argnums=(0,))
+def paged_adopt_slab(pool: KVCache, slab_k: list, slab_v: list,
+                     ids: jax.Array) -> KVCache:
+    """Land a migrated block slab in pool blocks ``ids`` — the
+    decode-side half of block-table KV migration."""
+    return KVCache(
+        k=[d.at[ids].set(s) for d, s in zip(pool.k, slab_k)],
+        v=[d.at[ids].set(s) for d, s in zip(pool.v, slab_v)],
+        pos=pool.pos)
+
+
+@functools.partial(jax.jit, static_argnames=("max_seq",))
+def paged_dense_from_slab(slab_k: list, slab_v: list, pos,
+                          max_seq: int) -> KVCache:
+    """Unpack a block slab into a dense [1, max_seq] cache — the
+    cross-layout bridge (a contiguous decode engine adopting a paged
+    prefill replica's slab)."""
+    def take(lst):
+        out = []
+        for a in lst:
+            rows = a.reshape(1, a.shape[0] * a.shape[1], *a.shape[2:])
+            out.append(jnp.pad(
+                rows, ((0, 0), (0, max_seq - rows.shape[1]),
+                       (0, 0), (0, 0))))
+        return out
+
+    return KVCache(k=take(slab_k), v=take(slab_v),
+                   pos=jnp.asarray(pos, jnp.int32))
+
+
 def _validated_prefill(params, prompt, cfg, n_tokens, max_seq):
     """Shared generation front half: static bounds checks + flash
     prefill of a fresh cache."""

@@ -78,6 +78,18 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   so "no full-prefill recompute on an index hit" is a CI-pinnable
   launch count.
 
+- **Paged KV cache** (``kv_layout="paged"``): the cache becomes a
+  block pool ([n_blocks, block_size] token rows per layer) owned by a
+  refcounted host ledger (serving_kv/), each slot reads through a
+  per-request block table, and prefix reuse is copy-on-write block
+  SHARING instead of row copies — fills share fully-covered blocks
+  zero-copy, finish-time capture is a refcount bump, and exhaustion
+  escalates evict-cold → preempt-and-requeue instead of crashing.
+  Token streams are byte-equal to the contiguous engine (the CPU
+  read path gathers blocks into a dense view with the contiguous
+  cache's exact shape and feeds the same ``_cached_attention``);
+  pinned in tests/test_serving_kv.py.
+
 No reference analog (SURVEY.md §2.3 — the reference has no serving
 stack at all); beyond-parity workload tier alongside speculative
 decoding and the int8 cache.
@@ -95,6 +107,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..serving_kv import (NULL_BLOCK, BlocksExhausted, KVBlockManager,
+                          PagedPrefixStore)
 from ..utils import dispatch
 from . import decode as _decode
 from .decode import (KVCache, decode_step_rows, decode_window_rows,
@@ -146,6 +160,32 @@ class KVBlock:
     first: int
     carry_key: Any = None           # [2] PRNG key, device-resident
     reused_tokens: int = 0
+
+
+@dataclasses.dataclass
+class PagedKVSlab:
+    """Block-shaped KV migration payload — the paged twin of the
+    [1, S] cache a :class:`KVBlock` carries: per-layer
+    [ceil(L/bs), bs, H_kv, D] slabs holding exactly the prompt's
+    blocks, ``pos`` = prompt length.  Registered as a pytree so the
+    migrator's tree-flatten + ``.pos`` accounting
+    (serving_disagg/migrate.py) works unchanged, while the transfer
+    moves ceil(L/bs)*bs rows instead of a full [1, max_seq]
+    allocation; the decode side lands the blocks straight in its pool
+    and inserts them into its prefix store, so a migrated prefix
+    arrives ALREADY SHARED (refcounted by slot and store at once)."""
+
+    k: list
+    v: list
+    pos: Any
+    block_size: int
+
+
+jax.tree_util.register_pytree_node(
+    PagedKVSlab,
+    lambda s: ((s.k, s.v, s.pos), s.block_size),
+    lambda bs, ch: PagedKVSlab(k=ch[0], v=ch[1], pos=ch[2],
+                               block_size=bs))
 
 
 @dispatch.counted("sample_one")
@@ -388,9 +428,32 @@ class ServingEngine:
                  draft_params=None,
                  draft_cfg: TransformerConfig | None = None,
                  draft_len: int = 4,
-                 chain_steps: int = 1):
+                 chain_steps: int = 1,
+                 kv_layout: str = "contiguous",
+                 kv_block_size: int = 16,
+                 kv_blocks: int | None = None,
+                 kv_kernel: bool | None = None):
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
+        if kv_layout not in ("contiguous", "paged"):
+            raise ValueError(f"unknown kv_layout {kv_layout!r}")
+        self._paged = kv_layout == "paged"
+        if self._paged:
+            # composition gates: each of these owns cache rows in a
+            # way the block ledger does not model yet — fail loudly
+            # instead of corrupting silently
+            if draft_params is not None:
+                raise ValueError("paged KV does not compose with "
+                                 "speculative decoding")
+            if chain_steps > 1:
+                raise ValueError("paged KV does not compose with "
+                                 "fused generation blocks")
+            if cfg.kv_cache_dtype == "int8":
+                raise ValueError("paged KV does not support the "
+                                 "int8 cache")
+            if getattr(cfg, "attention_window", None):
+                raise ValueError("paged KV does not support "
+                                 "windowed attention")
         if not 0.0 <= top_p <= 1.0:
             raise ValueError(f"top_p must be in [0, 1], got {top_p}")
         if (draft_params is None) != (draft_cfg is None):
@@ -410,8 +473,11 @@ class ServingEngine:
         self.slots = slots
         # prefix_cache=N retains the last N fills' K/V for zero-copy
         # prompt-prefix reuse (PrefixCache docstring; ~one cache
-        # slot's memory per entry); 0 disables.
-        self._prefix = PrefixCache(prefix_cache) if prefix_cache else None
+        # slot's memory per entry); 0 disables.  The paged engine
+        # ALWAYS carries a (block-granular) store — CoW sharing is
+        # its core mechanic — sized below once the pool exists.
+        self._prefix = (PrefixCache(prefix_cache)
+                        if prefix_cache and not self._paged else None)
         # speculative continuous batching: a draft model proposes
         # draft_len tokens per slot, the target scores the whole
         # window in one decode_window_rows pass.  Greedy rows use
@@ -447,7 +513,57 @@ class ServingEngine:
         self._time_decode = 0.0
         self._time_host = 0.0
         self.max_seq = max_seq or cfg.max_seq
-        self.cache = init_cache(cfg, slots, self.max_seq)
+        if self._paged:
+            if self.max_seq % kv_block_size:
+                # blocks_per_slot = max_seq // bs keeps the gathered
+                # dense view's shape IDENTICAL to the contiguous
+                # cache — the bitwise-equality invariant
+                raise ValueError(
+                    f"max_seq {self.max_seq} is not a multiple of "
+                    f"kv_block_size {kv_block_size}")
+            self._kv_bs = kv_block_size
+            self._kv_tw = self.max_seq // kv_block_size  # table width
+            if kv_blocks is None:
+                # memory parity with the contiguous cache (+ null
+                # block); callers shrink this to trade HBM for
+                # eviction/preemption pressure
+                kv_blocks = slots * self._kv_tw + 1
+            if kv_blocks - 1 < self._kv_tw:
+                raise ValueError(
+                    f"kv_blocks {kv_blocks} cannot hold one full "
+                    f"{self.max_seq}-token sequence "
+                    f"({self._kv_tw} blocks + the null block)")
+            self.kv_manager = KVBlockManager(kv_blocks, kv_block_size)
+            self.pool = _decode.init_paged_pool(cfg, kv_blocks,
+                                                kv_block_size)
+            self.cache = None        # no contiguous cache in paged mode
+            self._table = np.zeros((slots, self._kv_tw), np.int32)
+            # lazily rebuilt device mirror of _table: block tables
+            # change only at fills, boundary appends, CoW copies and
+            # releases, so steady-state decode skips the per-step
+            # host->device upload (a fixed ~0.1 ms per dispatch on
+            # the CPU backend — 25% of a tiny-model step)
+            self._table_dev = None
+            # one-entry memo of the last store-gathered dense prefix:
+            # KV rows for a token prefix are a pure function of
+            # (params, cfg, tokens) — the byte-equality invariant —
+            # so a value snapshot can never go stale, even after the
+            # store entry is evicted and its blocks recycled.  A
+            # shared-system-prompt wave gathers once instead of once
+            # per fill, at the cost of one slot-equivalent of HBM
+            self._kv_dense_memo: tuple | None = None
+            self._slot_blocks: list[list[int]] = [[] for _ in
+                                                  range(slots)]
+            self._prefix = PagedPrefixStore(
+                prefix_cache or max(2 * slots, 4), self.kv_manager)
+            self._prefix.bytes_per_token = (
+                sum(a.nbytes for a in self.pool.k + self.pool.v)
+                // (kv_blocks * kv_block_size))
+            self._kv_use_kernel = (kv_kernel if kv_kernel is not None
+                                   else jax.default_backend() == "tpu")
+            self._kv_preemptions = 0
+        else:
+            self.cache = init_cache(cfg, slots, self.max_seq)
         self._draft_cache = (init_cache(draft_cfg, slots, self.max_seq)
                              if draft_params is not None else None)
         self.queue: deque[Request] = deque()
@@ -498,6 +614,16 @@ class ServingEngine:
                 + (f" + scratch margin ({margin})" if margin
                    else "")
                 + f" exceeds the {self.max_seq}-slot cache")
+        if self._paged:
+            # a request that can NEVER fit the pool even with every
+            # other block reclaimed must be refused at intake, not
+            # discovered as a livelock under preemption
+            worst = min(prompt.size + req.max_new, self.max_seq)
+            need = -(-worst // self._kv_bs)
+            if need > self.kv_manager.n_blocks - 1:
+                raise ValueError(
+                    f"request needs {need} KV blocks at its longest; "
+                    f"the pool holds {self.kv_manager.n_blocks - 1}")
         return dataclasses.replace(req, prompt=prompt)
 
     def submit(self, req: Request) -> None:
@@ -534,8 +660,13 @@ class ServingEngine:
         """Scheduling snapshot for a router: slot/queue depth plus
         per-active-request generated-token counts (the gateway derives
         time-to-first-token from a count going 0 -> >=1; uids absent
-        from ``tokens`` are still queued engine-side)."""
-        return {
+        from ``tokens`` are still queued engine-side).  Paged engines
+        add their KV-memory signal: free/total blocks plus
+        ``kv_headroom_blocks`` (free + cold store entries the engine
+        can reclaim without touching live requests) — what the
+        router's headroom preference and the gateway's block-exhaustion
+        shed consume."""
+        out = {
             "slots": self.slots,
             "active": self.active,
             "pending": self.pending,
@@ -545,6 +676,15 @@ class ServingEngine:
                        for s, r in enumerate(self._req)
                        if r is not None},
         }
+        if self._paged:
+            view = self.kv_manager.view()
+            out["kv_block_size"] = self._kv_bs
+            out["kv_total_blocks"] = view["total_blocks"]
+            out["kv_free_blocks"] = view["free_blocks"]
+            out["kv_cow_shared_blocks"] = view["cow_shared_blocks"]
+            out["kv_headroom_blocks"] = (
+                view["free_blocks"] + self._prefix.evictable_count())
+        return out
 
     def prefix_peek(self, prompt) -> int:
         """Longest prompt prefix this engine's PrefixCache already
@@ -577,6 +717,8 @@ class ServingEngine:
         readback per export: the first token IS the TTFT-critical
         output of the prefill role)."""
         req = self._check_request(req)
+        if self._paged:
+            return self._kv_prefill_export(req)
         t0 = time.perf_counter()
         start = 0
         if self._prefix is not None:
@@ -630,6 +772,13 @@ class ServingEngine:
             # the block carries target K/V only; a speculative engine
             # would propose from an empty draft cache
             raise ValueError("draft engines cannot adopt KV blocks")
+        if isinstance(block.kv, PagedKVSlab) and not self._paged:
+            # cross-layout bridge: a paged prefill replica feeding a
+            # contiguous decode engine unpacks to the dense cache
+            block = dataclasses.replace(
+                block, kv=_decode.paged_dense_from_slab(
+                    block.kv.k, block.kv.v, block.kv.pos,
+                    self.max_seq))
         req = self._check_request(block.request)
         if any(r.uid == req.uid for r in self.queue) or any(
                 r is not None and r.uid == req.uid for r in self._req):
@@ -639,12 +788,16 @@ class ServingEngine:
         if slot is None:
             raise RuntimeError("no free decode slot to adopt into")
         t0 = time.perf_counter()
-        self.cache = _adopt_slot(self.cache, block.kv,
-                                 jnp.int32(slot))
-        if self._prefix is not None:
-            # the migrated prompt K/V is now a local asset: later
-            # same-prefix traffic hits HERE without another transfer
-            self._prefix.insert(req.prompt, block.kv)
+        if self._paged:
+            self._kv_adopt_into(slot, block, req)
+        else:
+            self.cache = _adopt_slot(self.cache, block.kv,
+                                     jnp.int32(slot))
+            if self._prefix is not None:
+                # the migrated prompt K/V is now a local asset: later
+                # same-prefix traffic hits HERE without another
+                # transfer
+                self._prefix.insert(req.prompt, block.kv)
         self._req[slot] = req
         self._pos[slot] = req.prompt.size
         self._temps[slot] = req.temperature
@@ -666,15 +819,37 @@ class ServingEngine:
         tokens are adopted."""
         if self._prefix is None:
             return None
-        return self._prefix.entry(np.asarray(tokens, np.int32))
+        entry = self._prefix.entry(np.asarray(tokens, np.int32))
+        if entry is None or not self._paged:
+            return entry
+        # dense bridge: the fleet index exchanges [1, S] caches so
+        # paged and contiguous replicas interoperate
+        return self._kv_entry_dense(entry, entry.length)
 
     def import_prefix(self, tokens, entry: KVCache) -> None:
         """Adopt a migrated prefix entry into the local PrefixCache so
         the next fill of a ``tokens``-prefixed prompt hits locally —
-        the receiving half of a fleet-index fetch."""
+        the receiving half of a fleet-index fetch.  On a paged engine
+        the dense rows land in freshly allocated pool blocks owned by
+        the store; under memory pressure the import is SKIPPED (the
+        index is optimization, never correctness — the fill computes
+        locally instead)."""
         if self._prefix is None:
             raise ValueError("prefix cache is off on this engine")
-        self._prefix.insert(np.asarray(tokens, np.int32), entry)
+        tokens = np.asarray(tokens, np.int32)
+        if not self._paged:
+            self._prefix.insert(tokens, entry)
+            return
+        nb = -(-tokens.size // self._kv_bs)
+        try:
+            ids = self._kv_alloc_fill(nb)
+        except BlocksExhausted:
+            return
+        self.pool = _decode.paged_adopt_blocks(
+            self.pool, entry, jnp.asarray(ids, jnp.int32),
+            jnp.int32(0), nb)
+        self._prefix.insert(tokens, ids, tokens.size)
+        self.kv_manager.free_blocks(ids)     # the store's ref remains
 
     def cancel(self, uid) -> bool:
         """Drop a request by uid — queued (removed before it ever
@@ -691,6 +866,8 @@ class ServingEngine:
         for slot, req in enumerate(self._req):
             if req is not None and req.uid == uid:
                 self._tokens_total += len(self._generated[slot])
+                if self._paged:
+                    self._kv_release_slot(slot)
                 self._req[slot] = None
                 self._generated[slot] = []
                 self._temps[slot] = 0.0
@@ -722,6 +899,18 @@ class ServingEngine:
         if self._exports or self._adoptions:
             out["kv_exports_total"] = self._exports
             out["kv_adoptions_total"] = self._adoptions
+        if self._paged:
+            view = self.kv_manager.view()
+            out["kv_blocks_total"] = view["total_blocks"]
+            out["kv_blocks_free"] = view["free_blocks"]
+            out["kv_blocks_used"] = view["used_blocks"]
+            out["kv_cow_shared_blocks"] = view["cow_shared_blocks"]
+            out["kv_block_evictions_total"] = self._prefix.evictions
+            out["kv_cow_copies_total"] = (
+                self.kv_manager.cow_copies_total)
+            out["kv_preemptions_total"] = self._kv_preemptions
+            out["kv_alloc_failures_total"] = (
+                self.kv_manager.alloc_failures)
         if self.draft_params is not None:
             out["speculative_windows_total"] = self._spec_windows
             out["speculative_accepted_total"] = self._spec_accepted
@@ -833,7 +1022,25 @@ class ServingEngine:
     def _finish_slot(self, slot: int, out: list[Finished]) -> None:
         req = self._req[slot]
         gen = self._generated[slot]               # eos token kept
-        if self._prefix is not None and len(gen) > 1:
+        if self._paged:
+            if len(gen) > 1:
+                # finish-time capture is FREE here: the store takes
+                # references on the slot's own blocks — zero copies,
+                # the CoW payoff (_extract_slot's dense twin copies a
+                # whole cache row).  Same written-rows invariant as
+                # the contiguous branch below.
+                written = np.concatenate(
+                    [req.prompt, np.asarray(gen[:-1], np.int32)])
+                if len(written) != int(self._pos[slot]):
+                    raise RuntimeError(
+                        f"prefix-capture invariant broken on slot "
+                        f"{slot}: {len(written)} written rows vs pos "
+                        f"{int(self._pos[slot])}")
+                self._prefix.drop(req.prompt)
+                self._prefix.insert(written, self._slot_blocks[slot],
+                                    len(written))
+            self._kv_release_slot(slot)
+        elif self._prefix is not None and len(gen) > 1:
             # multi-turn reuse: remember the finished conversation's
             # K/V so a follow-up prompt (prompt + generated + new
             # text) adopts the whole history.  Rows written so far =
@@ -913,11 +1120,28 @@ class ServingEngine:
             return finished
         if self.draft_params is not None:
             return self._spec_step(active, finished)
+        if self._paged:
+            # block upkeep BEFORE the step: boundary appends and CoW
+            # copies; under exhaustion this may preempt slots (theirs
+            # or, last resort, this round's own — shed, never crash)
+            self._kv_prepare_step(active)
+            active = [s for s in active
+                      if self._req[s] is not None]
+            if not active:
+                return finished
         t_dec = time.perf_counter()
         tokens = jnp.asarray(self._last[:, None])
-        logits, self.cache = decode_step_rows(
-            self.params, tokens, self.cfg, self.cache,
-            jnp.asarray(self._pos))
+        if self._paged:
+            if self._table_dev is None:
+                self._table_dev = jnp.asarray(self._table)
+            logits, self.pool = _decode.paged_decode_step_rows(
+                self.params, tokens, self.cfg, self.pool,
+                self._table_dev, jnp.asarray(self._pos),
+                self._kv_use_kernel)
+        else:
+            logits, self.cache = decode_step_rows(
+                self.params, tokens, self.cfg, self.cache,
+                jnp.asarray(self._pos))
         if self._temps.any():
             # one fused program merges greedy + sampled rows and
             # advances each sampled slot's key stream exactly as
@@ -1008,6 +1232,8 @@ class ServingEngine:
         — riding the decode step would emit one token past its
         budget and break engine==greedy exactness — so its freed
         slot feeds the next round."""
+        if self._paged:
+            return self._kv_refill(finished)
         for slot in range(self.slots):
             if self._req[slot] is not None and self._done(slot):
                 self._finish_slot(slot, finished)
@@ -1030,6 +1256,358 @@ class ServingEngine:
                 self._fill_finalize(slot, int(first))
                 if self._done(slot):
                     self._finish_slot(slot, finished)
+
+    # -- paged KV (serving_kv/): fills, block upkeep, preemption ---------
+    #
+    # The paged engine keeps the contiguous engine's scheduling
+    # EXACTLY (batched refill rounds, same-round shared-prefix
+    # deferral, per-request sampling schedule) and changes only where
+    # K/V rows live: fills run the same dense [1, S] prefill programs
+    # on a transient cache and scatter the rows into pool blocks;
+    # decode reads through per-slot block tables.  Since per-request
+    # token streams are schedule-independent (pinned by the serving
+    # fuzz tests), preempt-and-rerun under memory pressure never
+    # changes tokens — byte-equality survives the pressure wave.
+
+    def _kv_entry_dense(self, entry, pos: int) -> KVCache:
+        """Gather a store entry's blocks into a transient dense
+        [1, max_seq] cache with ``pos`` valid rows (the bridge into
+        the dense prefill machinery).  Table ids are padded to the
+        fixed slot width so every gather shares one program."""
+        ids = np.full(self._kv_tw, NULL_BLOCK, np.int32)
+        ids[:len(entry.block_ids)] = entry.block_ids
+        return _decode.paged_gather_entry(self.pool,
+                                          jnp.asarray(ids), pos)
+
+    def _kv_alloc_fill(self, n: int) -> list[int]:
+        """Fill-path allocation: free supply first, then cold-entry
+        eviction (LRU-oldest).  Never preempts — a fill must not
+        cannibalize running requests; BlocksExhausted propagates to
+        the caller's requeue/skip."""
+        try:
+            return self.kv_manager.alloc(n)
+        except BlocksExhausted:
+            self._prefix.evict_until(n)
+            return self.kv_manager.alloc(n)
+
+    def _kv_alloc_decode(self, slot: int, n: int) -> list[int]:
+        """Decode-path allocation with the full escalation: free
+        supply -> cold-entry eviction -> preempt the cheapest OTHER
+        slot (fewest generated tokens, ties to the highest slot
+        index).  Raises only when nothing is left to reclaim; the
+        caller then self-preempts ``slot``."""
+        while True:
+            try:
+                return self.kv_manager.alloc(n)
+            except BlocksExhausted:
+                pass
+            if self._prefix.evict_until(n):
+                continue
+            victims = [s for s in range(self.slots)
+                       if s != slot and self._req[s] is not None]
+            if not victims:
+                raise BlocksExhausted(
+                    f"{n} block(s) needed and nothing left to "
+                    f"reclaim")
+            victim = min(victims,
+                         key=lambda s: (len(self._generated[s]), -s))
+            self._kv_preempt(victim)
+
+    def _kv_preempt(self, slot: int) -> None:
+        """Evict a running request entirely: free its blocks, requeue
+        the ORIGINAL request at the queue FRONT.  The rerun prefills
+        the same prompt with the same seed, so its final tokens are
+        identical; nothing was emitted to ``finished``, so delivery
+        stays exactly-once."""
+        self.queue.appendleft(self._req[slot])
+        self._kv_release_slot(slot)
+        self._req[slot] = None
+        self._generated[slot] = []
+        self._temps[slot] = 0.0
+        self._kv_preemptions += 1
+
+    def _kv_release_slot(self, slot: int) -> None:
+        """Drop the slot's block references and point its table rows
+        back at the null block (dead-row writes land there
+        harmlessly)."""
+        if self._slot_blocks[slot]:
+            self.kv_manager.free_blocks(self._slot_blocks[slot])
+        self._slot_blocks[slot] = []
+        self._table[slot, :] = NULL_BLOCK
+        self._table_dev = None
+
+    def _kv_prepare_step(self, active: list) -> None:
+        """Host-side block upkeep before a paged step: append a block
+        when a row crosses a block boundary; copy-on-write the write
+        block when it is shared (a store entry or another slot still
+        references it).  Under exhaustion the escalation is evict
+        cold -> preempt the cheapest other slot -> self-preempt
+        (requeue at the front, retry when the wave passes)."""
+        bs = self._kv_bs
+        for slot in active:
+            if self._req[slot] is None:
+                continue              # preempted earlier in this pass
+            bi = int(self._pos[slot]) // bs
+            blocks = self._slot_blocks[slot]
+            try:
+                if bi == len(blocks):
+                    nid = self._kv_alloc_decode(slot, 1)[0]
+                    blocks.append(nid)
+                    self._table[slot, bi] = nid
+                    self._table_dev = None
+                elif not self.kv_manager.writable(blocks[bi]):
+                    nid = self._kv_alloc_decode(slot, 1)[0]
+                    self.pool = _decode.paged_copy_block(
+                        self.pool, jnp.int32(blocks[bi]),
+                        jnp.int32(nid))
+                    self.kv_manager.free_blocks([blocks[bi]])
+                    self.kv_manager.note_cow_copy()
+                    blocks[bi] = nid
+                    self._table[slot, bi] = nid
+                    self._table_dev = None
+            except BlocksExhausted:
+                self._kv_preempt(slot)
+
+    def _kv_can_admit(self, req: Request) -> bool:
+        """Admission gate for the paged refill: can the manager cover
+        this fill's fresh blocks (plus one block of first-append
+        headroom), counting cold store entries as reclaimable?  A
+        False keeps the request QUEUED — shed-not-crash is the
+        ``kv_exhaust`` contract."""
+        bs = self._kv_bs
+        p = self._prefix.peek(req.prompt)
+        need = -(-req.prompt.size // bs) - p // bs + 1
+        return (self.kv_manager.free
+                + self._prefix.evictable_count()) >= need
+
+    def _kv_refill(self, finished: list) -> None:
+        """Paged refill: the same batched rounds and same-round
+        shared-prefix deferral as the fused path, behind the
+        admission gate — a request is popped only when the pool
+        (after potential cold-entry eviction) can cover its fill.  A
+        fill that still hits BlocksExhausted puts its request (and
+        the rest of the round) back at the queue front; the
+        deterministic rerun keeps tokens byte-equal."""
+        for slot in range(self.slots):
+            if self._req[slot] is not None and self._done(slot):
+                self._finish_slot(slot, finished)
+        while self.queue and any(r is None for r in self._req):
+            t_fill = time.perf_counter()
+            batch = []
+            for slot in range(self.slots):
+                if self._req[slot] is None and self.queue:
+                    if not self._kv_can_admit(self.queue[0]):
+                        break
+                    batch.append((slot, self.queue.popleft()))
+            if not batch:
+                self._time_prefill += time.perf_counter() - t_fill
+                return
+            kept, deferred = [], []
+            live: list[np.ndarray] = []   # prompts filling THIS round
+            for slot, req in batch:
+                cap = req.prompt.size - 1
+                best_live = max(
+                    (min(_overlap(req.prompt, pr), cap)
+                     for pr in live), default=0)
+                if best_live > self._prefix.peek(req.prompt):
+                    # a LONGER match is filling right now (the shared
+                    # system-prompt pattern) — defer one round so this
+                    # request SHARES that fill's blocks instead of
+                    # recomputing them; the first of an overlapping
+                    # set is never deferred, so rounds always progress
+                    deferred.append(req)
+                    continue
+                live.append(req.prompt)
+                kept.append((slot, req))
+            self.queue.extendleft(reversed(deferred))
+            batch = kept
+            by_slot, short = {}, False
+            for i, (slot, req) in enumerate(batch):
+                try:
+                    by_slot[slot] = self._kv_fill_one(slot, req)
+                except BlocksExhausted:
+                    for _, r in reversed(batch[i:]):
+                        self.queue.appendleft(r)
+                    batch = batch[:i]
+                    short = True
+                    break
+            if batch:
+                firsts = np.asarray(jnp.stack(
+                    [by_slot[s] for s, _ in batch]))
+                dispatch.record_readback("fill_round")
+            else:
+                firsts = []
+            self._time_prefill += time.perf_counter() - t_fill
+            for (slot, _), first in zip(batch, firsts):
+                self._fill_finalize(slot, int(first))
+                if self._done(slot):
+                    self._finish_slot(slot, finished)
+            if short:
+                return
+
+    def _kv_fill_one(self, slot: int, req: Request) -> jax.Array:
+        """Paged fill: the longest remembered prefix is shared
+        zero-copy (refcount bumps on its fully-covered blocks), the
+        suffix rides the same dense prefill programs the contiguous
+        engine compiles, and fresh tail blocks are scattered into the
+        pool.  Returns the first token as a DEVICE scalar so the
+        round batches its readback."""
+        L = req.prompt.size
+        bs = self._kv_bs
+        p, entry = self._prefix.longest_prefix(req.prompt)
+        full = p // bs
+        nb = -(-L // bs)
+        # hold references on every entry block the gather reads (the
+        # partial boundary block included) so eviction inside the
+        # alloc fallback cannot free them mid-fill
+        guard = list(entry.block_ids[:-(-p // bs)]) if p else []
+        if guard:
+            self.kv_manager.share(guard)
+        try:
+            fresh = (self._kv_alloc_fill(nb - full)
+                     if nb > full else [])
+        except BlocksExhausted:
+            if guard:
+                self.kv_manager.free_blocks(guard)
+            raise
+        if p > 0:
+            key = req.prompt[:p].tobytes()
+            memo = self._kv_dense_memo
+            if memo is not None and memo[0] == key:
+                one = memo[1]
+            else:
+                one = self._kv_entry_dense(entry, p)
+                self._kv_dense_memo = (key, one)
+        else:
+            one = init_cache(self.cfg, 1, self.max_seq)
+        fill = (_prefill_suffix_jit if p > 0
+                else _decode._prefill_jit)
+        c = self.prefill_chunk or L
+        for off in range(p, L, c):
+            logits, one = fill(self.params,
+                               req.prompt[None, off:off + c],
+                               self.cfg, one, off == 0)
+        if fresh:
+            self.pool = _decode.paged_adopt_blocks(
+                self.pool, one, jnp.asarray(fresh, jnp.int32),
+                jnp.int32(full), nb - full)
+        # the fully-covered guard refs BECOME the slot's references;
+        # a partial boundary block was recomputed into a fresh block,
+        # so its guard ref is dropped
+        if p % bs:
+            self.kv_manager.free_blocks([guard[-1]])
+        blocks = guard[:full] + fresh
+        self._slot_blocks[slot] = blocks
+        self._table[slot, :] = NULL_BLOCK
+        self._table[slot, :nb] = blocks
+        self._table_dev = None
+        self._req[slot] = req
+        self._pos[slot] = L
+        # fill-time memo: the slot's OWN blocks, shared zero-copy (the
+        # store takes its own references; the slot's first write into
+        # a shared partial block triggers CoW, keeping the memo exact)
+        self._prefix.insert(req.prompt, blocks, L)
+        if req.temperature > 0:
+            key, sub = jax.random.split(jax.random.PRNGKey(req.seed))
+            first = _sample_one(logits[0, -1], sub,
+                                jnp.float32(req.temperature),
+                                self.top_k, self.top_p)
+            self._keys = self._keys.at[slot].set(key)
+            self._temps[slot] = req.temperature
+        else:
+            first = jnp.argmax(logits[0, -1])
+            self._temps[slot] = 0.0
+        return first
+
+    def _kv_adopt_into(self, slot: int, block: KVBlock,
+                       req: Request) -> None:
+        """Land an exported block's K/V in pool blocks for ``slot``
+        and insert the prompt into the prefix store — the migrated
+        prefix arrives ALREADY SHARED (slot and store refcount the
+        same physical blocks), the "lands already-shared" half of
+        block-table migration."""
+        L = req.prompt.size
+        nb = -(-L // self._kv_bs)
+        kv = block.kv
+        if isinstance(kv, PagedKVSlab):
+            if kv.block_size != self._kv_bs:
+                raise ValueError(
+                    f"slab block size {kv.block_size} != engine "
+                    f"block size {self._kv_bs}")
+            if kv.k[0].shape[0] != nb:
+                raise ValueError(
+                    f"slab holds {kv.k[0].shape[0]} blocks, prompt "
+                    f"needs {nb}")
+        ids = self._kv_alloc_fill(nb)
+        if isinstance(kv, PagedKVSlab):
+            self.pool = _decode.paged_adopt_slab(
+                self.pool, kv.k, kv.v, jnp.asarray(ids, jnp.int32))
+        else:
+            # dense [1, S] from a contiguous prefill replica
+            self.pool = _decode.paged_adopt_blocks(
+                self.pool, kv, jnp.asarray(ids, jnp.int32),
+                jnp.int32(0), nb)
+        self._slot_blocks[slot] = list(ids)
+        self._table[slot, :] = NULL_BLOCK
+        self._table[slot, :nb] = ids
+        self._table_dev = None
+        self._prefix.insert(req.prompt, ids, L)
+
+    def _kv_prefill_export(self, req: Request) -> KVBlock:
+        """Paged prefill export: the same fill machinery on a
+        transient dense [1, S] cache, but the payload is a
+        block-shaped :class:`PagedKVSlab` (ceil(L/bs) blocks, not the
+        [1, max_seq] slab) so migration moves only the prompt's rows.
+        The prompt is also memoized locally in pool blocks (cold,
+        evictable) when supply allows — later same-prefix exports pay
+        only the suffix."""
+        t0 = time.perf_counter()
+        start = 0
+        p, hit = self._prefix.longest_prefix(req.prompt)
+        if p > 0:
+            start = p
+            one = self._kv_entry_dense(hit, p)
+        else:
+            one = init_cache(self.cfg, 1, self.max_seq)
+        fill = (_prefill_suffix_jit if start > 0
+                else _decode._prefill_jit)
+        c = self.prefill_chunk or req.prompt.size
+        with dispatch.annotated("prefill_export"):
+            for off in range(start, req.prompt.size, c):
+                logits, one = fill(self.params,
+                                   req.prompt[None, off:off + c],
+                                   self.cfg, one, off == 0)
+        L = req.prompt.size
+        nb = -(-L // self._kv_bs)
+        try:
+            ids = self._kv_alloc_fill(nb)
+        except BlocksExhausted:
+            ids = None            # memory pressure: skip the memo
+        if ids is not None:
+            self.pool = _decode.paged_adopt_blocks(
+                self.pool, one, jnp.asarray(ids, jnp.int32),
+                jnp.int32(0), nb)
+            self._prefix.insert(req.prompt, ids, L)
+            self.kv_manager.free_blocks(ids)  # the store's ref remains
+        slab_k, slab_v = _decode.paged_slab_from_dense(
+            one, nb, self._kv_bs)
+        kv = PagedKVSlab(k=slab_k, v=slab_v, pos=jnp.int32(L),
+                         block_size=self._kv_bs)
+        carry = None
+        if req.temperature > 0:
+            key, sub = jax.random.split(jax.random.PRNGKey(req.seed))
+            first = _sample_one(logits[0, -1], sub,
+                                jnp.float32(req.temperature),
+                                self.top_k, self.top_p)
+            carry = key
+        else:
+            first = jnp.argmax(logits[0, -1])
+        first = int(first)
+        dispatch.record_readback("prefill_export")
+        self._exports += 1
+        self._time_prefill += time.perf_counter() - t0
+        return KVBlock(request=req, kv=kv, first=first,
+                       carry_key=carry, reused_tokens=start)
 
     def _fill_fused_round(self, batch: list) -> np.ndarray:
         """One refill round, fully fused, ONE readback: prefix-cache
@@ -1296,5 +1874,5 @@ class ServingEngine:
         raise RuntimeError(f"not drained after {max_steps} steps")
 
 
-__all__ = ["Finished", "KVBlock", "PrefixCache", "Request",
-           "ServingEngine"]
+__all__ = ["Finished", "KVBlock", "PagedKVSlab", "PrefixCache",
+           "Request", "ServingEngine"]

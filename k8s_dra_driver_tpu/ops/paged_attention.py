@@ -1,0 +1,282 @@
+"""Paged-attention decode kernel: block-table-indexed K/V gather.
+
+The serving engines' paged KV mode (models/serving.py,
+``kv_layout="paged"``) stores K/V in a pool of fixed-size token
+blocks ``[n_blocks, block_size, H_kv, D]`` and each request reads its
+own scattered blocks through a per-request block table
+(PagedAttention, Kwon et al., SOSP 2023).  This module is the device
+read path for one decode step (T=1 per row):
+
+- :func:`paged_attention` — the pallas kernel.  Grid ``(B,
+  n_table_blocks)``: each step streams ONE physical pool page per
+  row, selected by the block table riding as scalar prefetch (the
+  K/V BlockSpec index maps read ``tables[b, j]``), and folds it into
+  an online-softmax accumulator in VMEM scratch — so HBM traffic is
+  exactly the valid pages, never a materialized dense copy.  GQA is
+  native: q is carried as ``[H_kv, group, D]`` and the page dot is
+  batched over the un-repeated KV heads, same head convention as
+  ops/flash_attention.py.  Pages past a row's length are skipped
+  with ``pl.when`` (their table slots point at the null block).
+  Interpret mode on non-TPU backends, so the hermetic CPU suite runs
+  the real kernel path (tests/test_paged_attention.py).
+- :func:`paged_attention_reference` — the dense oracle: gather the
+  table's blocks into a ``[B, S, H_kv, D]`` view and apply exactly
+  the masked-softmax einsum math of ``models/decode._cached_attention``
+  (drift between the two is pinned bitwise by the parity tests).
+  This is also the engine's CPU decode path: because the gathered
+  rows are exact copies and masked tail rows contribute exact zeros,
+  the paged engine is BYTE-equal to the contiguous engine hermetically
+  while the kernel carries the TPU fast path.
+
+Tile choices (``dimension_semantics``) route through the shared
+autotable (ops/autotune.py, kernel key ``"paged_decode"``); the
+recorded capacity/throughput evidence for the paged mode is
+tools/paged_kv_cpu.json (hermetic — the TPU tunnel is wedged in this
+container, ROADMAP.md; first live round re-records on-chip).
+
+No reference-driver analog (SURVEY.md §2.3: the reference has no
+serving stack); kernel structure follows ops/flash_attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils import jax_compat  # noqa: F401  (version shims)
+from .autotune import get_autotuner, shape_key
+
+_NEG_INF = -1e30
+_LANE = 128
+
+
+def _round_up(n: int, k: int) -> int:
+    return -(-n // k) * k
+
+
+def _validate(q, k_pool, v_pool, tables, lengths):
+    if q.ndim != 3:
+        raise ValueError(f"q must be [B, H, D], got {q.shape}")
+    if k_pool.ndim != 4 or v_pool.shape != k_pool.shape:
+        raise ValueError(
+            f"pools must be matching [n_blocks, block_size, H_kv, D], "
+            f"got {k_pool.shape} / {v_pool.shape}")
+    b, h, d = q.shape
+    nb, bs, h_kv, dk = k_pool.shape
+    if dk != d:
+        raise ValueError(f"head dim mismatch: q {d} vs pool {dk}")
+    if h % h_kv:
+        raise ValueError(f"query heads {h} not a multiple of kv heads "
+                         f"{h_kv}")
+    if tables.shape[0] != b or tables.ndim != 2:
+        raise ValueError(f"tables must be [B, n] int32, got "
+                         f"{tables.shape}")
+    if lengths.shape != (b,):
+        raise ValueError(f"lengths must be [B], got {lengths.shape}")
+    return b, h, d, bs, h_kv, h // h_kv
+
+
+def paged_attention_reference(q, k_pool, v_pool, tables, lengths,
+                              scale: float | None = None):
+    """Dense oracle: block-gathered view + the exact
+    ``_cached_attention`` masked-softmax math (same einsum order and
+    dtypes, so the two stay BITWISE equal on CPU — pinned against
+    models/decode in tests/test_paged_attention.py).
+
+    q ``[B, H, D]``; pools ``[n_blocks, bs, H_kv, D]``; tables
+    ``[B, n]``; ``lengths`` [B] = valid keys per row (the row's
+    position + 1 when the current token's K/V is already written).
+    Returns ``[B, H, D]``.
+    """
+    b, h, d, bs, h_kv, group = _validate(q, k_pool, v_pool, tables,
+                                         lengths)
+    if scale is None:
+        scale = d ** -0.5
+    n = tables.shape[1]
+    k_cache = k_pool[tables].reshape(b, n * bs, h_kv, d)
+    v_cache = v_pool[tables].reshape(b, n * bs, h_kv, d)
+    key_pos = jnp.arange(n * bs)
+    # _cached_attention's mask is key_pos <= q_pos with q_pos =
+    # lengths - 1; junk gathered rows (partial tails, null-block
+    # pages) are masked to exact softmax zeros, so the gather is
+    # value-transparent
+    mask = key_pos[None, None, :] < lengths[:, None, None]   # [B,1,S]
+    if group == 1:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q[:, None], k_cache,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(mask[:, None], scores, _NEG_INF)
+        p = jax.nn.softmax(scores, axis=-1)
+        o = jnp.einsum("bhqk,bkhd->bqhd", p,
+                       v_cache.astype(p.dtype)).astype(q.dtype)
+        return o[:, 0]
+    qg = q[:, None].reshape(b, 1, h_kv, group, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask[:, None, None], scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", p, v_cache.astype(p.dtype))
+    return o.reshape(b, 1, h, d).astype(q.dtype)[:, 0]
+
+
+def _paged_decode_kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref,
+                         o_ref, m_scr, l_scr, acc_scr, *, bs: int,
+                         n_pages: int, scale: float):
+    """One (row, page) step: fold pool page ``tables[b, j]`` into the
+    row's online-softmax state.  m/l ride as [H_kv, G, LANE]
+    broadcast columns (flash-kernel convention), acc as
+    [H_kv, G, D]."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():                                  # noqa: ANN202
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[b]
+    base = j * bs
+
+    @pl.when(base < length)
+    def _page():                                  # noqa: ANN202
+        q = q_ref[0].astype(jnp.float32)          # [H_kv, G, D]
+        k = jnp.swapaxes(k_ref[0], 0, 1).astype(jnp.float32)
+        v = jnp.swapaxes(v_ref[0], 0, 1).astype(jnp.float32)
+        # [H_kv, G, D] x [H_kv, bs, D] -> [H_kv, G, bs]
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        kpos = base + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(kpos < length, s, _NEG_INF)
+        m_prev = m_scr[:, :, 0]                   # [H_kv, G]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[:, :, 0] * alpha + jnp.sum(p, axis=-1)
+        # [H_kv, G, bs] x [H_kv, bs, D] -> [H_kv, G, D]
+        pv = jax.lax.dot_general(
+            p, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha[..., None] + pv
+        m_scr[...] = jnp.broadcast_to(m_new[..., None], m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new[..., None], l_scr.shape)
+
+    @pl.when(j == n_pages - 1)
+    def _flush():                                 # noqa: ANN202
+        l = jnp.maximum(l_scr[:, :, 0], 1e-30)[..., None]
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret",
+                                             "dimension_semantics"))
+def _paged_attention_call(q, k_pool, v_pool, tables, lengths, *,
+                          scale: float, interpret: bool,
+                          dimension_semantics: tuple):
+    b, h, d = q.shape
+    nb, bs, h_kv, _ = k_pool.shape
+    group = h // h_kv
+    n_pages = tables.shape[1]
+    d_pad = _round_up(d, _LANE)
+    if d_pad != d:
+        pad = ((0, 0), (0, 0), (0, 0), (0, d_pad - d))
+        k_pool = jnp.pad(k_pool, pad)
+        v_pool = jnp.pad(v_pool, pad)
+        q = jnp.pad(q, ((0, 0), (0, 0), (0, d_pad - d)))
+    qg = q.reshape(b, h_kv, group, d_pad)
+
+    kernel = functools.partial(_paged_decode_kernel, bs=bs,
+                               n_pages=n_pages, scale=scale)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                    # tables, lengths
+        grid=(b, n_pages),
+        in_specs=[
+            pl.BlockSpec((1, h_kv, group, d_pad),
+                         lambda i, j, tables, lengths: (i, 0, 0, 0)),
+            pl.BlockSpec((1, bs, h_kv, d_pad),
+                         lambda i, j, tables, lengths:
+                         (tables[i, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, h_kv, d_pad),
+                         lambda i, j, tables, lengths:
+                         (tables[i, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, h_kv, group, d_pad),
+            lambda i, j, tables, lengths: (i, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((h_kv, group, _LANE), jnp.float32),
+            pltpu.VMEM((h_kv, group, _LANE), jnp.float32),
+            pltpu.VMEM((h_kv, group, d_pad), jnp.float32),
+        ],
+    )
+    o = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, group, d_pad),
+                                       q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=dimension_semantics),
+        interpret=interpret,
+    )(tables, lengths, qg, k_pool, v_pool)
+    return o.reshape(b, h, d_pad)[:, :, :d]
+
+
+_DEFAULT_PARAMS = {"dimension_semantics": ("parallel", "arbitrary")}
+
+
+def pick_decode_params(b: int, h_kv: int, group: int, d: int, bs: int,
+                       n_pages: int, dtype) -> dict:
+    """Kernel params for a paged-decode shape, via the shared
+    autotable (``TPU_AUTOTUNE_TABLE``; heuristic default when the
+    shape has no measured row).  The only tunable today is the grid's
+    ``dimension_semantics`` — page axis must stay "arbitrary" (it
+    carries the softmax accumulator), so the table can only flip the
+    batch axis; invalid table rows are clamped to the default."""
+    choice = get_autotuner().pick(
+        "paged_decode",
+        shape_key(b=b, hkv=h_kv, g=group, d=d, bs=bs, nb=n_pages),
+        jnp.dtype(dtype).name, dict(_DEFAULT_PARAMS))
+    params = dict(_DEFAULT_PARAMS)
+    sem = choice.params.get("dimension_semantics")
+    if (isinstance(sem, (list, tuple)) and len(sem) == 2
+            and sem[1] == "arbitrary"
+            and all(s in ("parallel", "arbitrary") for s in sem)):
+        params["dimension_semantics"] = tuple(sem)
+    return params
+
+
+def paged_attention(q, k_pool, v_pool, tables, lengths, *,
+                    scale: float | None = None,
+                    interpret: bool | None = None,
+                    params: dict | None = None):
+    """Block-table paged decode attention (validating entry).
+
+    q ``[B, H, D]`` (one query token per row); ``k_pool``/``v_pool``
+    ``[n_blocks, block_size, H_kv, D]``; ``tables`` ``[B, n]`` int32
+    physical block ids per row (unused tail slots point at the null
+    block 0); ``lengths`` ``[B]`` int32 valid keys per row.  Returns
+    ``[B, H, D]``.  ``interpret=None`` resolves to interpret mode on
+    non-TPU backends (the hermetic-suite contract shared with
+    ops/flash_attention.py)."""
+    tables = jnp.asarray(tables, jnp.int32)
+    lengths = jnp.asarray(lengths, jnp.int32)
+    b, h, d, bs, h_kv, group = _validate(q, k_pool, v_pool, tables,
+                                         lengths)
+    if scale is None:
+        scale = d ** -0.5
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    if params is None:
+        params = pick_decode_params(b, h_kv, group, d, bs,
+                                    tables.shape[1], q.dtype)
+    return _paged_attention_call(
+        q, k_pool, v_pool, tables, lengths, scale=float(scale),
+        interpret=bool(interpret),
+        dimension_semantics=tuple(params["dimension_semantics"]))
+
+
+__all__ = ["paged_attention", "paged_attention_reference",
+           "pick_decode_params"]

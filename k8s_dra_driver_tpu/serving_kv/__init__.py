@@ -1,0 +1,24 @@
+"""Paged KV-cache subsystem: block-table HBM allocation, refcounted
+copy-on-write prefix sharing, and the memory-pressure signals the
+gateway's admission tier consumes.
+
+The chip ledger (fleet/supply.py) bin-packs chips across gangs and
+pools; this package applies the same contiguous-run ledger idiom one
+level down, to the KV bytes *inside* a chip: HBM KV memory is owned as
+fixed-size token blocks, every request carries a block table instead
+of a private worst-case ``[1, max_seq]`` slab, and prefix reuse is a
+refcount bump instead of a copy (PagedAttention, Kwon et al., SOSP
+2023).  The device half — the block-table-indexed pallas decode
+kernel and the pool pytree — lives in ops/paged_attention.py and
+models/decode.py; the engine mode is ``ServingEngine(...,
+kv_layout="paged")`` (models/serving.py).
+
+No reference analog (the reference driver has no serving stack,
+SURVEY.md §2.3); this is the beyond-parity serving-memory tier.
+"""
+
+from .manager import NULL_BLOCK, BlocksExhausted, KVBlockManager
+from .prefix import PagedEntry, PagedPrefixStore
+
+__all__ = ["NULL_BLOCK", "BlocksExhausted", "KVBlockManager",
+           "PagedEntry", "PagedPrefixStore"]

@@ -141,6 +141,35 @@ class GatewayMetrics:
             "tpu_gateway_replica_role",
             "Live replicas by role (unified/prefill/decode)",
             ["role"], registry=self.registry)
+        # paged KV-cache pressure (serving_kv/): per-replica block
+        # ledger levels set once per pump step from occupancy (gauges
+        # are levels — they cannot be event-folded like the counters
+        # above), plus the fleet-wide eviction counter folded as
+        # per-replica deltas in the same walk
+        self.kv_blocks_free = Gauge(
+            "tpu_gateway_kv_blocks_free",
+            "Free KV-cache blocks per paged replica (the router's "
+            "admission headroom floor)", ["replica"],
+            registry=self.registry)
+        self.kv_blocks_used = Gauge(
+            "tpu_gateway_kv_blocks_used",
+            "KV-cache blocks holding live K/V per paged replica",
+            ["replica"], registry=self.registry)
+        self.kv_cow_shared = Gauge(
+            "tpu_gateway_kv_cow_shared_blocks",
+            "KV blocks shared copy-on-write (refcount >= 2) per "
+            "paged replica — the prefix-sharing savings, in blocks",
+            ["replica"], registry=self.registry)
+        self.kv_block_evictions = Counter(
+            "tpu_gateway_kv_block_evictions_total",
+            "Cold prefix-store entries evicted under block pressure, "
+            "across all paged replicas", registry=self.registry)
+        self.kv_exhausted_holds = Counter(
+            "tpu_gateway_kv_exhausted_holds_total",
+            "Dispatch stalls where every candidate replica lacked KV "
+            "block headroom for the queue head (fleet-wide block "
+            "exhaustion: the request waits, then sheds at its "
+            "deadline)", registry=self.registry)
         # sharded control plane (gateway/sharded.py): how many
         # admission/routing pumps serve this pool, and how often the
         # work-stealing spill moved a queued request off a hot shard

@@ -67,6 +67,8 @@ SLOW_PREFIXES = (
     "tests/test_decode.py::test_multi_turn_prefill_is_correct",
     "tests/test_decode.py::test_windowed_decode_matches_forward",
     "tests/test_quant.py::test_quantized_decode_matches_quantized",
+    "tests/test_serving_kv.py::TestPagedEngine::"
+    "test_mixed_workload_byte_equal_to_contiguous",
     "tests/test_flash_attention.py::TestSlidingWindow::test_narrow_grid",
 )
 

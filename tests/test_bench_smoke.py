@@ -238,17 +238,17 @@ def test_probe_roster_pins_multitenant_scalars():
 def test_crucible_probe_streams_zero_violations(tmp_path):
     """The compound-fault crucible probe at the hermetic shape
     bench.py streams (same kwargs object, so this pins what actually
-    streams): the seeded soak survives every cycle, fires all eight
-    fault kinds (the shard-corruption trio included), lands window-
-    triggered overlaps, and — the scalar
-    the whole subsystem exists for — reports ZERO invariant
-    violations."""
+    streams): the seeded soak survives every cycle, fires all nine
+    fault kinds (the shard-corruption trio and the kv_exhaust
+    seizure wave included), lands window-triggered overlaps, and —
+    the scalar the whole subsystem exists for — reports ZERO
+    invariant violations."""
     from k8s_dra_driver_tpu.cluster.chaosprobe import crucible_probe
     out = crucible_probe(**bench.CRUCIBLE_KWARGS,
                          workdir=str(tmp_path))
     assert out["cru_survived_cycles"] == bench.CRUCIBLE_KWARGS["cycles"]
     assert out["cru_invariant_violations"] == 0
-    assert out["cru_fault_kinds"] == 8
+    assert out["cru_fault_kinds"] == 9
     assert out["cru_overlap_hits"] >= 3
     assert out["cru_compound_mttr_ms"] > 0
     assert out["cru_finished"] == out["cru_submitted"] > 0
@@ -614,3 +614,53 @@ def test_rendezvous_gang_probe():
     out = bench.bench_rendezvous_gang(n_workers=2)
     assert out.get("psum_ok") is True, out
     assert out["wall_ms"] > 0
+
+
+def test_paged_kv_probe_streams_schema():
+    """The paged-KV probe at a reduced shape (one timed repeat):
+    the wave byte-equals the contiguous reference in-run, the
+    concurrency win and CoW sharing land, and every scalar the
+    compact line picks up is present.  Thresholds live on the
+    committed full-shape artifact (test_paged_kv_artifact below) —
+    a one-repeat hermetic run is too noisy to pin the ratio."""
+    from k8s_dra_driver_tpu.serving_kv.probe import paged_kv_probe
+    out = paged_kv_probe(wave=4, repeats=1)
+    assert out["byte_equal"] is True
+    assert out["pg_max_concurrent_x"] > 1.0
+    assert out["pg_cow_shared_frac"] > 0
+    assert out["pg_decode_tok_s_ratio"] > 0
+    assert out["paged_peak_active"] > out["contig_peak_active"]
+    assert out["budget_rows"] > 0
+    assert out["paged_tok_s"] > 0 and out["contig_tok_s"] > 0
+
+
+def test_probe_roster_pins_paged_kv_scalars():
+    """Bench-line schema: the paged-KV scalars (concurrency win at
+    fixed budget, CoW-shared fraction, the >=0.9x decode-ratio
+    gate) are IN the compact line roster."""
+    probes = [p for p, _, _ in bench._PROBE_SCALARS]
+    assert "serving_paged" in probes
+    keys = {k: f for _, k, f in bench._PROBE_SCALARS}
+    assert keys["pg_max_concurrent_x"] == "pg_max_concurrent_x"
+    assert keys["pg_cow_shared_frac"] == "pg_cow_shared_frac"
+    assert keys["pg_decode_tok_s_ratio"] == "pg_decode_tok_s_ratio"
+
+
+def test_paged_kv_artifact_pins_claims():
+    """THE paged-KV acceptance gates (repo rule: perf claims trace
+    to tools/*.json): the recorded full-shape artifact must show
+    >=1.5x concurrent requests at the fixed synthetic HBM budget
+    with real CoW sharing, a paged/contiguous decode ratio >=0.9,
+    and in-run byte-equality."""
+    artifact = Path(__file__).parent.parent / "tools" / \
+        "paged_kv_cpu.json"
+    doc = bench.json.loads(artifact.read_text())
+    res = doc["result"]
+    assert res["byte_equal"] is True
+    assert res["pg_max_concurrent_x"] >= 1.5
+    assert res["pg_cow_shared_frac"] > 0
+    assert res["pg_decode_tok_s_ratio"] >= 0.9
+    # same shape the bench run streams (PAGED_KV_KWARGS), so the
+    # artifact is evidence for the line's scalars
+    assert doc["probe"] == "serving_paged"
+    assert doc["harness"] == "serving_kv/probe.py paged_kv_probe"

@@ -1,0 +1,198 @@
+"""Paged-attention decode kernel parity (ops/paged_attention.py).
+
+The pallas kernel runs in interpret mode on the CPU suite (same
+hermetic contract as test_flash_attention.py) and must match the
+dense block-gather oracle ``paged_attention_reference`` across block
+sizes, GQA/MQA head layouts, ragged lengths with partial tail
+blocks, and lane-padded head dims.  The oracle itself is pinned
+BITWISE against ``models/decode._cached_attention`` — that identity
+is what makes the paged serving engine byte-equal to the contiguous
+one (tests/test_serving_kv.py builds on it).
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.models.decode import _cached_attention
+from k8s_dra_driver_tpu.models.transformer import TransformerConfig
+from k8s_dra_driver_tpu.ops.paged_attention import (
+    _DEFAULT_PARAMS,
+    paged_attention,
+    paged_attention_reference,
+    pick_decode_params,
+)
+
+
+def make_case(seed, b, h, h_kv, d, bs, n_pages, lengths=None):
+    """Random pool + scattered (shuffled, non-contiguous) block
+    tables; rows past a row's last valid page point at the null
+    block, as the engine's tables do."""
+    keys = jax.random.split(jax.random.PRNGKey(seed), 5)
+    nb = b * n_pages + 1
+    k_pool = jax.random.normal(keys[0], (nb, bs, h_kv, d), jnp.float32)
+    v_pool = jax.random.normal(keys[1], (nb, bs, h_kv, d), jnp.float32)
+    q = jax.random.normal(keys[2], (b, h, d), jnp.float32)
+    perm = np.asarray(jax.random.permutation(keys[3], nb - 1)) + 1
+    tables = perm[:b * n_pages].reshape(b, n_pages).astype(np.int32)
+    if lengths is None:
+        lengths = np.asarray(
+            jax.random.randint(keys[4], (b,), 1, n_pages * bs + 1),
+            np.int32)
+    else:
+        lengths = np.asarray(lengths, np.int32)
+    for i in range(b):
+        used = -(-int(lengths[i]) // bs)
+        tables[i, used:] = 0
+    return q, k_pool, v_pool, jnp.asarray(tables), jnp.asarray(lengths)
+
+
+@pytest.mark.parametrize("bs", [16, 32, 64])
+def test_kernel_matches_reference_block_sizes(bs):
+    q, kp, vp, tables, lengths = make_case(
+        seed=bs, b=4, h=4, h_kv=2, d=8, bs=bs, n_pages=3)
+    out = paged_attention(q, kp, vp, tables, lengths)
+    ref = paged_attention_reference(q, kp, vp, tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("h,h_kv", [(4, 4), (8, 2), (4, 1)])
+def test_kernel_matches_reference_head_layouts(h, h_kv):
+    """MHA (group 1), GQA, and MQA all share the [H_kv, G, D] kernel
+    layout; the reference has distinct group==1 / grouped branches."""
+    q, kp, vp, tables, lengths = make_case(
+        seed=h * 10 + h_kv, b=3, h=h, h_kv=h_kv, d=16, bs=16,
+        n_pages=2)
+    out = paged_attention(q, kp, vp, tables, lengths)
+    ref = paged_attention_reference(q, kp, vp, tables, lengths)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_kernel_partial_tail_and_boundary_lengths():
+    """Lengths landing mid-block, exactly on a block boundary, at a
+    single token, and at the full table must all mask identically:
+    junk rows in partially-valid pages contribute exact zeros."""
+    bs, n_pages = 16, 3
+    lengths = [1, bs - 1, bs, 2 * bs + 5]
+    q, kp, vp, tables, lens = make_case(
+        seed=7, b=4, h=4, h_kv=2, d=8, bs=bs, n_pages=n_pages,
+        lengths=lengths)
+    out = paged_attention(q, kp, vp, tables, lens)
+    ref = paged_attention_reference(q, kp, vp, tables, lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_kernel_lane_padded_head_dim():
+    """d=8 < the 128-lane tile: the call path pads pools and q to the
+    lane width and slices back; d=128 takes the unpadded path."""
+    for d, seed in ((8, 3), (128, 4)):
+        q, kp, vp, tables, lengths = make_case(
+            seed=seed, b=2, h=4, h_kv=2, d=d, bs=16, n_pages=2)
+        out = paged_attention(q, kp, vp, tables, lengths)
+        ref = paged_attention_reference(q, kp, vp, tables, lengths)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_reference_bitwise_vs_cached_attention():
+    """The oracle IS ``_cached_attention`` on the gathered dense view
+    — same einsum order, dtypes and mask — so the two agree to the
+    bit.  This identity is the byte-equality lemma the paged engine
+    relies on (its CPU decode path gathers and calls
+    ``_cached_attention`` directly)."""
+    b, h, h_kv, d, bs, n_pages = 4, 4, 2, 8, 16, 3
+    q, kp, vp, tables, lengths = make_case(
+        seed=11, b=b, h=h, h_kv=h_kv, d=d, bs=bs, n_pages=n_pages)
+    ref = paged_attention_reference(q, kp, vp, tables, lengths)
+    k_cache = kp[tables].reshape(b, n_pages * bs, h_kv, d)
+    v_cache = vp[tables].reshape(b, n_pages * bs, h_kv, d)
+    cfg = TransformerConfig(
+        vocab=8, d_model=h * d, n_layers=1, n_heads=h, d_head=d,
+        d_ff=16, max_seq=n_pages * bs, n_kv_heads=h_kv,
+        dtype=jnp.float32)
+    dense = _cached_attention(q[:, None], k_cache, v_cache,
+                              jnp.asarray(lengths) - 1, 1, cfg)
+    np.testing.assert_array_equal(np.asarray(ref),
+                                  np.asarray(dense[:, 0]))
+
+
+def test_reference_ignores_junk_in_masked_rows():
+    """Poisoning every key row at or past a row's length (including
+    the null block) must not change the output — the gather is
+    value-transparent under the position mask."""
+    q, kp, vp, tables, lengths = make_case(
+        seed=5, b=2, h=4, h_kv=2, d=8, bs=16, n_pages=2,
+        lengths=[5, 20])
+    ref = paged_attention_reference(q, kp, vp, tables, lengths)
+    kp2, vp2 = np.asarray(kp).copy(), np.asarray(vp).copy()
+    t = np.asarray(tables)
+    for i in range(2):
+        L = int(lengths[i])
+        bi, off = L // 16, L % 16
+        if off:
+            kp2[t[i, bi], off:] = 1e6
+            vp2[t[i, bi], off:] = -1e6
+    kp2[0] = 1e6
+    vp2[0] = -1e6
+    out = paged_attention_reference(q, jnp.asarray(kp2),
+                                    jnp.asarray(vp2), tables, lengths)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+    out_k = paged_attention(q, jnp.asarray(kp2), jnp.asarray(vp2),
+                            tables, lengths)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(out_k),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_validation_errors():
+    q, kp, vp, tables, lengths = make_case(
+        seed=1, b=2, h=4, h_kv=2, d=8, bs=16, n_pages=2)
+    with pytest.raises(ValueError, match=r"q must be \[B, H, D\]"):
+        paged_attention(q[:, 0], kp, vp, tables, lengths)
+    with pytest.raises(ValueError, match="head dim mismatch"):
+        paged_attention(q[..., :4], kp, vp, tables, lengths)
+    with pytest.raises(ValueError, match="not a multiple"):
+        paged_attention(q[:, :3], kp, vp, tables, lengths)
+    with pytest.raises(ValueError, match=r"tables must be \[B, n\]"):
+        paged_attention(q, kp, vp, tables[:1], lengths)
+    with pytest.raises(ValueError, match=r"lengths must be \[B\]"):
+        paged_attention(q, kp, vp, tables, lengths[:1])
+    with pytest.raises(ValueError, match="pools must be matching"):
+        paged_attention(q, kp, vp[:, :8], tables, lengths)
+
+
+def test_pick_decode_params_clamps_invalid_rows(monkeypatch):
+    """A table row flipping the page axis away from "arbitrary" (it
+    carries the softmax accumulator) is clamped to the default."""
+    import k8s_dra_driver_tpu.ops.autotune as autotune
+
+    default = pick_decode_params(2, 2, 2, 8, 16, 2, jnp.float32)
+    assert default == _DEFAULT_PARAMS
+
+    @dataclasses.dataclass
+    class _Choice:
+        params: dict
+
+    class _Tuner:
+        def __init__(self, params):
+            self._params = params
+
+        def pick(self, kernel, key, dtype, fallback):
+            return _Choice(params=self._params)
+
+    bad = {"dimension_semantics": ("arbitrary", "parallel")}
+    monkeypatch.setattr(autotune, "get_autotuner",
+                        lambda: _Tuner(bad))
+    import k8s_dra_driver_tpu.ops.paged_attention as pa
+    monkeypatch.setattr(pa, "get_autotuner", lambda: _Tuner(bad))
+    assert pick_decode_params(
+        2, 2, 2, 8, 16, 2, jnp.float32) == _DEFAULT_PARAMS
+    good = {"dimension_semantics": ["arbitrary", "arbitrary"]}
+    monkeypatch.setattr(pa, "get_autotuner", lambda: _Tuner(good))
+    assert pick_decode_params(2, 2, 2, 8, 16, 2, jnp.float32) == {
+        "dimension_semantics": ("arbitrary", "arbitrary")}

@@ -1,0 +1,619 @@
+"""Paged KV-cache subsystem (serving_kv/ + kv_layout="paged").
+
+Three layers of pins:
+
+- **Ledger units** — KVBlockManager best-fit allocation, refcounted
+  CoW sharing, exhaustion without partial allocation, the seizure
+  fault hook; PagedPrefixStore LRU/eviction/cold-supply accounting.
+- **Engine byte-equality** — the paged engine is a memory layout,
+  never a math change: token streams (greedy AND sampled) are
+  byte-equal to the contiguous engine through fills, CoW prefix
+  adoption, mid-block early stop, pressure eviction, slot preemption
+  under overcommit, and a kv_exhaust-style seizure wave mid-drain.
+- **Disagg interop** — block-shaped migration payloads (PagedKVSlab)
+  move ceil(L/bs) blocks instead of [1, max_seq] slabs, a migrated
+  prefix lands ALREADY shared (refcounted by slot and store at
+  once), and the cross-layout bridges keep paged and contiguous
+  replicas byte-interchangeable.
+"""
+
+import dataclasses
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.gateway import (FleetGateway,
+                                        LeastLoadedRouter,
+                                        PrefixAffinityRouter,
+                                        ReplicaManager, SHED_EXPIRED)
+from k8s_dra_driver_tpu.gateway.router import kv_admits
+from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                       greedy_generate, init_params)
+from k8s_dra_driver_tpu.models.serving import (PagedKVSlab, Request,
+                                               ServingEngine)
+from k8s_dra_driver_tpu.serving_disagg.migrate import KVMigrator
+from k8s_dra_driver_tpu.serving_kv import (NULL_BLOCK, BlocksExhausted,
+                                           KVBlockManager,
+                                           PagedPrefixStore)
+
+CFG = TransformerConfig(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                        d_head=8, d_ff=64, max_seq=48, n_kv_heads=2,
+                        dtype=jnp.float32)
+
+
+def params():
+    return init_params(CFG, jax.random.PRNGKey(0))
+
+
+def prompt(seed, n):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab), np.int32)
+
+
+def reference(p, prompt_arr, n_new):
+    out = greedy_generate(p, jnp.asarray(prompt_arr)[None, :], CFG,
+                          n_tokens=n_new)
+    return np.asarray(out[0], np.int32)
+
+
+class TestKVBlockManager:
+    def test_ctor_validation(self):
+        with pytest.raises(ValueError, match="null block"):
+            KVBlockManager(1, 16)
+        with pytest.raises(ValueError, match="block_size"):
+            KVBlockManager(4, 0)
+
+    def test_alloc_best_fit_prefers_smallest_run(self):
+        mgr = KVBlockManager(12, 16)
+        assert mgr.alloc(11) == list(range(1, 12))
+        mgr.free_blocks([2, 3])               # run of 2
+        mgr.free_blocks([6, 7, 8, 9])         # run of 4
+        # best fit: the 2-run holds a 2-alloc exactly, leave the 4-run
+        assert mgr.alloc(2) == [2, 3]
+        assert mgr.alloc(3) == [6, 7, 8]
+        # free supply now {9}; add {5}: no contiguous 2-run, so the
+        # scattered lowest-index fallback picks across runs
+        mgr.free_blocks([5])
+        assert mgr.alloc(2) == [5, 9]
+
+    def test_alloc_exhausted_is_atomic(self):
+        mgr = KVBlockManager(4, 16)
+        with pytest.raises(BlocksExhausted):
+            mgr.alloc(5)
+        assert mgr.free == 3                  # nothing partially taken
+        assert mgr.alloc_failures == 1
+        with pytest.raises(ValueError, match="n >= 1"):
+            mgr.alloc(0)
+
+    def test_refcounts_share_and_free(self):
+        mgr = KVBlockManager(6, 16)
+        ids = mgr.alloc(2)
+        assert all(mgr.writable(b) for b in ids)
+        mgr.share(ids)
+        assert mgr.cow_shared == 2
+        assert not mgr.writable(ids[0])
+        assert mgr.free_blocks(ids) == 0      # still held once
+        assert mgr.writable(ids[0])
+        assert mgr.free_blocks(ids) == 2      # back in the pool
+        with pytest.raises(RuntimeError, match="double free"):
+            mgr.free_blocks([ids[0]])
+        with pytest.raises(RuntimeError, match="share of free"):
+            mgr.share([ids[0]])
+
+    def test_null_block_is_pinned(self):
+        mgr = KVBlockManager(4, 16)
+        assert NULL_BLOCK not in mgr.alloc(3)
+        for op in (mgr.share, mgr.free_blocks):
+            with pytest.raises(ValueError, match="null block"):
+                op([NULL_BLOCK])
+        with pytest.raises(ValueError, match="never writable"):
+            mgr.writable(NULL_BLOCK)
+
+    def test_seize_and_release(self):
+        mgr = KVBlockManager(8, 16)
+        held = mgr.alloc(3)
+        assert mgr.seize_free() == 4
+        assert mgr.free == 0
+        assert mgr.view()["seized_blocks"] == 4
+        assert mgr.used == 3                  # seized != used: honest
+        with pytest.raises(BlocksExhausted):
+            mgr.alloc(1)
+        mgr.free_blocks(held[:1])
+        assert mgr.seize_free() == 1          # mid-wave accumulation
+        assert mgr.release_seized() == 5
+        assert mgr.free == 5
+
+    def test_view_reports_fragmentation(self):
+        mgr = KVBlockManager(10, 16)
+        mgr.alloc(9)
+        mgr.free_blocks([2, 5, 6, 7])
+        view = mgr.view()
+        assert view["total_blocks"] == 9
+        assert view["free_blocks"] == 4
+        assert view["used_blocks"] == 5
+        assert view["free_runs"] == 2
+        assert view["largest_free_run"] == 3
+
+
+class TestPagedPrefixStore:
+    def _pair(self, n_blocks=10, entries=4):
+        mgr = KVBlockManager(n_blocks, 4)
+        return mgr, PagedPrefixStore(entries, mgr)
+
+    def test_insert_shares_and_hits(self):
+        mgr, store = self._pair()
+        ids = mgr.alloc(2)
+        toks = prompt(1, 8)
+        store.insert(toks, ids, 8)
+        assert mgr.refcount(ids[0]) == 2      # slot ref + store ref
+        longer = np.concatenate([toks, prompt(2, 3)])
+        p, entry = store.longest_prefix(longer)
+        assert p == 8 and entry.block_ids == tuple(ids)
+        assert store.hits == 1
+        # exact-prompt match is capped at len-1: the last token must
+        # be re-prefilled so its logits seed generation
+        assert store.peek(toks) == 7
+
+    def test_insert_validation(self):
+        mgr, store = self._pair()
+        ids = mgr.alloc(2)
+        with pytest.raises(ValueError, match="token count"):
+            store.insert(prompt(1, 8), ids, 7)
+        with pytest.raises(ValueError, match="blocks"):
+            store.insert(prompt(1, 8), ids[:1], 8)
+
+    def test_lru_capacity_eviction_frees_cold_blocks(self):
+        mgr, store = self._pair(entries=2)
+        owned = []
+        for seed in (1, 2, 3):
+            ids = mgr.alloc(1)
+            store.insert(prompt(seed, 4), ids, 4)
+            mgr.free_blocks(ids)              # store-only (cold)
+            owned.append(ids[0])
+        assert len(store) == 2
+        assert store.evictions == 1
+        assert mgr.refcount(owned[0]) == 0    # oldest evicted, freed
+
+    def test_evictable_count_excludes_hot_blocks(self):
+        mgr, store = self._pair()
+        cold = mgr.alloc(1)
+        store.insert(prompt(1, 4), cold, 4)
+        mgr.free_blocks(cold)                 # only the store holds it
+        hot = mgr.alloc(1)
+        store.insert(prompt(2, 4), hot, 4)    # a live slot still holds
+        assert store.evictable_count() == 1
+        free0 = mgr.free
+        # "evicting" the hot entry drops the store ref but returns no
+        # memory — the engine keeps escalating to preemption
+        assert store.evict_until(mgr.free + 2) == 2
+        assert mgr.free == free0 + 1
+        assert mgr.refcount(hot[0]) == 1
+
+    def test_drop_and_flush_release_refs(self):
+        mgr, store = self._pair()
+        ids = mgr.alloc(1)
+        store.insert(prompt(1, 4), ids, 4)
+        mgr.free_blocks(ids)
+        store.drop(prompt(1, 4))
+        assert mgr.refcount(ids[0]) == 0
+        store.drop(prompt(1, 4))              # absent: no-op
+        ids2 = mgr.alloc(2)
+        store.insert(prompt(2, 8), ids2, 8)
+        assert store.flush() == 1
+        assert mgr.refcount(ids2[0]) == 1     # the slot's own ref
+
+
+class TestPagedEngine:
+    def test_ctor_gates(self):
+        p = params()
+        with pytest.raises(ValueError, match="unknown kv_layout"):
+            ServingEngine(p, CFG, slots=1, kv_layout="blocked")
+        with pytest.raises(ValueError, match="not a multiple"):
+            ServingEngine(p, CFG, slots=1, kv_layout="paged",
+                          kv_block_size=13)
+        with pytest.raises(ValueError, match="cannot hold"):
+            ServingEngine(p, CFG, slots=1, kv_layout="paged",
+                          kv_blocks=3)
+        with pytest.raises(ValueError, match="speculative"):
+            ServingEngine(p, CFG, slots=1, kv_layout="paged",
+                          draft_params=p, draft_cfg=CFG)
+        with pytest.raises(ValueError, match="fused generation"):
+            ServingEngine(p, CFG, slots=1, kv_layout="paged",
+                          chain_steps=2)
+        with pytest.raises(ValueError, match="int8"):
+            ServingEngine(p, dataclasses.replace(
+                CFG, kv_cache_dtype="int8"), slots=1,
+                kv_layout="paged")
+        with pytest.raises(ValueError, match="windowed"):
+            ServingEngine(p, dataclasses.replace(
+                CFG, attention_window=16), slots=1, kv_layout="paged")
+        eng = ServingEngine(p, CFG, slots=1, kv_layout="paged")
+        with pytest.raises(ValueError, match="exceeds"):
+            eng.submit(Request(uid="x", prompt=prompt(9, 40),
+                               max_new=20))
+
+    @pytest.mark.parametrize("kv_blocks", [None, 8])
+    def test_mixed_workload_byte_equal_to_contiguous(self, kv_blocks):
+        """Greedy + sampled requests with a shared system prompt:
+        identical token streams from the paged and contiguous
+        engines, on a memory-parity pool AND a tight 8-block pool
+        where CoW copies, evictions and admission gating all fire."""
+        p = params()
+        sys_p = prompt(99, 11)
+        reqs = [
+            ("a", np.concatenate([sys_p, prompt(1, 5)]), 8, 0.0, 0),
+            ("b", np.concatenate([sys_p, prompt(2, 7)]), 6, 0.7, 3),
+            ("c", prompt(3, 6), 5, 0.0, 0),
+            ("d", np.concatenate([sys_p, prompt(4, 4)]), 7, 0.9, 11),
+            ("e", prompt(5, 9), 4, 0.0, 0),
+        ]
+        dense = ServingEngine(p, CFG, slots=3)
+        paged = ServingEngine(p, CFG, slots=3, kv_layout="paged",
+                              kv_blocks=kv_blocks)
+        for eng in (dense, paged):
+            for uid, pr, n, temp, seed in reqs:
+                eng.submit(Request(uid=uid, prompt=pr, max_new=n,
+                                   temperature=temp, seed=seed))
+        want = {f.uid: f.tokens for f in dense.run()}
+        got = {f.uid: f.tokens for f in paged.run()}
+        assert set(got) == set(want)
+        for uid in want:
+            np.testing.assert_array_equal(
+                got[uid], want[uid],
+                err_msg=f"request {uid} diverged under paged KV")
+        stats = paged.stats()
+        assert stats["prefix_hits_total"] >= 1      # sys_p reused
+        assert stats["kv_cow_copies_total"] >= 1    # shared partial
+        if kv_blocks == 8:
+            # the tight pool had to reclaim cold store blocks
+            assert stats["kv_block_evictions_total"] >= 1
+        assert stats["kv_blocks_used"] >= 0
+        assert stats["kv_alloc_failures_total"] >= 0
+
+    def test_overcommit_preempts_and_stays_exact(self):
+        """Two slots whose worst-case demand (3 blocks each) exceeds
+        the 4 usable blocks: decode-time exhaustion preempts a victim
+        back to the queue and the rerun is byte-equal — per-request
+        token streams are schedule-independent."""
+        p = params()
+        eng = ServingEngine(p, CFG, slots=2, kv_layout="paged",
+                            kv_blocks=5)
+        prompts = {"a": prompt(31, 10), "b": prompt(32, 10)}
+        for uid, pr in prompts.items():
+            eng.submit(Request(uid=uid, prompt=pr, max_new=30))
+        done = {f.uid: f.tokens for f in eng.run()}
+        assert set(done) == {"a", "b"}
+        for uid, pr in prompts.items():
+            np.testing.assert_array_equal(
+                done[uid], reference(p, pr, 30),
+                err_msg=f"request {uid} diverged after preemption")
+        stats = eng.stats()
+        assert stats["kv_preemptions_total"] >= 1
+        assert stats["kv_alloc_failures_total"] >= 1
+
+    def test_seizure_wave_sheds_then_recovers(self):
+        """The kv_exhaust fault shape: every free block seized
+        mid-drain, released six steps later.  Requests preempted into
+        the queue are re-admitted after the wave; each finishes
+        exactly once, byte-equal (shed-not-crash)."""
+        p = params()
+        eng = ServingEngine(p, CFG, slots=2, kv_layout="paged",
+                            kv_blocks=9)
+        prompts = {"a": prompt(41, 8), "b": prompt(42, 8)}
+        for uid, pr in prompts.items():
+            eng.submit(Request(uid=uid, prompt=pr, max_new=12))
+        finished = []
+        for step in range(1, 200):
+            finished += eng.step()
+            if step == 3:
+                assert eng.kv_manager.seize_free() >= 1
+            if step == 9:
+                eng.kv_manager.release_seized()
+            if not eng.active and not eng.pending:
+                break
+        done = {}
+        for f in finished:
+            assert f.uid not in done, "finished twice"
+            done[f.uid] = f.tokens
+        assert set(done) == {"a", "b"}
+        for uid, pr in prompts.items():
+            np.testing.assert_array_equal(done[uid],
+                                          reference(p, pr, 12))
+
+    def test_mid_block_eos_stops_exactly(self):
+        """EOS landing mid-block (position 18 of a 16-token block
+        grid): the partial block frees with the slot and the output
+        is cut exactly at the eos."""
+        p = params()
+        pr = prompt(21, 14)
+        ref = reference(p, pr, 10)
+        eos = int(ref[17])                    # stop at total length 18
+        eng = ServingEngine(p, CFG, slots=1, kv_layout="paged")
+        eng.submit(Request(uid="x", prompt=pr, max_new=10,
+                           eos_id=eos))
+        done = eng.run()
+        np.testing.assert_array_equal(done[0].tokens, ref[:18])
+        assert done[0].tokens[-1] == eos
+
+    def test_cancel_active_releases_blocks(self):
+        p = params()
+        eng = ServingEngine(p, CFG, slots=1, kv_layout="paged",
+                            kv_blocks=7)
+        for uid in ("a", "b"):
+            eng.submit(Request(uid=uid, prompt=prompt(51, 6),
+                               max_new=5))
+        eng.step()                            # "a" fills the slot
+        headroom0 = eng.occupancy()["kv_headroom_blocks"]
+        assert eng.cancel("a") is True
+        # the slot's refs dropped; the store capture is now cold, so
+        # every one of its blocks is reclaimable headroom
+        assert eng.occupancy()["kv_headroom_blocks"] >= headroom0
+        assert eng._prefix.evictable_count() >= 1
+        done = eng.run()
+        assert [f.uid for f in done] == ["b"]
+        np.testing.assert_array_equal(
+            done[0].tokens, reference(p, prompt(51, 6), 5))
+
+    def test_occupancy_and_stats_surface_kv_signal(self):
+        p = params()
+        eng = ServingEngine(p, CFG, slots=2, kv_layout="paged")
+        occ = eng.occupancy()
+        assert occ["kv_block_size"] == 16
+        assert occ["kv_total_blocks"] == 6    # 2 slots * 3 + null - 1
+        assert occ["kv_free_blocks"] == 6
+        assert occ["kv_cow_shared_blocks"] == 0
+        assert occ["kv_headroom_blocks"] == 6
+        eng.submit(Request(uid="a", prompt=prompt(61, 12), max_new=4))
+        eng.step()
+        occ = eng.occupancy()
+        assert occ["kv_free_blocks"] < 6
+        # free + cold-store supply: the router's admission headroom
+        assert occ["kv_headroom_blocks"] >= occ["kv_free_blocks"]
+        stats = eng.stats()
+        for key in ("kv_blocks_total", "kv_blocks_free",
+                    "kv_blocks_used", "kv_cow_shared_blocks",
+                    "kv_block_evictions_total", "kv_cow_copies_total",
+                    "kv_preemptions_total", "kv_alloc_failures_total"):
+            assert key in stats, key
+        assert (stats["kv_blocks_free"] + stats["kv_blocks_used"]
+                == stats["kv_blocks_total"])
+
+
+class TestPagedDisagg:
+    def test_paged_migration_lands_already_shared(self):
+        """prefill(paged) -> migrate -> decode(paged): the payload is
+        ceil(L/bs) blocks (not [1, max_seq]), adoption inserts the
+        prompt into the decode store SHARING the slot's blocks (CoW
+        from the first migrated byte), and generation is byte-equal
+        to a local run."""
+        p = params()
+        pr = prompt(71, 13)
+        pre = ServingEngine(p, CFG, slots=1, kv_layout="paged")
+        block = pre.prefill_export(Request(uid="m", prompt=pr,
+                                           max_new=6))
+        assert isinstance(block.kv, PagedKVSlab)
+        slab_bytes = sum(a.nbytes for a in block.kv.k + block.kv.v)
+        dense_bytes = CFG.n_layers * 2 * CFG.max_seq * \
+            CFG.n_kv_heads * CFG.d_head * 4
+        assert slab_bytes < dense_bytes       # 1 block vs 48 rows
+        mig = KVMigrator()
+        moved = mig.migrate_block(block)
+        assert mig.stats()["tokens_moved"] == 13
+        dec = ServingEngine(p, CFG, slots=2, kv_layout="paged")
+        dec.adopt_block(moved)
+        assert dec.kv_manager.cow_shared >= 1  # slot + store at once
+        done = dec.run()
+        np.testing.assert_array_equal(done[0].tokens,
+                                      reference(p, pr, 6))
+
+    @pytest.mark.parametrize("pre_layout,dec_layout",
+                             [("paged", "contiguous"),
+                              ("contiguous", "paged")])
+    def test_cross_layout_bridges(self, pre_layout, dec_layout):
+        """A paged prefill replica can feed a contiguous decode
+        engine and vice versa — the slab/dense bridges keep mixed
+        fleets byte-interchangeable, sampled requests included."""
+        p = params()
+        pr = prompt(73, 9)
+        req = Request(uid="x", prompt=pr, max_new=7, temperature=0.8,
+                      seed=5)
+        uni = ServingEngine(p, CFG, slots=1)
+        uni.submit(dataclasses.replace(req))
+        want = uni.run()[0].tokens
+        pre = ServingEngine(p, CFG, slots=1, kv_layout=pre_layout)
+        dec = ServingEngine(p, CFG, slots=1, kv_layout=dec_layout)
+        block = KVMigrator().migrate_block(pre.prefill_export(req))
+        dec.adopt_block(block)
+        np.testing.assert_array_equal(dec.run()[0].tokens, want)
+
+    def test_export_import_prefix_dense_bridge(self):
+        """The fleet-index exchange stays [1, S]-dense: a paged
+        engine's export gathers its blocks, a paged importer lands
+        the rows in store-owned blocks, and the next fill hits the
+        imported prefix with byte-equal output.  Under exhaustion the
+        import SKIPS instead of failing."""
+        p = params()
+        pr = prompt(75, 10)
+        a = ServingEngine(p, CFG, slots=1, kv_layout="paged")
+        a.submit(Request(uid="a", prompt=pr, max_new=4))
+        full = a.run()[0].tokens
+        cap = full[:-1]                       # finish-time capture:
+        entry = a.export_prefix(cap)          # written rows only
+        assert entry is not None and int(entry.pos) == cap.size
+        b = ServingEngine(p, CFG, slots=1, kv_layout="paged")
+        b.import_prefix(cap, entry)
+        assert b.prefix_peek(np.concatenate(
+            [cap, prompt(76, 2)])) == cap.size
+        longer = np.concatenate([cap, prompt(76, 3)])
+        b.submit(Request(uid="b", prompt=longer, max_new=5))
+        done = b.run()
+        np.testing.assert_array_equal(done[0].tokens,
+                                      reference(p, longer, 5))
+        assert b.stats()["prefix_hits_total"] >= 1
+        # exhausted importer: every usable block seized -> no-op
+        c = ServingEngine(p, CFG, slots=1, kv_layout="paged",
+                          kv_blocks=4)
+        c.kv_manager.seize_free()
+        c.import_prefix(cap, entry)
+        assert c.prefix_peek(longer) == 0
+        c.kv_manager.release_seized()
+
+
+# -- gateway KV-memory signal ---------------------------------------------
+
+class _KVStub:
+    """Router-facing stub that reports a paged-KV occupancy."""
+
+    def __init__(self, name, depth=0, bound=4, headroom=8, bs=4):
+        self.name = name
+        self.ready = True
+        self.depth_bound = bound
+        self._depth = depth
+        self._headroom = headroom
+        self._bs = bs
+
+    def occupancy(self):
+        return {"active": self._depth, "pending": 0, "free_slots": 0,
+                "slots": 2, "depth": self._depth, "tokens": {},
+                "kv_block_size": self._bs, "kv_total_blocks": 16,
+                "kv_free_blocks": self._headroom,
+                "kv_cow_shared_blocks": 0,
+                "kv_headroom_blocks": self._headroom}
+
+    def prefix_peek(self, prompt):
+        return 0
+
+
+class _PlainStub(_KVStub):
+    """No KV signal at all (contiguous engine / remote stub)."""
+
+    def occupancy(self):
+        return {"active": self._depth, "pending": 0, "free_slots": 0,
+                "slots": 2, "depth": self._depth, "tokens": {}}
+
+
+class _GwClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def paged_pool(replicas=1, slots=2, **engine_kw):
+    return ReplicaManager(
+        lambda name: ServingEngine(params(), CFG, slots=slots,
+                                   kv_layout="paged", **engine_kw),
+        replicas=replicas)
+
+
+class TestGatewayKVSignal:
+    def test_kv_admits_needs_fill_plus_one(self):
+        """need = ceil((L + 1) / bs): an 8-token prompt at bs=4 needs
+        3 blocks (the +1 row seeds generation)."""
+        pr = np.arange(8, dtype=np.int32)
+        assert not kv_admits(_KVStub("r", headroom=2, bs=4), pr)
+        assert kv_admits(_KVStub("r", headroom=3, bs=4), pr)
+        # no KV keys -> always admissible (graceful degrade)
+        assert kv_admits(_PlainStub("r"), pr)
+
+    def test_router_skips_exhausted_replica(self):
+        """An exhausted replica is not a candidate even when it is the
+        least-deep one; an all-exhausted fleet routes None (the hold
+        surfaces in the admission queue, not inside an engine)."""
+        starved = _KVStub("r0", depth=0, headroom=0)
+        roomy = _KVStub("r1", depth=3, headroom=8)
+        pr = np.arange(6, dtype=np.int32)
+        assert LeastLoadedRouter().route(pr, [starved, roomy]) is roomy
+        roomy2 = _KVStub("r1", depth=3, headroom=0)
+        assert LeastLoadedRouter().route(pr, [starved, roomy2]) is None
+        # a signal-less replica stays admissible when paged peers
+        # are starved
+        plain = _PlainStub("r2", depth=3)
+        assert LeastLoadedRouter().route(
+            pr, [starved, roomy2, plain]) is plain
+
+    def test_headroom_breaks_depth_ties(self):
+        """At equal queue depth the spill lands where eviction is
+        least likely — on the replica with more reclaimable blocks."""
+        tight = _KVStub("r0", depth=1, headroom=2)
+        roomy = _KVStub("r1", depth=1, headroom=7)
+        pr = np.arange(6, dtype=np.int32)
+        assert LeastLoadedRouter().route(pr, [tight, roomy]) is roomy
+        assert PrefixAffinityRouter().route(pr, [tight, roomy]) is roomy
+
+    def test_exhausted_fleet_holds_then_sheds_with_counter(self):
+        """Fleet-wide block exhaustion: the request HOLDS in the
+        admission queue (kv_exhausted_holds ticks), sheds via the
+        normal SLO path when its deadline blows, and a fresh request
+        after pressure clears finishes byte-equal — shed, not crash."""
+        clock = _GwClock()
+        mgr = paged_pool(replicas=1, slots=2)
+        gw = FleetGateway(mgr, queue_capacity=4, clock=clock)
+        eng = mgr.replicas[0].engine
+        eng.kv_manager.seize_free()
+        g = gw.submit(Request(uid="held", prompt=prompt(61, 6),
+                              max_new=3), slo_s=5.0)
+        gw.step()
+        assert g.status == "queued"
+        text = gw.metrics.render().decode()
+        m = re.search(r"tpu_gateway_kv_exhausted_holds_total "
+                      r"(\d+)\.0", text)
+        assert m and int(m.group(1)) >= 1
+        clock.advance(10.0)
+        done = gw.run_until_idle()
+        assert [(d.uid, d.status) for d in done] \
+            == [("held", SHED_EXPIRED)]
+        eng.kv_manager.release_seized()
+        pr = prompt(62, 7)
+        gw.submit(Request(uid="fresh", prompt=pr, max_new=4),
+                  slo_s=60.0)
+        done = gw.run_until_idle()
+        assert [(d.uid, d.status) for d in done] \
+            == [("fresh", "finished")]
+        np.testing.assert_array_equal(
+            gw.results["fresh"].tokens, reference(params(), pr, 4))
+
+    def test_gauge_fold_mirrors_engine_occupancy(self):
+        """The per-step fold publishes block levels as gauges and the
+        store's eviction total as counter DELTAS (levels are read, not
+        event-folded, so a re-read never double-counts)."""
+        mgr = paged_pool(replicas=1, slots=2)
+        gw = FleetGateway(mgr, queue_capacity=8)
+        pr = prompt(63, 9)
+        gw.submit(Request(uid="a", prompt=pr, max_new=4), slo_s=60.0)
+        done = gw.run_until_idle()
+        assert [d.status for d in done] == ["finished"]
+        eng = mgr.replicas[0].engine
+        name = mgr.replicas[0].name
+        occ = eng.occupancy()
+        text = gw.metrics.render().decode()
+        for metric, want in (
+                ("kv_blocks_free", occ["kv_free_blocks"]),
+                ("kv_blocks_used",
+                 occ["kv_total_blocks"] - occ["kv_free_blocks"]),
+                ("kv_cow_shared_blocks",
+                 occ["kv_cow_shared_blocks"])):
+            m = re.search(
+                rf'tpu_gateway_{metric}{{replica="{name}"}} '
+                rf"([0-9.]+)", text)
+            assert m, metric
+            assert float(m.group(1)) == float(want), metric
+        # force a pressure eviction on the engine's store, then one
+        # idle pump step: the fold must advance the counter by the
+        # exact engine-side delta
+        before = eng._prefix.evictions
+        freed = eng._prefix.evict_until(
+            eng.occupancy()["kv_free_blocks"] + 1)
+        assert freed >= 1 and eng._prefix.evictions == before + 1
+        gw.step()
+        text = gw.metrics.render().decode()
+        m = re.search(r"tpu_gateway_kv_block_evictions_total "
+                      r"(\d+)\.0", text)
+        assert m and int(m.group(1)) == eng._prefix.evictions

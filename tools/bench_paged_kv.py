@@ -1,0 +1,60 @@
+"""Regenerate tools/paged_kv_cpu.json.
+
+The artifact behind the paged-KV claims (docs/SERVING.md "Paged
+KV"): peak concurrent requests at a fixed synthetic HBM budget
+(block tables + CoW prefix sharing vs contiguous per-slot slabs),
+the peak CoW-shared fraction of the pool, and the paged/contiguous
+decode-throughput ratio with outputs verified byte-equal in the
+same run.  Always CPU-pinned (the layout is a host-side memory
+discipline; serving_kv/probe.py documents the model sizing), but
+still run it on an IDLE machine — see
+tools/int8_decode_v5e_loaded_host.json for what a loaded host does
+to recorded baselines.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import platform
+import subprocess
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.serving_kv.probe import "
+        "paged_kv_probe\n"
+        "print(json.dumps(paged_kv_probe(wave=6, repeats=5)))\n")
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    res = subprocess.run([sys.executable, "-c", code], cwd=repo,
+                         env=cpu_jax_env(1), capture_output=True,
+                         text=True, timeout=600)
+    if res.returncode != 0:
+        sys.stderr.write(res.stderr)
+        raise SystemExit(1)
+    result = json.loads(res.stdout.strip().splitlines()[-1])
+    commit = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
+        capture_output=True, text=True).stdout.strip()
+    rec = {
+        "probe": "serving_paged",
+        "host": platform.machine(),
+        "platform": "cpu-hermetic",
+        "commit": commit,
+        "harness": "serving_kv/probe.py paged_kv_probe",
+        "result": result,
+    }
+    path = pathlib.Path(__file__).parent / "paged_kv_cpu.json"
+    path.write_text(json.dumps(rec, indent=1) + "\n")
+    print(json.dumps(rec, indent=1))
+
+
+if __name__ == "__main__":
+    main()
