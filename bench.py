@@ -130,6 +130,18 @@ TINY_CTL_KWARGS = dict(pump_counts=(1, 2), replicas=2, slots=4,
                        n_requests=96, trace_name="bursty",
                        offered_x=8.0)
 
+#: observatory probe (gateway/obsprobe.py): paired digest-off/on
+#: closed-loop saturation over NO-OP engines (the quantile-digest
+#: overhead ratio, merged render path included) + a MemWatch HBM
+#: accounting pass over a real tiny paged engine.  Always
+#: CPU-meaningful (sketch cost is host cost);
+#: tools/obs_digest_cpu.json is the committed artifact and the smoke
+#: tests pin the reduced TINY shape below.
+OBS_KWARGS = dict(n_requests=768, reps=9, pumps=2, replicas=4,
+                  slots=8)
+TINY_OBS_KWARGS = dict(n_requests=96, reps=2, pumps=2, replicas=2,
+                       slots=4, queue_capacity=48)
+
 _WALL_BUDGET_S = float(os.environ.get("BENCH_WALL_BUDGET_S", "630"))
 _DEADLINE = time.monotonic() + _WALL_BUDGET_S
 
@@ -682,6 +694,42 @@ def _control_plane_probe(timeout_s: float = 240.0) -> dict:
     return payload
 
 
+def _observatory_probe(timeout_s: float = 240.0) -> dict:
+    """Observatory probe (gateway/obsprobe.py) in a CPU-pinned
+    subprocess: the paired digest-on/off overhead ratio (merged
+    exposition render included in the on arm) and the MemWatch
+    accounted-HBM fraction over a tiny paged serving engine.  Always
+    CPU — streaming-sketch cost is host cost, like the ctl ceiling."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    kwargs = json.dumps(OBS_KWARGS)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.gateway.obsprobe import "
+        "observatory_probe\n"
+        f"print(json.dumps(observatory_probe("
+        f"**json.loads({kwargs!r}))))\n")
+    env = cpu_jax_env(1)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = "CPU-pinned subprocess; " + payload.get("note", "")
+    return payload
+
+
 def _paged_kv_probe(timeout_s: float = 300.0) -> dict:
     """Paged-KV probe (serving_kv/probe.py) in a CPU-pinned
     subprocess: peak concurrent requests at a fixed synthetic HBM
@@ -1189,6 +1237,8 @@ _PROBE_SCALARS = (
     ("control_plane", "ctl_routes_per_s", "routes_per_s"),
     ("control_plane", "ctl_goodput_flat_x", "goodput_flat_x"),
     ("control_plane", "ctl_trace_overhead_x", "trace_overhead_x"),
+    ("observatory", "obs_digest_overhead_x", "digest_overhead_x"),
+    ("observatory", "obs_hbm_accounted_frac", "hbm_accounted_frac"),
     ("allreduce_cpu_mesh8", "cpu_mesh_gbps", "gbps"),
 )
 
@@ -1446,6 +1496,14 @@ def main() -> None:
                 timeout_s=min(240.0, _remaining() - 45.0))
         else:
             ctl = {"error": "skipped: wall budget"}
+        # 3e. Observatory probe (hermetic, CPU subprocess): quantile
+        #     digest overhead ratio (paired off/on drives, merged
+        #     render on) + MemWatch accounted-HBM fraction.
+        if _remaining() > 90:
+            obs = _observatory_probe(
+                timeout_s=min(240.0, _remaining() - 45.0))
+        else:
+            obs = {"error": "skipped: wall budget"}
         # 4. TPU probes — the only section that can meet a wedged
         #    tunnel; child process + deadline, partial results kept.
         if _remaining() > 55:
@@ -1460,6 +1518,7 @@ def main() -> None:
         compute["resharding"] = resharding
         compute["serving_paged"] = paged
         compute["control_plane"] = ctl
+        compute["observatory"] = obs
         detail["tpu"] = compute
         detail["baseline_note"] = (
             "FLOOR comparison, not like-for-like: the reference "

@@ -189,6 +189,8 @@ class FaultEvent:
     #                                 (None = largest shard)
     n: int = 0                      # burst size
     prompt_seed: int = 0            # burst prompt family
+    slo_s: float = 900.0            # burst per-request SLO (tight
+    #                                 values drive burn-rate alerts)
     fired_cycle: int | None = None
     hit_windows: tuple = ()
 
@@ -476,12 +478,20 @@ class CrucibleRig:
             chip_of=chip_map.get,
             health_source=self.ledger.current_unhealthy,
             fault_plan=self.replica_plan, depth_bound=2)
+        # burn-rate alerting is ALWAYS-ON in the crucible (the soak
+        # must prove alerting rides along at zero invariant cost);
+        # clock.t-based windows stay deterministic under the seeded
+        # schedule, and the tracer hookup routes a firing alert into
+        # the flight recorder's "alert" trigger
+        from ..gateway.burnrate import SloBurnEngine
+        self.burn = SloBurnEngine(bus=self.bus, tracer=self.tracer,
+                                  clock=self.clock)
         self.gw = ShardedGateway(
             self.mgr, pumps=2,
             router_factory=lambda: DisaggRouter(self.mgr.index),
             queue_capacity=64, clock=self.clock, bus=self.bus,
             auto_replace=False, seed=seed, tenant="hi",
-            tracer=self.tracer)
+            tracer=self.tracer, burn=self.burn)
 
         registry = TenantRegistry(capacity=8)
         registry.add(TenantSpec("hi", priority=3, quota=6, floor=2),
@@ -630,7 +640,7 @@ class CrucibleRig:
                 n_tok = 4 + (i % 5)
                 self.gw.submit(Request(
                     uid=uid, prompt=_prompt(ev.prompt_seed + i, n_tok),
-                    max_new=3), slo_s=900.0)
+                    max_new=3), slo_s=ev.slo_s)
                 self.submitted[uid] = (ev.prompt_seed + i, n_tok, 3)
 
     def _corrupt(self, ev: FaultEvent) -> None:

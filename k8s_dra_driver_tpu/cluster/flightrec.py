@@ -32,8 +32,10 @@ from pathlib import Path
 
 from ..utils.metrics import render_all
 
-#: trigger reasons a default recorder can produce
-REASONS = ("slo_shed", "drain", "eviction", "failed", "preempt")
+#: trigger reasons a default recorder can produce ("alert" = a
+#: tenant's SLO burn-rate alert, gateway/burnrate.py)
+REASONS = ("slo_shed", "drain", "eviction", "failed", "preempt",
+           "alert")
 
 #: gang states whose entry is incident-worthy (matched on the span's
 #: ``to`` attr, case-insensitive — no import of parallel/supervisor
@@ -52,6 +54,10 @@ def default_trigger(rec: dict) -> str | None:
     attrs = rec.get("attrs", {})
     if name == "drain":
         return "drain"
+    if name == "alert":
+        # a burn-rate alert span (gateway/burnrate.py): the tenant is
+        # burning SLO budget across both windows — dump with digests
+        return "alert"
     if name == "terminal" and attrs.get("status") == "shed_expired":
         return "slo_shed"
     if name == "gang":
@@ -145,6 +151,17 @@ class FlightRecorder:
             out["bus"] = self.bus.journal_dump()
         if self.metrics:
             out["metrics"] = render_all(*self.metrics).decode()
+            # structured quantile snapshot next to the text
+            # exposition: registries carrying streaming digests
+            # (utils/digest.py) contribute {family: [rows]} so a dump
+            # answers "what was p999" without re-parsing exposition
+            digests: dict = {}
+            for m in self.metrics:
+                snap = getattr(m, "digest_snapshot", None)
+                if snap is not None:
+                    digests.update(snap())
+            if digests:
+                out["digests"] = digests
         return out
 
     def debug_payload(self) -> dict:

@@ -52,7 +52,12 @@ import time
 from ..cluster.bus import EventBus
 from ..models.serving import Finished, Request
 from ..utils import dispatch, tracing
+from ..utils.digest import DigestBank, NullDigestBank
 from ..utils.metrics import GatewayMetrics
+
+#: digest bank roster every pump carries (utils/digest.py): the
+#: streaming-quantile twins of the three latency histograms
+_DIGEST_SERIES = ("queue_wait", "ttft", "slo_margin")
 from .admission import (DISPATCHED, FINISHED, QUEUED,
                         REJECTED_INVALID, SHED_EXPIRED, AdmissionError,
                         AdmissionQueue, GatewayRequest)
@@ -84,7 +89,10 @@ class FleetGateway:
                  bus: EventBus | None = None,
                  pool_owner: bool = True,
                  tenant: str | None = None,
-                 tracer=None):
+                 tracer=None,
+                 burn=None,
+                 memwatch=None,
+                 digests: bool = True):
         self.manager = manager
         #: this pool's tenant in a multi-tenant fleet
         #: (fleet/tenancy.py): tags the pump's ``demand`` events so
@@ -135,10 +143,30 @@ class FleetGateway:
         # advances by deltas (a replaced replica's name never recurs
         # — ReplicaManager names are generation-fresh)
         self._kv_evictions_seen: dict[str, int] = {}
+        #: per-pump streaming quantile digests (utils/digest.py) —
+        #: each pump owns its OWN bank so a ShardedGateway can merge
+        #: them (the mergeability contract); ``digests=False`` swaps
+        #: in the no-op bank (the observatory probe's off arm)
+        self.digests = (DigestBank(_DIGEST_SERIES) if digests
+                        else NullDigestBank(_DIGEST_SERIES))
+        #: optional SLO burn-rate engine (gateway/burnrate.py): fed
+        #: per terminal SLO-bearing outcome, stepped once per cycle
+        self.burn = burn
+        if burn is not None:
+            burn.attach(self)
+        #: optional per-component HBM ledger (utils/memwatch.py),
+        #: fed from the per-step KV occupancy fold
+        self.memwatch = memwatch
         if tracer is not None and pool_owner:
             tracing.wire_pool(tracer, manager)
         if pool_owner:
             self.metrics.pumps.set(1)
+            # a standalone pump is its own merge group of one; a
+            # ShardedGateway (pool_owner=False members) registers the
+            # merged-across-pumps view instead (gateway/sharded.py)
+            labels = {} if tenant is None else {"tenant": tenant}
+            self.metrics.add_digest_source(lambda: self.digests,
+                                           **labels)
             self.bus.subscribe("prefix", self._on_prefix_event)
             for r in manager.replicas:
                 self._wire_replica(r)
@@ -258,6 +286,11 @@ class FleetGateway:
             self.metrics.replicas.labels(state=state).set(n)
         self._fold_kv_occupancy()
         self._drain_migrations()
+        if self.burn is not None:
+            # close the burn-rate cycle AFTER this step's terminal
+            # accounting and BEFORE the bus pump, so an alert event
+            # fired here is delivered within the same step
+            self.burn.step()
         self.bus.publish("demand", queue_depth=len(self.queue),
                          arrival_rate_rps=self.arrival_rate_rps,
                          slo_margin_ewma_s=self.slo_margin_ewma_s,
@@ -327,6 +360,7 @@ class FleetGateway:
                 continue
             self.routes_total += 1
             self.metrics.queue_wait_seconds.observe(now - g.arrival_s)
+            self.digests.observe("queue_wait", now - g.arrival_s)
             if g.tenant is not None:
                 self.metrics.tenant_queue_wait_seconds.labels(
                     tenant=g.tenant).observe(now - g.arrival_s)
@@ -374,6 +408,7 @@ class FleetGateway:
             if g is not None and g.first_token_s is None and n >= 1:
                 g.first_token_s = now
                 self.metrics.ttft_seconds.observe(now - g.arrival_s)
+                self.digests.observe("ttft", now - g.arrival_s)
         for f in finished:
             g = replica.in_flight.pop(f.uid, None)
             if g is None:
@@ -385,6 +420,7 @@ class FleetGateway:
             if g.first_token_s is None:
                 g.first_token_s = now
                 self.metrics.ttft_seconds.observe(now - g.arrival_s)
+                self.digests.observe("ttft", now - g.arrival_s)
             g.finished_s = now
             self.results[g.uid] = f
             self._terminal(g, FINISHED, done)
@@ -402,6 +438,7 @@ class FleetGateway:
                 outcome = _FINISHED_ATTAINED
             else:
                 self.metrics.slo_margin_seconds.observe(margin)
+                self.digests.observe("slo_margin", margin)
                 prev = self.slo_margin_ewma_s
                 self.slo_margin_ewma_s = (
                     margin if prev is None
@@ -423,9 +460,13 @@ class FleetGateway:
                 if outcome == _FINISHED_ATTAINED:
                     self.metrics.tenant_slo_attained.labels(
                         tenant=g.tenant).inc()
+                    if self.burn is not None:
+                        self.burn.observe(g.tenant, True)
                 elif outcome in (_FINISHED_LATE, SHED_EXPIRED):
                     self.metrics.tenant_slo_missed.labels(
                         tenant=g.tenant).inc()
+                    if self.burn is not None:
+                        self.burn.observe(g.tenant, False)
         if self.tracer is not None and g.trace is not None:
             end = (g.finished_s if g.finished_s is not None
                    else self.clock())
@@ -492,6 +533,11 @@ class FleetGateway:
             occ = r.occupancy()
             if "kv_free_blocks" not in occ:
                 continue
+            if self.memwatch is not None:
+                # per-replica byte attribution rides the same walk:
+                # params + the paged pool's full reservation
+                # (utils/memwatch.py account_engine)
+                self.memwatch.account_engine(r.engine, unit=r.name)
             free = occ["kv_free_blocks"]
             self.metrics.kv_blocks_free.labels(replica=r.name).set(free)
             self.metrics.kv_blocks_used.labels(replica=r.name).set(
@@ -530,6 +576,8 @@ class FleetGateway:
         self.metrics.drains.inc()
         self.manager.mark_down(replica)
         self.router.forget(replica.name)
+        if self.memwatch is not None:
+            self.memwatch.forget(replica.name)
         victims = list(replica.in_flight.values())
         replica.in_flight.clear()
         if now is None:

@@ -44,6 +44,7 @@ import numpy as np
 
 from ..cluster.bus import EventBus
 from ..utils import dispatch, tracing
+from ..utils.digest import DigestBank
 from ..utils.metrics import GatewayMetrics
 from .admission import QUEUED, GatewayRequest
 from .frontend import FleetGateway, _RATE_ALPHA
@@ -74,7 +75,10 @@ class ShardedGateway:
                  shard_tokens: int = 8,
                  seed: int = 0,
                  tenant: str | None = None,
-                 tracer=None):
+                 tracer=None,
+                 burn=None,
+                 memwatch=None,
+                 digests: bool = True):
         if pumps < 1:
             raise ValueError("ShardedGateway needs >= 1 pump")
         self.manager = manager
@@ -102,17 +106,30 @@ class ShardedGateway:
         self.results: dict = {}
         self.refused: list[GatewayRequest] = []
         self.per_replica = dispatch.Aggregator()
+        #: shared SLO burn-rate engine (gateway/burnrate.py): member
+        #: pumps feed observe() from their terminal accounting; the
+        #: CYCLE steps it exactly once (member step() never runs)
+        self.burn = burn
+        self.memwatch = memwatch
         self.pumps: list[FleetGateway] = []
         for _ in range(pumps):
             p = FleetGateway(
                 manager, router=router_factory(),
                 queue_capacity=queue_capacity, metrics=self.metrics,
                 clock=clock, auto_replace=False, bus=self.bus,
-                pool_owner=False, tracer=tracer)
+                pool_owner=False, tracer=tracer, burn=burn,
+                memwatch=memwatch, digests=digests)
             p.outcomes = self.outcomes
             p.results = self.results
             p.refused = self.refused
             self.pumps.append(p)
+        if burn is not None:
+            burn.attach(self)
+        # the merge contract on the production render path: the
+        # registry's digest source is the ON-DEMAND merge of every
+        # member pump's own bank (utils/digest.py merged)
+        labels = {} if tenant is None else {"tenant": tenant}
+        self.metrics.add_digest_source(self.merged_digests, **labels)
         #: live uid -> owning pump index (drain victims requeue HOME)
         self._owner: dict = {}
         self._steps = 0
@@ -231,6 +248,11 @@ class ShardedGateway:
         for state, n in counts.items():
             self.metrics.replicas.labels(state=state).set(n)
         self.pumps[0]._drain_migrations()
+        if self.burn is not None:
+            # exactly once per CYCLE (member pump step() never runs
+            # under the sharded cycle), after terminal accounting and
+            # before the bus pump — same ordering as the single pump
+            self.burn.step()
         self.bus.publish("demand", queue_depth=self.pending(),
                          arrival_rate_rps=self.arrival_rate_rps,
                          slo_margin_ewma_s=self.slo_margin_ewma_s,
@@ -253,6 +275,13 @@ class ShardedGateway:
 
     def pending(self) -> int:
         return sum(len(p.queue) for p in self.pumps)
+
+    def merged_digests(self) -> DigestBank:
+        """The fleet view of the per-pump quantile digests: a fresh
+        bucket-wise merge on every call, so render/debug always see
+        current counts.  Merge-of-parts equals the whole-stream
+        digest exactly (utils/digest.py; pinned in test_digest.py)."""
+        return DigestBank.merged(p.digests for p in self.pumps)
 
     @property
     def routes_total(self) -> int:

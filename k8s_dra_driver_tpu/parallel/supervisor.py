@@ -877,7 +877,7 @@ class GangSupervisor:
         if self._pending is not None:
             rec, t0 = self._pending
             rec.mttr_s = time.perf_counter() - t0
-            self.metrics.recovery_seconds.observe(rec.mttr_s)
+            self.metrics.observe_recovery(rec.mttr_s)
             self._pending = None
         if self._step % self.checkpoint_every == 0:
             self.ckpt.save(self._step, self.params, self.opt,

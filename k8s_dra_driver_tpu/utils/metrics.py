@@ -12,7 +12,116 @@ from __future__ import annotations
 from prometheus_client import (CollectorRegistry, Counter, Gauge, Histogram,
                                generate_latest)
 
+from .digest import DigestBank
+
 _BUCKETS = (.001, .005, .01, .05, .1, .5, 1, 5, 10, 30, 60)
+
+
+def escape_label_value(value: str) -> str:
+    """Prometheus text-exposition label escaping: backslash, double
+    quote, and newline are the three characters the format reserves.
+    prometheus_client escapes its own output; this exists for the
+    manually formatted lines below (digest summaries, memwatch
+    gauges), whose tenant/replica/component label values are
+    caller-supplied strings."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def expo_line(name: str, labels: dict | None, value) -> str:
+    """One exposition sample line with sorted, escaped labels —
+    deterministic output for equal inputs."""
+    v = float(value)
+    if labels:
+        lab = ",".join(
+            f'{k}="{escape_label_value(v2)}"'
+            for k, v2 in sorted(labels.items()))
+        return f"{name}{{{lab}}} {v!r}\n"
+    return f"{name} {v!r}\n"
+
+
+#: quantiles every digest series exposes, as (label, q) pairs —
+#: Prometheus summary-type convention
+_DIGEST_QUANTILES = (("0.5", 0.5), ("0.9", 0.9),
+                     ("0.99", 0.99), ("0.999", 0.999))
+
+
+def digest_exposition(series: tuple, groups: list) -> bytes:
+    """Render digest banks as Prometheus ``summary`` families.
+
+    ``series`` is a tuple of ``(bank_key, family_name, help_text)``;
+    ``groups`` is a list of ``(labels_dict, DigestBank)``.  HELP/TYPE
+    headers are emitted even when no bank holds samples yet, so
+    tools/lint_metrics_docs.py sees every declared family on a fresh
+    registry."""
+    out = []
+    for key, family, help_text in series:
+        out.append(f"# HELP {family} {help_text}\n")
+        out.append(f"# TYPE {family} summary\n")
+        for labels, bank in groups:
+            dig = bank.get(key)
+            if dig is None or dig.count == 0:
+                continue
+            for qlabel, q in _DIGEST_QUANTILES:
+                out.append(expo_line(
+                    family, {**labels, "quantile": qlabel},
+                    dig.quantile(q)))
+            out.append(expo_line(f"{family}_sum", labels, dig.total))
+            out.append(expo_line(f"{family}_count", labels, dig.count))
+    return "".join(out).encode()
+
+
+class _DigestSourceMixin:
+    """Shared digest-source plumbing: registries that carry streaming
+    quantile digests next to their fixed-bucket histograms.  Sources
+    are ``(labels, callable -> DigestBank)`` — callables so render
+    always sees the LIVE bank (ShardedGateway's merged view is built
+    on demand)."""
+
+    DIGEST_SERIES: tuple = ()
+
+    def _init_digest_sources(self):
+        self.digest_sources: list = []
+
+    def add_digest_source(self, source, **labels) -> None:
+        """Register a live digest bank; ``labels`` (e.g. tenant) ride
+        on every rendered sample from that source."""
+        self.digest_sources.append(
+            ({k: str(v) for k, v in labels.items()}, source))
+
+    def _digest_groups(self) -> list:
+        """Merge sources that share a label set — two plain gateways
+        on one registry must render one family, not duplicates."""
+        by_labels: dict = {}
+        for labels, source in self.digest_sources:
+            key = tuple(sorted(labels.items()))
+            bank = source()
+            if key in by_labels:
+                merged = DigestBank.merged([by_labels[key][1], bank])
+                by_labels[key] = (labels, merged)
+            else:
+                by_labels[key] = (labels, bank)
+        return [by_labels[k] for k in sorted(by_labels)]
+
+    def digest_snapshot(self) -> dict:
+        """JSON-safe structured view for flight-recorder dumps and
+        /debugz: ``{family: [{**labels, count, sum, min, max, p50,
+        p90, p99, p999}, ...]}``."""
+        groups = self._digest_groups()
+        out: dict = {}
+        for key, family, _help in self.DIGEST_SERIES:
+            rows = []
+            for labels, bank in groups:
+                dig = bank.get(key)
+                if dig is None or dig.count == 0:
+                    continue
+                rows.append({**labels, **dig.snapshot()})
+            out[family] = rows
+        return out
+
+    def _render_digests(self) -> bytes:
+        return digest_exposition(self.DIGEST_SERIES,
+                                 self._digest_groups())
 
 
 class DriverMetrics:
@@ -58,7 +167,7 @@ _SLO_MARGIN_BUCKETS = (-30.0, -5.0, -1.0, -.25, -.05, 0.0, .05, .25,
                        1.0, 5.0, 30.0)
 
 
-class GatewayMetrics:
+class GatewayMetrics(_DigestSourceMixin):
     """Fleet-gateway observability (gateway/frontend.py).
 
     Same dedicated-registry pattern as :class:`DriverMetrics` so
@@ -67,10 +176,29 @@ class GatewayMetrics:
     for drain/requeue: a replica kill is observable as requeued_total
     advancing and the requeued requests' queue-wait samples landing a
     second time.
+
+    Alongside each latency histogram rides a streaming quantile
+    digest (utils/digest.py): bounded memory, ~1% relative error at
+    any quantile, and mergeable across ShardedGateway pumps — the
+    fixed buckets answer "what band", the digests answer "what p999".
     """
+
+    #: (bank key, exposition family, HELP text) for the digest
+    #: summary lines render() appends after the registry exposition
+    DIGEST_SERIES = (
+        ("queue_wait", "tpu_gateway_digest_queue_wait_seconds",
+         "Streaming quantile digest of admission-queue wait "
+         "(mergeable across pumps, ~1% relative error)"),
+        ("ttft", "tpu_gateway_digest_ttft_seconds",
+         "Streaming quantile digest of arrival-to-first-token"),
+        ("slo_margin", "tpu_gateway_digest_slo_margin_seconds",
+         "Streaming quantile digest of the signed SLO margin "
+         "(negative = missed)"),
+    )
 
     def __init__(self):
         self.registry = CollectorRegistry()
+        self._init_digest_sources()
         self.queue_depth = Gauge(
             "tpu_gateway_queue_depth",
             "Requests currently waiting in the admission queue",
@@ -225,9 +353,25 @@ class GatewayMetrics:
             "tpu_gateway_tenant_slo_missed_total",
             "SLO-bearing requests finished late or shed at deadline, "
             "per tenant tag", ["tenant"], registry=self.registry)
+        # SLO burn-rate engine (gateway/burnrate.py): the attained/
+        # missed counters above turned into the Google-SRE multi-
+        # window signal — budget-burn multiples per tenant over a
+        # fast and a slow cycle window, plus the alert edge counter
+        self.tenant_burn_rate = Gauge(
+            "tpu_gateway_tenant_burn_rate",
+            "SLO error-budget burn-rate multiple per tenant over the "
+            "fast/slow cycle windows (1.0 = burning exactly the "
+            "budget; alert when both windows exceed their "
+            "thresholds)", ["tenant", "window"],
+            registry=self.registry)
+        self.tenant_slo_alerts = Counter(
+            "tpu_gateway_tenant_slo_alerts_total",
+            "Burn-rate alerts fired per tenant (rising edges only: "
+            "one per sustained burn episode, not one per burning "
+            "cycle)", ["tenant"], registry=self.registry)
 
     def render(self) -> bytes:
-        return generate_latest(self.registry)
+        return generate_latest(self.registry) + self._render_digests()
 
 
 # Recovery wall time spans a checkpoint restore plus a train-step
@@ -236,7 +380,7 @@ class GatewayMetrics:
 _RECOVERY_BUCKETS = (.1, .5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
 
 
-class RecoveryMetrics:
+class RecoveryMetrics(_DigestSourceMixin):
     """Elastic-gang training recovery observability
     (parallel/supervisor.py) — the training-side twin of
     :class:`GatewayMetrics`' drain counters.
@@ -249,8 +393,17 @@ class RecoveryMetrics:
     (scalar readback included, so a wedged resume can't look fast).
     """
 
+    DIGEST_SERIES = (
+        ("recovery", "tpu_train_digest_recovery_seconds",
+         "Streaming quantile digest of gang MTTR (eviction decision "
+         "to first completed post-resume step)"),
+    )
+
     def __init__(self):
         self.registry = CollectorRegistry()
+        self._init_digest_sources()
+        self.digests = DigestBank(("recovery",))
+        self.add_digest_source(lambda: self.digests)
         self.restarts = Counter(
             "tpu_train_restarts_total",
             "Gang recoveries (eviction→resume cycles) by cause",
@@ -285,8 +438,15 @@ class RecoveryMetrics:
             self.supervisor_state.labels(state=s).set(
                 1.0 if s == state else 0.0)
 
+    def observe_recovery(self, mttr_s: float) -> None:
+        """One recovery sample into BOTH views: the fixed-bucket
+        histogram and the streaming digest (so flightrec dumps carry
+        true recovery quantiles, not bucket edges)."""
+        self.recovery_seconds.observe(mttr_s)
+        self.digests.observe("recovery", mttr_s)
+
     def render(self) -> bytes:
-        return generate_latest(self.registry)
+        return generate_latest(self.registry) + self._render_digests()
 
 
 class FleetMetrics:

@@ -363,6 +363,59 @@ def test_probe_roster_pins_control_plane_scalars():
     assert keys["ctl_trace_overhead_x"] == "trace_overhead_x"
 
 
+def test_observatory_probe_tiny():
+    """The observatory probe at the hermetic shape bench.py pins
+    (TINY_OBS_KWARGS): paired digest-off/on drives over no-op
+    engines, every dispatch observed exactly once across the pumps,
+    the merged quantiles present, and the MemWatch half reconciling.
+    At the tiny shape the paired ratio is too noisy for the ≤1.05
+    budget itself (the committed full-shape artifact pins that —
+    test_obs_artifact_pins_digest_overhead), so sanity bounds only."""
+    from k8s_dra_driver_tpu.gateway.obsprobe import observatory_probe
+    out = observatory_probe(**bench.TINY_OBS_KWARGS)
+    assert out["valid"] is True
+    n = bench.TINY_OBS_KWARGS["n_requests"]
+    assert out["merged_digest_count"] == n
+    assert sum(out["per_pump_counts"]) == n
+    assert out["merged_quantiles"]["p99"] is not None
+    assert 0.5 < out["digest_overhead_x"] < 2.0
+    assert 0 < out["hbm_accounted_frac"] <= 1.0
+    assert out["hbm_components"]
+    assert "paired digest-off/on" in out["note"]
+
+
+def test_obs_artifact_pins_digest_overhead():
+    """THE quantile-observability budget (ISSUE 15): the streaming
+    digests must ride the control-plane ceiling at ≤1.05x wall —
+    same bar, same paired-drive discipline as the span layer.  The
+    recorded full-shape artifact must show it, plus an accounted-HBM
+    fraction ≥0.5 so the memory ledger is explaining real bytes."""
+    artifact = Path(__file__).parent.parent / "tools" / \
+        "obs_digest_cpu.json"
+    doc = bench.json.loads(artifact.read_text())
+    assert doc["probe"] == "observatory"
+    assert "obsprobe" in doc["harness"]
+    res = doc["result"]
+    assert res["valid"] is True
+    assert 0 < res["digest_overhead_x"] <= 1.05
+    assert res["hbm_accounted_frac"] >= 0.5
+    # same shape the bench run streams (OBS_KWARGS), so the artifact
+    # is evidence for the line's scalar, not a different experiment
+    assert res["n_requests"] == bench.OBS_KWARGS["n_requests"]
+    assert res["pumps"] == bench.OBS_KWARGS["pumps"]
+    assert res["merged_digest_count"] == res["n_requests"]
+
+
+def test_probe_roster_pins_observatory_scalars():
+    """Bench-line schema: the observatory scalars (digest overhead
+    ratio, accounted-HBM fraction) are IN the compact line roster."""
+    probes = [p for p, _, _ in bench._PROBE_SCALARS]
+    assert "observatory" in probes
+    keys = {k: f for _, k, f in bench._PROBE_SCALARS}
+    assert keys["obs_digest_overhead_x"] == "digest_overhead_x"
+    assert keys["obs_hbm_accounted_frac"] == "hbm_accounted_frac"
+
+
 def test_loadgen_trace_fixture_schema():
     """The checked-in trace fixtures bench's ctl probe replays: every
     fixture parses, carries exactly the pinned schema keys, and is

@@ -62,6 +62,10 @@ def test_schedule_json_roundtrip_and_fresh():
     fr = ev.fresh()
     assert fr.fired_cycle is None and fr.hit_windows == ()
     assert fr.id == ev.id and fr.kind == ev.kind
+    # the per-burst SLO rides the roundtrip (burn-rate alert knob)
+    tight = FaultEvent(id="t", kind="burst", at_cycle=1, n=2,
+                       slo_s=4.0)
+    assert FaultEvent.from_json(tight.to_json()).slo_s == 4.0
     with pytest.raises(ValueError):
         FaultEvent(id="x", kind="nope", at_cycle=1)
     with pytest.raises(ValueError):
@@ -112,6 +116,62 @@ def test_compound_soak_zero_violations(tmp_path):
                 "chip0-in-cascade", "chip1-while-parked"):
         assert by_id[eid].fired_cycle is not None, f"{eid} never fired"
         assert by_id[eid].hit_windows, f"{eid} fired outside a window"
+    # burn-rate alerting was ALWAYS-ON for the whole soak (the zero
+    # violations above price it at zero invariant cost), stepped
+    # every cycle, and stayed silent — the default schedule's 900s
+    # SLOs never miss, so a firing here would be a false page
+    assert rig.burn is not None
+    assert rig.burn.cycle >= res.cycles
+    assert rig.burn.alerts_total == 0
+
+
+@pytest.mark.faults
+def test_burn_rate_alert_fires_during_fault_window(tmp_path):
+    """ISSUE 15 satellite: during a scripted chip-kill + kv_exhaust
+    pressure window, a burst of tight-SLO requests must shed, the
+    per-tenant burn rate must cross both alert windows within
+    bounded cycles, and the flight recorder must ship an "alert"
+    dump carrying the quantile-digest snapshot — the full
+    fault -> burn -> page -> forensics arc, hermetic."""
+    sched = Schedule(seed=7, cycles=30, events=[
+        FaultEvent(id="warm-burst", kind="burst", at_cycle=1, n=6,
+                   prompt_seed=11),
+        FaultEvent(id="decode-chip-down", kind="chip_kill",
+                   at_cycle=3, chip=7, heal_after=8),
+        FaultEvent(id="kv-squeeze", kind="kv_exhaust", at_cycle=3,
+                   heal_after=6),
+        FaultEvent(id="doomed-burst", kind="burst", at_cycle=4, n=8,
+                   prompt_seed=23, slo_s=4.0),
+    ])
+    res, rig = cru.run_soak(sched, tmp_path / "alert",
+                            dump_dir=tmp_path / "fr")
+    assert_no_violations(
+        [f"cycle {c}: {m}" for c, v in res.violations for m in v],
+        label="alert-arc")
+    assert rig.burn.alerts_total >= 1
+    # bounded latency: the burst lands at cycle 4 with a 4s SLO; the
+    # alert must fire within the fast window plus shed slack, not
+    # "eventually" (marks carry the virtual-clock time, 1s/cycle)
+    marks = [m for m in rig.flightrec.marks if m["reason"] == "alert"]
+    assert marks, "no alert ever reached the flight recorder"
+    assert marks[0]["t"] <= 4.0 + 4.0 + rig.burn.fast_window + 4.0
+    # the dump is reason "alert" and carries the digest snapshot the
+    # on-call needs: fleet queue-wait quantiles at page time
+    dump = next(d for d in rig.flightrec.dumps
+                if "alert" in d["reasons"])
+    rows = dump["digests"]["tpu_gateway_digest_queue_wait_seconds"]
+    assert rows and rows[0]["count"] > 0
+    assert rows[0]["p99"] is not None
+    assert any(p.name.endswith("-alert.json")
+               for p in (tmp_path / "fr").glob("flightrec-*.json"))
+    # the page itself went out on the bus with the burn evidence
+    alert_events = [e for e in rig.bus.journal_dump()
+                    if e.get("topic") == "alert"]
+    assert alert_events
+    # the faults healed and the run still drained clean: everything
+    # submitted reached exactly one terminal outcome (the sheds ARE
+    # the misses that drove the burn)
+    assert res.submitted == 14 and res.finished < res.submitted
 
 
 # -- the hardened double-fault arcs, one targeted test each ---------------

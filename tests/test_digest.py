@@ -1,0 +1,240 @@
+"""Streaming quantile digest (utils/digest.py) accuracy + merge.
+
+The property under test is the digest's whole contract: bounded
+memory, advertised relative error at every quantile the fleet
+reports, and EXACT mergeability — the merged digest of per-pump
+parts must answer every quantile identically to the digest that saw
+the whole stream, because the ShardedGateway's production render
+path (GatewayMetrics digest sources -> merged_digests) depends on
+it.  Accuracy is checked against numpy's exact sorted order
+statistics over seeded uniform / lognormal / heavy-tail streams, so
+a bucket-math regression shows up as a number, not a flake.
+"""
+
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, str(Path(__file__).parent))
+
+from k8s_dra_driver_tpu.utils.digest import (DEFAULT_ALPHA,  # noqa: E402
+                                             DigestBank,
+                                             NullDigestBank,
+                                             QuantileDigest)
+
+#: the quantiles the snapshot/exposition layers report
+QS = (0.5, 0.9, 0.99, 0.999)
+
+
+def _streams(n=20_000, seed=0):
+    """(name, values) per distribution shape the fleet actually sees:
+    uniform queue waits, lognormal service times, heavy-tail stalls."""
+    rng = np.random.default_rng(seed)
+    return (
+        ("uniform", rng.uniform(1e-4, 10.0, n)),
+        ("lognormal", rng.lognormal(mean=-2.0, sigma=1.5, size=n)),
+        ("pareto", (rng.pareto(1.5, n) + 1.0) * 1e-3),
+    )
+
+
+def _assert_within_relative_error(dig, values, alpha):
+    """The DDSketch guarantee, checked against neighbor order
+    statistics: the estimate for quantile q must be within the
+    advertised relative error of the CLOSED interval between the
+    order statistics bracketing rank q*(n-1) (rank interpolation
+    means either neighbor is a correct answer)."""
+    s = np.sort(values)
+    n = len(s)
+    for q in QS:
+        est = dig.quantile(q)
+        rank = q * (n - 1)
+        lo = s[int(np.floor(rank))]
+        hi = s[int(np.ceil(rank))]
+        tol = alpha * 1.1 + 1e-12
+        assert lo * (1 - tol) <= est <= hi * (1 + tol), (
+            f"q={q}: est {est} outside "
+            f"[{lo * (1 - tol)}, {hi * (1 + tol)}]")
+
+
+class TestAccuracy:
+    @pytest.mark.parametrize("name,values",
+                             _streams(), ids=lambda v: v
+                             if isinstance(v, str) else "")
+    def test_advertised_relative_error(self, name, values):
+        dig = QuantileDigest()
+        for v in values:
+            dig.observe(float(v))
+        assert dig.count == len(values)
+        _assert_within_relative_error(dig, values, DEFAULT_ALPHA)
+
+    def test_signed_stream(self):
+        """SLO margins go negative; the signed bucket halves must
+        keep relative error on both sides of zero."""
+        rng = np.random.default_rng(1)
+        values = np.concatenate([
+            -rng.lognormal(mean=0.0, sigma=1.0, size=5000),
+            rng.lognormal(mean=0.0, sigma=1.0, size=5000)])
+        rng.shuffle(values)
+        dig = QuantileDigest()
+        for v in values:
+            dig.observe(float(v))
+        s = np.sort(values)
+        n = len(s)
+        for q in (0.01, 0.25, 0.5, 0.75, 0.99):
+            est = dig.quantile(q)
+            rank = q * (n - 1)
+            lo = s[int(np.floor(rank))]
+            hi = s[int(np.ceil(rank))]
+            tol = DEFAULT_ALPHA * 1.1 + 1e-12
+            # sign-aware relative band around the neighbor interval
+            band_lo = lo - abs(lo) * tol
+            band_hi = hi + abs(hi) * tol
+            assert band_lo <= est <= band_hi, (q, est, band_lo,
+                                               band_hi)
+
+    def test_bounded_memory_under_collapse(self):
+        """A stream spanning many decades must stay under the bucket
+        cap, and the upper quantiles (what collapse must protect)
+        must keep their accuracy."""
+        rng = np.random.default_rng(2)
+        values = 10.0 ** rng.uniform(-9, 9, 50_000)
+        dig = QuantileDigest(max_buckets=256)
+        for v in values:
+            dig.observe(float(v))
+        assert len(dig._pos) + len(dig._neg) <= 256
+        s = np.sort(values)
+        n = len(s)
+        for q in (0.9, 0.99, 0.999):
+            est = dig.quantile(q)
+            rank = q * (n - 1)
+            lo = s[int(np.floor(rank))]
+            hi = s[int(np.ceil(rank))]
+            tol = DEFAULT_ALPHA * 1.1 + 1e-12
+            assert lo * (1 - tol) <= est <= hi * (1 + tol), (q, est)
+
+    def test_nan_dropped_inf_survives_min_max(self):
+        dig = QuantileDigest()
+        dig.observe(float("nan"))
+        assert dig.count == 0
+        for v in (1.0, 2.0, float("inf")):
+            dig.observe(v)
+        assert dig.count == 3
+        assert dig.vmax == float("inf")
+
+
+class TestMerge:
+    def test_merge_of_parts_equals_whole_stream(self):
+        """The acceptance property: split any stream across parts,
+        merge the part digests, and every quantile answers EXACTLY
+        as the whole-stream digest (bucket counts are order-free
+        integer sums).  Float ``sum`` may differ by round-off — it
+        is the ONE field excluded from byte equality."""
+        for name, values in _streams(n=9000, seed=3):
+            whole = QuantileDigest()
+            for v in values:
+                whole.observe(float(v))
+            parts = [QuantileDigest() for _ in range(3)]
+            for i, v in enumerate(values):
+                parts[i % 3].observe(float(v))
+            merged = parts[0]
+            merged.merge(parts[1])
+            merged.merge(parts[2])
+            a = json.loads(merged.to_json())
+            b = json.loads(whole.to_json())
+            sa, sb = a.pop("sum"), b.pop("sum")
+            assert a == b, name
+            assert np.isclose(sa, sb, rtol=1e-9), name
+            for q in QS:
+                assert merged.quantile(q) == whole.quantile(q), (
+                    name, q)
+
+    def test_merge_alpha_mismatch_refused(self):
+        a = QuantileDigest(alpha=0.01)
+        b = QuantileDigest(alpha=0.02)
+        with pytest.raises(ValueError):
+            a.merge(b)
+
+    def test_serialization_roundtrip_deterministic(self):
+        rng = np.random.default_rng(4)
+        dig = QuantileDigest()
+        for v in rng.lognormal(size=500):
+            dig.observe(float(v))
+        blob = dig.to_json()
+        clone = QuantileDigest.from_json(blob)
+        assert clone.to_json() == blob
+        for q in QS:
+            assert clone.quantile(q) == dig.quantile(q)
+
+
+class TestDigestBank:
+    def test_series_and_snapshot(self):
+        bank = DigestBank(("queue_wait", "ttft"))
+        for v in (0.1, 0.2, 0.4):
+            bank.observe("queue_wait", v)
+        snap = bank.snapshot()
+        assert snap["queue_wait"]["count"] == 3
+        assert "p99" in snap["queue_wait"]
+        assert bank.get("ttft") is None or \
+            bank.get("ttft").count == 0
+
+    def test_merged_classmethod(self):
+        banks = [DigestBank(("w",)) for _ in range(3)]
+        for i, bank in enumerate(banks):
+            for v in range(10):
+                bank.observe("w", float(v + 10 * i))
+        merged = DigestBank.merged(banks)
+        assert merged.get("w").count == 30
+
+    def test_null_bank_is_inert(self):
+        bank = NullDigestBank(("queue_wait",))
+        bank.observe("queue_wait", 1.0)
+        dig = bank.get("queue_wait")
+        assert dig is None or dig.count == 0
+
+
+class TestShardedGatewayMerge:
+    def test_two_pump_merged_digest_matches_whole_stream(self):
+        """The production merge contract end-to-end: drive a 2-pump
+        ShardedGateway over no-op engines, then check the merged
+        queue-wait digest (a) saw every dispatch exactly once across
+        the pumps and (b) answers p99 identically no matter which
+        order the per-pump parts merge — the whole-stream
+        equivalence the exposition layer relies on."""
+        from k8s_dra_driver_tpu.gateway.ctlprobe import NullEngine
+        from k8s_dra_driver_tpu.gateway.replica import ReplicaManager
+        from k8s_dra_driver_tpu.gateway.sharded import ShardedGateway
+        from k8s_dra_driver_tpu.models.serving import Request
+
+        rng = np.random.default_rng(5)
+        n = 96
+        mgr = ReplicaManager(lambda name: NullEngine(slots=4),
+                             replicas=2, depth_bound=4)
+        gw = ShardedGateway(mgr, pumps=2, queue_capacity=48, seed=0)
+        reqs = [Request(uid=f"m{i}",
+                        prompt=rng.integers(0, 100, 8).astype(np.int32),
+                        max_new=1) for i in range(n)]
+        i = 0
+        while i < len(reqs):
+            while i < len(reqs) and gw.pending() < 96:
+                gw.submit(reqs[i], 3600.0)
+                i += 1
+            gw.step()
+        gw.run_until_idle()
+
+        per_pump = [p.digests.get("queue_wait") for p in gw.pumps]
+        counts = [d.count if d else 0 for d in per_pump]
+        assert sum(counts) == n
+        merged = gw.merged_digests().get("queue_wait")
+        assert merged.count == n
+        # merge in the opposite order: same answers, every quantile
+        other = QuantileDigest.from_json(per_pump[1].to_json())
+        other.merge(per_pump[0])
+        for q in QS:
+            assert merged.quantile(q) == other.quantile(q)
+        # and the summary exposition renders the merged answers
+        text = gw.metrics.render().decode()
+        assert "tpu_gateway_digest_queue_wait_seconds{" in text
+        assert 'quantile="0.99"' in text

@@ -279,6 +279,41 @@ class TestFleetExposition:
             in text
         assert 'tpu_fleet_chips{owner="free"} 2.0' in text
 
+    def test_label_values_escaped_in_manual_exposition(self):
+        """Prometheus text format requires ``\\``, ``\"`` and newline
+        escaped inside label values; the manual exposition writers
+        (digest summaries, the MemWatch ledger) must match what
+        prometheus_client does for registry families, or one weird
+        tenant name corrupts the whole scrape."""
+        from prometheus_client.parser import (
+            text_string_to_metric_families)
+
+        from k8s_dra_driver_tpu.utils.digest import DigestBank
+        from k8s_dra_driver_tpu.utils.memwatch import MemWatch
+        from k8s_dra_driver_tpu.utils.metrics import (GatewayMetrics,
+                                                      escape_label_value)
+
+        weird = 'we"ird\\x\ny'
+        assert escape_label_value(weird) == 'we\\"ird\\\\x\\ny'
+
+        gw = GatewayMetrics()
+        bank = DigestBank(("queue_wait",))
+        bank.observe("queue_wait", 0.25)
+        gw.add_digest_source(lambda: bank, tenant=weird)
+        mw = MemWatch()
+        mw.account("model_params", 1024, unit=weird)
+        text = (gw.render() + mw.render()).decode()
+        assert 'tenant="we\\"ird\\\\x\\ny"' in text
+        assert 'unit="we\\"ird\\\\x\\ny"' in text
+        # the escaped text must round-trip through the reference
+        # parser with the ORIGINAL value intact
+        seen = {}
+        for family in text_string_to_metric_families(text):
+            for sample in family.samples:
+                for v in sample.labels.values():
+                    seen[v] = True
+        assert weird in seen
+
     def test_http_endpoint_serves_combined_registries(self):
         """utils/httpendpoint.py extra_metrics: one /metrics scrape
         carries driver + fleet families (real HTTP round-trip)."""

@@ -435,8 +435,17 @@ def test_acceptance_cascade_across_two_tenants(tmp_path):
     reclaimed (parked) before mid is touched, mid never drops below
     its floor, hi never exceeds its quota, zero steps lost, losses
     exactly once; calm regrows both victims (priority order), and
-    every cascade step is visible in the mt metrics."""
+    every cascade step is visible in the mt metrics.
+
+    ISSUE 15 rides along: a batch tenant whose tight SLOs shed
+    during the cascade must trip a burn-rate alert (with a flight-
+    recorder dump carrying the digest snapshot), while the protected
+    hi tenant never pages."""
+    from k8s_dra_driver_tpu.cluster.bus import EventBus
+    from k8s_dra_driver_tpu.cluster.flightrec import FlightRecorder
+    from k8s_dra_driver_tpu.gateway.burnrate import SloBurnEngine
     from k8s_dra_driver_tpu.parallel import supervisor as sv
+    from k8s_dra_driver_tpu.utils.tracing import Tracer
 
     clock = Clock()
     sup_lo, ckpt_lo = _gang(tmp_path, "lo", dp=2, chips={0, 1},
@@ -447,8 +456,16 @@ def test_acceptance_cascade_across_two_tenants(tmp_path):
         lambda name: ServingEngine(params(), CFG, slots=2),
         replicas=2, chip_of=lambda name: 6 + int(name[1:]),
         depth_bound=2)
+    bus = EventBus(seed=0)
+    tracer = Tracer(bus=bus, clock=clock)
+    burn = SloBurnEngine(bus=bus, tracer=tracer, clock=clock)
     gw = FleetGateway(mgr, queue_capacity=64, clock=clock,
-                      auto_replace=False, tenant="hi")
+                      auto_replace=False, tenant="hi", bus=bus,
+                      tracer=tracer, burn=burn)
+    flightrec = FlightRecorder(tracer, bus=bus,
+                               metrics=(gw.metrics,))
+    alerts = []
+    bus.subscribe("alert", lambda ev: alerts.append(ev.payload))
     ledger = ChipLedger(list(range(8)))
     registry = TenantRegistry(capacity=8)
     registry.add(TenantSpec("hi", priority=3, quota=6, floor=2),
@@ -495,6 +512,13 @@ def test_acceptance_cascade_across_two_tenants(tmp_path):
                     max_new=3) for i in range(24)]
     for r in wave:
         gw.submit(r, slo_s=120.0)
+    # the doomed rider: batch-tenant requests whose 2s SLOs cannot
+    # survive behind hi's 24-deep queue on a full board — their
+    # sheds are the misses that must burn batch's budget
+    batch = [Request(uid=f"b{i}", prompt=prompt(300 + i, 5),
+                     max_new=3) for i in range(8)]
+    for r in batch:
+        gw.submit(r, slo_s=2.0, tenant="batch")
     for _ in range(80):
         pump()
         if (not len(gw.queue)
@@ -529,8 +553,29 @@ def test_acceptance_cascade_across_two_tenants(tmp_path):
     assert any(g.status == "finished" and g.replica in granted_names
                for g in gw.outcomes.values()), \
         "no granted replica ever served"
-    # every burst request reached exactly one terminal FINISHED
-    assert_exactly_once(gw, wave)
+    # every request reached exactly one terminal outcome — the hi
+    # wave all FINISHED, the batch rider all shed (asserted below)
+    assert_exactly_once(gw, wave + batch, status=None)
+    assert all(gw.outcomes[r.uid].status == "finished" for r in wave)
+
+    # -- the burn-rate page (ISSUE 15): batch burned, hi did not -----
+    assert all(gw.outcomes[r.uid].status == "shed_expired"
+               for r in batch)
+    assert burn.alerts_total >= 1
+    assert alerts and all(a["tenant"] == "batch" for a in alerts)
+    assert alerts[0]["burn_fast"] >= burn.fast_threshold
+    assert alerts[0]["burn_slow"] >= burn.slow_threshold
+    # the page shipped forensics: an "alert" dump whose digest
+    # snapshot answers "what were the fleet queue waits" at page time
+    dump = next(d for d in flightrec.dumps if "alert" in d["reasons"])
+    rows = dump["digests"]["tpu_gateway_digest_queue_wait_seconds"]
+    assert rows and rows[0]["count"] > 0
+    assert gw.metrics.registry.get_sample_value(
+        "tpu_gateway_tenant_slo_alerts_total",
+        {"tenant": "batch"}) >= 1
+    assert gw.metrics.registry.get_sample_value(
+        "tpu_gateway_tenant_slo_alerts_total",
+        {"tenant": "hi"}) is None
 
     # -- calm: releases, then regrow BOTH victims in priority order --
     for _ in range(120):

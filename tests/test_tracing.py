@@ -417,9 +417,11 @@ class TestFlightRecorder:
         assert t({"name": "reconcile",
                   "attrs": {"kind": "scale_up"}}) is None
         assert t({"name": "dispatch"}) is None
+        assert t({"name": "alert",
+                  "attrs": {"tenant": "batch"}}) == "alert"
         # every reason the default trigger can produce is declared
         assert {"drain", "slo_shed", "eviction", "failed",
-                "preempt"} == set(REASONS)
+                "preempt", "alert"} == set(REASONS)
 
     def test_trigger_dump_contents_and_json_safety(self):
         vc = VirtualClock()

@@ -43,16 +43,19 @@ _HIST_VIEWS = ("_bucket", "_sum", "_count")
 
 
 def live_series() -> dict[str, str]:
-    """name → kind for every series the four registries export,
-    ``*_created`` noise excluded."""
+    """name → kind for every series the registries (plus the manual
+    exposition sources: digest summaries, the MemWatch byte ledger)
+    export, ``*_created`` noise excluded."""
     sys.path.insert(0, str(REPO))
+    from k8s_dra_driver_tpu.utils.memwatch import MemWatch
     from k8s_dra_driver_tpu.utils.metrics import (DriverMetrics,
                                                   FleetMetrics,
                                                   GatewayMetrics,
                                                   RecoveryMetrics,
                                                   render_all)
     text = render_all(DriverMetrics(), GatewayMetrics(),
-                      RecoveryMetrics(), FleetMetrics()).decode()
+                      RecoveryMetrics(), FleetMetrics(),
+                      MemWatch()).decode()
     return {name: kind
             for name, kind in re.findall(r"^# TYPE (\S+) (\S+)",
                                          text, re.M)
@@ -81,6 +84,8 @@ def lint(doc: pathlib.Path = DOC) -> list[str]:
     for name in live:
         if live[name] == "histogram":
             resolvable.update(name + v for v in _HIST_VIEWS)
+        elif live[name] == "summary":
+            resolvable.update(name + v for v in ("_sum", "_count"))
     for name in sorted(documented - resolvable):
         problems.append(
             f"{label} documents {name} which no "

@@ -1,0 +1,265 @@
+"""Bench-trajectory regression sentinel.
+
+The driver records one ``BENCH_rNN.json`` per round and the probe
+artifacts under ``tools/*.json`` carry the recorded perf evidence —
+but until now nothing READ the trajectory, so a scalar could halve
+across three rounds and nobody would fail.  This tool is the
+automated reader:
+
+- **trajectory scan**: every ``BENCH_r*.json`` is parsed
+  schema-tolerantly (rounds 1–2 predate the flat ``parsed.summary``
+  dict, rounds with ``parsed: null`` recorded a harness failure, the
+  current schema is ``parsed.summary`` scalars + a ``platform`` tag
+  and an ``invalid`` list) — a malformed round contributes nothing
+  and NEVER crashes the sentinel;
+- **robust baseline**: per scalar, per platform (a CPU-hermetic
+  round must not baseline a TPU round), the baseline is the MEDIAN
+  of the last ``k`` prior values with a noise band of
+  ``max(rel_band x |baseline|, 3 x MAD)`` — one spiked round cannot
+  move the verdict (the same median discipline as
+  ops/collectives.py's differential harness);
+- **direction rules**: suffix patterns decide lower-is-better
+  (``*_ms``, ``*_overhead_x``) vs higher-is-better (``*_x``,
+  ``*_tok_s``, ``*_tflops`` ...); a scalar matching neither is
+  informational and can never flag;
+- **artifact gates**: absolute bars on recorded artifacts (the
+  tracing and digest ≤1.05x overhead gates) — a missing artifact or
+  key is "unknown", a violated bar is a regression;
+- **verdicts**: regression / improvement / steady / unknown per
+  scalar, rolled up into ``tools/perf_sentinel_report.json``; CI
+  gates through tests/test_perf_sentinel.py, and the process exit
+  code is 1 only on regression.
+
+Run from the repo root::
+
+    python tools/perf_sentinel.py
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import pathlib
+import re
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+REPORT = REPO / "tools" / "perf_sentinel_report.json"
+
+#: report schema tag (tests pin it)
+FORMAT = "tpu-dra-perf-sentinel/1"
+
+#: baseline = median of the last K prior same-platform values
+BASELINE_K = 4
+#: fewer prior values than this -> "unknown" (no baseline to trust)
+MIN_HISTORY = 3
+#: noise band as a fraction of |baseline| (bench rounds run on
+#: tunneled hardware and shared hosts; CLAUDE.md records a 2x swing
+#: from concurrent load alone, so the band is deliberately wide)
+REL_BAND = 0.25
+
+#: (pattern, direction) — FIRST match wins, so *_overhead_x stays
+#: lower-is-better even though bare *_x is higher-is-better, and
+#: the per-second RATES (*_tok_s, *_per_s) outrank the bare time
+#: units they would otherwise suffix-match (*_s is a duration)
+DIRECTION_RULES = (
+    (re.compile(r"overhead_x$"), "lower"),
+    (re.compile(r"(_x|_tflops|_gbps|_tok_s|_tps|_rps|_per_s|_frac"
+                r"|_ok)$"), "higher"),
+    (re.compile(r"(_ms|_s|_seconds|_ns|_us)$"), "lower"),
+)
+
+#: absolute bars on recorded artifacts: (relpath, key path into the
+#: doc, op, bound).  Missing file/key/NaN -> "unknown", never a crash.
+ARTIFACT_GATES = (
+    ("tools/ctl_ceiling_cpu.json",
+     ("result", "trace_overhead_x"), "<=", 1.05),
+    ("tools/obs_digest_cpu.json",
+     ("result", "digest_overhead_x"), "<=", 1.05),
+    ("tools/obs_digest_cpu.json",
+     ("result", "hbm_accounted_frac"), ">=", 0.5),
+)
+
+
+def direction_of(name: str) -> str | None:
+    for pat, direction in DIRECTION_RULES:
+        if pat.search(name):
+            return direction
+    return None
+
+
+def _is_scalar(v) -> bool:
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and math.isfinite(v))
+
+
+def load_round(path: pathlib.Path) -> tuple[str, dict] | None:
+    """(platform, {scalar: value}) for one BENCH round, or None when
+    the round recorded no usable summary.  Tolerates every schema the
+    trajectory actually contains: ``parsed: null`` (harness failure
+    rounds), the legacy ``parsed.detail.driver`` shape (rounds 1–2),
+    and the current flat ``parsed.summary``."""
+    try:
+        doc = json.loads(path.read_text())
+    except (OSError, ValueError):
+        return None
+    parsed = doc.get("parsed") or {}
+    if not isinstance(parsed, dict):
+        return None
+    summary = parsed.get("summary")
+    if isinstance(summary, dict):
+        invalid = set(summary.get("invalid") or ())
+        platform = str(summary.get("platform", "unknown"))
+        scalars = {k: float(v) for k, v in summary.items()
+                   if _is_scalar(v) and k not in invalid}
+        return (platform, scalars) if scalars else None
+    # legacy rounds: the driver latency detail is the only stable
+    # scalar surface, and those rounds ran the CPU-host driver path
+    driver = (parsed.get("detail") or {}).get("driver") or {}
+    scalars = {f"driver_{k}": float(v) for k, v in driver.items()
+               if _is_scalar(v)}
+    return ("legacy", scalars) if scalars else None
+
+
+def load_trajectory(root: pathlib.Path = REPO) -> list[dict]:
+    """Rounds in ascending round order:
+    ``{round, platform, scalars}``."""
+    rounds = []
+    for path in sorted(root.glob("BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path.name)
+        if not m:
+            continue
+        loaded = load_round(path)
+        if loaded is None:
+            continue
+        platform, scalars = loaded
+        rounds.append({"round": int(m.group(1)),
+                       "platform": platform, "scalars": scalars})
+    return rounds
+
+
+def classify(history: list[float], latest: float,
+             direction: str | None,
+             rel_band: float = REL_BAND) -> dict:
+    """Verdict for one scalar given its prior same-platform values.
+
+    regression / improvement require a direction AND enough history;
+    within the noise band -> steady; no direction -> informational.
+    """
+    out = {"latest": latest, "n_history": len(history)}
+    if not _is_scalar(latest):
+        out["verdict"] = "unknown"
+        out["why"] = "latest value missing or non-finite"
+        return out
+    if len(history) < MIN_HISTORY:
+        out["verdict"] = "unknown"
+        out["why"] = (f"only {len(history)} prior value(s); "
+                      f"need {MIN_HISTORY}")
+        return out
+    tail = sorted(history[-BASELINE_K:])
+    n = len(tail)
+    baseline = (tail[n // 2] if n % 2
+                else 0.5 * (tail[n // 2 - 1] + tail[n // 2]))
+    devs = sorted(abs(v - baseline) for v in tail)
+    mad = (devs[n // 2] if n % 2
+           else 0.5 * (devs[n // 2 - 1] + devs[n // 2]))
+    band = max(rel_band * abs(baseline), 3.0 * mad, 1e-12)
+    out["baseline"] = baseline
+    out["band"] = band
+    delta = latest - baseline
+    if direction is None:
+        out["verdict"] = "informational"
+        return out
+    worse = delta > band if direction == "lower" else delta < -band
+    better = delta < -band if direction == "lower" else delta > band
+    out["direction"] = direction
+    out["verdict"] = ("regression" if worse
+                      else "improvement" if better else "steady")
+    return out
+
+
+def check_artifact_gates(root: pathlib.Path = REPO,
+                         gates=ARTIFACT_GATES) -> list[dict]:
+    results = []
+    for relpath, keys, op, bound in gates:
+        entry = {"artifact": relpath, "key": "/".join(keys),
+                 "op": op, "bound": bound}
+        path = root / relpath
+        try:
+            node = json.loads(path.read_text())
+            for k in keys:
+                node = node[k]
+            value = float(node)
+            if not math.isfinite(value):
+                raise ValueError("non-finite")
+        except (OSError, ValueError, KeyError, TypeError) as e:
+            entry["verdict"] = "unknown"
+            entry["why"] = f"{type(e).__name__}: {e}"
+            results.append(entry)
+            continue
+        entry["value"] = value
+        ok = value <= bound if op == "<=" else value >= bound
+        entry["verdict"] = "steady" if ok else "regression"
+        results.append(entry)
+    return results
+
+
+def build_report(root: pathlib.Path = REPO,
+                 rel_band: float = REL_BAND) -> dict:
+    """The whole sentinel pass, pure (writes nothing)."""
+    rounds = load_trajectory(root)
+    scalars: dict[str, dict] = {}
+    if rounds:
+        latest = rounds[-1]
+        for name, value in sorted(latest["scalars"].items()):
+            history = [r["scalars"][name] for r in rounds[:-1]
+                       if r["platform"] == latest["platform"]
+                       and name in r["scalars"]]
+            scalars[name] = classify(history, value,
+                                     direction_of(name), rel_band)
+    gates = check_artifact_gates(root)
+    counts: dict[str, int] = {}
+    for entry in list(scalars.values()) + gates:
+        v = entry["verdict"]
+        counts[v] = counts.get(v, 0) + 1
+    return {
+        "tool": "perf_sentinel",
+        "format": FORMAT,
+        "rounds_seen": [r["round"] for r in rounds],
+        "latest_round": rounds[-1]["round"] if rounds else None,
+        "latest_platform": rounds[-1]["platform"] if rounds else None,
+        "rel_band": rel_band,
+        "baseline_k": BASELINE_K,
+        "min_history": MIN_HISTORY,
+        "scalars": scalars,
+        "artifact_gates": gates,
+        "counts": counts,
+        "verdict": ("regression" if counts.get("regression")
+                    else "green"),
+    }
+
+
+def main() -> int:
+    report = build_report()
+    REPORT.write_text(json.dumps(report, indent=1, sort_keys=True)
+                      + "\n")
+    n_reg = report["counts"].get("regression", 0)
+    print(f"perf_sentinel: {report['verdict']} "
+          f"({len(report['scalars'])} scalars over rounds "
+          f"{report['rounds_seen']}, {n_reg} regression(s)) "
+          f"-> {REPORT.relative_to(REPO)}")
+    for name, entry in report["scalars"].items():
+        if entry["verdict"] == "regression":
+            print(f"  REGRESSION {name}: {entry['latest']} vs "
+                  f"baseline {entry['baseline']:.4g} "
+                  f"(band {entry['band']:.4g})")
+    for entry in report["artifact_gates"]:
+        if entry["verdict"] == "regression":
+            print(f"  REGRESSION {entry['artifact']} "
+                  f"{entry['key']}={entry['value']} "
+                  f"violates {entry['op']} {entry['bound']}")
+    return 1 if n_reg else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
