@@ -130,6 +130,18 @@ TINY_CTL_KWARGS = dict(pump_counts=(1, 2), replicas=2, slots=4,
                        n_requests=96, trace_name="bursty",
                        offered_x=8.0)
 
+#: multi-process control-plane probe (gateway/procprobe.py): the same
+#: null-engine drive against REAL pump subprocesses with the durable
+#: outcome journal on — CPU-time-normalized scaling across widths
+#: (the GIL escape the in-process ceiling above cannot show) plus the
+#: per-commit fsync cost of exactly-once.
+#: tools/ctl_multiproc_cpu.json is the committed artifact; the smoke
+#: tests pin the reduced TINY shape below.
+CTL_PROC_KWARGS = dict(pump_counts=(1, 2, 4), n_requests=600,
+                       replicas=2, slots=8)
+TINY_CTL_PROC_KWARGS = dict(pump_counts=(1, 2), n_requests=64,
+                            replicas=2, slots=4)
+
 #: observatory probe (gateway/obsprobe.py): paired digest-off/on
 #: closed-loop saturation over NO-OP engines (the quantile-digest
 #: overhead ratio, merged render path included) + a MemWatch HBM
@@ -694,6 +706,42 @@ def _control_plane_probe(timeout_s: float = 240.0) -> dict:
     return payload
 
 
+def _control_plane_multiproc_probe(timeout_s: float = 300.0) -> dict:
+    """Multi-process control-plane probe (gateway/procprobe.py) in a
+    CPU-pinned subprocess: pump subprocesses + the durable outcome
+    journal, swept over widths.  Always CPU — what's measured is host
+    decision + fsync cost per process, isolated from any accelerator
+    (and honest about the 1-CPU host: see the probe's note field)."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    kwargs = json.dumps(CTL_PROC_KWARGS)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.gateway.procprobe import "
+        "multiproc_probe\n"
+        f"print(json.dumps(multiproc_probe("
+        f"**json.loads({kwargs!r}))))\n")
+    env = cpu_jax_env(1)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = "CPU-pinned subprocess; " + payload.get("note", "")
+    return payload
+
+
 def _observatory_probe(timeout_s: float = 240.0) -> dict:
     """Observatory probe (gateway/obsprobe.py) in a CPU-pinned
     subprocess: the paired digest-on/off overhead ratio (merged
@@ -767,7 +815,7 @@ def _paged_kv_probe(timeout_s: float = 300.0) -> dict:
     return payload
 
 
-def _tpu_probes():
+def _tpu_probes(skip: frozenset = frozenset()):
     """Yield (key, result) per probe — most valuable first.
 
     This generator runs ONLY in the ``--tpu-probes`` child process
@@ -775,7 +823,10 @@ def _tpu_probes():
     the parent enforces a deadline and keeps whatever streamed out
     before a kill, so the probes the round is judged on (the flash
     attention speedups, VERDICT r03 weak #4) come first and the
-    nice-to-haves last.
+    nice-to-haves last.  ``skip`` (BENCH_RESUME capture): probe keys
+    whose section artifact already landed in an earlier run — their
+    work is not re-paid; header keys (devices/platform/tpu_present)
+    always refresh.
     """
     try:
         import jax
@@ -849,58 +900,64 @@ def _tpu_probes():
     # interpret-mode shape purely to keep the code path exercised
     # hermetically. Standard shape first, then the long-context
     # regime the kernel exists for.
-    probe, _ = run(attn_attempts(
-        [(4, 2048, 8, 32), (2, 1024, 4, 16), (1, 512, 2, 8)]
-        if on_accel else [(1, 128, 2, 2)]), attn_fields)
-    yield "attention", probe
-    if on_accel:
+    if "attention" not in skip:
+        probe, _ = run(attn_attempts(
+            [(4, 2048, 8, 32), (2, 1024, 4, 16), (1, 512, 2, 8)]
+            if on_accel else [(1, 128, 2, 2)]), attn_fields)
+        yield "attention", probe
+    if on_accel and "attention_long_context" not in skip:
         probe, _ = run(attn_attempts(
             [(1, 8192, 8, 24), (1, 4096, 8, 24)]), attn_fields)
         yield "attention_long_context", probe
 
     # Training path: fwd+bwd through the pallas flash backward vs
     # naive XLA autodiff.
-    probe, _ = run(attn_attempts(
-        [(4, 2048, 8, 12), (1, 1024, 4, 8)]
-        if on_accel else [(1, 128, 2, 2)],
-        probe=attention_grad_probe), attn_fields)
-    yield "attention_grad", probe
+    if "attention_grad" not in skip:
+        probe, _ = run(attn_attempts(
+            [(4, 2048, 8, 12), (1, 1024, 4, 8)]
+            if on_accel else [(1, 128, 2, 2)],
+            probe=attention_grad_probe), attn_fields)
+        yield "attention_grad", probe
     if on_accel:
         # the long-context regime behind the README's headline claim
-        probe, _ = run(attn_attempts(
-            [(1, 8192, 8, 6), (1, 4096, 8, 8)],
-            probe=attention_grad_probe), attn_fields)
-        yield "attention_grad_long_context", probe
+        if "attention_grad_long_context" not in skip:
+            probe, _ = run(attn_attempts(
+                [(1, 8192, 8, 6), (1, 4096, 8, 8)],
+                probe=attention_grad_probe), attn_fields)
+            yield "attention_grad_long_context", probe
         # grouped-query attention: same MXU work, 1/4 the K/V traffic
-        probe, _ = run(attn_attempts(
-            [(4, 2048, 8, 16)],
-            probe=lambda **kw: attention_probe(kv_heads=2, **kw)),
-            attn_fields)
-        yield "attention_gqa", probe
+        if "attention_gqa" not in skip:
+            probe, _ = run(attn_attempts(
+                [(4, 2048, 8, 16)],
+                probe=lambda **kw: attention_probe(kv_heads=2, **kw)),
+                attn_fields)
+            yield "attention_gqa", probe
         # sliding-window long context: the block-skip claim
         # (ops/flash_attention.py window path) measured by the driver
-        probe, _ = run(attn_attempts(
-            [(1, 8192, 8, 24)],
-            probe=lambda **kw: attention_probe(window=1024, **kw)),
-            attn_fields)
-        yield "attention_window", probe
+        if "attention_window" not in skip:
+            probe, _ = run(attn_attempts(
+                [(1, 8192, 8, 24)],
+                probe=lambda **kw: attention_probe(window=1024, **kw)),
+                attn_fields)
+            yield "attention_window", probe
 
-    mm_shapes = ([(4096, 400), (4096, 100), (2048, 64), (1024, 16)]
-                 if on_accel else [(1024, 8)])
-    probe, _ = run(
-        [(f"bf16_{d}x{i}",
-          lambda d=d, i=i: matmul_tflops(dim=d, iters=i))
-         for d, i in mm_shapes],
-        lambda res: {"tflops": round(res["tflops"], 2),
-                     "valid": res["valid"]})
-    yield "matmul", probe
+    if "matmul" not in skip:
+        mm_shapes = ([(4096, 400), (4096, 100), (2048, 64), (1024, 16)]
+                     if on_accel else [(1024, 8)])
+        probe, _ = run(
+            [(f"bf16_{d}x{i}",
+              lambda d=d, i=i: matmul_tflops(dim=d, iters=i))
+             for d, i in mm_shapes],
+            lambda res: {"tflops": round(res["tflops"], 2),
+                         "valid": res["valid"]})
+        yield "matmul", probe
 
     # Multi-device only: a single-device psum is a copy, not an
     # interconnect transfer, and its old "HBM proxy" reading was
     # invalid for five straight rounds (VERDICT weak #6) — the
     # replacement below measures the thing a one-chip serving backend
     # is actually limited by (host dispatch).
-    if len(devs) > 1:
+    if len(devs) > 1 and "allreduce" not in skip:
         ar_shapes = [(64, 16), (16, 8), (4, 4)] if on_accel else [(4, 4)]
         probe, res = run(
             [(f"{mb}mb_x{i}",
@@ -921,9 +978,10 @@ def _tpu_probes():
     # ceiling in r05, now measured by the official line instead of
     # inferred from wall-clock gaps.
     from k8s_dra_driver_tpu.ops import dispatch_probe
-    label, res, errs = _retry_probe(
-        [("s2_r4_k8", lambda: dispatch_probe())])
-    yield "dispatch_overhead", shaped(label, res, errs)
+    if "dispatch_overhead" not in skip:
+        label, res, errs = _retry_probe(
+            [("s2_r4_k8", lambda: dispatch_probe())])
+        yield "dispatch_overhead", shaped(label, res, errs)
 
     # Serving path: greedy generation through the static-shape KV
     # cache, differential over scan lengths (prefill + dispatch RTT
@@ -948,6 +1006,11 @@ def _tpu_probes():
                         ("decode_int8", dict(int8=True)),
                         ("decode_int8_kv8",
                          dict(int8=True, kv_int8=True))]:
+        if key in skip:
+            # resumed capture: the bf16 base didn't re-run, so a
+            # non-skipped int8 variant reports without speedup_vs_bf16
+            # (the landed artifact already holds it)
+            continue
         label, res, errs = _retry_probe(
             [(lbl, lambda kw=kw, kwargs=kwargs:
               decode_probe(**kwargs, **kw))
@@ -965,51 +1028,57 @@ def _tpu_probes():
     # Continuous batching: mixed-length requests through the
     # slot-refill engine (models/serving.py)
     from k8s_dra_driver_tpu.ops import serving_probe
-    label, res, errs = _retry_probe(
-        [("s8_r24", lambda: serving_probe())] if on_accel else
-        [("tiny", lambda: serving_probe(**TINY_SERVING_KWARGS))])
-    yield "serving", shaped(label, res, errs)
+    if "serving" not in skip:
+        label, res, errs = _retry_probe(
+            [("s8_r24", lambda: serving_probe())] if on_accel else
+            [("tiny", lambda: serving_probe(**TINY_SERVING_KWARGS))])
+        yield "serving", shaped(label, res, errs)
 
     # the system-prompt pattern: every request shares a leading
     # prefix; the engine's automatic prefix cache adopts it zero-copy
     # and prefills only the tail (models/serving.py:PrefixCache)
-    label, res, errs = _retry_probe(
-        [("s8_r24_px64", lambda: serving_probe(
-            prefix_cache=8, shared_prefix=64))] if on_accel else
-        [("tiny_px", lambda: serving_probe(
-            prefix_cache=2, shared_prefix=8, **TINY_SERVING_KWARGS))])
-    yield "serving_prefix", shaped(label, res, errs)
+    if "serving_prefix" not in skip:
+        label, res, errs = _retry_probe(
+            [("s8_r24_px64", lambda: serving_probe(
+                prefix_cache=8, shared_prefix=64))] if on_accel else
+            [("tiny_px", lambda: serving_probe(
+                prefix_cache=2, shared_prefix=8,
+                **TINY_SERVING_KWARGS))])
+        yield "serving_prefix", shaped(label, res, errs)
 
     # dispatch-amortized drain (VERDICT r04 weak #3): chain_steps
     # decode steps per host round-trip, identical outputs — the
     # tokens/s here is ENGINE throughput, not transport throughput;
     # max_new-1 chains one whole decode wave per dispatch
-    label, res, errs = _retry_probe(
-        [("s8_r24_k47", lambda: serving_probe(chain_steps=47))]
-        if on_accel else
-        [("tiny_k3", lambda: serving_probe(
-            chain_steps=3, **TINY_SERVING_KWARGS))])
-    yield "serving_chain", shaped(label, res, errs)
+    if "serving_chain" not in skip:
+        label, res, errs = _retry_probe(
+            [("s8_r24_k47", lambda: serving_probe(chain_steps=47))]
+            if on_accel else
+            [("tiny_k3", lambda: serving_probe(
+                chain_steps=3, **TINY_SERVING_KWARGS))])
+        yield "serving_chain", shaped(label, res, errs)
 
     # fleet gateway (gateway/probe.py): offered-load sweep through a
     # replica pool behind SLO admission + prefix-affinity routing —
     # goodput, SLO attainment, and p50/p99 admission-queue wait at
     # loads below and above the pool's self-calibrated capacity
     from k8s_dra_driver_tpu.gateway import gateway_probe
-    label, res, errs = _retry_probe(
-        [("p2s4_r16", lambda: gateway_probe())] if on_accel else
-        [("tiny_p2", lambda: gateway_probe(**TINY_GATEWAY_KWARGS))])
-    yield "gateway", shaped(label, res, errs)
+    if "gateway" not in skip:
+        label, res, errs = _retry_probe(
+            [("p2s4_r16", lambda: gateway_probe())] if on_accel else
+            [("tiny_p2", lambda: gateway_probe(**TINY_GATEWAY_KWARGS))])
+        yield "gateway", shaped(label, res, errs)
 
     # disaggregated prefill/decode (serving_disagg/): the same engines
     # unified vs role-split behind the fleet prefix index, overloaded
     # at 4x calibrated capacity — p99 TTFT both ways, the win ratio,
     # and per-migration KV reshard-on-transfer cost
     from k8s_dra_driver_tpu.serving_disagg import disagg_probe
-    label, res, errs = _retry_probe(
-        [("p1d2_r24", lambda: disagg_probe())] if on_accel else
-        [("tiny_p1d1", lambda: disagg_probe(**TINY_DISAGG_KWARGS))])
-    yield "serving_disagg", shaped(label, res, errs)
+    if "serving_disagg" not in skip:
+        label, res, errs = _retry_probe(
+            [("p1d2_r24", lambda: disagg_probe())] if on_accel else
+            [("tiny_p1d1", lambda: disagg_probe(**TINY_DISAGG_KWARGS))])
+        yield "serving_disagg", shaped(label, res, errs)
 
 
 def tpu_probe_stream() -> None:
@@ -1022,6 +1091,10 @@ def tpu_probe_stream() -> None:
     """
     from k8s_dra_driver_tpu.utils.compcache import enable_persistent_cache
     enable_persistent_cache()
+    # resumable capture: sections already landed by an earlier cut-off
+    # run (BENCH_RESUME) arrive as a skip list — their work is done
+    skip = frozenset(filter(None, os.environ.get(
+        "BENCH_SKIP_PROBES", "").split(",")))
     # Opt-in device tracing (docs/OBSERVABILITY.md): when
     # TPU_DRA_PROFILE_DIR is set, every probe runs under a
     # jax.profiler trace with launch-site TraceAnnotations on, so the
@@ -1034,11 +1107,11 @@ def tpu_probe_stream() -> None:
         from k8s_dra_driver_tpu.utils import dispatch, profiling
         dispatch.enable_annotations()
         with profiling.trace(profile_dir):
-            for key, res in _tpu_probes():
+            for key, res in _tpu_probes(skip):
                 print(json.dumps({"probe": key, "result": res}),
                       flush=True)
         return
-    for key, res in _tpu_probes():
+    for key, res in _tpu_probes(skip):
         print(json.dumps({"probe": key, "result": res}), flush=True)
 
 
@@ -1085,10 +1158,24 @@ def bench_tpu_compute(timeout_s: float | None = None) -> dict:
 
     if timeout_s is None:
         timeout_s = max(45.0, _remaining() - 30.0)
+    out: dict = {}
+    child_env = dict(os.environ)
+    resume = os.environ.get("BENCH_RESUME", "") not in ("", "0")
+    if resume:
+        # resumable live capture: preload sections landed by an
+        # earlier (cut-off) run and tell the child to skip them —
+        # only CLEAN section dicts count; errors re-run
+        landed = _load_sections()
+        out.update(landed)
+        skip = sorted(k for k, v in landed.items()
+                      if isinstance(v, dict) and "error" not in v)
+        if skip:
+            child_env["BENCH_SKIP_PROBES"] = ",".join(skip)
     stderr_file = tempfile.TemporaryFile(mode="w+")
     proc = subprocess.Popen(
         [sys.executable, str(Path(__file__).resolve()), "--tpu-probes"],
-        cwd=REPO, stdout=subprocess.PIPE, stderr=stderr_file, text=True)
+        cwd=REPO, stdout=subprocess.PIPE, stderr=stderr_file,
+        text=True, env=child_env)
     _CHILDREN.append(proc)
     q: queue_mod.Queue = queue_mod.Queue()
 
@@ -1098,7 +1185,7 @@ def bench_tpu_compute(timeout_s: float | None = None) -> dict:
         q.put(None)
 
     threading.Thread(target=_read, daemon=True).start()
-    out: dict = {}
+    child_platform = [None]     # streamed before any probe section
 
     def _consume(line) -> bool:
         """Record one streamed line; returns False at EOF."""
@@ -1110,6 +1197,14 @@ def bench_tpu_compute(timeout_s: float | None = None) -> dict:
             return True
         if isinstance(rec, dict) and "probe" in rec:
             out[rec["probe"]] = rec["result"]
+            if rec["probe"] == "platform":
+                child_platform[0] = rec["result"]
+            elif isinstance(rec["result"], dict):
+                # land the section artifact the moment it exists: a
+                # later deadline kill must not erase it (resumable
+                # capture; header scalars stay stream-only)
+                _land_section(rec["probe"], rec["result"],
+                              platform=child_platform[0])
         return True           # stray stdout that happened to be JSON
 
     deadline = time.monotonic() + timeout_s
@@ -1179,9 +1274,65 @@ _EMITTED = False
 #: else on disk.
 DETAIL_FILE = REPO / "tools" / "bench_full_latest.json"
 
-#: hard cap on the printed line — comfortably inside the driver's
-#: ~2 KB tail even with a few stray log lines after it
-LINE_BUDGET = 1500
+#: resumable live capture (one file per TPU probe section): every
+#: section that streams out of the --tpu-probes child lands its own
+#: artifact IMMEDIATELY, so a deadline kill (or a tunnel wedge) never
+#: erases finished sections — and a re-run with ``BENCH_RESUME=1``
+#: preloads them and tells the child to skip those probes, continuing
+#: a live capture where the previous one was cut off instead of
+#: re-paying its compiles
+SECTION_DIR = REPO / "tools" / "bench_sections"
+
+
+def _land_section(probe: str, result, platform=None) -> None:
+    """Land one section artifact atomically; never let artifact I/O
+    break the capture itself.  Same clobber guard as the sidecar: a
+    hermetic/CPU run must not overwrite a section recorded on a real
+    TPU — it diverts to a ``_cpu``-suffixed sibling instead."""
+    try:
+        from k8s_dra_driver_tpu.utils.atomicio import write_atomic
+        SECTION_DIR.mkdir(parents=True, exist_ok=True)
+        path = SECTION_DIR / f"{probe}.json"
+        if platform != "tpu":
+            try:
+                prev = json.loads(path.read_text())
+                if prev.get("platform") == "tpu":
+                    path = path.with_name(f"{probe}_cpu.json")
+            except (OSError, ValueError):
+                pass
+        write_atomic(path,
+                     json.dumps({"probe": probe, "result": result,
+                                 "platform": platform,
+                                 "recorded_unix": time.time()},
+                                sort_keys=True) + "\n")
+    except Exception:
+        pass
+
+
+def _load_sections() -> dict:
+    """Previously landed section artifacts (probe -> result)."""
+    out: dict = {}
+    try:
+        paths = sorted(SECTION_DIR.glob("*.json"))
+    except OSError:
+        return out
+    for path in paths:
+        if path.name.endswith("_cpu.json"):
+            continue    # diverted hermetic lands never drive a skip
+        try:
+            rec = json.loads(path.read_text())
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict) and "probe" in rec:
+            out[rec["probe"]] = rec.get("result")
+    return out
+
+#: hard cap on the printed line — inside the driver's ~2 KB tail.
+#: Raised from 1500 when the probe roster grew past ~46 scalars: an
+#: all-green round must fit EVERY sentinel-watched scalar unclipped
+#: (the full 60-key roster at realistic value widths renders ~1.83 KB
+#: — pinned by test_bench_smoke's full-roster fit test)
+LINE_BUDGET = 2000
 
 #: tpu-section probe → (compact key, scalar field) — ONE number each.
 #: The judge-facing speedups come first so a future _fit_line clip
@@ -1237,6 +1388,11 @@ _PROBE_SCALARS = (
     ("control_plane", "ctl_routes_per_s", "routes_per_s"),
     ("control_plane", "ctl_goodput_flat_x", "goodput_flat_x"),
     ("control_plane", "ctl_trace_overhead_x", "trace_overhead_x"),
+    ("control_plane_multiproc", "ctl_proc_admissions_per_s",
+     "admissions_per_s"),
+    ("control_plane_multiproc", "ctl_proc_scaling_x", "scaling_x"),
+    ("control_plane_multiproc", "ctl_outcome_fsync_ms",
+     "outcome_fsync_ms"),
     ("observatory", "obs_digest_overhead_x", "digest_overhead_x"),
     ("observatory", "obs_hbm_accounted_frac", "hbm_accounted_frac"),
     ("allreduce_cpu_mesh8", "cpu_mesh_gbps", "gbps"),
@@ -1496,6 +1652,15 @@ def main() -> None:
                 timeout_s=min(240.0, _remaining() - 45.0))
         else:
             ctl = {"error": "skipped: wall budget"}
+        # 3d2. Multi-process control-plane probe (hermetic, CPU
+        #      subprocess): admissions/s through REAL pump
+        #      subprocesses with durable exactly-once journaling —
+        #      CPU-normalized width scaling + per-commit fsync cost.
+        if _remaining() > 90:
+            ctl_proc = _control_plane_multiproc_probe(
+                timeout_s=min(300.0, _remaining() - 45.0))
+        else:
+            ctl_proc = {"error": "skipped: wall budget"}
         # 3e. Observatory probe (hermetic, CPU subprocess): quantile
         #     digest overhead ratio (paired off/on drives, merged
         #     render on) + MemWatch accounted-HBM fraction.
@@ -1518,6 +1683,7 @@ def main() -> None:
         compute["resharding"] = resharding
         compute["serving_paged"] = paged
         compute["control_plane"] = ctl
+        compute["control_plane_multiproc"] = ctl_proc
         compute["observatory"] = obs
         detail["tpu"] = compute
         detail["baseline_note"] = (

@@ -142,6 +142,28 @@ class EventBus:
                  "payload": _safe(ev.payload)} for ev in events]
 
 
+class BusTap:
+    """Collect events from chosen topics for replay on ANOTHER bus —
+    the bridge half of the multi-process gateway (gateway/procpump.py):
+    a pump subprocess taps its local bus, ships :meth:`drain`'s JSON-
+    safe ``(topic, payload)`` pairs in its step reply, and the
+    conductor republishes them fleet-wide tagged with the pump name.
+    Payloads are summarized (:func:`_safe`) at capture, because they
+    are about to cross a process boundary as JSON."""
+
+    def __init__(self, bus: EventBus, topics):
+        self._pending: list = []
+        for topic in topics:
+            bus.subscribe(topic, self._on_event)
+
+    def _on_event(self, ev: Event) -> None:
+        self._pending.append((ev.topic, _safe(ev.payload)))
+
+    def drain(self) -> list:
+        out, self._pending = self._pending, []
+        return out
+
+
 #: journal_dump summarization bounds — wide enough that every payload
 #: the control plane publishes today survives intact; tight enough
 #: that a pathological payload cannot balloon a flight-recorder dump
@@ -174,4 +196,4 @@ def _safe(value, depth: int = _SAFE_DEPTH):
     return repr(value)[:_SAFE_REPR]
 
 
-__all__ = ["Event", "EventBus"]
+__all__ = ["BusTap", "Event", "EventBus"]

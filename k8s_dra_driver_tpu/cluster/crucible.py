@@ -57,8 +57,8 @@ from pathlib import Path
 
 from . import invariants
 from .faults import (CHIP_KIND, GANG_VERB, GANG_WORKER_KIND, HEAL,
-                     HEALTH_VERB, FaultPlan, FaultRule,
-                     ScriptedChipHealth)
+                     HEALTH_VERB, PUMP_KIND, PUMP_VERB, FaultPlan,
+                     FaultRule, ScriptedChipHealth)
 
 log = logging.getLogger(__name__)
 
@@ -73,9 +73,14 @@ log = logging.getLogger(__name__)
 #: matching paged replicas for ``heal_after`` cycles — the fleet-wide
 #: memory-pressure wave: admission must hold/shed at the gateway and
 #: the starved engines must keep their in-flight rows byte-exact.
+#: ``pump_kill`` (gateway/procpump.py) SIGKILLs a REAL pump
+#: subprocess of a multi-process gateway via its ``pump_plan``
+#: (cluster/faults.py PUMP_VERB) — the cross-process drain arc; on an
+#: in-process gateway (no ``pump_plan``) it is a logged no-op.
 EVENT_KINDS = ("chip_kill", "worker_crash", "worker_hang",
                "replica_kill", "burst", "shard_bitflip",
-               "shard_truncate", "gen_tear", "kv_exhaust")
+               "shard_truncate", "gen_tear", "kv_exhaust",
+               "pump_kill")
 CORRUPTION_KINDS = ("shard_bitflip", "shard_truncate", "gen_tear")
 
 #: reconciler event kinds that open the "cascade" window
@@ -293,6 +298,13 @@ def default_schedule(seed: int = 7, cycles: int = 220) -> Schedule:
         FaultEvent(id="decode-kill-in-handoff", kind="replica_kill",
                    window="handoff:hi", after_cycle=3 * u + 2,
                    replica_glob="d*"),
+        # ...and a gateway pump is killed at the crest of the same
+        # wave.  On this soak's IN-PROCESS gateway the event is a
+        # logged no-op by design (no pump_plan); a multi-process
+        # gateway under the same schedule loses a real OS process
+        # here (tests/test_chaos_multiproc.py pins that arc)
+        FaultEvent(id="pump-kill-in-pressure", kind="pump_kill",
+                   at_cycle=3 * u + 4, replica_glob="pump*"),
         # ...and a chip dies MID-CASCADE; its later heal lands while
         # grants/fences from the cascade are still live
         # (heal-mid-cascade)
@@ -614,6 +626,19 @@ class CrucibleRig:
             self.replica_plan.arm(FaultRule(
                 verb=HEALTH_VERB, kind="Replica",
                 name=ev.replica_glob or "d*", times=1, error="drop"))
+        elif ev.kind == "pump_kill":
+            # multi-process gateways consult pump_plan once per
+            # (pump, cycle); "crash" SIGKILLs the worker subprocess
+            plan = getattr(self.gw, "pump_plan", None)
+            if plan is None:
+                log.info("crucible: %s targets a pump process but the "
+                         "gateway is in-process (no pump_plan); no-op",
+                         ev.id)
+            else:
+                plan.arm(FaultRule(
+                    verb=PUMP_VERB, kind=PUMP_KIND,
+                    name=ev.replica_glob or "pump*", times=1,
+                    error="crash"))
         elif ev.kind == "kv_exhaust":
             glob = ev.replica_glob or "*"
             hit = 0

@@ -87,6 +87,15 @@ HEALTH_VERB = "health"
 CHIP_KIND = "Chip"
 HEAL = "heal"
 
+# Pump-process fault targets (gateway/procpump.py): one decision per
+# (pump, conductor cycle), verb "pump", kind "Pump", name = the pump
+# worker's name.  ``error: "crash"`` makes the conductor SIGKILL the
+# worker subprocess — a REAL process death, the cross-process analog
+# of the replica-kill drain arc — and the crucible's ``pump_kill``
+# event kind arms exactly this rule (cluster/crucible.py).
+PUMP_VERB = "pump"
+PUMP_KIND = "Pump"
+
 # Injection-log cap: plans live for one test scenario; a runaway loop
 # must not turn the log into the test's memory hog.
 _LOG_CAP = 10000
@@ -354,6 +363,12 @@ CRASH_RESHARD_COMMITTED = "reshard.manifest-committed"
 # but before the integrity sidecar lands
 CRASH_TRAIN_CKPT_SAVING = "train_ckpt.saving"
 CRASH_TRAIN_CKPT_COMMITTED = "train_ckpt.committed"
+# durable outcome journal (gateway/outcome_store.py): between the
+# buffered append reaching the OS (flush) and the fsync that commits
+# it, and just after the commit — the windows the exactly-once
+# replay contract must survive a writer dying inside
+CRASH_OUTCOME_APPENDED = "outcome.appended"
+CRASH_OUTCOME_COMMITTED = "outcome.committed"
 
 FAULT_PLAN_ENV = "TPU_DRA_FAULT_PLAN"
 

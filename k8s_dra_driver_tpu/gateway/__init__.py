@@ -9,7 +9,9 @@ from .admission import (AdmissionError, AdmissionQueue, GatewayRequest,
 from .calibrate import Capacity, calibrate_capacity
 from .ctlprobe import NullEngine, control_plane_probe
 from .frontend import FleetGateway
+from .outcome_store import OutcomeStore, OutcomeView, OutcomeWriter
 from .probe import gateway_probe
+from .procpump import ProcessGateway, PumpDead, PumpWedged
 from .replica import (DraChipLease, EngineReplica, ReplicaManager,
                       ROLE_DECODE, ROLE_PREFILL, ROLE_UNIFIED,
                       resolve_container_path)
@@ -21,8 +23,9 @@ __all__ = [
     "AdmissionError", "AdmissionQueue", "Capacity", "DraChipLease",
     "EngineReplica",
     "FINISHED", "FleetGateway", "GatewayRequest", "LeastLoadedRouter",
-    "NullEngine",
-    "PrefixAffinityRouter", "REJECTED_DUPLICATE", "REJECTED_FULL",
+    "NullEngine", "OutcomeStore", "OutcomeView", "OutcomeWriter",
+    "PrefixAffinityRouter", "ProcessGateway", "PumpDead", "PumpWedged",
+    "REJECTED_DUPLICATE", "REJECTED_FULL",
     "REJECTED_INVALID", "ROLE_DECODE", "ROLE_PREFILL", "ROLE_UNIFIED",
     "ReplicaManager", "RoundRobinRouter", "Router",
     "SHED_EXPIRED", "ShardedGateway",

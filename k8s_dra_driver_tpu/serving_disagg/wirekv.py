@@ -1,0 +1,155 @@
+"""KV handoff over the wire: serialize + reshard-on-receive.
+
+The process-boundary twin of serving_disagg/migrate.py: when prefill
+and decode pumps live in DIFFERENT OS processes (gateway/procpump.py)
+there is no shared jax runtime to ``device_put`` across, so a prompt's
+K/V state crosses as host bytes — the gather side of the SNIPPETS.md
+``make_shard_and_gather_fns`` pattern pulls every leaf to host for the
+frame (gateway/wire.py array codec), and the receive side is the shard
+half: leaves are placed onto the receiver's devices, and a paged slab
+is RE-CHUNKED to the receiver's block size first (reshard-on-receive —
+the sender's pool geometry must never leak into the receiver's, the
+same contract migrate.py keeps for shardings within one process).
+
+Costs stay honest: the encoded frame carries exactly the slab's block
+rows (ceil(pos/bs)·bs per layer), and the decode fold reports the
+frame's real byte size so cross-process handoff bytes land in the
+same ``kv_bytes_moved`` accounting as in-process migrations.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..gateway.wire import (decode_array, decode_request, encode_array,
+                            encode_request)
+from .migrate import make_kv_shard_and_gather_fns
+
+
+def _gather_list(leaves) -> list:
+    _, gather_fn = make_kv_shard_and_gather_fns()
+    return [np.asarray(gather_fn(leaf)) for leaf in leaves]
+
+
+def encode_paged_slab(slab) -> dict:
+    """A :class:`~..models.serving.PagedKVSlab` as host bytes: per-
+    layer block tensors [n_blocks, bs, H_kv, D], ``pos`` valid rows."""
+    import jax
+    return {"kind": "paged_slab",
+            "k": [encode_array(a) for a in _gather_list(slab.k)],
+            "v": [encode_array(a) for a in _gather_list(slab.v)],
+            "pos": int(jax.device_get(slab.pos)),
+            "block_size": slab.block_size}
+
+
+def _rechunk(blocks: np.ndarray, pos: int, bs_out: int) -> np.ndarray:
+    """[n_in, bs_in, H, D] -> [ceil(pos/bs_out), bs_out, H, D]: keep
+    the ``pos`` valid rows, re-pad to the receiver's block geometry."""
+    n_in, bs_in, h, d = blocks.shape
+    rows = blocks.reshape(n_in * bs_in, h, d)[:pos]
+    n_out = max(-(-pos // bs_out), 1)
+    out = np.zeros((n_out * bs_out, h, d), dtype=blocks.dtype)
+    out[:pos] = rows
+    return out.reshape(n_out, bs_out, h, d)
+
+
+def decode_paged_slab(d: dict, block_size: int | None = None,
+                      dest=None):
+    """Reconstruct a slab IN THE RECEIVER'S GEOMETRY: ``block_size``
+    is the receiving pool's (None = keep the sender's), ``dest`` the
+    receiving device/sharding.  Re-chunking happens on host — the
+    bytes are host-resident already — then each layer lands on the
+    device once, fresh buffers (the migrate.py aliasing rule)."""
+    import jax.numpy as jnp
+
+    from ..models.serving import PagedKVSlab
+    shard_fn, _ = make_kv_shard_and_gather_fns(dest)
+    pos = int(d["pos"])
+    bs_in = int(d["block_size"])
+    bs_out = block_size or bs_in
+    k, v = [], []
+    for enc_k, enc_v in zip(d["k"], d["v"]):
+        hk, hv = decode_array(enc_k), decode_array(enc_v)
+        if bs_out != bs_in:
+            hk = _rechunk(hk, pos, bs_out)
+            hv = _rechunk(hv, pos, bs_out)
+        k.append(shard_fn(jnp.asarray(hk)))
+        v.append(shard_fn(jnp.asarray(hv)))
+    return PagedKVSlab(k=k, v=v, pos=jnp.int32(pos),
+                       block_size=bs_out)
+
+
+def encode_kv_block(block) -> dict:
+    """A :class:`~..models.serving.KVBlock` (dense [1, S] handoff
+    unit) as host bytes — cache leaves, the carried PRNG key, and the
+    request itself, so an adopting decode process continues exactly
+    where the exporter's fill left off (byte-equal by construction,
+    the KVBlock contract)."""
+    import jax
+    kv = block.kv
+    enc = {"kind": "kv_block",
+           "request": encode_request(block.request),
+           "k": [encode_array(a) for a in _gather_list(kv.k)],
+           "v": [encode_array(a) for a in _gather_list(kv.v)],
+           "pos": int(jax.device_get(kv.pos)),
+           "first": int(block.first),
+           "reused_tokens": int(block.reused_tokens),
+           "carry_key": (None if block.carry_key is None
+                         else encode_array(np.asarray(
+                             jax.device_get(block.carry_key)))),
+           }
+    if kv.k_scale is not None:
+        enc["k_scale"] = [encode_array(a)
+                          for a in _gather_list(kv.k_scale)]
+        enc["v_scale"] = [encode_array(a)
+                          for a in _gather_list(kv.v_scale)]
+    return enc
+
+
+def decode_kv_block(d: dict, dest=None):
+    """Reconstruct the block on the receiver's devices."""
+    import jax.numpy as jnp
+
+    from ..models.decode import KVCache
+    from ..models.serving import KVBlock
+    shard_fn, _ = make_kv_shard_and_gather_fns(dest)
+
+    def land(encs):
+        return [shard_fn(jnp.asarray(decode_array(e))) for e in encs]
+
+    kv = KVCache(
+        k=land(d["k"]), v=land(d["v"]), pos=jnp.int32(d["pos"]),
+        k_scale=land(d["k_scale"]) if "k_scale" in d else None,
+        v_scale=land(d["v_scale"]) if "v_scale" in d else None)
+    carry = d.get("carry_key")
+    if carry is not None:
+        carry = shard_fn(jnp.asarray(decode_array(carry)))
+    return KVBlock(request=decode_request(d["request"]), kv=kv,
+                   first=d["first"], carry_key=carry,
+                   reused_tokens=d["reused_tokens"])
+
+
+def frame_bytes(d: dict) -> int:
+    """The frame's payload size — what the ``kv_bytes_moved`` fold
+    records for a cross-process handoff (honest wire cost: base64
+    expansion included, because those are the bytes that moved)."""
+    total = 0
+
+    def walk(x):
+        nonlocal total
+        if isinstance(x, dict):
+            for v in x.values():
+                walk(v)
+        elif isinstance(x, list):
+            for v in x:
+                walk(v)
+        elif isinstance(x, str):
+            total += len(x)
+        elif x is not None:
+            total += 8
+    walk(d)
+    return total
+
+
+__all__ = ["decode_kv_block", "decode_paged_slab", "encode_kv_block",
+           "encode_paged_slab", "frame_bytes"]

@@ -40,12 +40,14 @@ SLOW_MODULES = {
     "test_oop_plugin",           # real plugin subprocesses
     "test_oop_gang",             # 4 plugin binaries + controller + jax
     "test_chaos_oop",            # real plugin subprocesses + crashes
+    "test_chaos_multiproc",      # pump subprocesses + tiny compiles
     "test_bench_smoke",          # drives the bench beds end-to-end
     "test_multihost_train",      # 2 jax.distributed processes training
     "test_serving",              # per-prompt-length prefill compiles
 }
 
 SLOW_PREFIXES = (
+    "tests/test_procgateway.py::TestProcessGateway",
     "tests/test_decode.py::test_stepwise_decode_matches_forward",
     "tests/test_decode.py::test_prefill_matches_forward",
     "tests/test_decode.py::TestSamplingAndRope::test_top_p_limits_support",

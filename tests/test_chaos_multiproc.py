@@ -1,0 +1,154 @@
+"""Multi-process gateway chaos acceptance (ISSUE 16).
+
+THE acceptance scenario: a pump subprocess SIGKILLed mid-stream under
+trace-replay arrivals with >=2 surviving worker processes, every
+admitted request finishing EXACTLY once with tokens byte-equal to the
+single-engine oracle, the requeued victims observable in the
+outcomes, and recovery bounded by the stall guard.  The engines here
+are the real tiny transformer (``--engine tiny``): every pump process
+builds byte-identical weights from the shared seed, which is what
+makes a cross-process requeue re-run oracle-equal — the null-engine
+mechanics twins live in tests/test_procgateway.py.
+
+The second half is the crucible integration: the ``pump_kill`` event
+kind fired through the rig's own arming path against a REAL
+multi-process gateway (the chaos twin the shared invariants helpers
+exist for; the fast no-subprocess pin is in tests/test_crucible.py).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import k8s_dra_driver_tpu.cluster.crucible as cru
+from k8s_dra_driver_tpu.cluster.faults import (PUMP_KIND, PUMP_VERB,
+                                               FaultPlan, FaultRule)
+from k8s_dra_driver_tpu.gateway.admission import QUEUED
+from k8s_dra_driver_tpu.gateway.loadgen import load_trace, replay
+from k8s_dra_driver_tpu.gateway.procpump import ProcessGateway
+from k8s_dra_driver_tpu.models import (TransformerConfig,
+                                       greedy_generate, init_params)
+from k8s_dra_driver_tpu.models.serving import Request
+
+from invariants import (assert_byte_equal, assert_exactly_once,
+                        assert_requeue_observed)
+
+# Stall guard: three pump subprocesses each pay their own tiny-engine
+# compile on one CPU before the first token moves; the bound is
+# "minutes to fail", not a budget.
+pytestmark = [pytest.mark.faults, pytest.mark.timeout_s(900)]
+
+#: the chaos-twin transformer (the test_gateway shape) as the
+#: worker's ``--engine-cfg`` payload; dtype is supplied worker-side
+ENGINE_CFG = dict(vocab=64, d_model=32, n_layers=2, n_heads=4,
+                  d_head=8, d_ff=64, max_seq=48, n_kv_heads=2)
+
+CFG = TransformerConfig(dtype=jnp.float32, **ENGINE_CFG)
+
+_PARAMS = None
+
+
+def params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = init_params(CFG, jax.random.PRNGKey(0))
+    return _PARAMS
+
+
+def prompt(seed, n):
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, CFG.vocab), np.int32)
+
+
+def oracle(pr, n_new):
+    """Single-engine reference: tokens the process pool must
+    reproduce bit-for-bit, through the kill."""
+    out = greedy_generate(params(), jnp.asarray(pr)[None, :], CFG,
+                          n_tokens=n_new)
+    return np.asarray(out[0], np.int32)
+
+
+def reqs_on_shard(gw, shard, n, n_prompt=6, max_new=4):
+    """First ``n`` seeds whose prompts hash to ``shard``: the load is
+    AIMED at the pump the script kills, so the fault deterministically
+    lands on in-flight work (assert_requeue_observed's vacuity guard
+    can never save a kill that missed)."""
+    out, seed = [], 0
+    while len(out) < n:
+        req = Request(uid=f"k{shard}-{seed}",
+                      prompt=prompt(seed, n_prompt), max_new=max_new)
+        if gw._shard(req.prompt) == shard:
+            out.append(req)
+        seed += 1
+    return out
+
+
+def test_pump_sigkill_mid_stream_is_exactly_once_byte_equal(tmp_path):
+    """THE acceptance: SIGKILL pump0 mid-stream under bursty
+    trace-replay arrivals; the two surviving pump processes absorb
+    the drain.  Every request terminal exactly once, byte-equal to
+    the oracle, the journal conflict-free, victims visible."""
+    plan = FaultPlan([FaultRule(verb=PUMP_VERB, kind=PUMP_KIND,
+                                name="pump0", skip=4, times=1,
+                                error="crash")])
+    with ProcessGateway(tmp_path, workers=3, engine="tiny",
+                        engine_cfg=ENGINE_CFG, replicas=2, slots=2,
+                        queue_capacity=64, pump_plan=plan) as gw:
+        subs = reqs_on_shard(gw, 0, 18)
+        rep = replay(gw, load_trace("bursty"), offered_x=4.0,
+                     base_rps=20.0, make_request=lambda i: subs[i],
+                     n_requests=len(subs), slo_s=600.0)
+        assert rep["submitted"] == len(subs)
+        gw.run_until_idle()
+
+        st = gw.stats()
+        assert st["pump_deaths"] == 1
+        assert st["pumps_live"] == 2
+        assert_exactly_once(gw, subs)
+        assert_byte_equal(gw, subs, oracle)
+        victims = assert_requeue_observed(gw)
+        # drain semantics across the process boundary: surviving a
+        # requeue granted no SLO budget — the deadline still dates
+        # from ARRIVAL (a fresh-budget bug would shift it by the
+        # seconds the kill-and-requeue arc took)
+        for g in victims:
+            assert g.deadline_s == pytest.approx(
+                g.arrival_s + 600.0, abs=1e-3)
+        # the durable journal agrees: one terminal per uid, no
+        # conflicting re-run, nothing torn
+        view = gw.store.replay()
+        assert set(view.terminals) == {r.uid for r in subs}
+        assert view.conflicts == [] and view.corrupt == 0
+
+
+def test_crucible_pump_kill_event_drives_real_process_drain(tmp_path):
+    """The crucible chaos twin: fire ``pump_kill`` through the rig's
+    own event-arming path at a REAL multi-process gateway and let the
+    conductor's next membership check SIGKILL the pump.  Null engines
+    (mechanics, not math) keep the twin fast; the shared helpers pin
+    the same invariants the soak evaluates."""
+    rng = np.random.default_rng(7)
+    with ProcessGateway(tmp_path, workers=2, engine="null",
+                        replicas=2, slots=2, queue_capacity=64,
+                        steps_per_request=4,
+                        pump_plan=FaultPlan()) as gw:
+        subs = [Request(uid=f"c{i}",
+                        prompt=rng.integers(0, 64, 6, dtype=np.int32),
+                        max_new=4) for i in range(16)]
+        for r in subs:
+            assert gw.submit(r, 600.0).status == QUEUED
+        gw.step()                      # work dispatched pool-wide
+        rig = object.__new__(cru.CrucibleRig)
+        rig._sticky_windows = lambda: set()
+        rig.gw = gw
+        ev = cru.FaultEvent(id="pk", kind="pump_kill", at_cycle=1,
+                            replica_glob="pump0")
+        rig._fire(ev, 1)
+        assert ev.fired_cycle == 1
+        gw.run_until_idle()
+
+        assert gw.stats()["pump_deaths"] == 1
+        assert_exactly_once(gw, subs)
+        assert_requeue_observed(gw)
+        assert gw.store.replay().conflicts == []

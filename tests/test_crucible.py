@@ -84,6 +84,38 @@ def test_default_schedule_composes_every_kind():
                if e.kind == "chip_kill")
 
 
+def test_pump_kill_event_arms_process_plan_or_noops():
+    """ISSUE 16 satellite, fast pin (end-to-end twin in
+    tests/test_chaos_multiproc.py): firing ``pump_kill`` arms the
+    gateway's ``pump_plan`` with a one-shot crash rule the conductor's
+    membership check consumes; against an in-process gateway (no
+    ``pump_plan``) it is a logged no-op, never an error."""
+    assert "pump_kill" in cru.EVENT_KINDS
+    rig = object.__new__(cru.CrucibleRig)
+    rig._sticky_windows = lambda: set()
+
+    class _ProcGw:
+        pump_plan = FaultPlan()
+
+    rig.gw = _ProcGw()
+    rig._fire(FaultEvent(id="pk", kind="pump_kill", at_cycle=1,
+                         replica_glob="pump1"), 1)
+    plan = _ProcGw.pump_plan
+    assert plan.decide("pump", "Pump", "pump0") is None   # glob miss
+    d = plan.decide("pump", "Pump", "pump1")
+    assert d is not None and d.error == "crash"
+    assert plan.decide("pump", "Pump", "pump1") is None   # one-shot
+    # default glob: any pump matches
+    _ProcGw.pump_plan = FaultPlan()
+    rig.gw = _ProcGw()
+    rig._fire(FaultEvent(id="pk2", kind="pump_kill", at_cycle=1), 1)
+    assert _ProcGw.pump_plan.decide("pump", "Pump", "pump7") \
+        is not None
+    # in-process gateway: no pump_plan attribute -> logged no-op
+    rig.gw = object()
+    rig._fire(FaultEvent(id="pk3", kind="pump_kill", at_cycle=1), 1)
+
+
 # -- THE soak -------------------------------------------------------------
 
 @pytest.mark.faults

@@ -238,3 +238,35 @@ class TestShardedGatewayMerge:
         text = gw.metrics.render().decode()
         assert "tpu_gateway_digest_queue_wait_seconds{" in text
         assert 'quantile="0.99"' in text
+
+    def test_dead_pump_bank_survives_process_gateway_merge(self):
+        """ISSUE 16 fix, unit pin (subprocess twin in
+        test_procgateway): when pumps are PROCESSES, a dead pump's
+        last-reported bank must keep contributing to the render-time
+        merge — dying narrows future samples, never erases past ones.
+        Builds the conductor's merge state directly so the fast tier
+        pins the fold without spawning workers."""
+        import json as _json
+
+        from k8s_dra_driver_tpu.gateway.procpump import (ProcessGateway,
+                                                         _Handle)
+
+        def bank_json(values):
+            bank = DigestBank(("queue_wait",))
+            for v in values:
+                bank.observe("queue_wait", v)
+            return _json.loads(bank.to_json())
+
+        gw = object.__new__(ProcessGateway)
+        live = object.__new__(_Handle)
+        live.name, live.live = "pump1", True
+        live.last_bank = bank_json([0.1, 0.2, 0.3])
+        dead = object.__new__(_Handle)
+        dead.name, dead.live = "pump0", False
+        dead.last_bank = None       # death swallowed the last report
+        gw.handles = [dead, live]
+        gw._dead_banks = {"pump0": bank_json([5.0, 6.0])}
+        merged = gw.merged_digests().get("queue_wait")
+        assert merged.count == 5, (
+            "dead pump's retained samples dropped from the merge")
+        assert merged.quantile(0.99) >= 5.0

@@ -1,0 +1,245 @@
+"""Durable exactly-once outcome journal (gateway/outcome_store.py).
+
+The store is the cross-process truth the multi-process gateway
+(gateway/procpump.py) recovers from: pumps append terminals BEFORE
+reporting, the conductor replays a dead pump's segment and adopts
+what it never heard.  These tests pin the journal format (checksummed
+lines, torn-tail discard), the first-terminal-wins replay semantics
+(no double terminal, conflicts surfaced not silently merged), the
+writer-side duplicate suppression, and — in real subprocesses, the
+test_faults.py crashpoint idiom — the two crash windows of the
+append discipline: after flush (``outcome.appended``) and after fsync
+(``outcome.committed``).  No lost terminal, no double terminal,
+through either death.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+import textwrap
+import zlib
+
+import pytest
+
+from k8s_dra_driver_tpu.cluster import faults as f
+from k8s_dra_driver_tpu.gateway.outcome_store import (OutcomeStore,
+                                                      _decode_line,
+                                                      _encode_line)
+
+
+def _entry(uid, status="finished", tokens=(1, 2, 3), **extra):
+    e = {"uid": uid, "status": status, "tokens": list(tokens)}
+    e.update(extra)
+    return e
+
+
+# --------------------------------------------------------------------------
+# line framing: checksummed, torn-tolerant
+# --------------------------------------------------------------------------
+
+class TestLineFraming:
+    def test_roundtrip(self):
+        e = _entry("u1", requeues=2, pump="pump0")
+        assert _decode_line(_encode_line(e)) == e
+
+    def test_flipped_byte_fails_checksum(self):
+        line = _encode_line(_entry("u1"))
+        torn = line[:-4] + ("X" if line[-4] != "X" else "Y") + line[-3:]
+        assert _decode_line(torn) is None
+
+    def test_truncated_line_discarded(self):
+        line = _encode_line(_entry("u1"))
+        for cut in (3, 9, len(line) // 2, len(line) - 2):
+            assert _decode_line(line[:cut]) is None
+
+    def test_payload_missing_required_keys_discarded(self):
+        payload = json.dumps({"status": "finished"},
+                             sort_keys=True, separators=(",", ":"))
+        crc = zlib.crc32(payload.encode()) & 0xFFFFFFFF
+        assert _decode_line(f"{crc:08x} {payload}\n") is None
+
+
+# --------------------------------------------------------------------------
+# writer: append-only segment, duplicate suppression, batched fsync
+# --------------------------------------------------------------------------
+
+class TestWriter:
+    def test_record_then_duplicate_writes_nothing(self, tmp_path):
+        w = OutcomeStore(tmp_path).writer("pump0")
+        assert w.record(_entry("u1")) is True
+        assert w.record(_entry("u1")) is False
+        w.close()
+        view = OutcomeStore(tmp_path).replay()
+        assert list(view.terminals) == ["u1"]
+        assert view.duplicates == 0          # never even hit the disk
+
+    def test_batch_commits_under_one_fsync(self, tmp_path):
+        w = OutcomeStore(tmp_path).writer("pump0")
+        n = w.record_many([_entry(f"u{i}") for i in range(5)])
+        assert n == 5
+        assert len(w.fsync_ms) == 1          # one commit for the round
+        assert w.record_many([_entry("u1"), _entry("u9")]) == 1
+        assert len(w.fsync_ms) == 2
+        w.close()
+
+    def test_reopen_seeds_seen_from_disk(self, tmp_path):
+        store = OutcomeStore(tmp_path)
+        w = store.writer("pump0")
+        w.record(_entry("u1"))
+        w.close()
+        # the recovered pump re-reports its pre-crash terminal: no-op
+        w2 = store.writer("pump0")
+        assert "u1" in w2.seen
+        assert w2.record(_entry("u1")) is False
+        w2.close()
+        assert len(store.replay().terminals) == 1
+
+    def test_bad_segment_name_rejected(self, tmp_path):
+        store = OutcomeStore(tmp_path)
+        with pytest.raises(ValueError):
+            store.writer("../evil")
+        with pytest.raises(ValueError):
+            store.writer(".hidden")
+
+
+# --------------------------------------------------------------------------
+# replay view: first-wins, conflicts surfaced, torn vs corrupt
+# --------------------------------------------------------------------------
+
+class TestReplay:
+    def test_first_terminal_wins_across_segments(self, tmp_path):
+        store = OutcomeStore(tmp_path)
+        a = store.writer("pump0")
+        a.record(_entry("u1", tokens=[1, 2], pump="pump0"))
+        a.close()
+        b = store.writer("pump1")
+        # identical status+tokens = benign re-run, whoever ran it
+        b.record(_entry("u1", tokens=[1, 2], pump="pump1"))
+        b.close()
+        view = store.replay()
+        assert view.terminals["u1"]["pump"] == "pump0"   # first wins
+        assert view.duplicates == 1
+        assert view.conflicts == []
+
+    def test_disagreeing_rerun_is_a_conflict(self, tmp_path):
+        store = OutcomeStore(tmp_path)
+        a = store.writer("pump0")
+        a.record(_entry("u1", tokens=[1, 2]))
+        a.close()
+        b = store.writer("pump1")
+        b.record(_entry("u1", tokens=[9, 9]))       # invariant breach
+        b.close()
+        view = store.replay()
+        assert view.conflicts == ["u1"]
+        assert view.terminals["u1"]["tokens"] == [1, 2]   # kept first
+
+    def test_torn_tail_discards_exactly_one_record(self, tmp_path):
+        store = OutcomeStore(tmp_path)
+        w = store.writer("pump0")
+        w.record_many([_entry("u1"), _entry("u2")])
+        w.close()
+        path = store.segments()[0]
+        good = path.read_text()
+        path.write_text(good + _encode_line(_entry("u3"))[:-7])
+        view = store.replay()
+        assert set(view.terminals) == {"u1", "u2"}
+        assert view.torn == 1 and view.corrupt == 0
+
+    def test_mid_file_damage_counts_as_corrupt(self, tmp_path):
+        store = OutcomeStore(tmp_path)
+        w = store.writer("pump0")
+        w.record_many([_entry("u1"), _entry("u2")])
+        w.close()
+        path = store.segments()[0]
+        lines = path.read_text().splitlines()
+        lines[0] = lines[0][:-3] + "zzz"
+        path.write_text("\n".join(lines) + "\n")
+        view = store.replay()
+        assert set(view.terminals) == {"u2"}
+        assert view.corrupt == 1 and view.torn == 0
+
+    def test_single_segment_replay_scopes_to_that_pump(self, tmp_path):
+        store = OutcomeStore(tmp_path)
+        for name, uid in (("pump0", "a"), ("pump1", "b")):
+            w = store.writer(name)
+            w.record(_entry(uid))
+            w.close()
+        assert set(store.replay(segment="pump0").terminals) == {"a"}
+        assert set(store.replay().terminals) == {"a", "b"}
+        assert store.replay(segment="ghost").terminals == {}
+
+    def test_counts_by_status(self, tmp_path):
+        store = OutcomeStore(tmp_path)
+        w = store.writer("pump0")
+        w.record_many([_entry("u1"), _entry("u2"),
+                       _entry("u3", status="shed_expired", tokens=())])
+        w.close()
+        assert store.replay().counts() == {"finished": 2,
+                                           "shed_expired": 1}
+
+
+# --------------------------------------------------------------------------
+# crash windows: die inside each, replay restores (subprocess-injected)
+# --------------------------------------------------------------------------
+
+_CRASH_CHILD = textwrap.dedent("""
+    import sys
+    from k8s_dra_driver_tpu.cluster import faults
+    from k8s_dra_driver_tpu.cluster.faults import FaultPlan, FaultRule
+    from k8s_dra_driver_tpu.gateway.outcome_store import OutcomeStore
+    store = OutcomeStore(sys.argv[1])
+    w = store.writer("pump0")
+    w.record({"uid": "u0", "status": "finished", "tokens": [7]})
+    faults.install_process_plan(FaultPlan([FaultRule(
+        verb=sys.argv[2], times=1, error="crash")]))
+    w.record_many([
+        {"uid": "u1", "status": "finished", "tokens": [1, 2]},
+        {"uid": "u2", "status": "finished", "tokens": [3]}])
+    raise SystemExit("crashpoint never fired")
+""")
+
+
+def _crash_at(point, tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-c", _CRASH_CHILD, str(tmp_path), point],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == f.CRASH_EXIT_CODE, proc.stderr
+    return OutcomeStore(tmp_path)
+
+
+class TestCrashWindows:
+    def test_death_after_append_keeps_every_terminal(self, tmp_path):
+        """Dying between flush and fsync: the PROCESS is gone but the
+        bytes sit in the page cache, so the terminals survive a
+        process death (only a machine crash can still tear them —
+        which the checksum framing absorbs as ``torn``)."""
+        store = _crash_at(f.CRASH_OUTCOME_APPENDED, tmp_path)
+        view = store.replay()
+        assert set(view.terminals) == {"u0", "u1", "u2"}
+        assert view.conflicts == [] and view.corrupt == 0
+
+    def test_death_after_commit_keeps_every_terminal(self, tmp_path):
+        store = _crash_at(f.CRASH_OUTCOME_COMMITTED, tmp_path)
+        view = store.replay()
+        assert set(view.terminals) == {"u0", "u1", "u2"}
+        assert view.conflicts == []
+
+    def test_recovery_rerun_never_doubles_a_terminal(self, tmp_path):
+        """The full recovery contract: after a crash inside the append
+        window, a NEW writer (the re-run pump) re-records the same
+        outcomes — its own segment dedups what it holds, and the
+        merged replay folds cross-segment identical re-runs as benign
+        duplicates, never as second terminals."""
+        store = _crash_at(f.CRASH_OUTCOME_APPENDED, tmp_path)
+        w = store.writer("pump0")                  # recovered in place
+        assert w.record(_entry("u1", tokens=[1, 2])) is False
+        w.close()
+        w2 = store.writer("pump1")                 # re-run elsewhere
+        assert w2.record(_entry("u2", tokens=[3])) is True
+        w2.close()
+        view = store.replay()
+        assert len(view.terminals) == 3
+        assert view.duplicates == 1
+        assert view.conflicts == []

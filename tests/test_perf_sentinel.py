@@ -79,6 +79,19 @@ class TestVerdicts:
         assert perf_sentinel.direction_of(
             "cru_survived_cycles") is None
 
+    def test_multiproc_scalars_classify_rate_vs_latency(self):
+        """The PR 15 suffix fix, regression-pinned on the ISSUE 16
+        scalars: ``*_per_s`` is a RATE (higher), even though it also
+        suffix-matches the ``*_s`` duration rule; ``*_x`` scaling is
+        higher; ``*_ms`` fsync cost is lower.  A future rule reorder
+        that lets ``_s`` win would invert the admissions verdict."""
+        assert perf_sentinel.direction_of(
+            "ctl_proc_admissions_per_s") == "higher"
+        assert perf_sentinel.direction_of(
+            "ctl_proc_scaling_x") == "higher"
+        assert perf_sentinel.direction_of(
+            "ctl_outcome_fsync_ms") == "lower"
+
     def test_improvement_recognized(self, tmp_path):
         _fixture(tmp_path, {"decode_tok_s": 200.0,
                             "sup_mttr_ms": 52.0})
@@ -168,6 +181,27 @@ class TestArtifactGates:
                       "result/digest_overhead_x")] == "regression"
         assert gates[("tools/obs_digest_cpu.json",
                       "result/hbm_accounted_frac")] == "steady"
+
+    def test_multiproc_scaling_floor_is_gated(self, tmp_path):
+        """The process-split acceptance floor (ISSUE 16: >=3.2x
+        CPU-normalized admissions at the widest sweep) is an absolute
+        artifact bar, not just a trajectory verdict — a refreshed
+        artifact that regressed below the floor fails the round even
+        with no history."""
+        tools = tmp_path / "tools"
+        tools.mkdir()
+        (tools / "ctl_multiproc_cpu.json").write_text(json.dumps(
+            {"result": {"scaling_x": 2.0}}))
+        gates = {g["key"]: g["verdict"]
+                 for g in perf_sentinel.check_artifact_gates(tmp_path)
+                 if g["artifact"] == "tools/ctl_multiproc_cpu.json"}
+        assert gates["result/scaling_x"] == "regression"
+        (tools / "ctl_multiproc_cpu.json").write_text(json.dumps(
+            {"result": {"scaling_x": 3.668}}))
+        gates = {g["key"]: g["verdict"]
+                 for g in perf_sentinel.check_artifact_gates(tmp_path)
+                 if g["artifact"] == "tools/ctl_multiproc_cpu.json"}
+        assert gates["result/scaling_x"] == "steady"
 
 
 class TestRealTrajectory:

@@ -1,0 +1,321 @@
+"""Multi-process gateway (gateway/procpump.py + gateway/wire.py).
+
+Two tiers here.  ``TestWireCodecs``/``TestWireReader`` are fast and
+hermetic: the byte layout every cross-process move rides on (arrays
+without pickle, scheduling state that must survive a steal, ``inf``
+deadlines through JSON) and the classified-failure receive
+discipline.  ``TestProcessGateway`` spawns REAL pump subprocesses
+(null engines — mechanics, not math) and pins the conductor
+semantics: pool-wide exactly-once, door-spill past a full home
+shard, work stealing over the wire, scripted pump death with
+requeue-on-unchanged-deadlines, heartbeat-silence eviction, and
+dead-pump digest retention.  The subprocess classes are slow-tier
+(tests/conftest.py SLOW_PREFIXES); the tiny-engine byte-equality
+acceptance lives in tests/test_chaos_multiproc.py.
+"""
+
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.cluster.faults import (PUMP_KIND, PUMP_VERB,
+                                               FaultPlan, FaultRule)
+from k8s_dra_driver_tpu.gateway import wire
+from k8s_dra_driver_tpu.gateway.admission import (QUEUED,
+                                                  GatewayRequest)
+from k8s_dra_driver_tpu.gateway.procpump import (ProcessGateway,
+                                                 PumpDead)
+from k8s_dra_driver_tpu.models.serving import Finished, Request
+
+from invariants import assert_exactly_once, assert_requeue_observed
+
+pytestmark = pytest.mark.timeout_s(300)
+
+
+def make_req(uid, seed, n_prompt=6, max_new=4):
+    rng = np.random.default_rng(seed)
+    return Request(uid=uid,
+                   prompt=rng.integers(0, 64, n_prompt,
+                                       dtype=np.int32),
+                   max_new=max_new)
+
+
+# -- wire codecs (fast, no subprocess) ------------------------------------
+
+class TestWireCodecs:
+    def test_array_roundtrip_preserves_dtype_shape_values(self):
+        for a in (np.arange(12, dtype=np.int32).reshape(3, 4),
+                  np.linspace(0, 1, 5, dtype=np.float32),
+                  np.array([], dtype=np.int32)):
+            b = wire.decode_array(json.loads(json.dumps(
+                wire.encode_array(a))))
+            assert b.dtype == a.dtype and b.shape == a.shape
+            np.testing.assert_array_equal(a, b)
+
+    def test_array_codec_accepts_noncontiguous(self):
+        a = np.arange(24, dtype=np.int32).reshape(4, 6)[:, ::2]
+        np.testing.assert_array_equal(
+            wire.decode_array(wire.encode_array(a)), a)
+
+    def test_request_roundtrip(self):
+        req = make_req("u1", 3)
+        back = wire.decode_request(json.loads(json.dumps(
+            wire.encode_request(req))))
+        assert back.uid == req.uid and back.max_new == req.max_new
+        np.testing.assert_array_equal(back.prompt, req.prompt)
+
+    def test_greq_roundtrip_keeps_scheduling_state(self):
+        """Arrival, deadline, requeues, tenant cross the boundary —
+        a steal or drain-requeue must never grant SLO budget."""
+        g = GatewayRequest(request=make_req("u1", 3), arrival_s=12.5,
+                           deadline_s=17.25, status="dispatched",
+                           requeues=2, tenant="hi")
+        back = wire.decode_greq(json.loads(json.dumps(
+            wire.encode_greq(g))))
+        assert back.arrival_s == 12.5 and back.deadline_s == 17.25
+        assert back.requeues == 2 and back.tenant == "hi"
+        assert back.status == QUEUED      # lands queued at the taker
+
+    def test_inf_deadline_survives_json(self):
+        """No-SLO requests carry deadline inf; both wire ends are
+        Python so the JSON ``Infinity`` literal round-trips."""
+        g = GatewayRequest(request=make_req("u1", 3), arrival_s=0.0,
+                           deadline_s=float("inf"), status="queued")
+        back = wire.decode_greq(json.loads(json.dumps(
+            wire.encode_greq(g))))
+        assert back.deadline_s == float("inf")
+
+    def test_finished_roundtrip(self):
+        f = Finished(uid="u1", tokens=np.arange(7, dtype=np.int32),
+                     n_prompt=3)
+        back = wire.decode_finished(json.loads(json.dumps(
+            wire.encode_finished(f))))
+        assert back.uid == "u1" and back.n_prompt == 3
+        np.testing.assert_array_equal(back.tokens, f.tokens)
+
+    def test_parse_frame_rejects_noise_and_non_objects(self):
+        assert wire.parse_frame("a stray print\n") is None
+        assert wire.parse_frame(wire.TAG + "not json\n") is None
+        assert wire.parse_frame(wire.TAG + "[1, 2]\n") is None
+        assert wire.parse_frame(wire.TAG + '{"op": "x"}\n') \
+            == {"op": "x"}
+
+
+class TestWireReader:
+    def _pipe(self):
+        r, w = os.pipe()
+        return os.fdopen(r, "r"), os.fdopen(w, "w")
+
+    def test_frames_delivered_noise_ringed(self):
+        rd, wr = self._pipe()
+        reader = wire.WireReader(rd, name="t")
+        wr.write("library warning\n")
+        wire.send_msg(wr, {"id": 1})
+        assert reader.recv(timeout_s=5.0) == {"id": 1}
+        assert "library warning" in reader.noise_tail()
+        wr.close()
+
+    def test_timeout_is_retryable_classified(self):
+        rd, wr = self._pipe()
+        reader = wire.WireReader(rd, name="t")
+        with pytest.raises(wire.WireTimeout):
+            reader.recv(timeout_s=0.05)
+        wire.send_msg(wr, {"id": 2})          # still usable after
+        assert reader.recv(timeout_s=5.0) == {"id": 2}
+        wr.close()
+
+    def test_eof_is_fatal_classified(self):
+        rd, wr = self._pipe()
+        reader = wire.WireReader(rd, name="t")
+        wire.send_msg(wr, {"id": 1})
+        wr.close()
+        assert reader.recv(timeout_s=5.0) == {"id": 1}
+        with pytest.raises(wire.WireClosed):
+            reader.recv(timeout_s=5.0)
+
+
+# -- conductor mechanics over real pump subprocesses (slow tier) ----------
+
+def shard_of(gw, req):
+    return gw._shard(req.prompt)
+
+
+def reqs_for_shard(gw, shard, n, start_seed=0, **kw):
+    """First ``n`` seeds whose prompts hash into ``shard`` — the
+    deterministic way to aim load at one pump."""
+    out, seed = [], start_seed
+    while len(out) < n:
+        req = make_req(f"s{shard}-{seed}", seed, **kw)
+        if shard_of(gw, req) == shard:
+            out.append(req)
+        seed += 1
+    return out
+
+
+class TestProcessGateway:
+    def test_smoke_exactly_once_and_journaled(self, tmp_path):
+        with ProcessGateway(tmp_path, workers=2, engine="null",
+                            replicas=2, slots=4) as gw:
+            subs = [make_req(f"u{i}", i) for i in range(12)]
+            for r in subs:
+                assert gw.submit(r, 60.0).status == QUEUED
+            gw.run_until_idle()
+            assert_exactly_once(gw, subs)
+            # every terminal is durably journaled, conflict-free
+            view = gw.store.replay()
+            assert set(view.terminals) == {r.uid for r in subs}
+            assert view.conflicts == [] and view.corrupt == 0
+            # digest banks merged across pump PROCESSES
+            merged = gw.merged_digests()
+            assert merged.digests["queue_wait"].count == 12
+
+    def test_duplicate_uid_rejected_pool_wide(self, tmp_path):
+        """The duplicate contract spans processes: the same uid
+        admitted once is refused everywhere while live, and uid
+        reuse AFTER a terminal starts a fresh lifecycle."""
+        with ProcessGateway(tmp_path, workers=2, engine="null",
+                            replicas=1, slots=2,
+                            steps_per_request=50) as gw:
+            req = make_req("dup", 1)
+            assert gw.submit(req, 60.0).status == QUEUED
+            assert gw.submit(make_req("dup", 2), 60.0).status \
+                == "rejected_duplicate"
+            gw.run_until_idle()
+            assert gw.submit(make_req("dup", 3), 60.0).status \
+                == QUEUED
+            gw.run_until_idle()
+            assert gw.outcomes["dup"].status == "finished"
+
+    def test_door_spills_past_full_home_shard(self, tmp_path):
+        """A home pump at capacity spills to the least-loaded live
+        sibling instead of refusing — reject-on-full means the TIER
+        is full, not one shard."""
+        with ProcessGateway(tmp_path, workers=2, engine="null",
+                            replicas=1, slots=1, queue_capacity=3,
+                            steps_per_request=500) as gw:
+            subs = reqs_for_shard(gw, 0, 5)
+            for r in subs:
+                assert gw.submit(r, 600.0).status == QUEUED
+            workers = {gw._live[r.uid]["worker"] for r in subs}
+            assert workers == {"pump0", "pump1"}, (
+                "capacity overflow never spilled to the sibling")
+
+    def test_work_steal_moves_backlog_over_the_wire(self, tmp_path):
+        """All load aimed at one shard: the idle sibling must steal
+        the newest queued work, and everything still terminates
+        exactly once."""
+        with ProcessGateway(tmp_path, workers=2, engine="null",
+                            replicas=1, slots=1, queue_capacity=32,
+                            steps_per_request=3) as gw:
+            subs = reqs_for_shard(gw, 0, 8)
+            for r in subs:
+                assert gw.submit(r, 600.0).status == QUEUED
+            gw.run_until_idle()
+            assert gw.steals_total >= 1, "idle pump never stole"
+            assert_exactly_once(gw, subs)
+
+    def test_scripted_pump_kill_requeues_deadlines_unchanged(
+            self, tmp_path):
+        """THE drain contract across a process boundary: a scripted
+        SIGKILL mid-stream, every victim requeued with its original
+        deadline (no SLO budget granted for surviving a drain), all
+        requests exactly-once, requeues observable in outcomes and
+        stats."""
+        plan = FaultPlan([FaultRule(verb=PUMP_VERB, kind=PUMP_KIND,
+                                    name="pump0", skip=1, times=1,
+                                    error="crash")])
+        with ProcessGateway(tmp_path, workers=3, engine="null",
+                            replicas=2, slots=2, queue_capacity=64,
+                            steps_per_request=4,
+                            pump_plan=plan) as gw:
+            subs = [make_req(f"u{i}", i) for i in range(24)]
+            deadlines = {}
+            for r in subs:
+                g = gw.submit(r, 600.0)
+                assert g.status == QUEUED
+                deadlines[r.uid] = g.deadline_s
+            gw.step()                 # skip=1 burns here; work queued
+            gw.run_until_idle()       # kill fires on the next check
+            st = gw.stats()
+            assert st["pump_deaths"] == 1 and st["pumps_live"] == 2
+            assert_exactly_once(gw, subs)
+            victims = assert_requeue_observed(gw)
+            for g in victims:
+                assert g.deadline_s == deadlines[g.request.uid], (
+                    f"{g.request.uid}: deadline changed in requeue")
+            # no terminal lost, none doubled, across the whole pool
+            view = gw.store.replay()
+            assert view.conflicts == []
+            assert len(gw.outcomes) == len(subs)
+
+    def test_dead_pump_digest_bank_retained_in_merge(self, tmp_path):
+        """A pump dying must narrow the fleet's FUTURE samples, never
+        erase its past ones: the merged render keeps the dead pump's
+        last-reported bank (the silently-dropped-samples bug this PR
+        fixes; twin pin in tests/test_digest.py)."""
+        plan = FaultPlan([FaultRule(verb=PUMP_VERB, kind=PUMP_KIND,
+                                    name="pump0", skip=2, times=1,
+                                    error="crash")])
+        with ProcessGateway(tmp_path, workers=2, engine="null",
+                            replicas=2, slots=4,
+                            pump_plan=plan) as gw:
+            subs = [make_req(f"u{i}", i) for i in range(12)]
+            for r in subs:
+                gw.submit(r, 600.0)
+            gw.step()
+            gw.step()           # terminals reported, banks populated
+            before = gw.merged_digests().digests["queue_wait"].count
+            assert before > 0
+            gw.run_until_idle()
+            assert gw.stats()["pump_deaths"] == 1
+            after = gw.merged_digests().digests["queue_wait"].count
+            assert after >= before, (
+                "dead pump's digest samples vanished from the merge")
+            assert "pump0" in gw._dead_banks
+
+    def test_heartbeat_silence_evicts_and_recovers(self, tmp_path):
+        """SIGSTOP freezes a pump (process alive, heartbeat silent):
+        past the watchdog it is evicted into the same drain path as
+        a death, and its work finishes elsewhere."""
+        with ProcessGateway(tmp_path, workers=2, engine="null",
+                            replicas=1, slots=1, queue_capacity=32,
+                            steps_per_request=3, heartbeat_s=0.1,
+                            watchdog_s=1.0,
+                            rpc_timeout_s=5.0) as gw:
+            subs = [make_req(f"u{i}", i) for i in range(8)]
+            for r in subs:
+                assert gw.submit(r, 600.0).status == QUEUED
+            frozen = gw.handles[0]
+            os.kill(frozen.proc.pid, signal.SIGSTOP)
+            time.sleep(1.5)           # let the heartbeat go stale
+            gw.run_until_idle()
+            assert not frozen.live
+            assert gw.stats()["pump_deaths"] >= 1
+            assert_exactly_once(gw, subs)
+
+    def test_rpc_to_dead_pump_raises_classified(self, tmp_path):
+        with ProcessGateway(tmp_path, workers=1, engine="null",
+                            replicas=1, slots=1) as gw:
+            h = gw.handles[0]
+            os.kill(h.proc.pid, signal.SIGKILL)
+            h.proc.wait(timeout=10)
+            with pytest.raises(PumpDead):
+                gw._rpc(h, "step", rounds=1)
+
+    def test_last_pump_death_with_pending_work_is_loud(self, tmp_path):
+        """No survivor to requeue into: the conductor must raise, not
+        silently strand admitted requests."""
+        plan = FaultPlan([FaultRule(verb=PUMP_VERB, kind=PUMP_KIND,
+                                    name="pump0", times=1,
+                                    error="crash")])
+        with ProcessGateway(tmp_path, workers=1, engine="null",
+                            replicas=1, slots=1,
+                            steps_per_request=50,
+                            pump_plan=plan) as gw:
+            gw.submit(make_req("u0", 0), 600.0)
+            with pytest.raises(RuntimeError, match="no live pump"):
+                gw.run_until_idle()

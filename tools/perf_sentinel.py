@@ -78,6 +78,11 @@ ARTIFACT_GATES = (
      ("result", "digest_overhead_x"), "<=", 1.05),
     ("tools/obs_digest_cpu.json",
      ("result", "hbm_accounted_frac"), ">=", 0.5),
+    # multi-process control plane (gateway/procprobe.py): the
+    # CPU-normalized admission scaling the process split exists for
+    # must stay near-linear at the widest sweep point
+    ("tools/ctl_multiproc_cpu.json",
+     ("result", "scaling_x"), ">=", 3.2),
 )
 
 
