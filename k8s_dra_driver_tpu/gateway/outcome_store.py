@@ -144,7 +144,19 @@ class OutcomeWriter:
         self.seen: set = set()
         existed = path.exists()
         if existed:
-            for line in path.read_text().splitlines():
+            raw = path.read_bytes()
+            if raw and not raw.endswith(b"\n"):
+                # the prior writer died mid-append leaving a torn
+                # (never-committed) tail; drop it, or the next record
+                # appended here would be concatenated onto the torn
+                # bytes and a durably fsynced terminal would fail the
+                # checksum at replay
+                cut = raw.rfind(b"\n") + 1
+                with open(path, "rb+") as f:
+                    f.truncate(cut)
+                    os.fsync(f.fileno())
+                raw = raw[:cut]
+            for line in raw.decode("utf-8", "replace").splitlines():
                 entry = _decode_line(line + "\n")
                 if entry is not None:
                     self.seen.add(entry["uid"])

@@ -67,9 +67,8 @@ from ..utils.backoff import Backoff
 from ..utils.cpuproc import cpu_jax_env
 from ..utils.digest import DigestBank
 from ..utils.metrics import GatewayMetrics
-from .admission import (DISPATCHED, FINISHED, QUEUED,
-                        REJECTED_DUPLICATE, REJECTED_FULL,
-                        GatewayRequest)
+from .admission import (FINISHED, QUEUED, REJECTED_DUPLICATE,
+                        REJECTED_FULL, GatewayRequest)
 from .wire import (WireClosed, WireReader, WireTimeout, decode_greq,
                    decode_request, encode_greq, encode_request,
                    parse_frame, send_msg)
@@ -213,17 +212,21 @@ class _Worker:
                            tenant=msg.get("tenant"))
         out = {"status": g.status, "arrival_s": g.arrival_s,
                "deadline_s": g.deadline_s}
-        if g.status == QUEUED and req.uid in self._reported:
+        if g.status == QUEUED:
             # uid reuse after a terminal: a fresh lifecycle may reach
             # a fresh terminal, which must journal AGAIN (replay
             # first-wins keeps the earlier record; an identical re-run
-            # folds as a benign duplicate)
+            # folds as a benign duplicate).  Unconditional discards so
+            # writer.seen can never silently swallow the new terminal.
             self._reported.discard(req.uid)
             self.writer.seen.discard(req.uid)
-        if g.status not in (QUEUED, DISPATCHED):
-            # door refusals are terminal AT the door; journal them so
-            # a conductor recovering this pump cannot double-count
-            self.writer.record_many([self._outcome_entry(g)])
+        # Door refusals are NOT journaled: they travel synchronously
+        # in this reply, the uid never enters the conductor's live
+        # ledger (so recovery never needs the record), and the
+        # conductor may spill the same uid to a sibling — whose later
+        # FINISHED would then conflict with a REJECTED_FULL terminal
+        # at replay.  Refusals are terminal in the conductor's
+        # ``refused`` list, not in the per-uid journal namespace.
         return out
 
     def op_step(self, msg) -> dict:
@@ -903,29 +906,75 @@ class ProcessGateway:
     def _work_steal(self) -> None:
         """Idle pumps pull the newest queued request off the deepest
         live sibling, over the wire; FIFO heads and requeued victims
-        never move (AdmissionQueue.steal_newest)."""
-        alive = self._live_handles()
-        if len(alive) < 2:
-            return
+        never move (AdmissionQueue.steal_newest).  Both RPC legs are
+        death-classified like every other conductor wait: a donor
+        dying mid-steal folds into the normal recovery, and a thief
+        dying AFTER the donor handed the request over — the one
+        window where a request is queued on no pump and ``_live``
+        still blames the donor — is recovered and the orphan
+        explicitly re-homed (:meth:`_rehome`)."""
         while True:
+            alive = self._live_handles()
+            if len(alive) < 2:
+                return
             hungry = [h for h in alive if h.depth == 0]
             donor = max(alive, key=lambda h: h.depth)
             if not hungry or donor.depth <= 1:
                 return
             thief = hungry[0]
-            reply = self._rpc(donor, "steal")
+            try:
+                reply = self._rpc(donor, "steal")
+            except (PumpDead, PumpWedged) as e:
+                self._kill(donor, reason=str(e))
+                self._recover(donor)
+                continue
             if reply["greq"] is None:
                 donor.depth = 0
                 continue
             donor.depth -= 1
-            adopt = self._rpc(thief, "adopt", greq=reply["greq"])
+            greq = reply["greq"]
+            uid = greq["request"]["uid"]
+            try:
+                adopt = self._rpc(thief, "adopt", greq=greq)
+            except (PumpDead, PumpWedged) as e:
+                self._kill(thief, reason=str(e))
+                self._recover(thief)
+                self._rehome(uid, greq)
+                continue
             thief.depth = adopt["depth"]
-            uid = reply["greq"]["request"]["uid"]
             if uid in self._live:
                 self._live[uid]["worker"] = thief.name
-                self._live[uid]["greq"] = reply["greq"]
+                self._live[uid]["greq"] = greq
             self.steals_total += 1
             self.metrics.steals.inc()
+
+    def _rehome(self, uid, greq: dict) -> None:
+        """Re-home a request that left its donor but never reached
+        its thief: until it is requeued somewhere it exists only in
+        ``greq``, and ``_live`` still records the donor as owner —
+        so the thief's recovery pass cannot see it.  Requeued at a
+        survivor's FRONT with scheduling state unchanged (the drain
+        contract: the move grants no SLO budget)."""
+        if uid not in self._live:
+            return      # reached a terminal via the recovery replay
+        while True:
+            survivors = self._live_handles()
+            if not survivors:
+                raise RuntimeError(
+                    f"request {uid!r} orphaned mid-steal with no "
+                    f"live pump remaining")
+            target = min(survivors, key=lambda s: (s.depth, s.name))
+            try:
+                reply = self._rpc(target, "requeue", greqs=[greq])
+            except (PumpDead, PumpWedged) as e:
+                self._kill(target, reason=str(e))
+                self._recover(target)
+                continue
+            target.depth = reply["depth"]
+            self._live[uid]["worker"] = target.name
+            self._live[uid]["greq"] = greq
+            self.metrics.requeued.inc()
+            return
 
     # -- observability ---------------------------------------------------
 

@@ -96,6 +96,27 @@ class TestWriter:
         w2.close()
         assert len(store.replay().terminals) == 1
 
+    def test_reopen_after_torn_tail_never_concatenates(self, tmp_path):
+        """A writer reopening a segment whose prior owner died
+        mid-append must drop the torn (never-committed) tail before
+        appending: without that, the next durably fsynced record is
+        concatenated onto the torn bytes, fails the checksum at
+        replay, and a committed terminal is lost."""
+        store = OutcomeStore(tmp_path)
+        w = store.writer("pump0")
+        w.record(_entry("u1"))
+        w.close()
+        path = store.segments()[0]
+        good = path.read_text()
+        path.write_text(good + _encode_line(_entry("u2"))[:-7])
+        w2 = store.writer("pump0")
+        assert "u1" in w2.seen and "u2" not in w2.seen
+        assert w2.record(_entry("u3")) is True
+        w2.close()
+        view = store.replay()
+        assert set(view.terminals) == {"u1", "u3"}
+        assert view.torn == 0 and view.corrupt == 0
+
     def test_bad_segment_name_rejected(self, tmp_path):
         store = OutcomeStore(tmp_path)
         with pytest.raises(ValueError):

@@ -218,6 +218,90 @@ class TestProcessGateway:
             assert gw.steals_total >= 1, "idle pump never stole"
             assert_exactly_once(gw, subs)
 
+    def _kill_on_op(self, gw, op):
+        """Wrap ``gw._rpc`` so the FIRST rpc of ``op`` SIGKILLs its
+        target pump just before the exchange — the deterministic way
+        to land a death inside one leg of the steal protocol."""
+        real_rpc, killed = gw._rpc, []
+
+        def rpc(h, o, *a, **kw):
+            if o == op and not killed:
+                killed.append(h.name)
+                os.kill(h.proc.pid, signal.SIGKILL)
+                h.proc.wait(timeout=10)
+            return real_rpc(h, o, *a, **kw)
+
+        gw._rpc = rpc
+        return killed
+
+    def test_donor_death_mid_steal_recovers_not_crashes(self,
+                                                        tmp_path):
+        """The steal RPC leg is death-classified like every other
+        conductor wait: a donor dying as it is asked to donate folds
+        into the normal drain instead of propagating PumpDead out of
+        step() and crashing the conductor."""
+        with ProcessGateway(tmp_path, workers=2, engine="null",
+                            replicas=1, slots=1, queue_capacity=32,
+                            steps_per_request=3) as gw:
+            subs = reqs_for_shard(gw, 0, 8)
+            for r in subs:
+                assert gw.submit(r, 600.0).status == QUEUED
+            killed = self._kill_on_op(gw, "steal")
+            gw.run_until_idle()
+            assert killed, "no steal was ever attempted"
+            assert gw.stats()["pump_deaths"] == 1
+            assert_exactly_once(gw, subs)
+            assert gw.store.replay().conflicts == []
+
+    def test_thief_death_mid_steal_rehomes_stolen_request(self,
+                                                          tmp_path):
+        """THE orphan window: the donor has handed the request over
+        (it left the donor's queue) but the thief dies before
+        adopting it — at that instant the greq exists only in the
+        conductor's hands while ``_live`` still blames the donor.
+        It must be re-homed and finish exactly once, not stranded
+        forever (which would hang run_until_idle)."""
+        with ProcessGateway(tmp_path, workers=2, engine="null",
+                            replicas=1, slots=1, queue_capacity=32,
+                            steps_per_request=3) as gw:
+            subs = reqs_for_shard(gw, 0, 8)
+            for r in subs:
+                assert gw.submit(r, 600.0).status == QUEUED
+            killed = self._kill_on_op(gw, "adopt")
+            gw.run_until_idle()
+            assert killed, "no steal ever reached the adopt leg"
+            assert gw.stats()["pump_deaths"] == 1
+            assert_exactly_once(gw, subs)
+            assert len(gw.outcomes) == len(subs)
+            assert gw.store.replay().conflicts == []
+
+    def test_spill_after_worker_door_refusal_is_conflict_free(
+            self, tmp_path):
+        """A stale conductor depth view sends a submit to a full home
+        shard: the worker refuses at ITS door, the conductor spills
+        to the sibling, and the sibling's eventual FINISHED must be
+        the uid's ONLY journaled terminal — a worker-journaled
+        REJECTED_FULL here would replay as a conflict and break the
+        chaos suite's journal invariant."""
+        with ProcessGateway(tmp_path, workers=2, engine="null",
+                            replicas=1, slots=1, queue_capacity=3,
+                            steps_per_request=3) as gw:
+            subs = reqs_for_shard(gw, 0, 4)
+            for r in subs[:3]:
+                assert gw.submit(r, 600.0).status == QUEUED
+            # simulate the stale view: the conductor believes the
+            # home shard has room, so fullness is discovered at the
+            # worker's door and the spill starts from there
+            gw.handles[0].depth = 0
+            g = gw.submit(subs[3], 600.0)
+            assert g.status == QUEUED
+            assert gw._live[subs[3].uid]["worker"] == "pump1"
+            gw.run_until_idle()
+            assert_exactly_once(gw, subs)
+            view = gw.store.replay()
+            assert view.conflicts == []
+            assert "rejected_full" not in view.counts()
+
     def test_scripted_pump_kill_requeues_deadlines_unchanged(
             self, tmp_path):
         """THE drain contract across a process boundary: a scripted
@@ -319,3 +403,62 @@ class TestProcessGateway:
             gw.submit(make_req("u0", 0), 600.0)
             with pytest.raises(RuntimeError, match="no live pump"):
                 gw.run_until_idle()
+
+
+# -- worker door semantics (in-process _Worker, fast tier) ----------------
+
+class TestWorkerDoor:
+    """The worker half of one pump, driven in-process (no subprocess,
+    no heartbeat): a door refusal is terminal in the REPLY, never in
+    the journal — the conductor may spill the uid to a sibling whose
+    FINISHED must not meet a conflicting REJECTED_FULL at replay, and
+    a later resubmission of the refused uid on the SAME pump must
+    still journal its fresh terminal."""
+
+    def _worker(self, tmp_path, capacity=2):
+        from k8s_dra_driver_tpu.gateway.procpump import (_Worker,
+                                                         _parse_args)
+        args = _parse_args([
+            "--name", "pump0", "--ctl-dir", str(tmp_path / "coord"),
+            "--store-dir", str(tmp_path / "outcomes"),
+            "--engine", "null", "--replicas", "1", "--slots", "1",
+            "--queue-capacity", str(capacity)])
+        return _Worker(args)
+
+    def _submit(self, w, req):
+        return w.op_submit({"req": wire.encode_request(req),
+                            "slo_s": 600.0})
+
+    def _drain(self, w, max_steps=200):
+        for _ in range(max_steps):
+            w.op_step({"rounds": 1})
+            if not len(w.gw.queue) and not any(
+                    r.in_flight for r in w.gw.manager.replicas):
+                return
+        raise AssertionError("worker never drained")
+
+    def test_door_refusal_unjournaled_and_reuse_rejournals(
+            self, tmp_path):
+        from k8s_dra_driver_tpu.gateway.outcome_store import \
+            OutcomeStore
+        w = self._worker(tmp_path, capacity=2)
+        assert self._submit(w, make_req("u0", 0))["status"] == QUEUED
+        assert self._submit(w, make_req("u1", 1))["status"] == QUEUED
+        assert self._submit(w, make_req("u2", 2))["status"] \
+            == "rejected_full"
+        # the refusal travels in the reply only — not into seen, not
+        # onto disk
+        assert "u2" not in w.writer.seen
+        store = OutcomeStore(tmp_path / "outcomes")
+        assert "u2" not in store.replay().terminals
+        self._drain(w)
+        # the refused uid resubmits on the SAME pump: a fresh
+        # lifecycle whose FINISHED must journal (the old refusal
+        # journaling left u2 in writer.seen, which swallowed this
+        # terminal and let recovery adopt a stale REJECTED_FULL)
+        assert self._submit(w, make_req("u2", 2))["status"] == QUEUED
+        self._drain(w)
+        view = store.replay()
+        assert view.terminals["u2"]["status"] == "finished"
+        assert view.conflicts == []
+        w.writer.close()
