@@ -117,6 +117,11 @@ CRUCIBLE_KWARGS = dict(seed=7, cycles=90)
 #: checked in the same run
 PAGED_KV_KWARGS = dict(wave=6, repeats=5)
 
+#: speculative-decode probe (models/specprobe.py): the induction-ramp
+#: duel — ngram drafts fused into the chained loop vs the identical
+#: non-speculative engine, byte-equality checked in the same run
+SPEC_DECODE_KWARGS = dict(wave=4, repeats=5)
+
 #: control-plane ceiling probe (gateway/ctlprobe.py): NO-OP engines +
 #: open-loop trace replay, so the scalars isolate admission/routing
 #: decisions per second from model compute.  Always CPU-meaningful
@@ -815,6 +820,41 @@ def _paged_kv_probe(timeout_s: float = 300.0) -> dict:
     return payload
 
 
+def _spec_decode_probe(timeout_s: float = 300.0) -> dict:
+    """Speculative-decode probe (models/specprobe.py) in a CPU-pinned
+    subprocess: fused-ngram-draft tokens/s over the identical
+    non-speculative chained engine plus the run's draft accept rate,
+    outputs verified byte-equal in the same run."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    kwargs = json.dumps(SPEC_DECODE_KWARGS)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.models.specprobe import "
+        "spec_decode_probe\n"
+        f"print(json.dumps(spec_decode_probe("
+        f"**json.loads({kwargs!r}))))\n")
+    env = cpu_jax_env(1)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = "CPU-pinned subprocess; " + payload.get("note", "")
+    return payload
+
+
 def _tpu_probes(skip: frozenset = frozenset()):
     """Yield (key, result) per probe — most valuable first.
 
@@ -1384,6 +1424,8 @@ _PROBE_SCALARS = (
     ("serving_paged", "pg_cow_shared_frac", "pg_cow_shared_frac"),
     ("serving_paged", "pg_decode_tok_s_ratio",
      "pg_decode_tok_s_ratio"),
+    ("serving_spec", "spec_tok_s_x", "spec_tok_s_x"),
+    ("serving_spec", "spec_accept_rate", "spec_accept_rate"),
     ("control_plane", "ctl_admissions_per_s", "admissions_per_s"),
     ("control_plane", "ctl_routes_per_s", "routes_per_s"),
     ("control_plane", "ctl_goodput_flat_x", "goodput_flat_x"),
@@ -1644,6 +1686,15 @@ def main() -> None:
                 timeout_s=min(240.0, _remaining() - 45.0))
         else:
             paged = {"error": "skipped: wall budget"}
+        # 3c6. Speculative-decode probe (hermetic, CPU subprocess):
+        #      fused ngram-draft tokens/s over the identical
+        #      non-speculative chained engine + the run's accept
+        #      rate, byte-equality checked in-run.
+        if _remaining() > 90:
+            spec = _spec_decode_probe(
+                timeout_s=min(240.0, _remaining() - 45.0))
+        else:
+            spec = {"error": "skipped: wall budget"}
         # 3d. Control-plane ceiling probe (hermetic, CPU subprocess):
         #     admissions/s + routes/s over no-op engines under
         #     open-loop trace replay, swept over pump counts.
@@ -1682,6 +1733,7 @@ def main() -> None:
         compute["crucible"] = crucible
         compute["resharding"] = resharding
         compute["serving_paged"] = paged
+        compute["serving_spec"] = spec
         compute["control_plane"] = ctl
         compute["control_plane_multiproc"] = ctl_proc
         compute["observatory"] = obs

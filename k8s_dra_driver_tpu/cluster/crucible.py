@@ -395,12 +395,20 @@ class CrucibleRig:
     def __init__(self, schedule: Schedule, workdir,
                  *, dump_dir=None, step_deadline_s: float = 5.0,
                  hang_stall_s: float = 20.0,
-                 kv_layout: str = "paged"):
+                 kv_layout: str = "paged",
+                 draft_source: str | None = None,
+                 draft_len: int = 3):
         self.schedule = schedule
         # serving engines run the paged KV layout by default so
         # kv_exhaust waves starve a REAL block ledger; "contiguous"
         # opts back into the dense-slab fleet (byte-equal either way)
         self.kv_layout = kv_layout
+        # draft_source="ngram" runs the fleet speculatively (the
+        # model-free source composes with paged KV and block
+        # adoption); every burst is greedy, so the oracles need no
+        # change — speculation is byte-exact by construction
+        self.draft_source = draft_source
+        self.draft_len = draft_len
         self.workdir = Path(workdir)
         self.workdir.mkdir(parents=True, exist_ok=True)
         self.dump_dir = dump_dir
@@ -485,7 +493,9 @@ class CrucibleRig:
         self.mgr = DisaggReplicaManager(
             lambda name: ServingEngine(_params(), _cfg(), slots=2,
                                        prefix_cache=2,
-                                       kv_layout=self.kv_layout),
+                                       kv_layout=self.kv_layout,
+                                       draft_source=self.draft_source,
+                                       draft_len=self.draft_len),
             prefill_replicas=1, decode_replicas=1,
             chip_of=chip_map.get,
             health_source=self.ledger.current_unhealthy,
@@ -853,12 +863,16 @@ class CrucibleRig:
 
 
 def run_soak(schedule: Schedule, workdir, *, dump_dir=None,
-             drain_cycles: int = 300):
+             drain_cycles: int = 300, draft_source: str | None = None,
+             draft_len: int = 3):
     """One full soak: injection phase (``schedule.cycles`` co-loop
     cycles), drain phase, end-of-run checkers.  Returns ``(result,
     rig)`` — the rig is closed but readable, so tests can inspect
-    recoveries, events, and flight-recorder dumps."""
-    rig = CrucibleRig(schedule, workdir, dump_dir=dump_dir)
+    recoveries, events, and flight-recorder dumps.  ``draft_source``
+    runs the serving fleet speculatively (tests/test_crucible.py
+    twins the kill + kv_exhaust arc against it)."""
+    rig = CrucibleRig(schedule, workdir, dump_dir=dump_dir,
+                      draft_source=draft_source, draft_len=draft_len)
     try:
         for _ in range(schedule.cycles):
             rig.run_cycle()

@@ -143,6 +143,10 @@ class FleetGateway:
         # advances by deltas (a replaced replica's name never recurs
         # — ReplicaManager names are generation-fresh)
         self._kv_evictions_seen: dict[str, int] = {}
+        #: per-replica speculative accept-rate EWMAs — the router's
+        #: accept-aware preference signal, smoothed here (not in the
+        #: engine) so a single cold window cannot flip placement
+        self._spec_accept_ewma: dict[str, float] = {}
         #: per-pump streaming quantile digests (utils/digest.py) —
         #: each pump owns its OWN bank so a ShardedGateway can merge
         #: them (the mergeability contract); ``digests=False`` swaps
@@ -285,6 +289,7 @@ class FleetGateway:
         for state, n in counts.items():
             self.metrics.replicas.labels(state=state).set(n)
         self._fold_kv_occupancy()
+        self._fold_spec_accept()
         self._drain_migrations()
         if self.burn is not None:
             # close the burn-rate cycle AFTER this step's terminal
@@ -314,6 +319,11 @@ class FleetGateway:
         queued (router returns None at the pool's depth bound)."""
         while len(self.queue):
             g = self.queue.peek()
+            # attribute-hint to the router (the last_reason idiom in
+            # reverse): deadline-bearing requests prefer high-accept
+            # replicas at equal depth; best-effort traffic keeps the
+            # plain spill ordering
+            self.router.slo_tight = g.deadline_s != float("inf")
             if self.tracer is None:
                 route_s = 0.0
                 target = self.router.route(g.request.prompt,
@@ -551,6 +561,27 @@ class FleetGateway:
                 if total > seen:
                     self.metrics.kv_block_evictions.inc(total - seen)
                     self._kv_evictions_seen[r.name] = total
+
+    def _fold_spec_accept(self) -> None:
+        """Fold each speculative replica's draft accept rate into a
+        per-replica EWMA + gauge, once per pump step — the twin of
+        ``_fold_kv_occupancy`` for the accept-aware routing signal.
+        Smoothing lives HERE (not in the engine) so one cold window
+        cannot flip placement; replicas without the signal (plain
+        engines, stubs) are skipped — the degrade contract again."""
+        for r in self.manager.replicas:
+            if r.state == DEAD:
+                continue
+            rate = r.occupancy().get("spec_accept_rate")
+            if rate is None:
+                continue
+            prev = self._spec_accept_ewma.get(r.name)
+            ewma = (float(rate) if prev is None
+                    else _RATE_ALPHA * float(rate)
+                    + (1 - _RATE_ALPHA) * prev)
+            self._spec_accept_ewma[r.name] = ewma
+            self.metrics.spec_accept_rate.labels(
+                replica=r.name).set(ewma)
 
     def _drain_migrations(self) -> None:
         """Fold the pool's KV-migration events into the registry —

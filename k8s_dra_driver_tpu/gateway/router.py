@@ -36,6 +36,18 @@ engine; among candidates, more headroom wins load-spill ties
 (``_spill_key``).  Replicas without the signal (contiguous engines,
 remote stubs) are always admissible — the gate degrades to the old
 behavior, it never invents pressure.
+
+**Accept-aware preference** (speculative replicas): occupancy from a
+speculative engine carries ``spec_accept_rate`` (accepted / proposed
+drafts).  For SLO-TIGHT requests (a finite deadline — the gateway
+sets ``router.slo_tight`` before each route, the ``last_reason``
+attribute-hint idiom), a higher accept rate wins the spill tie right
+after queue depth: at equal load, deadline-bearing work lands where
+speculation is currently paying off (more tokens per weight stream =
+lower expected latency).  The rate is decile-quantized first
+(``_accept_bucket``) so EWMA jitter cannot thrash placement, and
+replicas without the signal bucket to 0 — an all-plain pool keeps
+the exact old ordering (degrade, never invent).
 """
 
 from __future__ import annotations
@@ -56,6 +68,12 @@ class Router:
     #: without re-deriving the router's decision.  Overwritten per
     #: call; meaningless when route() returned None.
     last_reason: str | None = None
+
+    #: hint set by the CALLER before route() (the last_reason idiom
+    #: in reverse): True when the request carries a finite deadline,
+    #: letting spill ties prefer high-spec-accept replicas without
+    #: widening the route() signature every policy implements.
+    slo_tight: bool = False
 
     def route(self, prompt: np.ndarray, replicas: list):
         raise NotImplementedError
@@ -93,11 +111,26 @@ def _headroom(replica) -> float:
     return replica.occupancy().get("kv_headroom_blocks", float("inf"))
 
 
-def _spill_key(replica):
-    """Least depth, then MOST KV headroom, then name order — the
-    memory-pressure-aware tiebreak: at equal load, new work lands
-    where eviction/preemption is least likely."""
-    return (_depth(replica), -_headroom(replica), replica.name)
+def _accept_bucket(replica) -> int:
+    """Decile-quantized speculative accept rate (0..10); 0 when the
+    replica reports none — quantization keeps EWMA jitter from
+    thrashing placement, and the 0 default keeps an all-plain pool's
+    ordering byte-identical to the pre-speculative router."""
+    rate = replica.occupancy().get("spec_accept_rate")
+    if not rate:
+        return 0
+    return int(min(max(float(rate), 0.0), 1.0) * 10)
+
+
+def _spill_key(replica, slo_tight: bool = False):
+    """Least depth, then (SLO-tight requests only) HIGHEST spec
+    accept bucket, then MOST KV headroom, then name order — the
+    memory-pressure-aware tiebreak: at equal load, deadline-bearing
+    work lands where speculation currently pays off, and new work
+    lands where eviction/preemption is least likely."""
+    return (_depth(replica),
+            -(_accept_bucket(replica) if slo_tight else 0),
+            -_headroom(replica), replica.name)
 
 
 def _candidates(prompt, replicas) -> list:
@@ -114,7 +147,8 @@ class LeastLoadedRouter(Router):
         ready = _candidates(prompt, replicas)
         if not ready:
             return None
-        return min(ready, key=_spill_key)
+        return min(ready,
+                   key=lambda r: _spill_key(r, self.slo_tight))
 
 
 class RoundRobinRouter(Router):
@@ -169,12 +203,14 @@ class PrefixAffinityRouter(Router):
         best, _ = max(scored, key=lambda s: s[0])
         if best >= self.min_affinity:
             # deterministic among equals: deepest affinity, then the
-            # memory-aware spill key (least depth, most KV headroom)
+            # memory-aware spill key (least depth, accept bucket for
+            # SLO-tight requests, most KV headroom)
             pick = min((r for a, r in scored if a == best),
-                       key=_spill_key)
+                       key=lambda r: _spill_key(r, self.slo_tight))
             self.last_reason = "affinity"
         else:
-            pick = min(ready, key=_spill_key)
+            pick = min(ready,
+                       key=lambda r: _spill_key(r, self.slo_tight))
             self.last_reason = "spill"
         hist = self._routed.setdefault(pick.name,
                                        deque(maxlen=self.history))

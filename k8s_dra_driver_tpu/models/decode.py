@@ -642,6 +642,33 @@ def decode_fused_rows(params: Params, last: jax.Array,
     return packed, jnp.sum(done.astype(jnp.int32)), cache, keys
 
 
+def _draft_scan(params, last, cfg, cache, pos_rows, k, keys, temps,
+                top_k, top_p):
+    """Shared sampled-draft scan body: the k+1-step proposal loop
+    behind ``draft_sample_rows`` AND the in-loop draft stage of
+    ``decode_spec_fused_rows`` — a PLAIN function (no jit, no
+    dispatch label) because the fused block traces it inside a
+    ``lax.while_loop``, where a counted wrapper would fire once at
+    trace time and corrupt per-replica dispatch attribution
+    (utils/dispatch.py counts host calls, not device launches)."""
+    def step(carry, _):
+        tok, cache, pos, keys = carry
+        logits, cache = _rows_forward(params, tok[:, None], cfg,
+                                      cache, pos)
+        filt = _filter_logits(logits[:, 0], temps, top_k, top_p)
+        split = jax.vmap(jax.random.split)(keys)
+        sampled = jax.vmap(jax.random.categorical)(split[:, 1], filt)
+        greedy = jnp.argmax(logits[:, 0], axis=-1)
+        nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+        q = jax.nn.softmax(filt, axis=-1)
+        new_keys = jnp.where((temps > 0)[:, None], split[:, 0], keys)
+        return (nxt, cache, pos + 1, new_keys), (nxt, q)
+    (_, cache, _, keys), (toks, qs) = jax.lax.scan(
+        step, (last, cache, jnp.asarray(pos_rows), keys), None,
+        length=k + 1)
+    return toks[:k].T, jnp.moveaxis(qs[:k], 0, 1), cache, keys
+
+
 @dispatch.counted("draft_sample_rows")
 @functools.partial(jax.jit, static_argnames=("cfg", "k", "top_k",
                                              "top_p"),
@@ -666,55 +693,62 @@ def draft_sample_rows(params: Params, last: jax.Array,
     sampled from, which is what ``spec_accept_rows``'s accept ratio
     and residual must use (standard speculative sampling, Leviathan/
     Chen et al.; the reference has no serving stack — SURVEY §2.3)."""
-    def step(carry, _):
-        tok, cache, pos, keys = carry
-        logits, cache = _rows_forward(params, tok[:, None], cfg,
-                                      cache, pos)
-        filt = _filter_logits(logits[:, 0], temps, top_k, top_p)
-        split = jax.vmap(jax.random.split)(keys)
-        sampled = jax.vmap(jax.random.categorical)(split[:, 1], filt)
-        greedy = jnp.argmax(logits[:, 0], axis=-1)
-        nxt = jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
-        q = jax.nn.softmax(filt, axis=-1)
-        new_keys = jnp.where((temps > 0)[:, None], split[:, 0], keys)
-        return (nxt, cache, pos + 1, new_keys), (nxt, q)
-    (_, cache, _, keys), (toks, qs) = jax.lax.scan(
-        step, (last, cache, jnp.asarray(pos_rows), keys), None,
-        length=k + 1)
-    return toks[:k].T, jnp.moveaxis(qs[:k], 0, 1), cache, keys
+    return _draft_scan(params, last, cfg, cache, pos_rows, k, keys,
+                       temps, top_k, top_p)
 
 
-@dispatch.counted("spec_accept_rows")
-@functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
-def spec_accept_rows(logits: jax.Array, proposals: jax.Array,
-                     q_probs: jax.Array, keys: jax.Array,
-                     temps: jax.Array, top_k: int = 0,
-                     top_p: float = 0.0
-                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Per-row speculative acceptance, greedy and sampled rows in ONE
-    program: target ``logits`` [B, K+1, V] over the window, draft
-    ``proposals`` [B, K] with their distributions ``q_probs``
-    [B, K, V], per-row ``keys``/``temps`` -> (emit [B, K+1],
-    accepts [B], new keys).
+def ngram_propose_rows(ctx: jax.Array, ctx_len: jax.Array,
+                       last: jax.Array, k: int) -> jax.Array:
+    """Model-free prompt-lookup draft source: per row, find the LAST
+    occurrence of the row's current token in its prompt context and
+    propose the ``k`` tokens that followed it there (prompt-lookup /
+    n-gram speculation — zero extra weights, zero extra KV HBM, the
+    draft is a pure gather).  ``ctx`` [B, C] int32 (prompt tokens,
+    zero-padded), ``ctx_len`` [B] valid lengths, ``last`` [B] ->
+    proposals [B, k].
 
-    Greedy rows (temp==0): the exact-match rule — accepted prefix is
-    proposals matching the target's raw argmax, correction/bonus is
-    the argmax at the first mismatch (identical to the host loop it
-    replaces, so speculative == plain greedy stays bit-exact).
+    Only matches with a full k-token continuation inside the prompt
+    qualify (``i + k < ctx_len``); recency (last match) wins because
+    repeated patterns continue from their most recent occurrence.
+    No-match rows propose ``last`` repeated — almost surely rejected,
+    and the verify stage's correction token still guarantees >= 1
+    emitted token per window, so a cold row costs nothing vs plain
+    decode.  The proposal distribution is a point mass (one-hot), so
+    rejection sampling stays exact for sampled rows: accept w.p.
+    ``min(1, p(x))`` and the residual renormalizes ``max(p - 1_x,
+    0)`` — the standard prompt-lookup acceptance rule."""
+    b, c = ctx.shape
+    idx = jnp.arange(c)[None]                              # [1, C]
+    m = (ctx == last[:, None]) & (idx + k < ctx_len[:, None])
+    has = jnp.any(m, axis=1)
+    at = jnp.max(jnp.where(m, idx, -1), axis=1)
+    cols = jnp.clip(at[:, None] + 1 + jnp.arange(k)[None], 0, c - 1)
+    prop = jnp.take_along_axis(ctx, cols, axis=1)
+    return jnp.where(has[:, None], prop,
+                     last[:, None]).astype(jnp.int32)
 
-    Sampled rows: standard rejection sampling — accept draft token i
-    w.p. ``min(1, p_i(x_i) / q_i(x_i))`` with both distributions
-    under the SAME temperature/top-k/top-p filter the samplers use;
-    on the first reject, resample from the residual
-    ``norm(max(p_i - q_i, 0))``; on a full accept, draw the bonus
-    token from ``p_K``.  Each emitted token is therefore distributed
-    exactly as non-speculative sampling of the target would produce
-    (the Leviathan/Chen guarantee), pinned empirically by
-    tests/test_speculative.py on a small vocab.
 
-    ``emit[b, :accepts[b]+1]`` are the tokens to append; positions
-    past that are padding.  Greedy rows leave their key untouched.
-    """
+@dispatch.counted("draft_ngram_rows")
+@functools.partial(jax.jit, static_argnames=("k", "vocab", "want_q"))
+def draft_ngram_rows(ctx: jax.Array, ctx_len: jax.Array,
+                     last: jax.Array, k: int, vocab: int,
+                     want_q: bool = False):
+    """Launch-site wrapper for the n-gram draft (non-fused engine
+    path): returns (proposals [B, k], one-hot q_probs [B, k, V] when
+    ``want_q`` else None).  Carries its own ``draft_*`` dispatch
+    label so per-replica attribution can pin which replicas launch
+    draft work (tests/test_disagg.py)."""
+    prop = ngram_propose_rows(ctx, ctx_len, last, k)
+    if want_q:
+        return prop, jax.nn.one_hot(prop, vocab, dtype=jnp.float32)
+    return prop, None
+
+
+def _spec_accept_body(logits, proposals, q_probs, keys, temps,
+                      top_k, top_p):
+    """Shared verify-accept body behind ``spec_accept_rows`` and the
+    in-loop verify stage of ``decode_spec_fused_rows`` — plain for
+    the same trace-time-counting reason as ``_draft_scan``."""
     b, k1, v = logits.shape
     k = k1 - 1
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -756,6 +790,164 @@ def spec_accept_rows(logits: jax.Array, proposals: jax.Array,
                      corr[:, None], padded)
     new_keys = jnp.where((temps > 0)[:, None], new_keys, keys)
     return emit, a, new_keys
+
+
+@dispatch.counted("spec_accept_rows")
+@functools.partial(jax.jit, static_argnames=("top_k", "top_p"))
+def spec_accept_rows(logits: jax.Array, proposals: jax.Array,
+                     q_probs: jax.Array, keys: jax.Array,
+                     temps: jax.Array, top_k: int = 0,
+                     top_p: float = 0.0
+                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Per-row speculative acceptance, greedy and sampled rows in ONE
+    program: target ``logits`` [B, K+1, V] over the window, draft
+    ``proposals`` [B, K] with their distributions ``q_probs``
+    [B, K, V], per-row ``keys``/``temps`` -> (emit [B, K+1],
+    accepts [B], new keys).
+
+    Greedy rows (temp==0): the exact-match rule — accepted prefix is
+    proposals matching the target's raw argmax, correction/bonus is
+    the argmax at the first mismatch (identical to the host loop it
+    replaces, so speculative == plain greedy stays bit-exact).
+
+    Sampled rows: standard rejection sampling — accept draft token i
+    w.p. ``min(1, p_i(x_i) / q_i(x_i))`` with both distributions
+    under the SAME temperature/top-k/top-p filter the samplers use;
+    on the first reject, resample from the residual
+    ``norm(max(p_i - q_i, 0))``; on a full accept, draw the bonus
+    token from ``p_K``.  Each emitted token is therefore distributed
+    exactly as non-speculative sampling of the target would produce
+    (the Leviathan/Chen guarantee), pinned empirically by
+    tests/test_speculative.py on a small vocab.
+
+    ``emit[b, :accepts[b]+1]`` are the tokens to append; positions
+    past that are padding.  Greedy rows leave their key untouched.
+    """
+    return _spec_accept_body(logits, proposals, q_probs, keys, temps,
+                             top_k, top_p)
+
+
+@dispatch.counted("decode_spec_fused_rows")
+@functools.partial(jax.jit, static_argnames=("cfg", "k", "draft_len",
+                                             "draft_cfg", "top_k",
+                                             "top_p"),
+                   donate_argnums=(3,))
+def decode_spec_fused_rows(params: Params, last: jax.Array,
+                           cfg: TransformerConfig, cache: KVCache,
+                           pos_rows: jax.Array, k: int,
+                           keys: jax.Array, temps: jax.Array,
+                           budget: jax.Array, eos: jax.Array,
+                           ctx: jax.Array | None,
+                           ctx_len: jax.Array | None,
+                           draft_params: Params | None,
+                           draft_cfg: TransformerConfig | None,
+                           draft_cache: KVCache | None,
+                           draft_keys: jax.Array | None,
+                           draft_len: int, top_k: int = 0,
+                           top_p: float = 0.0):
+    """Speculation INSIDE the fused generation block: a donated-
+    buffer ``lax.while_loop`` of up to ``k`` speculative windows per
+    row — each iteration drafts ``draft_len`` proposals (draft model
+    via ``_draft_scan`` when ``draft_params`` is given, else the
+    model-free n-gram lookup over ``ctx``), scores the whole window
+    with ONE target forward (``_rows_forward`` at T=draft_len+1),
+    and verify-accepts per row on device (``_spec_accept_body``) —
+    so a block of up to ``k * (draft_len+1)`` tokens per row costs
+    one launch + one readback, composing the fused loop's dispatch
+    amortization (decode_fused_rows) with speculation's
+    tokens-per-weight-stream win.  Recorded hermetic duel:
+    tools/spec_decode_cpu.json.
+
+    Per-row accept depths feed the same EOS/length freezing as
+    ``decode_fused_rows``: a row appends ``min(accepts+1,
+    first-EOS-cut, remaining budget)`` tokens per window and freezes
+    when EOS lands or the budget drains, so continuous batching
+    keeps rows at DIFFERENT accept depths in one packed block.
+    Frozen rows ride along — their window writes land at
+    [pos, pos+draft_len+1) past their finish line, which is why the
+    engine reserves a ``draft_len + 1`` capacity margin at intake
+    for fused-spec requests (models/serving.py _check_request): one
+    row more than the non-fused spec path, because there a finished
+    slot is released before the next window while here it stays in
+    the batch until the block returns.
+
+    Rollback is positional, as in ``decode_window_rows``: rejected
+    rows beyond the accepted prefix stay in the cache but are
+    position-masked and overwritten by the next window at the same
+    offsets.
+
+    Returns ``(packed [B, k*(draft_len+1) + 3], rows_finished,
+    cache, keys, draft_cache, draft_keys)``: packed rows are the
+    token block, then per-row emitted count, accepted-draft count,
+    and windows-run count (the accept-rate numerators/denominators
+    ride in the one transfer).  ``draft_cache``/``draft_keys`` echo
+    back None for the n-gram source."""
+    b = last.shape[0]
+    kd = draft_len
+    cap = k * (kd + 1)
+    steps = jnp.arange(kd + 1)[None]                    # [1, kd+1]
+
+    def cond(carry):
+        j, done = carry[0], carry[1]
+        return (j < k) & ~jnp.all(done)
+
+    def body(carry):
+        (j, done, last, cache, pos, keys, emitted, toks, accepted,
+         windows, d_cache, d_keys) = carry
+        if draft_params is not None:
+            proposals, q_probs, d_cache, d_keys = _draft_scan(
+                draft_params, last, draft_cfg, d_cache, pos, kd,
+                d_keys, temps, top_k, top_p)
+        else:
+            proposals = ngram_propose_rows(ctx, ctx_len, last, kd)
+            q_probs = jax.nn.one_hot(proposals, cfg.vocab,
+                                     dtype=jnp.float32)
+        window = jnp.concatenate([last[:, None], proposals], axis=1)
+        logits, cache = _rows_forward(params, window, cfg, cache,
+                                      pos)
+        emit, a, new_keys = _spec_accept_body(
+            logits, proposals, q_probs, keys, temps, top_k, top_p)
+        alive = ~done
+        # per-row append count: accepted prefix + correction, cut at
+        # the first emitted EOS, then at the remaining budget
+        n0 = a + 1
+        hit = ((eos[:, None] >= 0) & (emit == eos[:, None])
+               & (steps < n0[:, None]))
+        has = jnp.any(hit, axis=1)
+        first = jnp.argmax(hit, axis=1)
+        n = jnp.where(has, first + 1, n0)
+        n = jnp.minimum(n, budget - emitted)
+        n = jnp.where(alive, n, 0)
+        cols = emitted[:, None] + steps
+        cols = jnp.where(steps < n[:, None], cols, cap)
+        toks = toks.at[jnp.arange(b)[:, None], cols].set(
+            emit, mode="drop")
+        last_new = jnp.take_along_axis(
+            emit, jnp.clip(n - 1, 0, kd)[:, None], axis=1)[:, 0]
+        last = jnp.where(alive, last_new, last)
+        pos = pos + n                      # n is 0 for frozen rows
+        emitted = emitted + n
+        accepted = accepted + jnp.minimum(n, a)
+        windows = windows + alive.astype(jnp.int32)
+        keys = jnp.where(alive[:, None], new_keys, keys)
+        done = done | (alive & ((has & (first < n))
+                                | (emitted >= budget)))
+        return (j + 1, done, last, cache, pos, keys, emitted, toks,
+                accepted, windows, d_cache, d_keys)
+
+    (_, done, _, cache, _, keys, emitted, toks, accepted, windows,
+     d_cache, d_keys) = jax.lax.while_loop(
+        cond, body,
+        (jnp.int32(0), budget <= 0, last, cache,
+         jnp.asarray(pos_rows), keys, jnp.zeros((b,), jnp.int32),
+         jnp.zeros((b, cap), jnp.int32),
+         jnp.zeros((b,), jnp.int32), jnp.zeros((b,), jnp.int32),
+         draft_cache, draft_keys))
+    packed = jnp.concatenate(
+        [toks, emitted[:, None], accepted[:, None],
+         windows[:, None]], axis=1)
+    return (packed, jnp.sum(done.astype(jnp.int32)), cache, keys,
+            d_cache, d_keys)
 
 
 # -- paged KV cache (serving_kv/) ------------------------------------
@@ -800,24 +992,35 @@ def _paged_dense(pool_arr, tables):
 
 def _paged_rows_forward(params, tokens, cfg, pool, tables, pos_rows,
                         use_kernel):
-    """tokens [B, 1] appended at per-row positions into the block
-    pool -> (logits [B, 1, vocab], pool).  The paged twin of
-    ``_rows_forward``: the write lands at (tables[b, pos//bs],
-    pos % bs) and dead rows (table slot = null block) write to block
-    0, which no live row ever reads — so full-batch dispatch stays
-    static-shape with no mask argument."""
+    """tokens [B, T] appended at per-row positions into the block
+    pool -> (logits [B, T, vocab], pool).  The paged twin of
+    ``_rows_forward``: each token's write lands at
+    (tables[b, (pos+t)//bs], (pos+t) % bs) — a static Python loop
+    over the window width, so T stays a compile-time constant — and
+    dead rows (table slot = null block) write to block 0, which no
+    live row ever reads, so full-batch dispatch stays static-shape
+    with no mask argument.  The pallas kernel read is single-query;
+    windows (T > 1, the paged speculative path) read through the
+    block gather + dense ``_cached_attention``, which is what keeps
+    paged speculation bitwise-equal to contiguous on CPU."""
     params = _with_layers(params, cfg)
     b, t = tokens.shape
+    if use_kernel and t > 1:
+        raise ValueError("the paged-attention kernel is single-query; "
+                         "T > 1 windows use the dense-gather read")
     positions = pos_rows[:, None] + jnp.arange(t)[None]
     x = take_rows(params["embed"], tokens, cfg.dtype)
     bs = pool.k[0].shape[1]
-    phys = jnp.take_along_axis(tables, (pos_rows // bs)[:, None],
-                               axis=1)[:, 0]
-    off = pos_rows % bs
+    phys = [jnp.take_along_axis(tables,
+                                ((pos_rows + i) // bs)[:, None],
+                                axis=1)[:, 0] for i in range(t)]
+    off = [(pos_rows + i) % bs for i in range(t)]
     new_k, new_v = [], []
 
     def write_pool(dst, new):
-        return dst.at[phys, off].set(new[:, 0])
+        for i in range(t):
+            dst = dst.at[phys[i], off[i]].set(new[:, i])
+        return dst
 
     for layer, k_pool, v_pool in zip(params["layers"], pool.k,
                                      pool.v):
@@ -861,6 +1064,30 @@ def paged_decode_step_rows(params: Params, token: jax.Array,
     logits, pool = _paged_rows_forward(params, token, cfg, pool,
                                        tables, pos_rows, use_kernel)
     return logits[:, 0], pool
+
+
+@dispatch.counted("paged_window_rows")
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnums=(3,))
+def paged_window_rows(params: Params, tokens: jax.Array,
+                      cfg: TransformerConfig, pool: KVCache,
+                      tables: jax.Array, pos_rows: jax.Array
+                      ) -> tuple[jax.Array, KVCache]:
+    """Multi-token paged step: tokens [B, K+1] appended at each
+    row's own position through its block table -> (logits
+    [B, K+1, vocab], pool).  The paged twin of
+    ``decode_window_rows`` — the target-scoring half of PAGED
+    speculative decoding.  The caller must have reserved writable
+    blocks covering [pos, pos+K] per live row
+    (serving.py ``_kv_prepare_step`` with a window span); rejected
+    rows beyond the accepted prefix are rolled back as a
+    block-table edit (trim + refcount release), never a pool
+    rewrite — the pool keeps every written byte and the next window
+    simply re-targets the same offsets."""
+    logits, pool = _paged_rows_forward(params, tokens, cfg, pool,
+                                       tables, pos_rows,
+                                       use_kernel=False)
+    return logits, pool
 
 
 @dispatch.counted("paged_adopt")

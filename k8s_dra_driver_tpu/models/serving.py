@@ -28,8 +28,11 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   host-side bookkeeping is plain numpy mirrors of slot state (the
   device only ever sees static shapes).
 - **Speculative continuous batching** (``draft_params``/
-  ``draft_cfg``/``draft_len``): a draft model proposes ``draft_len``
-  tokens per slot in ONE compiled scan, the target scores every
+  ``draft_cfg``/``draft_len``, or the model-free
+  ``draft_source="ngram"`` prompt-lookup source): a draft proposes
+  ``draft_len`` tokens per slot (one compiled scan for the model
+  source; a pure gather over the prompt for n-gram — zero extra
+  weights, zero extra KV HBM), the target scores every
   slot's whole window in ONE ``decode_window_rows`` pass, and each
   row emits its accepted prefix + a correction/bonus token — up to
   ``draft_len+1`` tokens per big-weight stream instead of one,
@@ -40,7 +43,13 @@ active-slot count, not the slowest request.  TPU-first mechanics:
   on reject — ``spec_accept_rows``), so every emitted token is
   distributed exactly as plain sampling of the target.  Rollback is
   just not advancing ``_pos`` (rejected rows stay position-masked
-  and are overwritten by the next window).
+  and are overwritten by the next window).  Speculation COMPOSES
+  with ``chain_steps`` — it moves inside the fused block
+  (``decode_spec_fused_rows``: up to K windows per launch, per-row
+  accept depths feeding the same on-device freezing) — and with
+  paged KV (n-gram source): rejected-draft rollback there is a
+  block-table trim + refcount release
+  (``KVBlockManager.trim_tail``), never a pool rewrite.
 - **Fused on-device generation blocks** (``chain_steps=K``): up to K
   decode steps per dispatch via a donated-buffer ``lax.while_loop``
   (``decode_fused_rows``) that samples, updates the KV cache, and
@@ -112,8 +121,9 @@ from ..serving_kv import (NULL_BLOCK, BlocksExhausted, KVBlockManager,
 from ..utils import dispatch
 from . import decode as _decode
 from .decode import (KVCache, decode_step_rows, decode_window_rows,
-                     draft_propose_rows, draft_sample_rows, init_cache,
-                     sample_token, spec_accept_rows)
+                     draft_ngram_rows, draft_propose_rows,
+                     draft_sample_rows, init_cache, sample_token,
+                     spec_accept_rows)
 from .transformer import TransformerConfig
 
 
@@ -401,6 +411,14 @@ def _extract_slot(cache: KVCache, slot, pos) -> KVCache:
 _prefill_suffix_jit = dispatch.counted("prefill_suffix")(
     _decode._prefill_jit._fn)
 
+#: draft-model prompt fills under their OWN label (same underlying
+#: jit): with it, draft work is attributable per replica — decode
+#: replicas of a disaggregated pool carry ``draft_*`` launch labels
+#: and prefill replicas carry none (tests/test_disagg.py), the
+#: prefill_suffix idiom applied to speculation.
+_draft_prefill_jit = dispatch.counted("draft_prefill")(
+    _decode._prefill_jit._fn)
+
 
 @dispatch.counted("adopt_slot")
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -428,6 +446,7 @@ class ServingEngine:
                  draft_params=None,
                  draft_cfg: TransformerConfig | None = None,
                  draft_len: int = 4,
+                 draft_source: str | None = None,
                  chain_steps: int = 1,
                  kv_layout: str = "contiguous",
                  kv_block_size: int = 16,
@@ -443,8 +462,12 @@ class ServingEngine:
             # way the block ledger does not model yet — fail loudly
             # instead of corrupting silently
             if draft_params is not None:
-                raise ValueError("paged KV does not compose with "
-                                 "speculative decoding")
+                # the n-gram source composes (draft_source="ngram"):
+                # it needs no draft KV, so the ledger models nothing
+                # new; a draft MODEL would need its own paged cache
+                raise ValueError("paged KV composes with the n-gram "
+                                 "draft source only; use "
+                                 "draft_source='ngram'")
             if chain_steps > 1:
                 raise ValueError("paged KV does not compose with "
                                  "fused generation blocks")
@@ -462,12 +485,15 @@ class ServingEngine:
             raise ValueError("draft_len must be >= 1")
         if chain_steps < 1:
             raise ValueError("chain_steps must be >= 1")
-        if chain_steps > 1 and draft_params is not None:
-            # both amortize the per-step dispatch; composing them
-            # would chain whole speculative windows, which the
-            # rollback bookkeeping does not support
-            raise ValueError("chain_steps and draft_params are "
-                             "mutually exclusive")
+        if draft_source not in (None, "model", "ngram"):
+            raise ValueError(f"unknown draft_source {draft_source!r}")
+        if draft_source == "model" and draft_params is None:
+            raise ValueError("draft_source='model' needs draft_params")
+        if draft_source == "ngram" and draft_params is not None:
+            raise ValueError("draft_source='ngram' is model-free; "
+                             "drop draft_params")
+        if draft_source == "ngram" and draft_len < 1:
+            raise ValueError("draft_len must be >= 1")
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -478,15 +504,20 @@ class ServingEngine:
         # its core mechanic — sized below once the pool exists.
         self._prefix = (PrefixCache(prefix_cache)
                         if prefix_cache and not self._paged else None)
-        # speculative continuous batching: a draft model proposes
-        # draft_len tokens per slot, the target scores the whole
-        # window in one decode_window_rows pass.  Greedy rows use
-        # exact-match acceptance; sampled rows (temperature > 0) use
-        # per-row rejection sampling (spec_accept_rows), so both
-        # compose with the draft in the same batch.
+        # speculative continuous batching: a draft proposes draft_len
+        # tokens per slot (model scan or prompt-n-gram gather), the
+        # target scores the whole window in one decode_window_rows
+        # pass.  Greedy rows use exact-match acceptance; sampled rows
+        # (temperature > 0) use per-row rejection sampling
+        # (spec_accept_rows), so both compose with the draft in the
+        # same batch.
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.draft_len = draft_len
+        self._draft_source = draft_source or (
+            "model" if draft_params is not None else None)
+        self._ngram = self._draft_source == "ngram"
+        self._spec_on = self._draft_source is not None
         # draft-side PRNG streams for sampled rows, independent of
         # the target streams (_keys) — any independent scheme
         # preserves the output distribution
@@ -494,6 +525,10 @@ class ServingEngine:
                                     (slots, 1))
         self._spec_windows = 0
         self._spec_accepted = 0
+        # proposals made for LIVE rows (draft_len per active row per
+        # window) — the accept-rate denominator; _spec_accepted only
+        # counts drafts actually emitted, so the rate is conservative
+        self._spec_drafts = 0
         # chain_steps=K runs up to K decode steps per dispatch through
         # the fused on-device generation block (decode_fused_rows):
         # per-row EOS/length stops are detected ON DEVICE (no
@@ -513,6 +548,15 @@ class ServingEngine:
         self._time_decode = 0.0
         self._time_host = 0.0
         self.max_seq = max_seq or cfg.max_seq
+        if self._ngram:
+            # per-slot prompt context for the n-gram lookup (host
+            # mirror + lazily built device twin, the _table/_table_dev
+            # pattern): zero-padded token rows, valid lengths.  Zeros
+            # with ctx_len 0 can never match (i + k < 0 is false), so
+            # a freed slot's stale context is inert.
+            self._ngram_ctx = np.zeros((slots, self.max_seq), np.int32)
+            self._ngram_len = np.zeros(slots, np.int32)
+            self._ngram_dev = None
         if self._paged:
             if self.max_seq % kv_block_size:
                 # blocks_per_slot = max_seq // bs keeps the gathered
@@ -603,11 +647,17 @@ class ServingEngine:
         # a speculative window's first write is the last emitted
         # token's own row; only the draft_len proposal rows lie past
         # it, so that is the scratch margin the capacity guard
-        # reserves.  The fused block (chain_steps > 1) needs NO
+        # reserves.  The FUSED-spec block needs one row more
+        # (draft_len + 1): frozen rows ride along inside the block
+        # and their windows write [pos, pos+draft_len+1) past the
+        # finish line, where the non-fused path releases a finished
+        # slot before the next window (decode_spec_fused_rows).  The
+        # plain fused block (chain_steps > 1, no draft) needs NO
         # margin: finished rows freeze on device and never write past
         # the finish line (decode_fused_rows).
-        margin = (self.draft_len
-                  if self.draft_params is not None else 0)
+        margin = ((self.draft_len
+                   + (1 if self.chain_steps > 1 else 0))
+                  if self._spec_on else 0)
         if prompt.size + req.max_new + margin > self.max_seq:
             raise ValueError(
                 f"prompt ({prompt.size}) + max_new ({req.max_new})"
@@ -617,8 +667,11 @@ class ServingEngine:
         if self._paged:
             # a request that can NEVER fit the pool even with every
             # other block reclaimed must be refused at intake, not
-            # discovered as a livelock under preemption
-            worst = min(prompt.size + req.max_new, self.max_seq)
+            # discovered as a livelock under preemption (the spec
+            # margin counts: window-scratch blocks are held until the
+            # post-window trim)
+            worst = min(prompt.size + req.max_new + margin,
+                        self.max_seq)
             need = -(-worst // self._kv_bs)
             if need > self.kv_manager.n_blocks - 1:
                 raise ValueError(
@@ -684,6 +737,12 @@ class ServingEngine:
             out["kv_cow_shared_blocks"] = view["cow_shared_blocks"]
             out["kv_headroom_blocks"] = (
                 view["free_blocks"] + self._prefix.evictable_count())
+        if self._spec_on:
+            # the router's accept-aware preference signal: EWMA'd
+            # fleet-side (gateway/frontend.py), quantized into the
+            # spill key for SLO-tight requests (gateway/router.py)
+            out["spec_accept_rate"] = round(
+                self._spec_accepted / max(1, self._spec_drafts), 4)
         return out
 
     def prefix_peek(self, prompt) -> int:
@@ -911,9 +970,14 @@ class ServingEngine:
             out["kv_preemptions_total"] = self._kv_preemptions
             out["kv_alloc_failures_total"] = (
                 self.kv_manager.alloc_failures)
-        if self.draft_params is not None:
+            out["kv_spec_trims_total"] = (
+                self.kv_manager.spec_trims_total)
+        if self._spec_on:
             out["speculative_windows_total"] = self._spec_windows
             out["speculative_accepted_total"] = self._spec_accepted
+            out["speculative_drafts_total"] = self._spec_drafts
+            out["spec_accept_rate"] = round(
+                self._spec_accepted / max(1, self._spec_drafts), 4)
         return out
 
     # -- slot lifecycle --------------------------------------------------
@@ -975,13 +1039,13 @@ class ServingEngine:
             # per-length compile tail prefill_chunk exists to bound
             one_d = init_cache(self.draft_cfg, 1, self.max_seq)
             if self.prefill_chunk is None:
-                _, one_d = _decode._prefill_jit(self.draft_params,
+                _, one_d = _draft_prefill_jit(self.draft_params,
                                         req.prompt[None, :],
                                         self.draft_cfg, one_d, True)
             else:
                 c = self.prefill_chunk
                 for off in range(0, req.prompt.size, c):
-                    _, one_d = _decode._prefill_jit(
+                    _, one_d = _draft_prefill_jit(
                         self.draft_params,
                         req.prompt[None, off:off + c],
                         self.draft_cfg, one_d, off == 0)
@@ -1015,9 +1079,20 @@ class ServingEngine:
         return first
 
     def _fill_finalize(self, slot: int, first: int) -> None:
-        """Record the resolved first token for a dispatched fill."""
+        """Record the resolved first token for a dispatched fill.
+        Every fill/adopt path funnels through here, so it is also
+        where the n-gram draft source snapshots the slot's prompt
+        context (prompt-lookup decoding matches against the PROMPT;
+        generated tokens are not folded in, keeping the context
+        static for the whole request)."""
         self._generated[slot] = [first]
         self._last[slot] = first
+        if self._ngram:
+            prompt = self._req[slot].prompt
+            self._ngram_ctx[slot, :] = 0
+            self._ngram_ctx[slot, :prompt.size] = prompt
+            self._ngram_len[slot] = prompt.size
+            self._ngram_dev = None
 
     def _finish_slot(self, slot: int, out: list[Finished]) -> None:
         req = self._req[slot]
@@ -1112,13 +1187,14 @@ class ServingEngine:
     def _step_inner(self) -> list[Finished]:
         finished: list[Finished] = []
         if self.chain_steps > 1:
-            return self._fused_step(finished)
+            return (self._fused_spec_step(finished) if self._spec_on
+                    else self._fused_step(finished))
         self._refill(finished)
         active = [s for s in range(self.slots)
                   if self._req[s] is not None]
         if not active:
             return finished
-        if self.draft_params is not None:
+        if self._spec_on:
             return self._spec_step(active, finished)
         if self._paged:
             # block upkeep BEFORE the step: boundary appends and CoW
@@ -1215,6 +1291,79 @@ class ServingEngine:
         self._steps_total += int(max(arr[slot, k] for slot in active))
         for slot in active:
             for j in range(int(arr[slot, k])):
+                self._pos[slot] += 1
+                self._generated[slot].append(int(arr[slot, j]))
+                self._last[slot] = arr[slot, j]
+            if self._done(slot):
+                self._finish_slot(slot, finished)
+        return finished
+
+    def _fused_spec_step(self, finished: list[Finished]
+                         ) -> list[Finished]:
+        """Speculation INSIDE the fused block
+        (``decode_spec_fused_rows``): up to ``chain_steps``
+        speculative windows per row — draft, one target window
+        forward, verify-accept, all on device — so one launch + one
+        packed readback covers up to ``chain_steps * (draft_len+1)``
+        tokens per row.  The refill overlap, scalar sync, and packed
+        transfer are ``_fused_step``'s mechanics unchanged; per-row
+        accept depths feed the same on-device EOS/length/budget
+        freezing, so rows at DIFFERENT accept depths share one block.
+        Greedy rows are byte-equal to the non-speculative fused
+        engine by construction (exact-match acceptance); sampled rows
+        keep rejection-sampling parity (tests/test_speculative.py).
+        The packed tail rows carry per-row accepted-draft and
+        windows-run counts, so accept-rate accounting costs no extra
+        readback."""
+        active = [s for s in range(self.slots)
+                  if self._req[s] is not None]
+        if not active:
+            self._refill(finished)
+            return finished
+        k = self.chain_steps
+        kd = self.draft_len
+        cap = k * (kd + 1)
+        t_dec = time.perf_counter()
+        budget = np.zeros(self.slots, np.int32)
+        eos = np.full(self.slots, -1, np.int32)
+        for slot in active:
+            req = self._req[slot]
+            budget[slot] = min(
+                req.max_new - len(self._generated[slot]),
+                self.max_seq - 1 - kd - int(self._pos[slot]))
+            if req.eos_id is not None:
+                eos[slot] = req.eos_id
+        if self._ngram:
+            if self._ngram_dev is None:
+                self._ngram_dev = jnp.asarray(self._ngram_ctx)
+            ctx = self._ngram_dev
+            ctx_len = jnp.asarray(self._ngram_len)
+        else:
+            ctx = ctx_len = None
+        (packed, rows_done, self.cache, self._keys,
+         self._draft_cache, self._draft_keys) = \
+            _decode.decode_spec_fused_rows(
+                self.params, jnp.asarray(self._last), self.cfg,
+                self.cache, jnp.asarray(self._pos), k, self._keys,
+                jnp.asarray(self._temps), jnp.asarray(budget),
+                jnp.asarray(eos), ctx, ctx_len, self.draft_params,
+                self.draft_cfg, self._draft_cache, self._draft_keys,
+                kd, self.top_k, self.top_p)
+        self._time_decode += time.perf_counter() - t_dec
+        self._refill(finished)          # overlaps the running block
+        t_wait = time.perf_counter()
+        int(rows_done)                  # scalar sync on the block
+        arr = np.asarray(packed, np.int32)
+        dispatch.record_readback("fused_spec_block")
+        self._time_decode += time.perf_counter() - t_wait
+        windows = [int(arr[s, cap + 2]) for s in active]
+        self._steps_total += max(windows)
+        self._spec_windows += max(windows)
+        self._spec_drafts += sum(windows) * kd
+        self._spec_accepted += sum(int(arr[s, cap + 1])
+                                   for s in active)
+        for slot in active:
+            for j in range(int(arr[slot, cap])):
                 self._pos[slot] += 1
                 self._generated[slot].append(int(arr[slot, j]))
                 self._last[slot] = arr[slot, j]
@@ -1336,37 +1485,61 @@ class ServingEngine:
         self._table[slot, :] = NULL_BLOCK
         self._table_dev = None
 
-    def _kv_prepare_step(self, active: list) -> None:
+    def _kv_prepare_step(self, active: list, span: int = 1) -> None:
         """Host-side block upkeep before a paged step: append a block
         when a row crosses a block boundary; copy-on-write the write
         block when it is shared (a store entry or another slot still
-        references it).  Under exhaustion the escalation is evict
-        cold -> preempt the cheapest other slot -> self-preempt
-        (requeue at the front, retry when the wave passes)."""
+        references it).  ``span`` widens the write window — the
+        speculative path reserves ``draft_len + 1`` rows
+        [pos, pos+draft_len] so the whole window lands in writable
+        blocks (scratch tail blocks are trimmed back after the
+        accept, ``_kv_spec_trim``).  Under exhaustion the escalation
+        is evict cold -> preempt the cheapest other slot ->
+        self-preempt (requeue at the front, retry when the wave
+        passes)."""
         bs = self._kv_bs
         for slot in active:
             if self._req[slot] is None:
                 continue              # preempted earlier in this pass
-            bi = int(self._pos[slot]) // bs
+            pos = int(self._pos[slot])
             blocks = self._slot_blocks[slot]
             try:
-                if bi == len(blocks):
-                    nid = self._kv_alloc_decode(slot, 1)[0]
-                    blocks.append(nid)
-                    self._table[slot, bi] = nid
-                    self._table_dev = None
-                elif not self.kv_manager.writable(blocks[bi]):
-                    nid = self._kv_alloc_decode(slot, 1)[0]
-                    self.pool = _decode.paged_copy_block(
-                        self.pool, jnp.int32(blocks[bi]),
-                        jnp.int32(nid))
-                    self.kv_manager.free_blocks([blocks[bi]])
-                    self.kv_manager.note_cow_copy()
-                    blocks[bi] = nid
-                    self._table[slot, bi] = nid
-                    self._table_dev = None
+                for bi in range(pos // bs,
+                                (pos + span - 1) // bs + 1):
+                    if bi == len(blocks):
+                        nid = self._kv_alloc_decode(slot, 1)[0]
+                        blocks.append(nid)
+                        self._table[slot, bi] = nid
+                        self._table_dev = None
+                    elif not self.kv_manager.writable(blocks[bi]):
+                        nid = self._kv_alloc_decode(slot, 1)[0]
+                        self.pool = _decode.paged_copy_block(
+                            self.pool, jnp.int32(blocks[bi]),
+                            jnp.int32(nid))
+                        self.kv_manager.free_blocks([blocks[bi]])
+                        self.kv_manager.note_cow_copy()
+                        blocks[bi] = nid
+                        self._table[slot, bi] = nid
+                        self._table_dev = None
             except BlocksExhausted:
                 self._kv_preempt(slot)
+
+    def _kv_spec_trim(self, slot: int) -> None:
+        """Rejected-draft KV rollback, the paged way: keep exactly
+        the blocks covering the accepted prefix ([0, _pos)) and
+        release every window-scratch block past them — a block-table
+        edit + refcount release (``KVBlockManager.trim_tail``), ZERO
+        pool bytes moved.  The scratch blocks' written rows simply
+        become unreferenced; the next window re-reserves and rewrites
+        the same row offsets through fresh (or the same, if re-
+        allocated) blocks, so reruns stay byte-exact
+        (tests/test_serving_kv.py)."""
+        keep = -(-int(self._pos[slot]) // self._kv_bs)
+        dropped = self.kv_manager.trim_tail(
+            self._slot_blocks[slot], keep)
+        if dropped:
+            self._table[slot, keep:] = NULL_BLOCK
+            self._table_dev = None
 
     def _kv_can_admit(self, req: Request) -> bool:
         """Admission gate for the paged refill: can the manager cover
@@ -1749,13 +1922,34 @@ class ServingEngine:
         whole row (same contract as the plain step).  Rejected rows
         stay in both caches position-masked and are overwritten by
         the next window at the same offsets — rollback is just not
-        advancing ``_pos``."""
+        advancing ``_pos``.  On the PAGED layout the same rollback
+        is a block-table edit: writable blocks covering the whole
+        window are reserved before the step
+        (``_kv_prepare_step(span=draft_len+1)``) and blocks past the
+        accepted prefix are trimmed after it (``_kv_spec_trim`` —
+        refcount release, zero pool bytes moved)."""
         k = self.draft_len
+        if self._paged:
+            # reserve/CoW writable blocks covering [pos, pos+k] per
+            # live row BEFORE the window forward; the escalation may
+            # preempt (shed, never crash), so re-filter the batch
+            self._kv_prepare_step(active, span=k + 1)
+            active = [s for s in active
+                      if self._req[s] is not None]
+            if not active:
+                return finished
         t_dec = time.perf_counter()
         last = jnp.asarray(self._last)
         pos = jnp.asarray(self._pos)
         sampled_mode = bool(self._temps.any())
-        if sampled_mode:
+        if self._ngram:
+            if self._ngram_dev is None:
+                self._ngram_dev = jnp.asarray(self._ngram_ctx)
+            temps = jnp.asarray(self._temps)
+            proposals, q_probs = draft_ngram_rows(
+                self._ngram_dev, jnp.asarray(self._ngram_len), last,
+                k, self.cfg.vocab, sampled_mode)
+        elif sampled_mode:
             temps = jnp.asarray(self._temps)
             (proposals, q_probs, self._draft_cache,
              self._draft_keys) = draft_sample_rows(
@@ -1767,8 +1961,15 @@ class ServingEngine:
                 self.draft_params, last, self.draft_cfg,
                 self._draft_cache, pos, k)
         window = jnp.concatenate([last[:, None], proposals], axis=1)
-        logits, self.cache = decode_window_rows(
-            self.params, window, self.cfg, self.cache, pos)
+        if self._paged:
+            if self._table_dev is None:
+                self._table_dev = jnp.asarray(self._table)
+            logits, self.pool = _decode.paged_window_rows(
+                self.params, window, self.cfg, self.pool,
+                self._table_dev, pos)
+        else:
+            logits, self.cache = decode_window_rows(
+                self.params, window, self.cfg, self.cache, pos)
         if sampled_mode:
             emit_dev, a_dev, self._keys = spec_accept_rows(
                 logits, proposals, q_probs, self._keys, temps,
@@ -1792,6 +1993,7 @@ class ServingEngine:
         self._time_decode += time.perf_counter() - t_dec
         self._steps_total += 1
         self._spec_windows += 1
+        self._spec_drafts += k * len(active)
         for slot in active:
             if sampled_mode:
                 a = int(a_all[slot])
@@ -1822,6 +2024,11 @@ class ServingEngine:
             # same gen[-1]-unwritten invariant as the plain step, so
             # the finish-time prefix capture sees a consistent _pos
             self._pos[slot] += appended
+            if self._paged:
+                # rejected-draft rollback: drop the window-scratch
+                # blocks past the accepted prefix — a table edit +
+                # refcount release, never a pool rewrite
+                self._kv_spec_trim(slot)
             if self._done(slot):
                 self._finish_slot(slot, finished)
         return finished

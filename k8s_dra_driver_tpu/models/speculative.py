@@ -1,12 +1,26 @@
-"""Speculative decoding: draft-model speculation, target-exact output.
+"""Speculative decoding: draft-sourced speculation, target-exact output.
 
-The serving-latency lever for memory-bound decode: a small draft
-model proposes ``draft_len`` greedy tokens autoregressively (cheap —
-its weights are small), then the target model scores all of them in
-ONE forward_with_cache call (one stream of the big weights instead of
-``draft_len``).  Accepted prefix + one correction token advance the
-output per iteration, so the big model's HBM traffic per emitted
-token drops by up to ``(accepted+1)x``.
+The serving-latency lever for memory-bound decode: a draft source
+proposes ``draft_len`` tokens, then the target model scores all of
+them in ONE forward_with_cache call (one stream of the big weights
+instead of ``draft_len``).  Accepted prefix + one correction token
+advance the output per iteration, so the big model's HBM traffic per
+emitted token drops by up to ``(accepted+1)x``.
+
+TWO draft sources share the verify machinery:
+
+- **draft model** (``speculative_generate`` here, engine
+  ``draft_source="model"``): a small model proposes autoregressively
+  — cheap because its weights are small, but it carries its own
+  params + KV cache in HBM;
+- **prompt n-gram lookup** (``ngram_speculative_generate``, engine
+  ``draft_source="ngram"``): proposals are gathered from the
+  request's OWN prompt at the last occurrence of the current token
+  (prompt-lookup decoding) — zero extra weights, zero extra KV HBM,
+  and a one-hot proposal distribution that keeps rejection sampling
+  exact.  Wins on structured/self-referential text (code edit,
+  summarization, RAG); degrades gracefully to >= 1 token per window
+  on cold prompts.
 
 Greedy speculation is **algorithmically exact**: a draft token is
 accepted only when it equals the target's own greedy choice at that
@@ -54,7 +68,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from .decode import KVCache, forward_with_cache, init_cache
+from .decode import (KVCache, forward_with_cache, init_cache,
+                     ngram_propose_rows)
 from .transformer import Params, TransformerConfig
 
 
@@ -164,6 +179,81 @@ def speculative_generate(params: Params, draft_params: Params,
 
     _, _, out, _, _, iters = jax.lax.while_loop(
         cond, body, (t_cache, d_cache, out0, jnp.int32(1), first,
+                     jnp.int32(0)))
+    return (jnp.concatenate([prompt, out[:, :n_tokens]], axis=1),
+            iters)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "n_tokens", "draft_len", "max_seq"))
+def ngram_speculative_generate(params: Params, prompt: jax.Array,
+                               cfg: TransformerConfig, n_tokens: int,
+                               draft_len: int = 4,
+                               max_seq: int | None = None):
+    """Model-free speculative generation: ``speculative_generate``
+    with the prompt-n-gram lookup source (``ngram_propose_rows``,
+    models/decode.py) in place of the draft model — no second set of
+    weights, no second KV cache, proposals are a pure gather over
+    the prompt.  prompt [B, Tp] -> ([B, Tp + n_tokens] greedy
+    continuation of the target, target-forward iterations).
+
+    Same greedy-exactness and lockstep-min batching as the
+    draft-model loop: every accepted token equals the target's own
+    greedy choice, so the output is bit-identical to
+    ``greedy_generate`` on the f32 CPU suite regardless of how many
+    proposals the prompt lookup lands.  ``iterations`` approaches
+    ``n_tokens / (draft_len + 1)`` when the prompt predicts the
+    continuation (repetitive/structured text) and degrades to
+    ``n_tokens`` — never worse than one emitted token per target
+    forward — when it never matches."""
+    b, tp = prompt.shape
+    max_seq = max_seq or cfg.max_seq
+    if n_tokens < 1:
+        raise ValueError(f"n_tokens must be >= 1, got {n_tokens}")
+    if draft_len < 1:
+        raise ValueError(f"draft_len must be >= 1, got {draft_len}")
+    need = tp + n_tokens + draft_len + 1
+    if need > max_seq:
+        raise ValueError(
+            f"prompt ({tp}) + n_tokens ({n_tokens}) + draft_len "
+            f"({draft_len}) + 1 exceeds the {max_seq}-slot cache")
+
+    t_cache = init_cache(cfg, b, max_seq)
+    t_logits, t_cache = forward_with_cache(params, prompt, cfg,
+                                           t_cache, first_chunk=True)
+    first = jnp.argmax(t_logits[:, -1], axis=-1).astype(prompt.dtype)
+    ctx_len = jnp.full((b,), tp, jnp.int32)
+    out0 = jnp.zeros((b, n_tokens + draft_len + 1), prompt.dtype)
+    out0 = out0.at[:, 0].set(first)
+
+    def cond(carry):
+        _, _, count, _, _ = carry
+        return count < n_tokens
+
+    def body(carry):
+        t_cache, out, count, last, iters = carry
+        drafts = ngram_propose_rows(prompt.astype(jnp.int32), ctx_len,
+                                    last.astype(jnp.int32), draft_len
+                                    ).astype(last.dtype)
+        t_in = jnp.concatenate([last[:, None], drafts], axis=1)
+        t_logits, t_cache_spec = forward_with_cache(
+            params, t_in, cfg, t_cache)
+        greedy = jnp.argmax(t_logits, axis=-1).astype(last.dtype)
+        match = (drafts == greedy[:, :-1])
+        acc = jnp.min(jnp.cumprod(match.astype(jnp.int32),
+                                  axis=1).sum(axis=1))
+        emit_n = acc + 1
+        out = jax.lax.dynamic_update_slice(out, greedy, (0, count))
+        last = jax.lax.dynamic_index_in_dim(greedy, acc, axis=1,
+                                            keepdims=False)
+        t_cache = KVCache(k=t_cache_spec.k, v=t_cache_spec.v,
+                          pos=t_cache.pos + emit_n,
+                          k_scale=t_cache_spec.k_scale,
+                          v_scale=t_cache_spec.v_scale)
+        return (t_cache, out, count + emit_n, last, iters + 1)
+
+    _, out, _, _, iters = jax.lax.while_loop(
+        cond, body, (t_cache, out0, jnp.int32(1), first,
                      jnp.int32(0)))
     return (jnp.concatenate([prompt, out[:, :n_tokens]], axis=1),
             iters)

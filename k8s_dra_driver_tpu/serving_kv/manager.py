@@ -81,6 +81,7 @@ class KVBlockManager:
         self.allocs_total = 0
         self.alloc_failures = 0
         self.cow_copies_total = 0
+        self.spec_trims_total = 0
 
     # -- views ------------------------------------------------------------
 
@@ -179,6 +180,24 @@ class KVBlockManager:
             if self._ref[bid] == 0:
                 freed += 1
         return freed
+
+    def trim_tail(self, blocks: list, keep: int) -> list:
+        """Speculative-decode rollback primitive: release every block
+        past index ``keep`` in a slot's block list — the window-
+        scratch blocks whose draft rows the verify stage rejected.
+        The list is shortened IN PLACE, one reference per trimmed
+        block is dropped, and the trimmed ids are returned so the
+        caller can null its table rows.  A pure ledger edit: no pool
+        bytes move, which is the whole point — rejected-draft
+        rollback is a block-table edit, never a KV rewrite."""
+        if keep < 0:
+            raise ValueError(f"keep must be >= 0, got {keep}")
+        dropped = blocks[keep:]
+        if dropped:
+            del blocks[keep:]
+            self.free_blocks(dropped)
+            self.spec_trims_total += len(dropped)
+        return dropped
 
     # -- fault hook (cluster/crucible.py kv_exhaust) ----------------------
 

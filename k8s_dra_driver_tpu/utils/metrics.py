@@ -298,6 +298,12 @@ class GatewayMetrics(_DigestSourceMixin):
             "block headroom for the queue head (fleet-wide block "
             "exhaustion: the request waits, then sheds at its "
             "deadline)", registry=self.registry)
+        self.spec_accept_rate = Gauge(
+            "tpu_gateway_spec_accept_rate",
+            "EWMA of the speculative-decode draft acceptance rate "
+            "per replica (accepted / proposed drafts) — the router's "
+            "high-accept preference signal for SLO-tight requests",
+            ["replica"], registry=self.registry)
         # sharded control plane (gateway/sharded.py): how many
         # admission/routing pumps serve this pool, and how often the
         # work-stealing spill moved a queued request off a hot shard

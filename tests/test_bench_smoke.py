@@ -881,3 +881,51 @@ def test_paged_kv_artifact_pins_claims():
     # artifact is evidence for the line's scalars
     assert doc["probe"] == "serving_paged"
     assert doc["harness"] == "serving_kv/probe.py paged_kv_probe"
+
+def test_spec_decode_probe_streams_schema():
+    """The speculative-decode probe at a reduced shape (one timed
+    repeat): outputs byte-equal the non-speculative twin AND the
+    induction model's closed-form ramp in-run, the accept rate is
+    the by-construction ceiling, and every scalar the compact line
+    picks up is present.  The >=1.5x bar lives on the committed
+    full-shape artifact (test_spec_decode_artifact below) — a
+    one-repeat hermetic run is too noisy to pin the ratio."""
+    from k8s_dra_driver_tpu.models.specprobe import spec_decode_probe
+    out = spec_decode_probe(wave=2, timed_new=18, repeats=1)
+    assert out["byte_equal"] is True
+    # ramp prompts + the rolled-unembed model make every draft land:
+    # windows align with the budget (timed_new % (draft_len+1) == 0),
+    # so anything below 1.0 is a verify-accept bug, not noise
+    assert out["spec_accept_rate"] == 1.0
+    assert out["spec_tok_s_x"] > 0
+    assert out["spec_tok_s"] > 0 and out["base_tok_s"] > 0
+    assert out["spec_windows"] > 0
+
+
+def test_probe_roster_pins_spec_scalars():
+    """Bench-line schema: the speculative-decode scalars (the fused
+    duel ratio and the accept rate the router reads) are IN the
+    compact line roster."""
+    probes = [p for p, _, _ in bench._PROBE_SCALARS]
+    assert "serving_spec" in probes
+    keys = {k: f for _, k, f in bench._PROBE_SCALARS}
+    assert keys["spec_tok_s_x"] == "spec_tok_s_x"
+    assert keys["spec_accept_rate"] == "spec_accept_rate"
+
+
+def test_spec_decode_artifact_pins_claims():
+    """THE speculative-decode acceptance gates (repo rule: perf
+    claims trace to tools/*.json): the recorded full-shape artifact
+    must show >=1.5x decode tok/s at batch over the identical
+    non-speculative chained engine with in-run byte-equality."""
+    artifact = Path(__file__).parent.parent / "tools" / \
+        "spec_decode_cpu.json"
+    doc = bench.json.loads(artifact.read_text())
+    res = doc["result"]
+    assert res["byte_equal"] is True
+    assert res["spec_tok_s_x"] >= 1.5
+    assert 0.0 < res["spec_accept_rate"] <= 1.0
+    # same shape the bench run streams (SPEC_DECODE_KWARGS), so the
+    # artifact is evidence for the line's scalars
+    assert doc["probe"] == "serving_spec"
+    assert doc["harness"] == "models/specprobe.py spec_decode_probe"

@@ -206,6 +206,49 @@ def test_burn_rate_alert_fires_during_fault_window(tmp_path):
     assert res.submitted == 14 and res.finished < res.submitted
 
 
+@pytest.mark.faults
+def test_spec_fleet_survives_kill_and_kv_exhaust(tmp_path):
+    """ISSUE 17 satellite: the replica-kill + kv_exhaust arc twinned
+    against a SPECULATIVE fleet (``draft_source="ngram"`` threaded
+    through the rig's engine factory).  The decode replica dies with
+    speculative windows in flight, the seizure wave starves the block
+    ledger so window-scratch allocations fail mid-draft, and the
+    drain begins while the wave is still seizing — every submitted
+    request must still reach exactly one terminal outcome, byte-equal
+    to the non-speculative greedy oracle (the rig's end-of-run
+    checkers), proving verify-accept and paged rollback never leak a
+    rejected draft through a fault boundary."""
+    sched = Schedule(seed=11, cycles=14, events=[
+        FaultEvent(id="warm-burst", kind="burst", at_cycle=1, n=6,
+                   prompt_seed=31),
+        FaultEvent(id="mid-burst", kind="burst", at_cycle=4, n=6,
+                   prompt_seed=47),
+        FaultEvent(id="decode-kill", kind="replica_kill", at_cycle=5,
+                   replica_glob="d*"),
+        # heal lands AFTER the injection phase: the drain itself
+        # pumps through the tail of the seizure wave
+        FaultEvent(id="kv-squeeze", kind="kv_exhaust", at_cycle=6,
+                   heal_after=12),
+        FaultEvent(id="tail-burst", kind="burst", at_cycle=8, n=6,
+                   prompt_seed=59),
+    ])
+    res, rig = cru.run_soak(sched, tmp_path / "spec",
+                            draft_source="ngram", draft_len=3)
+    assert_no_violations(
+        [f"cycle {c}: {m}" for c, v in res.violations for m in v],
+        label="spec-faults")
+    assert res.submitted == 18 and res.finished == res.submitted
+    by_id = {e.id: e for e in sched.events}
+    assert by_id["decode-kill"].fired_cycle is not None
+    assert rig.kv_seizures >= 1
+    # the fleet really speculated: windows ran on the decode side
+    # (dead replicas' engines keep their counters readable)
+    windows = sum(
+        r.engine.stats().get("speculative_windows_total", 0)
+        for r in rig.mgr.replicas if r.role != "prefill")
+    assert windows > 0, "speculation never engaged under faults"
+
+
 # -- the hardened double-fault arcs, one targeted test each ---------------
 
 def _sup(tmp_path, *, dp, batch, plan=None, health_source=None,

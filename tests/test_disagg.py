@@ -348,6 +348,53 @@ def test_two_role_pool_exactly_once_byte_equal_zero_decode_prefill():
                      text)
 
 
+def test_spec_pool_draft_labels_attribute_to_decode_only():
+    """Draft launches carry their OWN dispatch labels (``draft_*``),
+    so speculative work is attributable per replica: in a 1-prefill +
+    2-decode pool whose engines speculate via the model-free n-gram
+    source, every decode replica that decoded anything tallies
+    ``draft_ngram_rows`` launches, the prefill replica tallies NO
+    ``draft_*`` label of any kind (prompt fills launch no draft
+    work), and the token streams stay byte-equal to the single-engine
+    oracle — the ``prefill_suffix`` attribution idiom applied to
+    speculation."""
+    spec_kw = dict(draft_source="ngram", draft_len=2)
+    mgr = DisaggReplicaManager(
+        lambda name: engine(name, **spec_kw),
+        prefill_replicas=1, decode_replicas=2, depth_bound=2)
+    gw = FleetGateway(mgr, router=DisaggRouter(mgr.index),
+                      queue_capacity=32, auto_replace=False)
+    reqs = [Request(uid=f"r{i}", prompt=prompt(80 + i, 5 + (i % 2) * 3),
+                    max_new=4 + (i % 3),
+                    temperature=0.7 if i % 3 == 2 else 0.0,
+                    seed=80 + i)
+            for i in range(5)]
+    oracles = {r.uid: oracle_tokens(r, **spec_kw) for r in reqs}
+    for r in reqs:
+        assert gw.submit(r, slo_s=300.0).status == "queued"
+    done = gw.run_until_idle()
+    assert {g.uid for g in done} == {r.uid for r in reqs}
+    assert_byte_equal(gw, reqs, oracles)
+    # greedy requests additionally match the NON-speculative oracle:
+    # the drafts changed the launch shape, never the math
+    for r in reqs:
+        if r.temperature == 0:
+            np.testing.assert_array_equal(
+                oracles[r.uid], oracle_tokens(r))
+    per = gw.stats()["per_replica_dispatches"]
+    for r in mgr.replicas:
+        labels = per.get(r.name, {}).get("by_label", {})
+        drafts = {lbl for lbl in labels if lbl.startswith("draft_")}
+        if r.role == ROLE_DECODE:
+            if any(lbl.startswith("decode_") for lbl in labels):
+                assert "draft_ngram_rows" in drafts, (r.name, labels)
+        else:
+            assert not drafts, (r.name, labels)
+    assert any("draft_ngram_rows" in per.get(r.name, {})
+               .get("by_label", {}) for r in mgr.replicas
+               if r.role == ROLE_DECODE)
+
+
 @pytest.mark.faults
 def test_prefill_replica_killed_mid_transfer_falls_back_local():
     """Chaos twin: the only prefill replica dies via the FaultPlan

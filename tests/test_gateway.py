@@ -150,17 +150,21 @@ class TestAdmissionQueue:
 # -- routers (stub replicas, no jax) --------------------------------------
 
 class StubReplica:
-    def __init__(self, name, depth=0, bound=4, peek=0):
+    def __init__(self, name, depth=0, bound=4, peek=0, accept=None):
         self.name = name
         self.ready = True
         self.depth_bound = bound
         self._depth = depth
         self._peek = peek
+        self.accept = accept
 
     def occupancy(self):
-        return {"active": self._depth, "pending": 0,
-                "free_slots": 0, "slots": 2,
-                "depth": self._depth, "tokens": {}}
+        occ = {"active": self._depth, "pending": 0,
+               "free_slots": 0, "slots": 2,
+               "depth": self._depth, "tokens": {}}
+        if self.accept is not None:
+            occ["spec_accept_rate"] = self.accept
+        return occ
 
     def prefix_peek(self, prompt):
         return self._peek
@@ -215,6 +219,43 @@ class TestRouters:
         picks = [router.route(np.arange(4, dtype=np.int32),
                               [r0, r1]).name for _ in range(4)]
         assert picks == ["r0", "r1", "r0", "r1"]
+
+    def test_slo_tight_prefers_high_accept_at_equal_depth(self):
+        """Accept-aware spill (ISSUE 17): at equal queue depth a
+        deadline-bearing request lands where speculation currently
+        pays off; best-effort traffic and all-plain pools keep the
+        exact pre-speculative ordering — degrade, never invent."""
+        pr = np.arange(6, dtype=np.int32)
+        r0 = StubReplica("r0", depth=1)
+        r1 = StubReplica("r1", depth=1, accept=0.9)
+        router = LeastLoadedRouter()
+        # best-effort: the accept signal is invisible, name order
+        assert router.route(pr, [r0, r1]) is r0
+        # SLO-tight: the high-accept replica wins the depth tie
+        router.slo_tight = True
+        assert router.route(pr, [r0, r1]) is r1
+        # depth still outranks acceptance — this is a TIEBREAK
+        r1._depth = 2
+        assert router.route(pr, [r0, r1]) is r0
+        r1._depth = 1
+        # decile quantization: jitter within one bucket cannot
+        # thrash placement (0.88 and 0.83 both bucket to 8)
+        r0.accept, r1.accept = 0.88, 0.83
+        assert router.route(pr, [r0, r1]) is r0
+        # an all-plain pool under slo_tight keeps name order too
+        r0.accept = r1.accept = None
+        assert router.route(pr, [r0, r1]) is r0
+
+    def test_affinity_spill_honors_accept_for_tight_slo(self):
+        """The same preference applies on PrefixAffinityRouter's
+        cold-spill path (no affinity winner)."""
+        pr = np.arange(12, dtype=np.int32)
+        r0 = StubReplica("r0", depth=1)
+        r1 = StubReplica("r1", depth=1, accept=0.7)
+        router = PrefixAffinityRouter(min_affinity=4)
+        router.slo_tight = True
+        assert router.route(pr, [r0, r1]) is r1
+        assert router.last_reason == "spill"
 
 
 # -- engine pool-facing API -----------------------------------------------
@@ -555,6 +596,37 @@ def test_per_replica_dispatch_attribution():
     assert set(per) == {"r0", "r1"}
     assert sum(v["dispatches"] for v in per.values()) == t.dispatches
     assert sum(v["readbacks"] for v in per.values()) == t.readbacks
+
+
+def test_spec_accept_ewma_folds_into_metrics():
+    """The accept-aware routing signal's plumbing (ISSUE 17): a
+    speculative pool folds each replica's ``spec_accept_rate`` into
+    a per-replica EWMA once per pump step and exports it as the
+    ``tpu_gateway_spec_accept_rate`` gauge; a plain pool folds (and
+    exports) NOTHING — the degrade contract."""
+    mgr = ReplicaManager(
+        lambda name: ServingEngine(params(), CFG, slots=2,
+                                   draft_source="ngram", draft_len=2),
+        replicas=2)
+    gw = FleetGateway(mgr, queue_capacity=8)
+    for i in range(4):
+        gw.submit(make_req(f"u{i}", 70 + i, 5, 4))
+    done = gw.run_until_idle()
+    assert len(done) == 4
+    ewma = gw._spec_accept_ewma
+    assert ewma and set(ewma) <= {"r0", "r1"}
+    assert all(0.0 <= v <= 1.0 for v in ewma.values())
+    text = gw.metrics.render().decode()
+    m = re.search(r'tpu_gateway_spec_accept_rate\{replica="r0"\} '
+                  r'([0-9.]+)', text)
+    assert m and 0.0 <= float(m.group(1)) <= 1.0
+    # plain pool: no signal, no EWMA entries, no gauge series
+    plain = FleetGateway(pool(replicas=2), queue_capacity=8)
+    plain.submit(make_req("p0", 75, 5, 3))
+    plain.run_until_idle()
+    assert plain._spec_accept_ewma == {}
+    assert "tpu_gateway_spec_accept_rate{" not in \
+        plain.metrics.render().decode()
 
 
 # -- DRA lease path -------------------------------------------------------

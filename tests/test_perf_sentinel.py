@@ -92,6 +92,21 @@ class TestVerdicts:
         assert perf_sentinel.direction_of(
             "ctl_outcome_fsync_ms") == "lower"
 
+    def test_spec_scalars_classify_direction(self):
+        """The ISSUE 17 scalars, same suffix discipline: the duel
+        ratio ``spec_tok_s_x`` is higher-is-better via its trailing
+        ``_x`` (the embedded ``_tok_s`` must not confuse anything),
+        the accept rate is higher-is-better via the explicit
+        ``_accept_rate`` rule (no generic suffix covers it), and a
+        ``_ms`` control stays lower — a rule reorder that flips any
+        of these would invert the speculative verdicts."""
+        assert perf_sentinel.direction_of("spec_tok_s_x") == "higher"
+        assert perf_sentinel.direction_of(
+            "spec_accept_rate") == "higher"
+        assert perf_sentinel.direction_of("spec_tok_s") == "higher"
+        assert perf_sentinel.direction_of(
+            "spec_verify_ms") == "lower"
+
     def test_improvement_recognized(self, tmp_path):
         _fixture(tmp_path, {"decode_tok_s": 200.0,
                             "sup_mttr_ms": 52.0})
@@ -202,6 +217,27 @@ class TestArtifactGates:
                  for g in perf_sentinel.check_artifact_gates(tmp_path)
                  if g["artifact"] == "tools/ctl_multiproc_cpu.json"}
         assert gates["result/scaling_x"] == "steady"
+
+
+    def test_spec_decode_floor_is_gated(self, tmp_path):
+        """The fused-speculation acceptance floor (ISSUE 17: >=1.5x
+        decode tok/s at batch on the duel harness) is an absolute
+        artifact bar — a refreshed artifact below the floor fails
+        the round even with no trajectory history."""
+        tools = tmp_path / "tools"
+        tools.mkdir()
+        (tools / "spec_decode_cpu.json").write_text(json.dumps(
+            {"result": {"spec_tok_s_x": 1.2}}))
+        gates = {g["key"]: g["verdict"]
+                 for g in perf_sentinel.check_artifact_gates(tmp_path)
+                 if g["artifact"] == "tools/spec_decode_cpu.json"}
+        assert gates["result/spec_tok_s_x"] == "regression"
+        (tools / "spec_decode_cpu.json").write_text(json.dumps(
+            {"result": {"spec_tok_s_x": 1.856}}))
+        gates = {g["key"]: g["verdict"]
+                 for g in perf_sentinel.check_artifact_gates(tmp_path)
+                 if g["artifact"] == "tools/spec_decode_cpu.json"}
+        assert gates["result/spec_tok_s_x"] == "steady"
 
 
 class TestRealTrajectory:

@@ -604,13 +604,6 @@ class TestServingEngine:
         p = params()
         with pytest.raises(ValueError, match="chain_steps"):
             ServingEngine(p, CFG, slots=1, chain_steps=0)
-        dcfg = dataclasses.replace(CFG, d_model=16, n_heads=2,
-                                   d_head=8, d_ff=32, n_layers=1)
-        with pytest.raises(ValueError, match="mutually exclusive"):
-            ServingEngine(p, CFG, slots=1, chain_steps=2,
-                          draft_params=init_params(
-                              dcfg, jax.random.PRNGKey(3)),
-                          draft_cfg=dcfg)
         eng = ServingEngine(p, CFG, slots=1, chain_steps=4)
         # the fused block stops rows ON DEVICE (no overshoot writes),
         # so unlike the old scan-based chain NO scratch margin is
@@ -622,6 +615,47 @@ class TestServingEngine:
         (done,) = eng.run()
         assert done.tokens.size == CFG.max_seq
         np.testing.assert_array_equal(done.tokens, reference(p, pr, n))
+
+    def test_chain_composes_with_speculation(self):
+        """The contract that replaced the old chain x draft
+        "mutually exclusive" gate: speculation now runs INSIDE the
+        fused chained loop (decode.decode_spec_fused_rows), so
+        composing the two must be byte-equal to the plain engine
+        for BOTH draft sources, and the ``draft_source`` knob
+        validates its own preconditions instead of banning the
+        combination."""
+        p = params()
+        dcfg = dataclasses.replace(CFG, d_model=16, n_heads=2,
+                                   d_head=8, d_ff=32, n_layers=1)
+        dp = init_params(dcfg, jax.random.PRNGKey(3))
+        reqs = [(u, prompt(80 + i, 4 + i), 5 + i)
+                for i, u in enumerate("abc")]
+
+        def run(**kw):
+            eng = ServingEngine(p, CFG, slots=2, **kw)
+            for uid, pr, n in reqs:
+                eng.submit(Request(uid=uid, prompt=pr, max_new=n))
+            return {f.uid: f.tokens for f in eng.run()}, eng.stats()
+
+        plain, _ = run()
+        for kw in (dict(chain_steps=3, draft_params=dp,
+                        draft_cfg=dcfg, draft_len=2),
+                   dict(chain_steps=3, draft_source="ngram",
+                        draft_len=2)):
+            fused, stats = run(**kw)
+            for uid in plain:
+                np.testing.assert_array_equal(
+                    fused[uid], plain[uid],
+                    err_msg=f"composed {kw} changed request {uid}")
+            assert stats["speculative_windows_total"] > 0
+            assert 0.0 <= stats["spec_accept_rate"] <= 1.0
+        with pytest.raises(ValueError, match="unknown draft_source"):
+            ServingEngine(p, CFG, slots=1, draft_source="magic")
+        with pytest.raises(ValueError, match="needs draft_params"):
+            ServingEngine(p, CFG, slots=1, draft_source="model")
+        with pytest.raises(ValueError, match="model-free"):
+            ServingEngine(p, CFG, slots=1, draft_source="ngram",
+                          draft_params=dp, draft_cfg=dcfg)
 
     def test_fused_continuous_batching_invariants(self):
         """No token loss or duplication across slot insertion and

@@ -217,7 +217,9 @@ class TestPagedEngine:
         with pytest.raises(ValueError, match="cannot hold"):
             ServingEngine(p, CFG, slots=1, kv_layout="paged",
                           kv_blocks=3)
-        with pytest.raises(ValueError, match="speculative"):
+        # a draft MODEL would need its own paged cache — only the
+        # model-free n-gram source composes with the block ledger
+        with pytest.raises(ValueError, match="n-gram"):
             ServingEngine(p, CFG, slots=1, kv_layout="paged",
                           draft_params=p, draft_cfg=CFG)
         with pytest.raises(ValueError, match="fused generation"):
@@ -380,6 +382,74 @@ class TestPagedEngine:
             assert key in stats, key
         assert (stats["kv_blocks_free"] + stats["kv_blocks_used"]
                 == stats["kv_blocks_total"])
+
+    def test_spec_rollback_is_table_edit_only(self):
+        """Rejected-draft rollback on the paged layout is a block-
+        table edit, never a pool rewrite: after every engine step,
+        each slot that has generated at least one token holds exactly
+        ceil(pos / block_size) blocks with every table column past
+        that prefix nulled (the window's scratch blocks were trimmed
+        and their refcounts released).  Random prompts make the
+        n-gram lookup miss, so nearly every window rejects drafts and
+        the trim path fires constantly.  The streams stay byte-equal
+        to the contiguous engine running the identical speculative
+        math, and a RERUN on the same engine — whose pool now
+        recycles blocks still holding stale rejected-draft rows —
+        is byte-exact, proving no stale-draft bytes ever leak past
+        the accepted prefix."""
+        p = params()
+        reqs = [("a", prompt(71, 7), 9, 0.0, 0),
+                ("b", prompt(72, 5), 7, 0.8, 3),
+                ("c", prompt(73, 9), 6, 0.0, 0)]
+        spec_kw = dict(draft_source="ngram", draft_len=3)
+        dense = ServingEngine(p, CFG, slots=2, **spec_kw)
+        eng = ServingEngine(p, CFG, slots=2, kv_layout="paged",
+                            kv_block_size=4, **spec_kw)
+        for e in (dense, eng):
+            for uid, pr, n, temp, seed in reqs:
+                e.submit(Request(uid=uid, prompt=pr, max_new=n,
+                                 temperature=temp, seed=seed))
+        want = {f.uid: f.tokens for f in dense.run()}
+        finished = []
+        for _ in range(200):
+            finished += eng.step()
+            for slot in range(2):
+                req = eng._req[slot]
+                if req is None or \
+                        int(eng._pos[slot]) <= req.prompt.size:
+                    continue          # fresh fill: no spec step yet
+                keep = -(-int(eng._pos[slot]) // eng._kv_bs)
+                assert len(eng._slot_blocks[slot]) == keep, \
+                    f"slot {slot} holds scratch past accepted prefix"
+                assert (np.asarray(eng._table[slot, keep:])
+                        == NULL_BLOCK).all(), \
+                    f"slot {slot} table not nulled past block {keep}"
+            if not eng.active and not eng.pending:
+                break
+        got = {f.uid: f.tokens for f in finished}
+        assert set(got) == set(want)
+        for uid in want:
+            np.testing.assert_array_equal(
+                got[uid], want[uid],
+                err_msg=f"request {uid} diverged under paged spec")
+        stats = eng.stats()
+        assert stats["speculative_windows_total"] > 0
+        assert stats["kv_spec_trims_total"] > 0
+        assert stats["kv_spec_trims_total"] == \
+            eng.kv_manager.spec_trims_total
+        view = eng.kv_manager.view()
+        assert (view["free_blocks"] + view["used_blocks"]
+                == view["total_blocks"])
+        # rerun on the SAME engine: the pool recycles blocks whose
+        # rows still hold rejected-draft garbage from pass one
+        for uid, pr, n, temp, seed in reqs:
+            eng.submit(Request(uid=uid, prompt=pr, max_new=n,
+                               temperature=temp, seed=seed))
+        rerun = {f.uid: f.tokens for f in eng.run()}
+        for uid in want:
+            np.testing.assert_array_equal(
+                rerun[uid], want[uid],
+                err_msg=f"rerun {uid} read stale draft bytes")
 
 
 class TestPagedDisagg:

@@ -1,0 +1,60 @@
+"""Regenerate tools/spec_decode_cpu.json.
+
+The artifact behind the fused speculative-decode claims
+(docs/SERVING.md "Speculative decoding"): decode tokens/s of a
+chained engine with n-gram drafts fused into its donated-buffer loop
+over the identical engine without speculation, with outputs verified
+byte-equal (against each other AND the probe model's closed-form
+ramp) in the same run, plus the run's draft accept rate.  Always
+CPU-pinned (models/specprobe.py documents the induction-ramp model
+and why its accept rate is the mechanism ceiling), but still run it
+on an IDLE machine — see tools/int8_decode_v5e_loaded_host.json for
+what a loaded host does to recorded baselines.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import platform
+import subprocess
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.models.specprobe import "
+        "spec_decode_probe\n"
+        "print(json.dumps(spec_decode_probe(wave=4, repeats=5)))\n")
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    res = subprocess.run([sys.executable, "-c", code], cwd=repo,
+                         env=cpu_jax_env(1), capture_output=True,
+                         text=True, timeout=600)
+    if res.returncode != 0:
+        sys.stderr.write(res.stderr)
+        raise SystemExit(1)
+    result = json.loads(res.stdout.strip().splitlines()[-1])
+    commit = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
+        capture_output=True, text=True).stdout.strip()
+    rec = {
+        "probe": "serving_spec",
+        "host": platform.machine(),
+        "platform": "cpu-hermetic",
+        "commit": commit,
+        "harness": "models/specprobe.py spec_decode_probe",
+        "result": result,
+    }
+    path = pathlib.Path(__file__).parent / "spec_decode_cpu.json"
+    path.write_text(json.dumps(rec, indent=1) + "\n")
+    print(json.dumps(rec, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -65,7 +65,7 @@ REL_BAND = 0.25
 DIRECTION_RULES = (
     (re.compile(r"overhead_x$"), "lower"),
     (re.compile(r"(_x|_tflops|_gbps|_tok_s|_tps|_rps|_per_s|_frac"
-                r"|_ok)$"), "higher"),
+                r"|_ok|_accept_rate)$"), "higher"),
     (re.compile(r"(_ms|_s|_seconds|_ns|_us)$"), "lower"),
 )
 
@@ -83,6 +83,12 @@ ARTIFACT_GATES = (
     # must stay near-linear at the widest sweep point
     ("tools/ctl_multiproc_cpu.json",
      ("result", "scaling_x"), ">=", 3.2),
+    # fused speculative decode (models/specprobe.py): the duel win
+    # the in-loop verify-accept exists for — ngram drafts fused into
+    # the chained loop must hold >= 1.5x decode tok/s at batch over
+    # the identical non-speculative engine
+    ("tools/spec_decode_cpu.json",
+     ("result", "spec_tok_s_x"), ">=", 1.5),
 )
 
 
