@@ -122,6 +122,12 @@ PAGED_KV_KWARGS = dict(wave=6, repeats=5)
 #: non-speculative engine, byte-equality checked in the same run
 SPEC_DECODE_KWARGS = dict(wave=4, repeats=5)
 
+#: multi-adapter serving probe (serving_lora/probe.py): a mixed-
+#: adapter churn wave over an undersized resident pool plus the
+#: warm-switch vs cold-load duel, byte-equality against per-adapter
+#: oracle engines checked in the same run
+LORA_SERVING_KWARGS = dict(wave=16, repeats=5)
+
 #: control-plane ceiling probe (gateway/ctlprobe.py): NO-OP engines +
 #: open-loop trace replay, so the scalars isolate admission/routing
 #: decisions per second from model compute.  Always CPU-meaningful
@@ -855,6 +861,41 @@ def _spec_decode_probe(timeout_s: float = 300.0) -> dict:
     return payload
 
 
+def _lora_serving_probe(timeout_s: float = 300.0) -> dict:
+    """Multi-adapter serving probe (serving_lora/probe.py) in a
+    CPU-pinned subprocess: warm adapter-switch vs full cold-load
+    cost plus the churn wave's resident-hit fraction, outputs
+    verified byte-equal to per-adapter oracle engines in-run."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    kwargs = json.dumps(LORA_SERVING_KWARGS)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.serving_lora.probe import "
+        "lora_serving_probe\n"
+        f"print(json.dumps(lora_serving_probe("
+        f"**json.loads({kwargs!r}))))\n")
+    env = cpu_jax_env(1)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = "CPU-pinned subprocess; " + payload.get("note", "")
+    return payload
+
+
 def _tpu_probes(skip: frozenset = frozenset()):
     """Yield (key, result) per probe — most valuable first.
 
@@ -1426,6 +1467,10 @@ _PROBE_SCALARS = (
      "pg_decode_tok_s_ratio"),
     ("serving_spec", "spec_tok_s_x", "spec_tok_s_x"),
     ("serving_spec", "spec_accept_rate", "spec_accept_rate"),
+    ("serving_lora", "lora_switch_ms", "lora_switch_ms"),
+    ("serving_lora", "lora_coldload_ms", "lora_coldload_ms"),
+    ("serving_lora", "lora_resident_hit_frac",
+     "lora_resident_hit_frac"),
     ("control_plane", "ctl_admissions_per_s", "admissions_per_s"),
     ("control_plane", "ctl_routes_per_s", "routes_per_s"),
     ("control_plane", "ctl_goodput_flat_x", "goodput_flat_x"),
@@ -1695,6 +1740,15 @@ def main() -> None:
                 timeout_s=min(240.0, _remaining() - 45.0))
         else:
             spec = {"error": "skipped: wall budget"}
+        # 3c7. Multi-adapter serving probe (hermetic, CPU
+        #      subprocess): warm adapter-switch vs full cold-load
+        #      cost + churn-wave resident-hit fraction, byte-equality
+        #      against per-adapter oracle engines checked in-run.
+        if _remaining() > 90:
+            lora = _lora_serving_probe(
+                timeout_s=min(240.0, _remaining() - 45.0))
+        else:
+            lora = {"error": "skipped: wall budget"}
         # 3d. Control-plane ceiling probe (hermetic, CPU subprocess):
         #     admissions/s + routes/s over no-op engines under
         #     open-loop trace replay, swept over pump counts.
@@ -1734,6 +1788,7 @@ def main() -> None:
         compute["resharding"] = resharding
         compute["serving_paged"] = paged
         compute["serving_spec"] = spec
+        compute["serving_lora"] = lora
         compute["control_plane"] = ctl
         compute["control_plane_multiproc"] = ctl_proc
         compute["observatory"] = obs

@@ -77,10 +77,16 @@ log = logging.getLogger(__name__)
 #: subprocess of a multi-process gateway via its ``pump_plan``
 #: (cluster/faults.py PUMP_VERB) — the cross-process drain arc; on an
 #: in-process gateway (no ``pump_plan``) it is a logged no-op.
+#: ``adapter_evict_storm`` (serving_lora/) evicts every cold adapter
+#: and pins the matching replicas' pools down to ONE usable resident
+#: slot for ``heal_after`` cycles — the multi-adapter starvation
+#: wave: adapter'd fills serialize through the surviving slot or
+#: hold at their prefill replicas, and the release must cold-load
+#: the evicted adapters back with byte-exact outputs.
 EVENT_KINDS = ("chip_kill", "worker_crash", "worker_hang",
                "replica_kill", "burst", "shard_bitflip",
                "shard_truncate", "gen_tear", "kv_exhaust",
-               "pump_kill")
+               "pump_kill", "adapter_evict_storm")
 CORRUPTION_KINDS = ("shard_bitflip", "shard_truncate", "gen_tear")
 
 #: reconciler event kinds that open the "cascade" window
@@ -132,20 +138,58 @@ def _prompt(seed: int, n: int):
         jax.random.PRNGKey(seed), (n,), 0, _cfg().vocab), np.int32)
 
 
-def _oracle(seed: int, n: int, max_new: int):
-    """Single-engine greedy oracle, cached by (seed, n, max_new) —
-    ddmin re-runs the rig a dozen times and must not recompute the
-    reference output per probe run."""
-    key = (seed, n, max_new)
+#: the crucible's LoRA roster (serving_lora/): three adapters with
+#: deterministic weights over TWO resident slots per engine, so the
+#: soak's adapter traffic churns residency (cold loads + evictions)
+#: even before the storm seizes the pool down to one slot
+_ADAPTER_RANK = 2
+_ADAPTER_SEEDS = {"lora-a": 101, "lora-b": 102, "lora-c": 103}
+
+
+def _adapter_pool():
+    """A fresh per-engine AdapterPool with the full roster registered
+    — every engine (and the oracle) sees byte-identical adapter
+    weights because the sources are seed-deterministic."""
+    from ..serving_lora import (AdapterManifest, AdapterPool,
+                                make_adapter)
+    pool = AdapterPool(_cfg(), _ADAPTER_RANK, n_resident=2)
+    for name, seed in _ADAPTER_SEEDS.items():
+        pool.register(AdapterManifest(
+            name, _ADAPTER_RANK, tenant="hi",
+            source=make_adapter(_cfg(), _ADAPTER_RANK, seed=seed)))
+    return pool
+
+
+def _oracle(seed: int, n: int, max_new: int,
+            adapter: str | None = None):
+    """Single-engine greedy oracle, cached by (seed, n, max_new,
+    adapter) — ddmin re-runs the rig a dozen times and must not
+    recompute the reference output per probe run.  Adapter'd
+    requests compare against a dedicated single-slot engine holding
+    ONLY that adapter (the per-adapter oracle the acceptance
+    contract names)."""
+    key = (seed, n, max_new, adapter)
     if key not in _ORACLES:
         import jax.numpy as jnp
         import numpy as np
 
-        from ..models import greedy_generate
-        out = greedy_generate(_params(),
-                              jnp.asarray(_prompt(seed, n))[None, :],
-                              _cfg(), n_tokens=max_new)
-        _ORACLES[key] = np.asarray(out[0], np.int32)
+        if adapter is None:
+            from ..models import greedy_generate
+            out = greedy_generate(
+                _params(), jnp.asarray(_prompt(seed, n))[None, :],
+                _cfg(), n_tokens=max_new)
+            _ORACLES[key] = np.asarray(out[0], np.int32)
+        else:
+            from ..models.serving import Request, ServingEngine
+            eng = ServingEngine(_params(), _cfg(), slots=1,
+                                adapter_pool=_adapter_pool())
+            eng.submit(Request(uid="oracle", prompt=_prompt(seed, n),
+                               max_new=max_new, adapter=adapter))
+            done = None
+            while done is None:
+                for f in eng.step():
+                    done = f
+            _ORACLES[key] = np.asarray(done.tokens, np.int32)
     return _ORACLES[key]
 
 
@@ -196,6 +240,7 @@ class FaultEvent:
     prompt_seed: int = 0            # burst prompt family
     slo_s: float = 900.0            # burst per-request SLO (tight
     #                                 values drive burn-rate alerts)
+    adapter: str | None = None      # burst LoRA adapter (None = base)
     fired_cycle: int | None = None
     hit_windows: tuple = ()
 
@@ -265,9 +310,13 @@ def default_schedule(seed: int = 7, cycles: int = 220) -> Schedule:
     u = max(cycles // 11, 5)        # one "act" of the run
     ps = lambda: rng.randrange(10_000)
     events = [
-        # act 1: warm the serving pool so handoff windows exist
+        # act 1: warm the serving pool so handoff windows exist; a
+        # LoRA wave right behind it makes an adapter resident, so the
+        # later storm has something real to evict
         FaultEvent(id="warm-burst", kind="burst", at_cycle=1,
                    n=6, prompt_seed=ps()),
+        FaultEvent(id="adapter-warm-burst", kind="burst", at_cycle=3,
+                   n=4, prompt_seed=ps(), adapter="lora-a"),
         # act 2: chip death evicts a mid-gang worker; a SECOND chip
         # dies inside the resulting REFORM window (the classic
         # chip-death-mid-REFORM double fault)
@@ -317,6 +366,16 @@ def default_schedule(seed: int = 7, cycles: int = 220) -> Schedule:
         FaultEvent(id="chip1-while-parked", kind="chip_kill",
                    window="parked:lo", after_cycle=3 * u, chip=1,
                    heal_after=u),
+        # act 3.5: the decode pool's adapter slots are seized down to
+        # one (every cold adapter evicted), and a DIFFERENT adapter's
+        # burst lands inside the starvation window — its fills must
+        # serialize through the surviving slot or hold at prefill,
+        # then cold-load back byte-exact once the storm lifts
+        FaultEvent(id="adapter-storm", kind="adapter_evict_storm",
+                   at_cycle=5 * u, replica_glob="d*", heal_after=3),
+        FaultEvent(id="adapter-burst-in-storm", kind="burst",
+                   window="adapter_pressure:hi", after_cycle=5 * u,
+                   n=4, prompt_seed=ps(), adapter="lora-b"),
         # act 4: in-band gang faults on their own arcs
         FaultEvent(id="mid-crash-w1", kind="worker_crash",
                    at_cycle=6 * u, gang="mid", row=1),
@@ -434,6 +493,9 @@ class CrucibleRig:
         # replica name -> cycle at which its seized KV blocks release
         self._kv_seized: dict = {}
         self.kv_seizures = 0
+        # replica name -> cycle at which its adapter-pool storm lifts
+        self._adapter_seized: dict = {}
+        self.adapter_storms = 0
         self._build()
 
     # -- construction ----------------------------------------------------
@@ -490,12 +552,17 @@ class CrucibleRig:
                                    if c not in spec["chips"]])
 
         chip_map = {"p0": 6, "d1": 7}
+        # every engine (prefill validators included) carries its own
+        # AdapterPool over the shared seed-deterministic roster, so
+        # adapter'd bursts survive grants, drains and handoffs with
+        # byte-identical weights everywhere
         self.mgr = DisaggReplicaManager(
             lambda name: ServingEngine(_params(), _cfg(), slots=2,
                                        prefix_cache=2,
                                        kv_layout=self.kv_layout,
                                        draft_source=self.draft_source,
-                                       draft_len=self.draft_len),
+                                       draft_len=self.draft_len,
+                                       adapter_pool=_adapter_pool()),
             prefill_replicas=1, decode_replicas=1,
             chip_of=chip_map.get,
             health_source=self.ledger.current_unhealthy,
@@ -587,6 +654,8 @@ class CrucibleRig:
             w.add("cascade")
         if self._kv_seized:
             w.add("kv_pressure:hi")
+        if self._adapter_seized:
+            w.add("adapter_pressure:hi")
         self._win_hist.append(frozenset(w))
 
     def _sticky_windows(self) -> set:
@@ -666,6 +735,23 @@ class CrucibleRig:
                 log.info("crucible: %s matched no paged replica "
                          "(glob %s, layout %s); no-op", ev.id, glob,
                          self.kv_layout)
+        elif ev.kind == "adapter_evict_storm":
+            glob = ev.replica_glob or "*"
+            hit = 0
+            for r in self.mgr.replicas:
+                pool = getattr(r.engine, "adapter_pool", None)
+                if pool is None or r.state == "dead":
+                    continue
+                if not fnmatch.fnmatchcase(r.name, glob):
+                    continue
+                pool.seize_to_one()
+                self._adapter_seized[r.name] = (
+                    cycle + (ev.heal_after or 2))
+                hit += 1
+            self.adapter_storms += hit
+            if not hit:
+                log.info("crucible: %s matched no adapter-pooled "
+                         "replica (glob %s); no-op", ev.id, glob)
         elif ev.kind in CORRUPTION_KINDS:
             self._corrupt(ev)
         elif ev.kind == "burst":
@@ -675,8 +761,9 @@ class CrucibleRig:
                 n_tok = 4 + (i % 5)
                 self.gw.submit(Request(
                     uid=uid, prompt=_prompt(ev.prompt_seed + i, n_tok),
-                    max_new=3), slo_s=ev.slo_s)
-                self.submitted[uid] = (ev.prompt_seed + i, n_tok, 3)
+                    max_new=3, adapter=ev.adapter), slo_s=ev.slo_s)
+                self.submitted[uid] = (ev.prompt_seed + i, n_tok, 3,
+                                       ev.adapter)
 
     def _corrupt(self, ev: FaultEvent) -> None:
         """Damage the target gang's NEWEST committed generation on
@@ -743,6 +830,15 @@ class CrucibleRig:
             for r in self.mgr.replicas:
                 if r.name == name and r.state != "dead":
                     r.engine.kv_manager.release_seized()
+                    break
+        # same release-before-inject discipline for adapter storms
+        for name, until in list(self._adapter_seized.items()):
+            if cycle < until:
+                continue
+            del self._adapter_seized[name]
+            for r in self.mgr.replicas:
+                if r.name == name and r.state != "dead":
+                    r.engine.adapter_pool.release_storm()
                     break
         if inject:
             for ev in self.schedule.events:
@@ -825,10 +921,10 @@ class CrucibleRig:
         out = invariants.exactly_once_terminal(
             self.gw, list(self.submitted))
         oracles = {}
-        for uid, (seed, n, max_new) in self.submitted.items():
+        for uid, (seed, n, max_new, adapter) in self.submitted.items():
             g = self.gw.outcomes.get(uid)
             if g is not None and g.status == "finished":
-                oracles[uid] = _oracle(seed, n, max_new)
+                oracles[uid] = _oracle(seed, n, max_new, adapter)
         out += invariants.byte_equal(self.gw.results, oracles)
         for name, sup in self.sups.items():
             out += [f"[{name}] {v}"

@@ -36,6 +36,15 @@ action:
 - **release / regrow** — a calm tenant above entitlement returns
   chips; a gang below its target regrows (priority order, EXPAND
   path) onto a bin-packed ICI-contiguous home.
+- **adapter_evict** — serving tenants may also carry an
+  ``adapter_quota_bytes`` ceiling on resident adapter-HBM
+  (serving_lora/ AdapterPool slots whose manifests bear their tag).
+  An over-quota tenant with COLD (unpinned) residents is evicted
+  back under quota BEFORE any chip action is considered: freeing
+  adapter slots costs no drain, no checkpoint, and touches no
+  decoding request, so it must never escalate into a preemption
+  cascade.  A fully pinned over-quota pool is left alone until pins
+  drop (the check gates on cold-evictable bytes — no livelock).
 
 Floors are invariant: no reclaim ever takes a tenant below
 ``max(floor, entitlement)``, and entitlements never fall below
@@ -70,6 +79,7 @@ RECLAIM_SHRINK = "reclaim_shrink"
 RECLAIM_DRAIN = "reclaim_drain"
 RELEASE = "release"
 REGROW = "regrow"
+ADAPTER_EVICT = "adapter_evict"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -81,6 +91,11 @@ class TenantSpec:
     quota: int                  # chip ceiling (bursts stop here)
     floor: int = 0              # guaranteed chips, never reclaimed
     share: float = 1.0          # burstable weight within the class
+    # resident adapter-HBM ceiling (serving_lora/ AdapterPool slots
+    # whose manifests carry this tenant's tag), enforced through the
+    # arbiter tick by evicting the tenant's COLD adapters — never a
+    # chip action.  None = unlimited.
+    adapter_quota_bytes: int | None = None
 
     def __post_init__(self):
         if self.floor < 0 or self.quota < self.floor:
@@ -89,6 +104,11 @@ class TenantSpec:
                 f"floor={self.floor} quota={self.quota}")
         if self.share <= 0:
             raise ValueError(f"tenant {self.name}: share must be > 0")
+        if (self.adapter_quota_bytes is not None
+                and self.adapter_quota_bytes < 0):
+            raise ValueError(
+                f"tenant {self.name}: adapter_quota_bytes must be "
+                f">= 0, got {self.adapter_quota_bytes}")
 
 
 class ServingTenant:
@@ -103,6 +123,34 @@ class ServingTenant:
     def chips(self) -> set:
         return {r.chip for r in self.manager.replicas
                 if r.state != "dead" and r.chip is not None}
+
+    # -- adapter-HBM accounting (serving_lora/) -------------------
+
+    def adapter_pools(self) -> list:
+        """Every live replica's AdapterPool (engines without one are
+        skipped — a mixed pool accounts only what exists)."""
+        out = []
+        for r in self.manager.replicas:
+            if r.state == "dead":
+                continue
+            pool = getattr(getattr(r, "engine", None),
+                           "adapter_pool", None)
+            if pool is not None:
+                out.append(pool)
+        return out
+
+    def adapter_bytes(self, tenant: str) -> int:
+        """Resident adapter-HBM attributed to ``tenant``'s manifests
+        across this workload's pools — the quota numerator."""
+        return sum(p.resident_bytes(tenant)
+                   for p in self.adapter_pools())
+
+    def adapter_cold_bytes(self, tenant: str) -> int:
+        """The COLD (refcount==1, evictable without touching a
+        decoding request) portion of :meth:`adapter_bytes` — what an
+        ``adapter_evict`` action can actually reclaim this tick."""
+        return sum(len(p.cold_names(tenant)) * p.bytes_per_slot
+                   for p in self.adapter_pools())
 
 
 class TrainingTenant:
@@ -179,6 +227,8 @@ class TenantState:
     gang_dp: int = 0             # training only
     gang_tp: int = 1             # training only
     parked: bool = False         # training only
+    adapter_bytes: int = 0       # serving only (serving_lora/)
+    adapter_cold_bytes: int = 0  # evictable portion of the above
 
     @property
     def held(self) -> int:
@@ -257,6 +307,19 @@ class FairShareArbiter:
         claim_order = sorted(
             states, key=lambda s: (s.spec.priority, s.spec.name),
             reverse=True)
+        # 0. adapter-quota enforcement BEFORE any chip action, lowest
+        #    class first (reclaim order): an over-quota tenant's COLD
+        #    adapters free HBM without draining a replica or touching
+        #    a decoding pin, so they go before any preemption cascade
+        #    sees the fleet.  Gated on cold-evictable bytes — a fully
+        #    pinned over-quota pool has nothing to give this tick and
+        #    must not livelock the one-action-per-tick budget.
+        for s in reversed(claim_order):
+            quota = s.spec.adapter_quota_bytes
+            if (s.kind == SERVING and quota is not None
+                    and s.adapter_bytes > quota
+                    and s.adapter_cold_bytes > 0):
+                return MtAction(ADAPTER_EVICT, tenant=s.spec.name)
         # 1. pressure grants, highest class first; a blocked grant
         #    turns into one cascade step against the lowest class
         for s in claim_order:
@@ -423,10 +486,12 @@ class MultiTenantReconciler:
             held = len(w.chips())
             wanted = (spec.quota if hot
                       else spec.floor if calm else held)
-            return TenantState(spec=spec, kind=SERVING,
-                               chips=frozenset(w.chips()),
-                               wanted=max(wanted, spec.floor),
-                               pressured=hot, calm=calm)
+            return TenantState(
+                spec=spec, kind=SERVING, chips=frozenset(w.chips()),
+                wanted=max(wanted, spec.floor),
+                pressured=hot, calm=calm,
+                adapter_bytes=w.adapter_bytes(spec.name),
+                adapter_cold_bytes=w.adapter_cold_bytes(spec.name))
         sup = w.supervisor
         return TenantState(
             spec=spec, kind=TRAINING, chips=frozenset(w.chips()),
@@ -558,6 +623,21 @@ class MultiTenantReconciler:
                                chip=victim.chip)
                 return [a.kind]
             return []
+        if a.kind == ADAPTER_EVICT:
+            quota = self.registry.spec(a.tenant).adapter_quota_bytes
+            evicted: list[str] = []
+            for pool in w.adapter_pools():
+                for name in pool.cold_names(a.tenant):
+                    if w.adapter_bytes(a.tenant) <= (quota or 0):
+                        break
+                    if pool.evict(name):
+                        evicted.append(name)
+            if not evicted:
+                return []
+            self._mt_event(now, a, adapters=evicted)
+            log.info("mt: adapter quota evict %s: %s", a.tenant,
+                     evicted)
+            return [ADAPTER_EVICT]
         if a.kind == REGROW:
             if a.run is None:
                 return []
@@ -593,6 +673,9 @@ class MultiTenantReconciler:
             self.metrics.tenant_chips.labels(tenant=name).set(s.held)
             self.metrics.tenant_entitled.labels(tenant=name).set(
                 self.arbiter.entitled.get(name, 0))
+            if s.kind == SERVING:
+                self.metrics.tenant_adapter_bytes.labels(
+                    tenant=name).set(s.adapter_bytes)
         free = len(self.ledger.healthy_free())
         self.metrics.chips.labels(owner="free").set(free)
         self.metrics.chips.labels(owner="unhealthy").set(
@@ -613,7 +696,8 @@ class MultiTenantReconciler:
                    for s in states) / total
 
 
-__all__ = ["FairShareArbiter", "GRANT", "MtAction", "MtConfig",
+__all__ = ["ADAPTER_EVICT", "FairShareArbiter", "GRANT", "MtAction",
+           "MtConfig",
            "MultiTenantReconciler", "RECLAIM_DRAIN", "RECLAIM_PARK",
            "RECLAIM_SHRINK", "REGROW", "RELEASE", "ServingTenant",
            "TenantRegistry", "TenantSpec", "TenantState",

@@ -143,6 +143,10 @@ class FleetGateway:
         # advances by deltas (a replaced replica's name never recurs
         # — ReplicaManager names are generation-fresh)
         self._kv_evictions_seen: dict[str, int] = {}
+        # adapter churn counter fold (serving_lora/): last seen
+        # (cold_loads_total, evictions_total) per replica, same
+        # delta-fold pattern as _kv_evictions_seen
+        self._adapter_counts_seen: dict[str, tuple[int, int]] = {}
         #: per-replica speculative accept-rate EWMAs — the router's
         #: accept-aware preference signal, smoothed here (not in the
         #: engine) so a single cold window cannot flip placement
@@ -290,6 +294,7 @@ class FleetGateway:
             self.metrics.replicas.labels(state=state).set(n)
         self._fold_kv_occupancy()
         self._fold_spec_accept()
+        self._fold_adapter_occupancy()
         self._drain_migrations()
         if self.burn is not None:
             # close the burn-rate cycle AFTER this step's terminal
@@ -322,8 +327,11 @@ class FleetGateway:
             # attribute-hint to the router (the last_reason idiom in
             # reverse): deadline-bearing requests prefer high-accept
             # replicas at equal depth; best-effort traffic keeps the
-            # plain spill ordering
+            # plain spill ordering.  The adapter hint gates
+            # candidates on residency/headroom and makes warm
+            # replicas win the spill tie.
             self.router.slo_tight = g.deadline_s != float("inf")
+            self.router.adapter = getattr(g.request, "adapter", None)
             if self.tracer is None:
                 route_s = 0.0
                 target = self.router.route(g.request.prompt,
@@ -561,6 +569,40 @@ class FleetGateway:
                 if total > seen:
                     self.metrics.kv_block_evictions.inc(total - seen)
                     self._kv_evictions_seen[r.name] = total
+
+    def _fold_adapter_occupancy(self) -> None:
+        """Fold every multi-adapter replica's pool levels and churn
+        counters into the registry, once per pump step — the
+        serving_lora twin of ``_fold_kv_occupancy``: residency and
+        free-slot gauges are levels, cold-loads/evictions fold as
+        counter deltas against the last-seen totals.  Replicas
+        without the adapter signal are skipped (degrade contract)."""
+        for r in self.manager.replicas:
+            if r.state == DEAD:
+                continue
+            occ = r.occupancy()
+            if "adapter_pool_slots" not in occ:
+                continue
+            if self.memwatch is not None and \
+                    "kv_free_blocks" not in occ:
+                # paged replicas were already accounted by the KV
+                # fold; this covers contiguous engines with a pool
+                self.memwatch.account_engine(r.engine, unit=r.name)
+            self.metrics.adapter_residents.labels(
+                replica=r.name).set(len(occ["adapter_resident"]))
+            self.metrics.adapter_pool_blocks_free.labels(
+                replica=r.name).set(occ["adapter_free_slots"])
+            pool = getattr(r.engine, "adapter_pool", None)
+            if pool is None:
+                continue
+            cold, evic = (pool.cold_loads_total,
+                          pool.evictions_total)
+            seen = self._adapter_counts_seen.get(r.name, (0, 0))
+            if cold > seen[0]:
+                self.metrics.adapter_cold_loads.inc(cold - seen[0])
+            if evic > seen[1]:
+                self.metrics.adapter_evictions.inc(evic - seen[1])
+            self._adapter_counts_seen[r.name] = (cold, evic)
 
     def _fold_spec_accept(self) -> None:
         """Fold each speculative replica's draft accept rate into a

@@ -47,7 +47,7 @@ TRACE_NAMES = ("bursty", "diurnal", "heavy_tail")
 #: tests/test_bench_smoke.py so a drifting fixture fails CI)
 TRACE_SCHEMA_KEYS = frozenset(
     {"name", "kind", "seed", "n", "unit_mean", "interarrivals",
-     "tenants", "note"})
+     "tenants", "adapters", "note"})
 
 _FIXTURE_SEEDS = {"bursty": 7, "diurnal": 11, "heavy_tail": 13}
 _FIXTURE_N = 96
@@ -59,6 +59,14 @@ _FIXTURE_N = 96
 #: no arrival time in any fixture
 _TENANT_LABELS = ("a", "b", "c")
 _TENANT_WEIGHTS = (0.5, 0.3, 0.2)
+
+#: per-arrival adapter tags (multi-adapter serving, serving_lora/):
+#: a base-model majority plus three LoRA labels with a fixed skew —
+#: drawn AFTER the tenants from the same seeded stream, so adding
+#: them changed no arrival time and no tenant tag in any fixture.
+#: ``"base"`` means Request.adapter=None at replay.
+_ADAPTER_LABELS = ("base", "lora-a", "lora-b", "lora-c")
+_ADAPTER_WEIGHTS = (0.4, 0.3, 0.2, 0.1)
 
 
 def generate_trace(name: str, n: int = _FIXTURE_N,
@@ -88,6 +96,8 @@ def generate_trace(name: str, n: int = _FIXTURE_N,
     arr = arr / arr.mean()          # unit mean: offered_x is exact
     tenants = [str(t) for t in rng.choice(
         _TENANT_LABELS, size=n, p=_TENANT_WEIGHTS)]
+    adapters = [str(a) for a in rng.choice(
+        _ADAPTER_LABELS, size=n, p=_ADAPTER_WEIGHTS)]
     return {
         "name": name,
         "kind": "interarrival",
@@ -96,10 +106,13 @@ def generate_trace(name: str, n: int = _FIXTURE_N,
         "unit_mean": 1.0,
         "interarrivals": [round(float(g), 6) for g in arr],
         "tenants": tenants,
+        "adapters": adapters,
         "note": ("unit-mean normalized interarrivals; replay scales "
                  "by offered_x * calibrated base_rps "
                  "(gateway/calibrate.py); per-arrival tenant tags "
-                 "skewed 0.5/0.3/0.2; regenerable via "
+                 "skewed 0.5/0.3/0.2; adapter tags skewed "
+                 "0.4/0.3/0.2/0.1 with 'base' = no adapter; "
+                 "regenerable via "
                  f"generate_trace({name!r})"),
     }
 

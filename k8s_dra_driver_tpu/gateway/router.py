@@ -48,6 +48,20 @@ lower expected latency).  The rate is decile-quantized first
 (``_accept_bucket``) so EWMA jitter cannot thrash placement, and
 replicas without the signal bucket to 0 — an all-plain pool keeps
 the exact old ordering (degrade, never invent).
+
+**Adapter residency** (serving_lora/): occupancy from a multi-adapter
+replica carries ``adapter_resident`` (warm adapter names) and
+``adapter_headroom_slots`` (pool slots claimable without touching a
+decoding pin).  A replica that neither holds the request's adapter
+nor has a claimable slot is not a candidate (:func:`adapter_admits` —
+routing there would head-of-line-block its refill); among candidates,
+a replica where the adapter is already RESIDENT wins the spill tie
+right after queue depth, so repeat-adapter traffic lands warm and a
+miss cold-loads on the least-loaded eligible replica — asynchronously
+inside that engine's refill round, never as a synchronous stall in
+the gateway pump.  The gateway sets ``router.adapter`` before each
+route (the ``slo_tight`` hint idiom); replicas without the signal are
+always admissible and score no bonus (degrade, never invent).
 """
 
 from __future__ import annotations
@@ -74,6 +88,12 @@ class Router:
     #: letting spill ties prefer high-spec-accept replicas without
     #: widening the route() signature every policy implements.
     slo_tight: bool = False
+
+    #: the request's adapter name (serving_lora/), set by the caller
+    #: before route() like ``slo_tight``; None = base model.  Gates
+    #: candidates through :func:`adapter_admits` and makes resident
+    #: replicas win spill ties right after depth.
+    adapter: str | None = None
 
     def route(self, prompt: np.ndarray, replicas: list):
         raise NotImplementedError
@@ -111,6 +131,31 @@ def _headroom(replica) -> float:
     return replica.occupancy().get("kv_headroom_blocks", float("inf"))
 
 
+def adapter_admits(replica, adapter) -> bool:
+    """Whether the replica can serve ``adapter``: resident, or one
+    pool slot claimable without touching a decoding pin.  True for
+    base requests and for replicas reporting no adapter signal
+    (adapter-less engine or remote stub) — the gate degrades, it
+    never invents pressure."""
+    if adapter is None:
+        return True
+    occ = replica.occupancy()
+    if "adapter_headroom_slots" not in occ:
+        return True
+    return (adapter in occ.get("adapter_resident", ())
+            or occ["adapter_headroom_slots"] >= 1)
+
+
+def _adapter_resident(replica, adapter) -> int:
+    """1 when the request's adapter is warm on this replica — the
+    spill tiebreak right after depth (resident wins; a miss lands on
+    the least-loaded eligible replica and cold-loads there)."""
+    if adapter is None:
+        return 0
+    occ = replica.occupancy()
+    return int(adapter in occ.get("adapter_resident", ()))
+
+
 def _accept_bucket(replica) -> int:
     """Decile-quantized speculative accept rate (0..10); 0 when the
     replica reports none — quantization keeps EWMA jitter from
@@ -122,20 +167,24 @@ def _accept_bucket(replica) -> int:
     return int(min(max(float(rate), 0.0), 1.0) * 10)
 
 
-def _spill_key(replica, slo_tight: bool = False):
-    """Least depth, then (SLO-tight requests only) HIGHEST spec
-    accept bucket, then MOST KV headroom, then name order — the
-    memory-pressure-aware tiebreak: at equal load, deadline-bearing
-    work lands where speculation currently pays off, and new work
-    lands where eviction/preemption is least likely."""
+def _spill_key(replica, slo_tight: bool = False, adapter=None):
+    """Least depth, then adapter residency (warm wins), then
+    (SLO-tight requests only) HIGHEST spec accept bucket, then MOST
+    KV headroom, then name order — the memory-pressure-aware
+    tiebreak: at equal load, adapter traffic lands where its weights
+    are warm, deadline-bearing work lands where speculation
+    currently pays off, and new work lands where eviction/preemption
+    is least likely."""
     return (_depth(replica),
+            -_adapter_resident(replica, adapter),
             -(_accept_bucket(replica) if slo_tight else 0),
             -_headroom(replica), replica.name)
 
 
-def _candidates(prompt, replicas) -> list:
+def _candidates(prompt, replicas, adapter=None) -> list:
     return [r for r in replicas
-            if r.ready and _under_bound(r) and kv_admits(r, prompt)]
+            if r.ready and _under_bound(r) and kv_admits(r, prompt)
+            and adapter_admits(r, adapter)]
 
 
 class LeastLoadedRouter(Router):
@@ -144,11 +193,12 @@ class LeastLoadedRouter(Router):
     last_reason = "least_loaded"
 
     def route(self, prompt, replicas):
-        ready = _candidates(prompt, replicas)
+        ready = _candidates(prompt, replicas, self.adapter)
         if not ready:
             return None
         return min(ready,
-                   key=lambda r: _spill_key(r, self.slo_tight))
+                   key=lambda r: _spill_key(r, self.slo_tight,
+                                            self.adapter))
 
 
 class RoundRobinRouter(Router):
@@ -160,7 +210,7 @@ class RoundRobinRouter(Router):
         self._i = 0
 
     def route(self, prompt, replicas):
-        ready = _candidates(prompt, replicas)
+        ready = _candidates(prompt, replicas, self.adapter)
         if not ready:
             return None
         pick = ready[self._i % len(ready)]
@@ -196,21 +246,23 @@ class PrefixAffinityRouter(Router):
 
     def route(self, prompt, replicas):
         prompt = np.asarray(prompt, np.int32)
-        ready = _candidates(prompt, replicas)
+        ready = _candidates(prompt, replicas, self.adapter)
         if not ready:
             return None
         scored = [(self._affinity(prompt, r), r) for r in ready]
         best, _ = max(scored, key=lambda s: s[0])
         if best >= self.min_affinity:
             # deterministic among equals: deepest affinity, then the
-            # memory-aware spill key (least depth, accept bucket for
-            # SLO-tight requests, most KV headroom)
+            # memory-aware spill key (least depth, adapter residency,
+            # accept bucket for SLO-tight requests, most KV headroom)
             pick = min((r for a, r in scored if a == best),
-                       key=lambda r: _spill_key(r, self.slo_tight))
+                       key=lambda r: _spill_key(r, self.slo_tight,
+                                                self.adapter))
             self.last_reason = "affinity"
         else:
             pick = min(ready,
-                       key=lambda r: _spill_key(r, self.slo_tight))
+                       key=lambda r: _spill_key(r, self.slo_tight,
+                                                self.adapter))
             self.last_reason = "spill"
         hist = self._routed.setdefault(pick.name,
                                        deque(maxlen=self.history))
@@ -225,4 +277,4 @@ class PrefixAffinityRouter(Router):
 
 
 __all__ = ["Router", "LeastLoadedRouter", "RoundRobinRouter",
-           "PrefixAffinityRouter", "kv_admits"]
+           "PrefixAffinityRouter", "kv_admits", "adapter_admits"]

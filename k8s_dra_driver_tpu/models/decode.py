@@ -289,17 +289,32 @@ def _with_layers(params: Params, cfg: TransformerConfig) -> Params:
 
 
 def _project_and_write(layer, x, positions, cfg, k_cache, v_cache,
-                       ks_in, vs_in, write):
+                       ks_in, vs_in, write, lora=None):
     """Shared per-layer front half of cached decoding: q/k/v
     projections + RoPE at ``positions`` ([T] shared or [B,T] per-row),
     optional int8 quantization, and cache writes through ``write`` —
     the ONLY part that differs between the aligned path
     (forward_with_cache, scalar pos) and the continuous-batching path
     (decode_step_rows, per-row pos) is the write offset and position
-    shape, so both paths share this body and cannot drift."""
+    shape, so both paths share this body and cannot drift.
+
+    ``lora`` is one layer's slice of the per-row adapter gather
+    (serving_lora/): ``(slots [B], aq [S,d,r], bq [S,r,H,K], ao, bo)``
+    — each row adds its adapter's low-rank wq delta ``h@A@B`` before
+    RoPE, gathered from the pooled buffers by table index (the paged
+    ``pool[tables]`` pattern).  Slot 0 is the pinned null adapter
+    (zero A/B), so base rows pay one masked add and the base trace is
+    untouched when ``lora is None``.  K/V projections carry NO
+    adapter by design: prompt K/V and prefix sharing stay
+    adapter-independent (serving_lora/pool.py LORA_TARGETS)."""
     h = rms_norm(x, layer["ln1"])
-    q = rotary(ein("btd,dhk->bthk", h, layer["wq"]), positions,
-               cfg.rope_theta)
+    q_raw = ein("btd,dhk->bthk", h, layer["wq"])
+    if lora is not None:
+        slots, aq, bq = lora[0], lora[1], lora[2]
+        q_raw = q_raw + ein("btr,brhk->bthk",
+                            ein("btd,bdr->btr", h, aq[slots]),
+                            bq[slots])
+    q = rotary(q_raw, positions, cfg.rope_theta)
     k = rotary(ein("btd,dhk->bthk", h, layer["wk"]), positions,
                cfg.rope_theta)
     v = ein("btd,dhk->bthk", h, layer["wv"])
@@ -317,10 +332,18 @@ def _project_and_write(layer, x, positions, cfg, k_cache, v_cache,
     return q, k, v, k_cache, v_cache, ks_cache, vs_cache
 
 
-def _attn_mlp_tail(x, o, layer, cfg):
+def _attn_mlp_tail(x, o, layer, cfg, lora=None):
     """Shared per-layer back half: attention output projection +
-    residual + MLP (dense or serving-config MoE)."""
-    x = x + ein("bthk,hkd->btd", o, layer["wo"])
+    residual + MLP (dense or serving-config MoE).  ``lora`` adds the
+    per-row wo delta ``o@A@B`` to the projection (same gather
+    contract as ``_project_and_write``)."""
+    proj = ein("bthk,hkd->btd", o, layer["wo"])
+    if lora is not None:
+        slots, ao, bo = lora[0], lora[3], lora[4]
+        proj = proj + ein("btr,brd->btd",
+                          ein("bthk,bhkr->btr", o, ao[slots]),
+                          bo[slots])
+    x = x + proj
     mlp_in = rms_norm(x, layer["ln2"])
     if cfg.is_moe:
         return x + _moe_mlp(mlp_in, layer, _serving_cfg(cfg))
@@ -329,13 +352,19 @@ def _attn_mlp_tail(x, o, layer, cfg):
 
 def _rows_forward(params: Params, tokens: jax.Array,
                   cfg: TransformerConfig, cache: KVCache,
-                  pos_rows: jax.Array
+                  pos_rows: jax.Array, lora=None
                   ) -> tuple[jax.Array, KVCache]:
     """tokens [B, T] appended at PER-ROW positions -> (logits
     [B, T, vocab], cache).  The shared body behind decode_step_rows
     (T=1) and decode_window_rows (T=draft_len+1): ``cache.pos`` is
     ignored — the caller owns per-slot positions; writes land at each
-    row's own offset and attention masks per row and position."""
+    row's own offset and attention masks per row and position.
+
+    ``lora`` is ``(slots [B] int32, layers)`` with ``layers[i] =
+    (aq, bq, ao, bo)`` pooled adapter buffers (serving_lora/): each
+    row gathers its adapter's low-rank delta by slot index inside
+    the SAME trace, so heterogeneous-adapter batches stay one static
+    dispatch."""
     params = _with_layers(params, cfg)
     b, t = tokens.shape
     positions = pos_rows[:, None] + jnp.arange(t)[None]  # [B, T]
@@ -351,12 +380,13 @@ def _rows_forward(params: Params, tokens: jax.Array,
 
     for i, (layer, k_cache, v_cache) in enumerate(
             zip(params["layers"], cache.k, cache.v)):
+        lr = None if lora is None else (lora[0],) + tuple(lora[1][i])
         (q, k, v, k_cache, v_cache, ks_cache, vs_cache) = \
             _project_and_write(layer, x, positions, cfg, k_cache,
                                v_cache,
                                cache.k_scale[i] if quantized else None,
                                cache.v_scale[i] if quantized else None,
-                               write_rows)
+                               write_rows, lora=lr)
         if quantized:
             new_ks.append(ks_cache)
             new_vs.append(vs_cache)
@@ -364,7 +394,7 @@ def _rows_forward(params: Params, tokens: jax.Array,
         new_v.append(v_cache)
         o = _cached_attention(q, k_cache, v_cache, pos_rows, t, cfg,
                               ks_cache, vs_cache)
-        x = _attn_mlp_tail(x, o, layer, cfg)
+        x = _attn_mlp_tail(x, o, layer, cfg, lora=lr)
     x = rms_norm(x, params["ln_f"])
     logits = ein("btd,dv->btv", x, params["unembed"])
     cache = KVCache(k=new_k, v=new_v, pos=cache.pos,
@@ -377,7 +407,7 @@ def _rows_forward(params: Params, tokens: jax.Array,
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
 def decode_step_rows(params: Params, token: jax.Array,
                      cfg: TransformerConfig, cache: KVCache,
-                     pos_rows: jax.Array
+                     pos_rows: jax.Array, lora=None
                      ) -> tuple[jax.Array, KVCache]:
     """One decode step with PER-ROW positions: token [B, 1], pos_rows
     [B] int32 (each slot's fill depth) -> (logits [B, vocab], cache).
@@ -390,7 +420,8 @@ def decode_step_rows(params: Params, token: jax.Array,
     if t != 1:
         raise ValueError(f"decode_step_rows is one token per slot, "
                          f"got T={t}")
-    logits, cache = _rows_forward(params, token, cfg, cache, pos_rows)
+    logits, cache = _rows_forward(params, token, cfg, cache, pos_rows,
+                                  lora)
     return logits[:, 0], cache
 
 
@@ -398,7 +429,7 @@ def decode_step_rows(params: Params, token: jax.Array,
 @functools.partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
 def decode_window_rows(params: Params, tokens: jax.Array,
                        cfg: TransformerConfig, cache: KVCache,
-                       pos_rows: jax.Array
+                       pos_rows: jax.Array, lora=None
                        ) -> tuple[jax.Array, KVCache]:
     """Multi-token per-row step: tokens [B, K] appended at each
     row's own position -> (logits [B, K, vocab], cache).
@@ -409,7 +440,8 @@ def decode_window_rows(params: Params, tokens: jax.Array,
     stay in the cache but are position-masked and overwritten by the
     next window at the same offsets (the ``speculative_generate``
     rollback trick, row-wise)."""
-    logits, cache = _rows_forward(params, tokens, cfg, cache, pos_rows)
+    logits, cache = _rows_forward(params, tokens, cfg, cache, pos_rows,
+                                  lora)
     return logits, cache
 
 
@@ -572,7 +604,7 @@ def decode_fused_rows(params: Params, last: jax.Array,
                       pos_rows: jax.Array, k: int, keys: jax.Array,
                       temps: jax.Array, budget: jax.Array,
                       eos: jax.Array, top_k: int = 0,
-                      top_p: float = 0.0
+                      top_p: float = 0.0, lora=None
                       ) -> tuple[jax.Array, jax.Array, KVCache,
                                  jax.Array]:
     """The on-device generation block: up to ``k`` per-row decode
@@ -619,7 +651,7 @@ def decode_fused_rows(params: Params, last: jax.Array,
     def body(carry):
         j, done, last, cache, pos, keys, emitted, toks = carry
         logits, cache = _rows_forward(params, last[:, None], cfg,
-                                      cache, pos)
+                                      cache, pos, lora)
         nxt, new_keys = select_next_tokens(logits[:, 0], keys, temps,
                                            top_k, top_p)
         alive = ~done
@@ -844,7 +876,7 @@ def decode_spec_fused_rows(params: Params, last: jax.Array,
                            draft_cache: KVCache | None,
                            draft_keys: jax.Array | None,
                            draft_len: int, top_k: int = 0,
-                           top_p: float = 0.0):
+                           top_p: float = 0.0, lora=None):
     """Speculation INSIDE the fused generation block: a donated-
     buffer ``lax.while_loop`` of up to ``k`` speculative windows per
     row — each iteration drafts ``draft_len`` proposals (draft model
@@ -903,8 +935,11 @@ def decode_spec_fused_rows(params: Params, last: jax.Array,
             q_probs = jax.nn.one_hot(proposals, cfg.vocab,
                                      dtype=jnp.float32)
         window = jnp.concatenate([last[:, None], proposals], axis=1)
+        # the draft stays base-model (a wrong draft only lowers the
+        # accept rate); the TARGET scoring carries each row's adapter,
+        # so verify-accept is exact against the adapter'd model
         logits, cache = _rows_forward(params, window, cfg, cache,
-                                      pos)
+                                      pos, lora)
         emit, a, new_keys = _spec_accept_body(
             logits, proposals, q_probs, keys, temps, top_k, top_p)
         alive = ~done
@@ -991,7 +1026,7 @@ def _paged_dense(pool_arr, tables):
 
 
 def _paged_rows_forward(params, tokens, cfg, pool, tables, pos_rows,
-                        use_kernel):
+                        use_kernel, lora=None):
     """tokens [B, T] appended at per-row positions into the block
     pool -> (logits [B, T, vocab], pool).  The paged twin of
     ``_rows_forward``: each token's write lands at
@@ -1022,11 +1057,12 @@ def _paged_rows_forward(params, tokens, cfg, pool, tables, pos_rows,
             dst = dst.at[phys[i], off[i]].set(new[:, i])
         return dst
 
-    for layer, k_pool, v_pool in zip(params["layers"], pool.k,
-                                     pool.v):
+    for i, (layer, k_pool, v_pool) in enumerate(
+            zip(params["layers"], pool.k, pool.v)):
+        lr = None if lora is None else (lora[0],) + tuple(lora[1][i])
         (q, k, v, k_pool, v_pool, _, _) = _project_and_write(
             layer, x, positions, cfg, k_pool, v_pool, None, None,
-            write_pool)
+            write_pool, lora=lr)
         new_k.append(k_pool)
         new_v.append(v_pool)
         if use_kernel:
@@ -1037,7 +1073,7 @@ def _paged_rows_forward(params, tokens, cfg, pool, tables, pos_rows,
             o = _cached_attention(q, _paged_dense(k_pool, tables),
                                   _paged_dense(v_pool, tables),
                                   pos_rows, t, cfg)
-        x = _attn_mlp_tail(x, o, layer, cfg)
+        x = _attn_mlp_tail(x, o, layer, cfg, lora=lr)
     x = rms_norm(x, params["ln_f"])
     logits = ein("btd,dv->btv", x, params["unembed"])
     return logits, KVCache(k=new_k, v=new_v, pos=pool.pos)
@@ -1049,7 +1085,7 @@ def _paged_rows_forward(params, tokens, cfg, pool, tables, pos_rows,
 def paged_decode_step_rows(params: Params, token: jax.Array,
                            cfg: TransformerConfig, pool: KVCache,
                            tables: jax.Array, pos_rows: jax.Array,
-                           use_kernel: bool = False
+                           use_kernel: bool = False, lora=None
                            ) -> tuple[jax.Array, KVCache]:
     """One paged decode step: token [B, 1], tables [B, n_pages]
     int32, pos_rows [B] -> (logits [B, vocab], pool).  The pool is
@@ -1062,7 +1098,8 @@ def paged_decode_step_rows(params: Params, token: jax.Array,
         raise ValueError(f"paged_decode_step_rows is one token per "
                          f"slot, got T={t}")
     logits, pool = _paged_rows_forward(params, token, cfg, pool,
-                                       tables, pos_rows, use_kernel)
+                                       tables, pos_rows, use_kernel,
+                                       lora)
     return logits[:, 0], pool
 
 
@@ -1071,8 +1108,8 @@ def paged_decode_step_rows(params: Params, token: jax.Array,
                    donate_argnums=(3,))
 def paged_window_rows(params: Params, tokens: jax.Array,
                       cfg: TransformerConfig, pool: KVCache,
-                      tables: jax.Array, pos_rows: jax.Array
-                      ) -> tuple[jax.Array, KVCache]:
+                      tables: jax.Array, pos_rows: jax.Array,
+                      lora=None) -> tuple[jax.Array, KVCache]:
     """Multi-token paged step: tokens [B, K+1] appended at each
     row's own position through its block table -> (logits
     [B, K+1, vocab], pool).  The paged twin of
@@ -1086,7 +1123,7 @@ def paged_window_rows(params: Params, tokens: jax.Array,
     simply re-targets the same offsets."""
     logits, pool = _paged_rows_forward(params, tokens, cfg, pool,
                                        tables, pos_rows,
-                                       use_kernel=False)
+                                       use_kernel=False, lora=lora)
     return logits, pool
 
 

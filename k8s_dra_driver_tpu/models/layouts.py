@@ -69,4 +69,28 @@ def transformer_rules(cfg) -> tuple[Rule, ...]:
     return tuple(rules + layer)
 
 
-__all__ = ["Rule", "transformer_rules"]
+#: attention leaves a LoRA adapter may target (serving_lora/).  K/V
+#: projections are excluded BY DESIGN: prompt K/V rows and every
+#: prefix-cache/CoW-shared block stay adapter-independent, so paged
+#: prefix sharing keeps working across adapters.
+LORA_TARGETS = ("wq", "wo")
+
+
+def lora_rules(cfg) -> tuple[Rule, ...]:
+    """Layout table for one adapter's low-rank leaves
+    (``layers/<i>/<target>/<A|B>``): the A/B factor whose axis
+    touches a head dimension inherits the base leaf's tp split
+    (wq splits heads on B's dim 1, wo on A's dim 0 — the same axes
+    ``transformer_rules`` splits for the base weights), the
+    rank-``r`` axis always replicates.  First match wins, unmatched
+    adapter leaves are a hard error, exactly as for the base table.
+    """
+    return (
+        (r"wq/A$", P(None, None)),          # [d, r]
+        (r"wq/B$", P(None, "tp", None)),    # [r, H, K] heads on tp
+        (r"wo/A$", P("tp", None, None)),    # [H, K, r] heads on tp
+        (r"wo/B$", P(None, None)),          # [r, d]
+    )
+
+
+__all__ = ["Rule", "transformer_rules", "lora_rules", "LORA_TARGETS"]

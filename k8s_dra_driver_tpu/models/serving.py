@@ -138,6 +138,11 @@ class Request:
     # top_k/top_p are engine-level (static program shape).
     temperature: float = 0.0
     seed: int = 0
+    # adapter name (serving_lora/ AdapterPool manifest), None = base
+    # model.  Prefill stays base-model (prompt K/V and prefix shares
+    # remain adapter-independent); the adapter engages from the first
+    # decode step forward.
+    adapter: str | None = None
 
 
 @dataclasses.dataclass
@@ -451,7 +456,8 @@ class ServingEngine:
                  kv_layout: str = "contiguous",
                  kv_block_size: int = 16,
                  kv_blocks: int | None = None,
-                 kv_kernel: bool | None = None):
+                 kv_kernel: bool | None = None,
+                 adapter_pool=None):
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         if kv_layout not in ("contiguous", "paged"):
@@ -494,6 +500,13 @@ class ServingEngine:
                              "drop draft_params")
         if draft_source == "ngram" and draft_len < 1:
             raise ValueError("draft_len must be >= 1")
+        if adapter_pool is not None:
+            pc = adapter_pool.cfg
+            if ((pc.n_layers, pc.d_model, pc.n_heads, pc.d_head)
+                    != (cfg.n_layers, cfg.d_model, cfg.n_heads,
+                        cfg.d_head)):
+                raise ValueError("adapter pool is laid out for a "
+                                 "different model shape")
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -621,6 +634,18 @@ class ServingEngine:
         # fused program — no per-step key up/downloads)
         self._keys = jnp.tile(jax.random.PRNGKey(0)[None], (slots, 1))
         self._temps = np.zeros(slots, np.float32)
+        # multi-adapter serving (serving_lora/): per-slot pins into
+        # the shared AdapterPool.  _adapter_slot is the host mirror of
+        # the per-row pool-slot-id vector the decode wrappers gather
+        # with; _lora_dev is its lazily rebuilt device twin (the
+        # _table/_table_dev pattern) — binds/releases invalidate it,
+        # steady-state decode skips the per-step upload.  Slot id 0 is
+        # the pool's permanently pinned null adapter, so base rows in
+        # a mixed batch gather a zero delta.
+        self.adapter_pool = adapter_pool
+        self._adapter: list[str | None] = [None] * slots
+        self._adapter_slot = np.zeros(slots, np.int32)
+        self._lora_dev = None
         # lifetime counters (stats())
         self._finished_total = 0
         self._cancelled = 0
@@ -644,6 +669,19 @@ class ServingEngine:
         if req.max_new < 1:
             # same contract as greedy_generate's n_tokens >= 1
             raise ValueError(f"max_new must be >= 1, got {req.max_new}")
+        if getattr(req, "adapter", None) is not None:
+            # adapters must be registered BEFORE traffic names them:
+            # an unknown name at decode time would be a cold-load
+            # KeyError mid-batch instead of a clean intake refusal
+            if self.adapter_pool is None:
+                raise ValueError(
+                    f"request {req.uid!r} names adapter "
+                    f"{req.adapter!r} but this engine has no "
+                    f"adapter pool")
+            if not self.adapter_pool.known(req.adapter):
+                raise ValueError(
+                    f"unknown adapter {req.adapter!r} (register its "
+                    f"manifest with the pool first)")
         # a speculative window's first write is the last emitted
         # token's own row; only the draft_len proposal rows lie past
         # it, so that is the scratch margin the capacity guard
@@ -743,6 +781,17 @@ class ServingEngine:
             # spill key for SLO-tight requests (gateway/router.py)
             out["spec_accept_rate"] = round(
                 self._spec_accepted / max(1, self._spec_drafts), 4)
+        if self.adapter_pool is not None:
+            # the residency-aware routing signal: which adapters are
+            # warm HERE plus how many pool slots a new adapter could
+            # claim without blocking (free + evictable-cold) — what
+            # Router.adapter_admits and its resident-wins tie-break
+            # consume
+            pool = self.adapter_pool
+            out["adapter_resident"] = list(pool.resident())
+            out["adapter_pool_slots"] = pool.n_resident
+            out["adapter_free_slots"] = pool.ledger.free
+            out["adapter_headroom_slots"] = pool.headroom_slots()
         return out
 
     def prefix_peek(self, prompt) -> int:
@@ -846,6 +895,14 @@ class ServingEngine:
                      if self._req[s] is None), None)
         if slot is None:
             raise RuntimeError("no free decode slot to adopt into")
+        if (self.adapter_pool is not None and req.adapter is not None
+                and not self.adapter_pool.can_admit(req.adapter)):
+            # checked BEFORE any state mutates: finalize's acquire
+            # must be infallible, and a storm-seized pool refusing an
+            # adoption here leaves the block with its prefill replica
+            # for retry (the handoff's failure-atomic contract) —
+            # never a torn half-adopted slot
+            raise RuntimeError("no adapter slot to adopt into")
         t0 = time.perf_counter()
         if self._paged:
             self._kv_adopt_into(slot, block, req)
@@ -927,6 +984,7 @@ class ServingEngine:
                 self._tokens_total += len(self._generated[slot])
                 if self._paged:
                     self._kv_release_slot(slot)
+                self._adapter_release(slot)
                 self._req[slot] = None
                 self._generated[slot] = []
                 self._temps[slot] = 0.0
@@ -978,6 +1036,13 @@ class ServingEngine:
             out["speculative_drafts_total"] = self._spec_drafts
             out["spec_accept_rate"] = round(
                 self._spec_accepted / max(1, self._spec_drafts), 4)
+        if self.adapter_pool is not None:
+            pool = self.adapter_pool
+            out["adapter_residents"] = len(pool.resident())
+            out["adapter_pool_slots"] = pool.n_resident
+            out["adapter_hits_total"] = pool.hits_total
+            out["adapter_cold_loads_total"] = pool.cold_loads_total
+            out["adapter_evictions_total"] = pool.evictions_total
         return out
 
     # -- slot lifecycle --------------------------------------------------
@@ -1081,10 +1146,14 @@ class ServingEngine:
     def _fill_finalize(self, slot: int, first: int) -> None:
         """Record the resolved first token for a dispatched fill.
         Every fill/adopt path funnels through here, so it is also
-        where the n-gram draft source snapshots the slot's prompt
-        context (prompt-lookup decoding matches against the PROMPT;
+        where the slot's adapter is pinned in the pool (the refill
+        admission gate made that acquire infallible) and where the
+        n-gram draft source snapshots the slot's prompt context
+        (prompt-lookup decoding matches against the PROMPT;
         generated tokens are not folded in, keeping the context
         static for the whole request)."""
+        if self.adapter_pool is not None:
+            self._adapter_bind(slot)
         self._generated[slot] = [first]
         self._last[slot] = first
         if self._ngram:
@@ -1094,11 +1163,77 @@ class ServingEngine:
             self._ngram_len[slot] = prompt.size
             self._ngram_dev = None
 
+    # -- adapter lifecycle (serving_lora/) -------------------------------
+    #
+    # Pin discipline mirrors paged KV: a slot pins its adapter for
+    # the whole decode (acquire at fill-finalize, release at finish /
+    # cancel / preempt), so eviction pressure can only claim COLD
+    # adapters — a decoding row's weights never vanish under it.
+
+    def _adapter_bind(self, slot: int) -> None:
+        """Pin the slot's adapter and point its row of the slot-id
+        vector at the pinned pool slot (NULL_BLOCK for base
+        requests).  A cold adapter streams in here — a functional
+        ``.at[slot].set`` on the pooled buffers, same shapes, so the
+        decode programs never retrace."""
+        aid = self._req[slot].adapter
+        sid = self.adapter_pool.acquire(aid)
+        self._adapter[slot] = aid
+        if sid != int(self._adapter_slot[slot]):
+            self._adapter_slot[slot] = sid
+            self._lora_dev = None
+
+    def _adapter_release(self, slot: int) -> None:
+        """Drop the slot's pin (the weights stay warm until eviction
+        pressure claims them) and zero its row back to the null
+        adapter."""
+        if self.adapter_pool is None or self._adapter[slot] is None:
+            return
+        self.adapter_pool.release(int(self._adapter_slot[slot]))
+        self._adapter[slot] = None
+        self._adapter_slot[slot] = 0
+        self._lora_dev = None
+
+    def _adapter_admit(self, req: Request, pend: set) -> bool:
+        """Refill-round admission gate.  Every distinct adapter a
+        round pins costs at most one pool slot at finalize time (a
+        resident acquire may pin an evictable slot; a cold one
+        claims a free slot or evicts), so a candidate is admitted
+        only while free+evictable headroom covers the round's
+        distinct adapters — conservative, which makes
+        ``_fill_finalize``'s acquire infallible in ANY acquire
+        order.  A False keeps the request QUEUED at the head (FIFO
+        preserved): shed-not-crash, the kv_exhaust discipline."""
+        if (self.adapter_pool is None or req.adapter is None
+                or req.adapter in pend):
+            return True
+        if self.adapter_pool.headroom_slots() <= len(pend):
+            return False
+        pend.add(req.adapter)
+        return True
+
+    def _lora_args(self):
+        """The decode wrappers' ``lora`` argument: (per-row pool
+        slot ids, pooled buffers), or None without a pool — the None
+        case leaves the base trace byte-identical (the adapter-less
+        regression pin)."""
+        if self.adapter_pool is None:
+            return None
+        if self._lora_dev is None:
+            self._lora_dev = jnp.asarray(self._adapter_slot)
+        return (self._lora_dev, self.adapter_pool.buffers)
+
     def _finish_slot(self, slot: int, out: list[Finished]) -> None:
         req = self._req[slot]
         gen = self._generated[slot]               # eos token kept
+        # finish-time prefix capture is for BASE requests only:
+        # decode-written K/V rows are adapter-dependent through the
+        # residual stream (even with wq/wo-only targets), so an
+        # adapter'd conversation must never seed the shared
+        # adapter-independent prefix store.  Fill-time PROMPT inserts
+        # stay safe everywhere — prefill is base-model.
         if self._paged:
-            if len(gen) > 1:
+            if len(gen) > 1 and req.adapter is None:
                 # finish-time capture is FREE here: the store takes
                 # references on the slot's own blocks — zero copies,
                 # the CoW payoff (_extract_slot's dense twin copies a
@@ -1115,7 +1250,8 @@ class ServingEngine:
                 self._prefix.insert(written, self._slot_blocks[slot],
                                     len(written))
             self._kv_release_slot(slot)
-        elif self._prefix is not None and len(gen) > 1:
+        elif (self._prefix is not None and len(gen) > 1
+                and req.adapter is None):
             # multi-turn reuse: remember the finished conversation's
             # K/V so a follow-up prompt (prompt + generated + new
             # text) adopts the whole history.  Rows written so far =
@@ -1140,6 +1276,7 @@ class ServingEngine:
             self._prefix.insert(
                 written, _extract_slot(self.cache, jnp.int32(slot),
                                        int(self._pos[slot])))
+        self._adapter_release(slot)
         out.append(Finished(
             uid=req.uid,
             tokens=np.concatenate([req.prompt,
@@ -1213,11 +1350,11 @@ class ServingEngine:
             logits, self.pool = _decode.paged_decode_step_rows(
                 self.params, tokens, self.cfg, self.pool,
                 self._table_dev, jnp.asarray(self._pos),
-                self._kv_use_kernel)
+                self._kv_use_kernel, lora=self._lora_args())
         else:
             logits, self.cache = decode_step_rows(
                 self.params, tokens, self.cfg, self.cache,
-                jnp.asarray(self._pos))
+                jnp.asarray(self._pos), lora=self._lora_args())
         if self._temps.any():
             # one fused program merges greedy + sampled rows and
             # advances each sampled slot's key stream exactly as
@@ -1280,7 +1417,8 @@ class ServingEngine:
                 self.params, jnp.asarray(self._last), self.cfg,
                 self.cache, jnp.asarray(self._pos), k, self._keys,
                 jnp.asarray(self._temps), jnp.asarray(budget),
-                jnp.asarray(eos), self.top_k, self.top_p)
+                jnp.asarray(eos), self.top_k, self.top_p,
+                lora=self._lora_args())
         self._time_decode += time.perf_counter() - t_dec
         self._refill(finished)          # overlaps the running block
         t_wait = time.perf_counter()
@@ -1348,7 +1486,8 @@ class ServingEngine:
                 jnp.asarray(self._temps), jnp.asarray(budget),
                 jnp.asarray(eos), ctx, ctx_len, self.draft_params,
                 self.draft_cfg, self._draft_cache, self._draft_keys,
-                kd, self.top_k, self.top_p)
+                kd, self.top_k, self.top_p,
+                lora=self._lora_args())
         self._time_decode += time.perf_counter() - t_dec
         self._refill(finished)          # overlaps the running block
         t_wait = time.perf_counter()
@@ -1391,9 +1530,18 @@ class ServingEngine:
         while self.queue and any(r is None for r in self._req):
             t_fill = time.perf_counter()
             batch = []
+            pend: set = set()      # adapters this round will pin
             for slot in range(self.slots):
                 if self._req[slot] is None and self.queue:
+                    if not self._adapter_admit(self.queue[0], pend):
+                        break
                     batch.append((slot, self.queue.popleft()))
+            if not batch:
+                # head-of-line adapter needs a pool slot and none is
+                # claimable — requests stay queued until a decoding
+                # pin drops (shed-not-crash, never a stall mid-batch)
+                self._time_prefill += time.perf_counter() - t_fill
+                return
             if fused_ok:
                 firsts = self._fill_fused_round(batch)
             else:
@@ -1470,6 +1618,7 @@ class ServingEngine:
         stays exactly-once."""
         self.queue.appendleft(self._req[slot])
         self._kv_release_slot(slot)
+        self._adapter_release(slot)
         self._req[slot] = None
         self._generated[slot] = []
         self._temps[slot] = 0.0
@@ -1567,9 +1716,12 @@ class ServingEngine:
         while self.queue and any(r is None for r in self._req):
             t_fill = time.perf_counter()
             batch = []
+            pend: set = set()      # adapters this round will pin
             for slot in range(self.slots):
                 if self._req[slot] is None and self.queue:
                     if not self._kv_can_admit(self.queue[0]):
+                        break
+                    if not self._adapter_admit(self.queue[0], pend):
                         break
                     batch.append((slot, self.queue.popleft()))
             if not batch:
@@ -1966,10 +2118,11 @@ class ServingEngine:
                 self._table_dev = jnp.asarray(self._table)
             logits, self.pool = _decode.paged_window_rows(
                 self.params, window, self.cfg, self.pool,
-                self._table_dev, pos)
+                self._table_dev, pos, lora=self._lora_args())
         else:
             logits, self.cache = decode_window_rows(
-                self.params, window, self.cfg, self.cache, pos)
+                self.params, window, self.cfg, self.cache, pos,
+                lora=self._lora_args())
         if sampled_mode:
             emit_dev, a_dev, self._keys = spec_accept_rows(
                 logits, proposals, q_probs, self._keys, temps,

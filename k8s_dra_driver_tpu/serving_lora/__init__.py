@@ -1,0 +1,15 @@
+"""Multi-adapter (LoRA) serving: paged adapter pool + utilities.
+
+The production shape for millions of users is one base model plus
+per-tenant fine-tuned adapters (S-LoRA / Punica); this package holds
+the host-side half — the refcounted paged adapter-weight pool and
+its manifests — while the device half (per-row adapter gather inside
+the fused decode loop) lives in ``models/decode.py`` and the engine
+plumbing in ``models/serving.py``.
+"""
+
+from .pool import (AdapterManifest, AdapterPool, adapter_leaves,
+                   checkpoint_source, make_adapter)
+
+__all__ = ["AdapterManifest", "AdapterPool", "adapter_leaves",
+           "checkpoint_source", "make_adapter"]

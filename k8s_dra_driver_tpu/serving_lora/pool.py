@@ -1,0 +1,378 @@
+"""Paged adapter-weight pool: the serving_kv ledger generalized to
+LoRA shards.
+
+One chip serves thousands of adapters but only ``n_resident`` fit in
+HBM at once, so adapter weights get the same treatment PR 14 gave
+K/V: a dumb pooled device buffer per low-rank leaf
+(``[S, ...leaf shape]``, S = n_resident + 1) plus a host-side
+refcounted ledger deciding which adapter owns which slot
+(serving_kv/manager.py ``KVBlockManager`` reused verbatim at
+block_size=1 — a slot is one block).  Slot 0 is the permanently
+pinned NULL adapter: its buffers stay zero forever, so base-model
+rows gather a zero delta and pay one masked add (the S-LoRA /
+Punica batched-heterogeneous shape; the reference driver has no
+serving stack — SURVEY §2.3).
+
+Refcount discipline mirrors paged KV exactly:
+
+- resident          -> refcount 1 (the pool's own reference);
+- pinned (decoding) -> ``acquire`` bumps via ``share``, ``release``
+  drops — a slot with in-flight rows can NEVER be evicted;
+- evictable         -> refcount back to 1 AND not slot 0;
+- eviction          -> LRU cold adapter freed on allocation pressure
+  (watermark = pool exhaustion, the serving_kv cold-entry rule).
+
+Cold-loads stream from the PR 13 sharded-checkpoint format via
+``read_slice`` (``checkpoint_source``) or from an in-memory tree;
+either way leaf names are ``layers/<i>/<wq|wo>/<A|B>`` and are
+validated against the ``models/layouts.py lora_rules`` table —
+adapters are laid out by rule, not by convention.  HBM accounting
+rides ``utils/memwatch.py`` under the ``adapter_pool`` component
+(full reservation: the pool is allocated up front).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable
+
+from ..serving_kv.manager import NULL_BLOCK, BlocksExhausted, \
+    KVBlockManager
+
+__all__ = ["AdapterManifest", "AdapterPool", "adapter_leaves",
+           "checkpoint_source", "make_adapter"]
+
+#: leaf tails per layer, in buffer order — A/B factors for the two
+#: LORA_TARGETS (models/layouts.py): wq delta applies pre-RoPE, wo
+#: delta on the attention output projection
+_LEAF_TAILS = ("wq/A", "wq/B", "wo/A", "wo/B")
+
+
+def adapter_leaves(cfg, rank: int):
+    """Yield ``(layer, leaf_idx, name, shape)`` for every low-rank
+    leaf of one adapter on ``cfg`` — THE single definition of the
+    adapter tree layout (pool buffers, manifests, checkpoints, and
+    the lora_rules validation all walk this)."""
+    d, h, k = cfg.d_model, cfg.n_heads, cfg.d_head
+    shapes = ((d, rank), (rank, h, k), (h, k, rank), (rank, d))
+    for i in range(cfg.n_layers):
+        for j, (tail, shape) in enumerate(zip(_LEAF_TAILS, shapes)):
+            yield i, j, f"layers/{i}/{tail}", shape
+
+
+def make_adapter(cfg, rank: int, seed: int, scale: float = 0.05
+                 ) -> dict:
+    """Deterministic in-memory adapter source: ``{leaf name: array}``
+    with both factors non-zero (a zero B would alias the base model),
+    seeded so tests and the crucible can regenerate byte-identical
+    adapters anywhere."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return {name: (scale * rng.standard_normal(shape)
+                   ).astype(np.float32)
+            for _, _, name, shape in adapter_leaves(cfg, rank)}
+
+
+def checkpoint_source(ckpt, step: int, prefix: str = "params/"
+                      ) -> Callable[[str], Any]:
+    """Streaming cold-load source over a PR 13 sharded checkpoint:
+    each leaf is ONE verified ``read_slice`` (only the shard files
+    overlapping that leaf are opened), so loading one adapter never
+    reads the full checkpoint."""
+    def fetch(name: str):
+        return ckpt.read_slice(int(step), prefix + name)
+    return fetch
+
+
+@dataclasses.dataclass(frozen=True)
+class AdapterManifest:
+    """One registered adapter: identity, ownership, and where its
+    leaves come from.  ``source`` is a ``{leaf name: array}`` dict or
+    a ``fetch(leaf name) -> array`` callable (``checkpoint_source``);
+    registration validates names/shapes, fetch happens at cold-load.
+    """
+
+    name: str
+    rank: int
+    tenant: str = "-"
+    source: Any = None
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("adapter name must be non-empty")
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        if self.source is None:
+            raise ValueError(f"adapter {self.name!r} has no source")
+
+    def fetch(self, leaf: str):
+        if callable(self.source):
+            return self.source(leaf)
+        return self.source[leaf]
+
+
+class AdapterPool:
+    """The paged adapter-weight pool (module docstring).
+
+    Device state is ``buffers``: per layer a 4-tuple of pooled
+    arrays ``(aq [S,d,r], bq [S,r,H,K], ao [S,H,K,r], bo [S,r,d])``
+    that rides into the jitted decode wrappers as the ``lora``
+    argument next to each row's slot id — shapes are static, so
+    cold-loads (functional ``.at[slot].set``) never retrace.
+    """
+
+    def __init__(self, cfg, rank: int, n_resident: int):
+        import jax.numpy as jnp
+
+        from ..models.layouts import lora_rules
+
+        if n_resident < 1:
+            raise ValueError(f"need >= 1 resident adapter slot, got "
+                             f"{n_resident}")
+        self.cfg = cfg
+        self.rank = int(rank)
+        self.n_resident = int(n_resident)
+        # slot ledger: block 0 is the null adapter (NULL_BLOCK,
+        # permanently pinned by the manager itself)
+        self.ledger = KVBlockManager(self.n_resident + 1, 1)
+        s = self.n_resident + 1
+        self._buffers = [
+            [jnp.zeros((s,) + shape, cfg.dtype)
+             for _, _, _, shape in leaves]
+            for leaves in _per_layer(adapter_leaves(cfg, self.rank))]
+        self._rules = tuple(re.compile(pat)
+                            for pat, _ in lora_rules(cfg))
+        self._manifests: dict[str, AdapterManifest] = {}
+        self._slot: dict[str, int] = {}
+        self._of_slot: dict[int, str] = {}
+        self._touch: dict[str, int] = {}
+        self._clock = 0
+        self._storm: list[int] = []
+        self.hits_total = 0
+        self.cold_loads_total = 0
+        self.evictions_total = 0
+
+    # -- layout ----------------------------------------------------
+
+    @property
+    def buffers(self) -> tuple:
+        """Pooled device buffers as the decode ``lora[1]`` pytree:
+        per layer ``(aq, bq, ao, bo)``."""
+        return tuple(tuple(layer) for layer in self._buffers)
+
+    @property
+    def bytes_per_slot(self) -> int:
+        """HBM bytes one resident adapter occupies (all slots are
+        equal-size: rank is a pool-level constant)."""
+        total = 0
+        for layer in self._buffers:
+            for buf in layer:
+                total += buf.nbytes // buf.shape[0]
+        return int(total)
+
+    def accounted_bytes(self) -> int:
+        """Full pool reservation (memwatch ``adapter_pool``
+        component): allocated up front regardless of residency."""
+        return sum(int(b.nbytes) for layer in self._buffers
+                   for b in layer)
+
+    # -- registration ----------------------------------------------
+
+    def register(self, manifest: AdapterManifest) -> None:
+        """Admit an adapter to the catalog (no device work): rank
+        must match the pool's static rank, and every leaf name must
+        match the lora_rules table — an unplaceable leaf is a hard
+        error at registration, not at cold-load."""
+        if manifest.rank != self.rank:
+            raise ValueError(
+                f"adapter {manifest.name!r} rank {manifest.rank} != "
+                f"pool rank {self.rank} (rank is a static pool "
+                f"shape)")
+        for _, _, name, _ in adapter_leaves(self.cfg, self.rank):
+            if not any(r.search(name) for r in self._rules):
+                raise ValueError(f"adapter leaf {name!r} matches no "
+                                 f"lora_rules entry")
+        self._manifests[manifest.name] = manifest
+
+    def known(self, name: str) -> bool:
+        return name in self._manifests
+
+    def manifest(self, name: str) -> AdapterManifest:
+        return self._manifests[name]
+
+    # -- residency -------------------------------------------------
+
+    def slot_of(self, name: str | None) -> int | None:
+        """Resident slot id, NULL_BLOCK for the base model, None
+        when not resident."""
+        if name is None:
+            return NULL_BLOCK
+        return self._slot.get(name)
+
+    def resident(self) -> tuple[str, ...]:
+        return tuple(sorted(self._slot))
+
+    def evictable(self) -> tuple[str, ...]:
+        """Resident adapters with no pins (refcount back at the
+        pool's own reference), coldest first."""
+        cold = [n for n, s in self._slot.items()
+                if self.ledger.refcount(s) == 1]
+        return tuple(sorted(cold, key=lambda n: self._touch[n]))
+
+    def headroom_slots(self) -> int:
+        """Slots a new adapter could claim without blocking: free
+        plus evictable-cold (the router's admission floor)."""
+        return self.ledger.free + len(self.evictable())
+
+    def can_admit(self, name: str | None) -> bool:
+        """Could a request for ``name`` be bound here eventually —
+        registered AND (resident or claimable)?  The per-round
+        admission gate (serving.py) subtracts its own pending
+        cold-loads from the headroom on top of this."""
+        if name is None:
+            return True
+        if name not in self._manifests:
+            return False
+        return name in self._slot or self.headroom_slots() >= 1
+
+    # -- pin lifecycle ---------------------------------------------
+
+    def acquire(self, name: str | None) -> int:
+        """Pin ``name`` for a decoding row and return its slot.
+
+        Resident -> refcount bump (``share``), LRU touch, hit.
+        Cold -> claim a slot (evicting the LRU cold adapter under
+        pressure), stream the leaves in, then pin.  Raises
+        ``KeyError`` for an unregistered adapter and
+        ``BlocksExhausted`` when every slot is pinned — the
+        admission gate exists to make the latter unreachable."""
+        if name is None:
+            return NULL_BLOCK
+        manifest = self._manifests[name]
+        self._clock += 1
+        slot = self._slot.get(name)
+        if slot is not None:
+            self.hits_total += 1
+            self.ledger.share([slot])
+            self._touch[name] = self._clock
+            return slot
+        slot = self._claim_slot()
+        self._load(slot, manifest)
+        self._slot[name] = slot
+        self._of_slot[slot] = name
+        self._touch[name] = self._clock
+        self.cold_loads_total += 1
+        self.ledger.share([slot])
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Drop one pin.  The resident reference stays — the adapter
+        remains warm until eviction pressure claims it."""
+        if slot != NULL_BLOCK:
+            self.ledger.free_blocks([slot])
+
+    def evict(self, name: str) -> bool:
+        """Evict one cold resident adapter (tenancy actuation and
+        the storm fault use this); False when pinned or absent."""
+        slot = self._slot.get(name)
+        if slot is None or self.ledger.refcount(slot) != 1:
+            return False
+        self.ledger.free_blocks([slot])
+        del self._slot[name]
+        del self._of_slot[slot]
+        self.evictions_total += 1
+        return True
+
+    def _claim_slot(self) -> int:
+        try:
+            return self.ledger.alloc(1)[0]
+        except BlocksExhausted:
+            for victim in self.evictable():
+                if self.evict(victim):
+                    return self.ledger.alloc(1)[0]
+            raise
+
+    def _load(self, slot: int, manifest: AdapterManifest) -> None:
+        """Stream one adapter's leaves into ``slot`` — functional
+        ``.at[slot].set`` writes, shapes validated against the
+        adapter_leaves contract so a malformed source fails loudly
+        before any buffer is touched."""
+        import numpy as np
+
+        staged = []
+        for li, lj, name, shape in adapter_leaves(self.cfg,
+                                                  self.rank):
+            arr = np.asarray(manifest.fetch(name))
+            if tuple(arr.shape) != tuple(shape):
+                raise ValueError(
+                    f"adapter {manifest.name!r} leaf {name!r} has "
+                    f"shape {tuple(arr.shape)}, want {tuple(shape)}")
+            staged.append((li, lj, arr))
+        for li, lj, arr in staged:
+            buf = self._buffers[li][lj]
+            self._buffers[li][lj] = buf.at[slot].set(
+                arr.astype(buf.dtype))
+
+    # -- tenancy / accounting --------------------------------------
+
+    def resident_bytes(self, tenant: str | None = None) -> int:
+        """Resident adapter HBM, optionally one tenant's share —
+        what the fleet arbiter holds against adapter quotas."""
+        names = (self._slot if tenant is None else
+                 [n for n in self._slot
+                  if self._manifests[n].tenant == tenant])
+        return len(names) * self.bytes_per_slot
+
+    def cold_names(self, tenant: str) -> tuple[str, ...]:
+        """One tenant's evictable residents, coldest first (the
+        arbiter's over-quota eviction order)."""
+        return tuple(n for n in self.evictable()
+                     if self._manifests[n].tenant == tenant)
+
+    # -- fault injection (adapter_evict_storm) ---------------------
+
+    @property
+    def storm_active(self) -> bool:
+        return bool(self._storm)
+
+    def seize_to_one(self) -> int:
+        """The ``adapter_evict_storm`` fault: evict every cold
+        adapter, then pin all but ONE free slot — the pool serves
+        with a single usable resident slot until ``release_storm``.
+        Accumulating and idempotent, like ``seize_free``."""
+        for victim in self.evictable():
+            self.evict(victim)
+        while self.ledger.free > 1:
+            self._storm.extend(self.ledger.alloc(1))
+        return len(self._storm)
+
+    def release_storm(self) -> int:
+        ids, self._storm = self._storm, []
+        if ids:
+            self.ledger.free_blocks(ids)
+        return len(ids)
+
+    # -- observability ---------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {
+            "pool_slots": self.n_resident,
+            "resident": list(self.resident()),
+            "free_slots": self.ledger.free,
+            "headroom_slots": self.headroom_slots(),
+            "bytes_per_slot": self.bytes_per_slot,
+            "hits_total": self.hits_total,
+            "cold_loads_total": self.cold_loads_total,
+            "evictions_total": self.evictions_total,
+            "storm_active": self.storm_active,
+        }
+
+
+def _per_layer(leaves):
+    """Group the adapter_leaves stream back into per-layer lists."""
+    layers: dict[int, list] = {}
+    for li, lj, name, shape in leaves:
+        layers.setdefault(li, []).append((li, lj, name, shape))
+    return [layers[i] for i in sorted(layers)]

@@ -1,0 +1,177 @@
+"""Multi-adapter serving probe: churn wave + switch/cold-load duel.
+
+bench.py runs this in a CPU-pinned subprocess and records three
+scalars per round (artifact: tools/lora_serving_cpu.json, regenerate
+with tools/bench_lora_serving.py):
+
+- ``lora_switch_ms`` — pinning an ALREADY-RESIDENT adapter (the
+  ledger hit path: refcount bump + LRU touch, no device traffic).
+  This is the number multi-adapter serving exists for: switching
+  among warm adapters must cost nothing next to a decode step.
+- ``lora_coldload_ms`` — evict-then-acquire of the same adapter:
+  every low-rank leaf streamed into its pool slot via functional
+  ``.at[slot].set`` writes, synced by scalar readback (the only
+  reliable sync on the tunneled backend — ops/collectives.py).
+- ``lora_resident_hit_frac`` — warm-hit fraction of a mixed-adapter
+  churn wave pushed through one ServingEngine whose pool is smaller
+  than its working set (n_adapters > n_resident), so the wave
+  genuinely evicts and cold-reloads while heterogeneous rows decode
+  in one fused batch.
+
+Correctness rides in the same run: every churn output must be
+byte-equal to a per-adapter ORACLE — a fresh single-slot engine with
+an identical (seed-regenerated) pool serving only that adapter, one
+request at a time.  The speculative probe's closed-form induction
+ramp is not available here (LoRA ``wo`` deltas perturb the residual
+stream the ramp relies on), so the oracle is another engine, exactly
+the crucible's adapter-oracle discipline (cluster/crucible.py).
+Real weights, tiny config: this measures pool mechanics, not model
+quality.
+"""
+
+from __future__ import annotations
+
+#: churn-wave adapter tags, cycled over the wave: a base-model row,
+#: repeats (warm hits), and all three adapters over two resident
+#: slots (forced evictions + cold reloads)
+_CHURN_PATTERN = ("l-0", "l-0", None, "l-1", "l-1", "l-2", "l-0",
+                  "l-2")
+
+
+def _probe_cfg():
+    import jax.numpy as jnp
+
+    from ..models.transformer import TransformerConfig
+    return TransformerConfig(vocab=64, d_model=64, n_layers=2,
+                             n_heads=4, d_head=16, d_ff=256,
+                             max_seq=96, n_kv_heads=2,
+                             dtype=jnp.float32)
+
+
+def _fresh_pool(cfg, rank: int, n_resident: int, n_adapters: int):
+    """A pool with ``n_adapters`` seed-regenerated adapters — every
+    call yields byte-identical weights, so churn engine and oracle
+    engines agree on what ``l-i`` means."""
+    from .pool import AdapterManifest, AdapterPool, make_adapter
+
+    pool = AdapterPool(cfg, rank, n_resident=n_resident)
+    for i in range(n_adapters):
+        pool.register(AdapterManifest(
+            f"l-{i}", rank, tenant="probe",
+            source=make_adapter(cfg, rank, seed=40 + i)))
+    return pool
+
+
+def _sync(pool, slot: int) -> float:
+    """Force completion of any pending device writes to ``slot``
+    via scalar readback."""
+    return float(pool.buffers[0][0][slot, 0, 0])
+
+
+def lora_serving_probe(wave: int = 16, n_adapters: int = 3,
+                       n_resident: int = 2, rank: int = 2,
+                       max_new: int = 8, repeats: int = 5) -> dict:
+    """One byte-equality churn pass + one timed duel, flattened to
+    bench scalars (module docstring)."""
+    import time
+
+    import numpy as np
+
+    from ..models.serving import Request, ServingEngine
+    from ..models.transformer import init_params
+
+    t0 = time.perf_counter()
+    cfg = _probe_cfg()
+    import jax
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    plen = 8
+
+    def prompt(i):
+        rng = np.random.default_rng(100 + i)
+        return rng.integers(0, cfg.vocab, plen).astype(np.int32)
+
+    def adapter_of(i):
+        return _CHURN_PATTERN[i % len(_CHURN_PATTERN)]
+
+    # -- churn wave: heterogeneous rows through one small pool --------
+    pool = _fresh_pool(cfg, rank, n_resident, n_adapters)
+    eng = ServingEngine(params, cfg, slots=4, adapter_pool=pool)
+    for i in range(wave):
+        eng.submit(Request(uid=f"r{i}", prompt=prompt(i),
+                           max_new=max_new, adapter=adapter_of(i)))
+    outs = {f.uid: np.asarray(f.tokens, np.int32) for f in eng.run()}
+    hits, colds = pool.hits_total, pool.cold_loads_total
+    evictions = pool.evictions_total
+    hit_frac = hits / max(1, hits + colds)
+
+    # -- oracle: per-adapter single-slot engines, one at a time -------
+    byte_equal = len(outs) == wave
+    for name in sorted({adapter_of(i) for i in range(wave)},
+                       key=str):
+        o_pool = _fresh_pool(cfg, rank, n_resident, n_adapters)
+        o_eng = ServingEngine(params, cfg, slots=1,
+                              adapter_pool=o_pool)
+        for i in range(wave):
+            if adapter_of(i) != name:
+                continue
+            o_eng.submit(Request(uid=f"o{i}", prompt=prompt(i),
+                                 max_new=max_new, adapter=name))
+        for f in o_eng.run():
+            i = int(f.uid[1:])
+            byte_equal &= bool(np.array_equal(
+                np.asarray(f.tokens, np.int32), outs[f"r{i}"]))
+
+    # -- duel: resident switch vs evict-then-cold-load ----------------
+    d_pool = _fresh_pool(cfg, rank, n_resident, n_adapters)
+    d_pool.release(d_pool.acquire("l-0"))       # make it resident
+    _sync(d_pool, d_pool.slot_of("l-0"))
+    switch_s = float("inf")
+    for _ in range(repeats):
+        t = time.perf_counter()
+        slot = d_pool.acquire("l-0")            # warm: ledger only
+        switch_s = min(switch_s, time.perf_counter() - t)
+        d_pool.release(slot)
+    cold_s = float("inf")
+    for _ in range(repeats):
+        assert d_pool.evict("l-0")
+        t = time.perf_counter()
+        slot = d_pool.acquire("l-0")            # streams every leaf
+        _sync(d_pool, slot)
+        cold_s = min(cold_s, time.perf_counter() - t)
+        d_pool.release(slot)
+
+    return {
+        "lora_switch_ms": round(switch_s * 1e3, 4),
+        "lora_coldload_ms": round(cold_s * 1e3, 3),
+        "lora_resident_hit_frac": round(hit_frac, 3),
+        "churn_hits": hits,
+        "churn_cold_loads": colds,
+        "churn_evictions": evictions,
+        "wave": wave,
+        "n_adapters": n_adapters,
+        "n_resident": n_resident,
+        "rank": rank,
+        "byte_equal": bool(byte_equal),
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "note": (f"churn wave of {wave} mixed-adapter requests "
+                 f"({n_adapters} adapters over {n_resident} resident "
+                 "slots) byte-equal to per-adapter oracle engines; "
+                 "duel is warm ledger pin vs full leaf-stream "
+                 "cold-load on the same adapter"),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--wave", type=int, default=16)
+    ap.add_argument("--repeats", type=int, default=5)
+    ns = ap.parse_args(argv)
+    print(json.dumps(lora_serving_probe(wave=ns.wave,
+                                        repeats=ns.repeats)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -84,9 +84,11 @@ class MemWatch:
     def account_engine(self, engine, unit: str) -> int:
         """Account one serving engine's resident components: params,
         the paged-KV pool (full reservation — the pool is allocated
-        up front regardless of occupancy), and dense prefix-cache
+        up front regardless of occupancy), dense prefix-cache
         entries (paged prefixes live inside the pool and must not be
-        double-counted).  Returns the engine's accounted total."""
+        double-counted), and the paged adapter-weight pool
+        (serving_lora/ — also a full up-front reservation).  Returns
+        the engine's accounted total."""
         total = self.account_params(
             getattr(engine, "params", None), "model_params", unit)
         pool = getattr(engine, "pool", None)
@@ -98,6 +100,10 @@ class MemWatch:
         if store is not None and pool is None:
             total += self.account("prefix_cache", tree_nbytes(store),
                                   unit)
+        apool = getattr(engine, "adapter_pool", None)
+        if apool is not None:
+            total += self.account("adapter_pool",
+                                  apool.accounted_bytes(), unit)
         return total
 
     def account_compile_cache(self, cache_dir=None) -> int:

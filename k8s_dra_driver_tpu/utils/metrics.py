@@ -375,6 +375,27 @@ class GatewayMetrics(_DigestSourceMixin):
             "Burn-rate alerts fired per tenant (rising edges only: "
             "one per sustained burn episode, not one per burning "
             "cycle)", ["tenant"], registry=self.registry)
+        # multi-adapter serving (serving_lora/): per-replica adapter
+        # residency plus the fleet-wide churn counters — folded from
+        # engine occupancy/stats each pump step, the same delta-fold
+        # pattern as the paged-KV eviction counter above
+        self.adapter_residents = Gauge(
+            "tpu_serving_adapter_residents",
+            "LoRA adapters resident in the paged adapter pool per "
+            "replica", ["replica"], registry=self.registry)
+        self.adapter_pool_blocks_free = Gauge(
+            "tpu_serving_adapter_pool_blocks_free",
+            "Free adapter-pool slots per replica (claimable without "
+            "evicting a cold adapter)", ["replica"],
+            registry=self.registry)
+        self.adapter_cold_loads = Counter(
+            "tpu_serving_adapter_cold_loads_total",
+            "Adapters streamed into a pool slot on a residency miss, "
+            "across all replicas", registry=self.registry)
+        self.adapter_evictions = Counter(
+            "tpu_serving_adapter_evictions_total",
+            "Cold resident adapters evicted under pool pressure, "
+            "across all replicas", registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry) + self._render_digests()
@@ -500,7 +521,7 @@ class FleetMetrics:
             "tpu_fleet_mt_actions_total",
             "Multi-tenant arbiter actions by tenant and kind "
             "(grant/reclaim_park/reclaim_shrink/reclaim_drain/"
-            "release/regrow)", ["tenant", "action"],
+            "release/regrow/adapter_evict)", ["tenant", "action"],
             registry=self.registry)
         self.tenant_chips = Gauge(
             "tpu_fleet_tenant_chips",
@@ -511,6 +532,11 @@ class FleetMetrics:
             "Fair-share chip entitlement per tenant (floors + "
             "priority-ordered water-fill)", ["tenant"],
             registry=self.registry)
+        self.tenant_adapter_bytes = Gauge(
+            "tpu_fleet_tenant_adapter_bytes",
+            "Resident adapter-pool HBM per tenant across the serving "
+            "workload's replicas (the adapter-quota enforcement "
+            "surface)", ["tenant"], registry=self.registry)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -44,6 +44,7 @@ SLOW_MODULES = {
     "test_bench_smoke",          # drives the bench beds end-to-end
     "test_multihost_train",      # 2 jax.distributed processes training
     "test_serving",              # per-prompt-length prefill compiles
+    "test_serving_lora",         # per-adapter oracle engines compile
 }
 
 SLOW_PREFIXES = (

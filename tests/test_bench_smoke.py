@@ -238,19 +238,19 @@ def test_probe_roster_pins_multitenant_scalars():
 def test_crucible_probe_streams_zero_violations(tmp_path):
     """The compound-fault crucible probe at the hermetic shape
     bench.py streams (same kwargs object, so this pins what actually
-    streams): the seeded soak survives every cycle, fires all ten
+    streams): the seeded soak survives every cycle, fires all eleven
     fault kinds (the shard-corruption trio, the kv_exhaust seizure
-    wave, and the pump_kill no-op arc — the rig's in-process gateway
-    has no pump subprocesses, so firing it pins exactly the logged
-    no-op contract), lands window-triggered overlaps, and —
-    the scalar the whole subsystem exists for — reports ZERO
-    invariant violations."""
+    wave, the pump_kill no-op arc — the rig's in-process gateway has
+    no pump subprocesses, so firing it pins exactly the logged no-op
+    contract — and the adapter_evict_storm starvation wave), lands
+    window-triggered overlaps, and — the scalar the whole subsystem
+    exists for — reports ZERO invariant violations."""
     from k8s_dra_driver_tpu.cluster.chaosprobe import crucible_probe
     out = crucible_probe(**bench.CRUCIBLE_KWARGS,
                          workdir=str(tmp_path))
     assert out["cru_survived_cycles"] == bench.CRUCIBLE_KWARGS["cycles"]
     assert out["cru_invariant_violations"] == 0
-    assert out["cru_fault_kinds"] == 10
+    assert out["cru_fault_kinds"] == 11
     assert out["cru_overlap_hits"] >= 3
     assert out["cru_compound_mttr_ms"] > 0
     assert out["cru_finished"] == out["cru_submitted"] > 0
@@ -911,6 +911,53 @@ def test_probe_roster_pins_spec_scalars():
     keys = {k: f for _, k, f in bench._PROBE_SCALARS}
     assert keys["spec_tok_s_x"] == "spec_tok_s_x"
     assert keys["spec_accept_rate"] == "spec_accept_rate"
+
+
+def test_lora_serving_probe_streams_schema():
+    """The multi-adapter probe at a reduced shape (short wave, one
+    timed repeat): every churn output byte-equal to its per-adapter
+    oracle engine in-run, the churn genuinely cold-loads AND hits,
+    and every scalar the compact line picks up is present.  The
+    hit-fraction bar lives on the committed full-shape artifact
+    (test_lora_serving_artifact below)."""
+    from k8s_dra_driver_tpu.serving_lora.probe import \
+        lora_serving_probe
+    out = lora_serving_probe(wave=8, max_new=4, repeats=1)
+    assert out["byte_equal"] is True
+    assert out["churn_hits"] > 0 and out["churn_cold_loads"] > 0
+    assert 0.0 < out["lora_resident_hit_frac"] < 1.0
+    assert out["lora_switch_ms"] > 0
+    assert out["lora_coldload_ms"] > out["lora_switch_ms"]
+
+
+def test_probe_roster_pins_lora_scalars():
+    """Bench-line schema: the multi-adapter scalars (warm switch,
+    cold load, churn hit fraction) are IN the compact line roster."""
+    probes = [p for p, _, _ in bench._PROBE_SCALARS]
+    assert "serving_lora" in probes
+    keys = {k: f for _, k, f in bench._PROBE_SCALARS}
+    assert keys["lora_switch_ms"] == "lora_switch_ms"
+    assert keys["lora_coldload_ms"] == "lora_coldload_ms"
+    assert keys["lora_resident_hit_frac"] == "lora_resident_hit_frac"
+
+
+def test_lora_serving_artifact_pins_claims():
+    """THE multi-adapter acceptance gates (repo rule: perf claims
+    trace to tools/*.json): the recorded full-shape artifact must
+    show warm switching strictly cheaper than cold-loading, a churn
+    hit fraction at or above the sentinel bar, and in-run
+    byte-equality against the per-adapter oracle engines."""
+    artifact = Path(__file__).parent.parent / "tools" / \
+        "lora_serving_cpu.json"
+    doc = bench.json.loads(artifact.read_text())
+    res = doc["result"]
+    assert res["byte_equal"] is True
+    assert res["lora_coldload_ms"] > res["lora_switch_ms"]
+    assert res["lora_resident_hit_frac"] >= 0.4
+    # same shape the bench run streams (LORA_SERVING_KWARGS), so the
+    # artifact is evidence for the line's scalars
+    assert doc["probe"] == "serving_lora"
+    assert doc["harness"] == "serving_lora/probe.py lora_serving_probe"
 
 
 def test_spec_decode_artifact_pins_claims():

@@ -205,6 +205,19 @@ class TestTraces:
         assert cv["bursty"] > cv["diurnal"]
         assert cv["heavy_tail"] > cv["diurnal"]
 
+    def test_fixtures_carry_adapter_tags(self):
+        """serving_lora/: per-arrival adapter tags, drawn AFTER the
+        tenants from the same seeded stream so no arrival time and no
+        tenant tag moved (the generator-equality pin above audits
+        that); ``"base"`` majority means Request.adapter=None."""
+        for name in TRACE_NAMES:
+            t = load_trace(name)
+            assert len(t["adapters"]) == t["n"]
+            assert set(t["adapters"]) <= {"base", "lora-a",
+                                          "lora-b", "lora-c"}
+            # the 0.4-weight base majority survives in every fixture
+            assert t["adapters"].count("base") >= t["n"] // 4
+
     def test_replay_is_open_loop(self):
         """Arrival times come from the trace, not from completions: a
         saturated null pool still receives every submission, and the

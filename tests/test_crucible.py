@@ -416,6 +416,55 @@ def test_kv_exhaust_wave_holds_admission_then_recovers(tmp_path):
 
 
 @pytest.mark.faults
+def test_adapter_evict_storm_cold_loads_then_recovers(tmp_path):
+    """adapter_evict_storm chaos twin (serving_lora/): a warm LoRA
+    adapter goes cold, the storm evicts it and pins the decode pool
+    down to ONE usable slot, a DIFFERENT adapter's burst lands inside
+    the open ``adapter_pressure:hi`` window, and after release the
+    first adapter's return traffic must cold-load back.  Everything
+    terminates exactly once and byte-equal to its per-adapter oracle
+    engine — eviction may re-stage weights, never change output."""
+    events = [
+        FaultEvent(id="warm", kind="burst", at_cycle=1, n=4,
+                   prompt_seed=100, adapter="lora-a"),
+        # cycle 10: the warm wave has fully drained, so lora-a sits
+        # resident-but-cold — exactly what the storm must evict
+        FaultEvent(id="storm", kind="adapter_evict_storm",
+                   at_cycle=10, replica_glob="d*", heal_after=3),
+        FaultEvent(id="burst-in-storm", kind="burst",
+                   window="adapter_pressure:hi", after_cycle=10, n=4,
+                   prompt_seed=200, adapter="lora-b"),
+        FaultEvent(id="reload", kind="burst", at_cycle=15, n=4,
+                   prompt_seed=300, adapter="lora-a"),
+    ]
+    sched = Schedule(seed=11, cycles=20, events=events)
+    res, rig = cru.run_soak(sched, tmp_path / "lora")
+    assert_no_violations(
+        [f"cycle {c}: {m}" for c, v in res.violations for m in v],
+        label="adapter-storm")
+    # the storm really happened, into the window it opened, and it
+    # really lifted (nothing stays seized past heal_after)
+    assert rig.adapter_storms >= 1 and not rig._adapter_seized
+    by_id = {e.id: e for e in sched.events}
+    assert by_id["storm"].fired_cycle is not None
+    assert ("adapter_pressure:hi"
+            in by_id["burst-in-storm"].hit_windows)
+    pools = {r.name: r.engine.adapter_pool
+             for r in rig.mgr.replicas
+             if getattr(r.engine, "adapter_pool", None) is not None}
+    d1 = pools["d1"]
+    assert not d1.storm_active
+    # the warm adapter was cold when the storm hit -> a real eviction,
+    # and its reload burst forced a cold load back (plus the initial
+    # two loads: >= 3 cold loads total on the decode pool)
+    assert d1.evictions_total >= 1
+    assert d1.cold_loads_total >= 3
+    # starve-then-recover, never lose: all 12 arrivals finished
+    assert res.submitted == 12 and res.finished == res.submitted
+    assert res.gang_failures == [] and res.operator_repairs == 0
+
+
+@pytest.mark.faults
 def test_heal_mid_cascade_fences_foreign_owned_chip(tmp_path):
     """Double fault #3: a chip heals while a preemption cascade has
     granted it to ANOTHER tenant.  The reconciler must readmit the

@@ -119,3 +119,13 @@ def test_unrelated_comment_above_does_not_exempt(tmp_path):
     ''')
     problems = lint_deadlines.lint(repo)
     assert len(problems) == 1
+
+
+def test_scope_reaches_the_adapter_serving_tier():
+    """ISSUE 18 satellite: the package-wide scope walks serving_lora/
+    too — the pool's ledger has no blocking waits today, and any that
+    appear must carry deadlines like everything else."""
+    repo = Path(lint_deadlines.REPO)
+    scoped = [p for scope in lint_deadlines.SCOPES
+              for p in (repo / scope).rglob("*.py")]
+    assert any("serving_lora" in str(p) for p in scoped)

@@ -32,6 +32,12 @@ def test_scope_covers_the_control_plane_tiers():
     assert "k8s_dra_driver_tpu/gateway" in lint_perf_claims.SCOPES
 
 
+def test_scope_covers_the_adapter_serving_tier():
+    """ISSUE 18 satellite: serving_lora/ docstrings carry switch vs
+    cold-load cost claims, so the lint walks them too."""
+    assert "k8s_dra_driver_tpu/serving_lora" in lint_perf_claims.SCOPES
+
+
 def _scratch_repo(tmp_path, body, artifact=True):
     mod_dir = tmp_path / "k8s_dra_driver_tpu" / "ops"
     mod_dir.mkdir(parents=True)

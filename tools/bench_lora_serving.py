@@ -1,0 +1,61 @@
+"""Regenerate tools/lora_serving_cpu.json.
+
+The artifact behind the multi-adapter serving claims
+(docs/SERVING.md "Multi-adapter serving"): warm adapter-switch cost
+(resident ledger pin) vs full cold-load (every low-rank leaf
+streamed into its pool slot), plus the warm-hit fraction of a
+mixed-adapter churn wave whose working set exceeds the resident
+pool, with every churn output verified byte-equal to per-adapter
+oracle engines in the same run.  Always CPU-pinned
+(serving_lora/probe.py documents why the oracle is another engine
+rather than a closed form), but still run it on an IDLE machine —
+see tools/int8_decode_v5e_loaded_host.json for what a loaded host
+does to recorded baselines.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import platform
+import subprocess
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.serving_lora.probe import "
+        "lora_serving_probe\n"
+        "print(json.dumps(lora_serving_probe(wave=16, repeats=5)))\n")
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    res = subprocess.run([sys.executable, "-c", code], cwd=repo,
+                         env=cpu_jax_env(1), capture_output=True,
+                         text=True, timeout=600)
+    if res.returncode != 0:
+        sys.stderr.write(res.stderr)
+        raise SystemExit(1)
+    result = json.loads(res.stdout.strip().splitlines()[-1])
+    commit = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
+        capture_output=True, text=True).stdout.strip()
+    rec = {
+        "probe": "serving_lora",
+        "host": platform.machine(),
+        "platform": "cpu-hermetic",
+        "commit": commit,
+        "harness": "serving_lora/probe.py lora_serving_probe",
+        "result": result,
+    }
+    path = pathlib.Path(__file__).parent / "lora_serving_cpu.json"
+    path.write_text(json.dumps(rec, indent=1) + "\n")
+    print(json.dumps(rec, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -89,6 +89,12 @@ ARTIFACT_GATES = (
     # the identical non-speculative engine
     ("tools/spec_decode_cpu.json",
      ("result", "spec_tok_s_x"), ">=", 1.5),
+    # multi-adapter serving (serving_lora/probe.py): the churn wave
+    # is built so half its adapter pins land warm (3 adapters over 2
+    # resident slots) — a hit fraction below the bar means the LRU
+    # residency ledger stopped keeping hot adapters resident
+    ("tools/lora_serving_cpu.json",
+     ("result", "lora_resident_hit_frac"), ">=", 0.4),
 )
 
 
