@@ -83,10 +83,45 @@ log = logging.getLogger(__name__)
 #: wave: adapter'd fills serialize through the surviving slot or
 #: hold at their prefill replicas, and the release must cold-load
 #: the evicted adapters back with byte-exact outputs.
-EVENT_KINDS = ("chip_kill", "worker_crash", "worker_hang",
-               "replica_kill", "burst", "shard_bitflip",
-               "shard_truncate", "gen_tear", "kv_exhaust",
-               "pump_kill", "adapter_evict_storm")
+#: kind -> one-line description.  Insertion-ordered, so EVENT_KINDS
+#: (derived below) keeps the historical tuple order and every count
+#: pin becomes "matches the registry" instead of a hardcoded integer
+#: that churns each time a PR teaches the crucible a new fault
+#: (tests/test_bench_smoke.py, tests/test_crucible.py).
+FAULT_KIND_REGISTRY: dict[str, str] = {}
+
+
+def register_fault_kind(kind: str, description: str = "") -> str:
+    """Add a fault kind to the roster (idempotent only for identical
+    re-registration; a silent overwrite would hide a name collision
+    between two subsystems' faults)."""
+    if kind in FAULT_KIND_REGISTRY:
+        if FAULT_KIND_REGISTRY[kind] != description:
+            raise ValueError(f"fault kind {kind!r} already registered "
+                             f"with a different description")
+        return kind
+    FAULT_KIND_REGISTRY[kind] = description
+    global EVENT_KINDS
+    EVENT_KINDS = tuple(FAULT_KIND_REGISTRY)
+    return kind
+
+
+EVENT_KINDS: tuple = ()
+for _kind, _desc in (
+        ("chip_kill", "chip goes unhealthy; heals after heal_after"),
+        ("worker_crash", "gang worker process dies"),
+        ("worker_hang", "gang worker wedges past the watchdog"),
+        ("replica_kill", "serving replica marked down mid-flight"),
+        ("burst", "open-loop request wave (load, not a fault)"),
+        ("shard_bitflip", "newest checkpoint shard: silent bitflip"),
+        ("shard_truncate", "newest checkpoint shard: torn write"),
+        ("gen_tear", "newest generation: manifest deleted"),
+        ("kv_exhaust", "paged replicas: free KV blocks seized"),
+        ("pump_kill", "multi-process gateway pump SIGKILLed"),
+        ("adapter_evict_storm", "adapter pools seized to one slot")):
+    register_fault_kind(_kind, _desc)
+del _kind, _desc
+
 CORRUPTION_KINDS = ("shard_bitflip", "shard_truncate", "gen_tear")
 
 #: reconciler event kinds that open the "cascade" window
@@ -994,15 +1029,20 @@ def run_soak(schedule: Schedule, workdir, *, dump_dir=None,
 
 
 def minimize(schedule: Schedule, workdir, *, max_runs: int = 16,
-             check=None):
+             check=None, soak=None):
     """Delta-debug (Zeller's ddmin, complement-reduction form) the
     schedule's event list down to a minimal set that still fails.
     ``check(result) -> bool`` decides failure (default: any invariant
     violation).  ``max_runs`` bounds the probe budget — each probe is
-    a full soak in a fresh workdir subdirectory.  Returns
+    a full soak in a fresh workdir subdirectory.  ``soak(schedule,
+    workdir, **kw) -> (result, rig)`` swaps the rig the probes run
+    against (default :func:`run_soak`, the live 8-chip crucible; the
+    fleet simulator passes ``sim.rig.sim_soak_for(...)`` so the SAME
+    ddmin minimizes thousand-replica pathologies).  Returns
     ``(minimized_schedule, runs_used)``; the caller re-runs the
     minimized schedule to capture its violation log for the repro."""
     check = check or (lambda res: bool(res.violations))
+    soak = soak or run_soak
     workdir = Path(workdir)
     events = [e.fresh() for e in schedule.events]
     runs = 0
@@ -1012,7 +1052,7 @@ def minimize(schedule: Schedule, workdir, *, max_runs: int = 16,
         runs += 1
         sub = Schedule(seed=schedule.seed, cycles=schedule.cycles,
                        events=[e.fresh() for e in subset])
-        res, _ = run_soak(sub, workdir / f"probe-{runs:03d}")
+        res, _ = soak(sub, workdir / f"probe-{runs:03d}")
         log.info("ddmin probe %d: %d event(s) -> %s", runs,
                  len(subset), "FAIL" if check(res) else "pass")
         return check(res)
@@ -1064,10 +1104,13 @@ def write_repro(path, schedule: Schedule,
     return path
 
 
-def replay(path, workdir, *, dump_dir=None, drain_cycles: int = 300):
+def replay(path, workdir, *, dump_dir=None, drain_cycles: int = 300,
+           soak=None):
     """Re-run a repro file.  ``dump_dir`` hands the flight recorder a
     directory, so the confirming run ships forensic dumps next to the
-    repro.  Returns ``(result, rig)``."""
+    repro.  ``soak`` swaps the rig (see :func:`minimize`) so a repro
+    minted by the fleet simulator replays on the simulator.  Returns
+    ``(result, rig)``."""
     payload = json.loads(Path(path).read_text())
     if payload.get("format") != REPRO_FORMAT:
         raise ValueError(
@@ -1078,12 +1121,12 @@ def replay(path, workdir, *, dump_dir=None, drain_cycles: int = 300):
     # _due() would see every event as already fired and replay a
     # fault-free run
     sched = Schedule.from_json(payload["schedule"]).fresh()
-    return run_soak(sched, workdir, dump_dir=dump_dir,
-                    drain_cycles=drain_cycles)
+    return (soak or run_soak)(sched, workdir, dump_dir=dump_dir,
+                              drain_cycles=drain_cycles)
 
 
 def investigate(schedule: Schedule, workdir, *,
-                max_runs: int = 16) -> dict:
+                max_runs: int = 16, soak=None) -> dict:
     """The whole violation workflow in one call: soak; on violation,
     ddmin-minimize the schedule, write ``repro.json``, and REPLAY it
     (flight recorder dumping alongside) to confirm the repro fails
@@ -1091,21 +1134,23 @@ def investigate(schedule: Schedule, workdir, *,
     when a violation was found — ``minimized`` (Schedule), ``repro``
     (path), ``confirm_result``, and ``confirmed`` (bool)."""
     workdir = Path(workdir)
-    res, _rig = run_soak(schedule, workdir / "soak")
+    soak = soak or run_soak
+    res, _rig = soak(schedule, workdir / "soak")
     out = {"result": res, "minimized": None, "repro": None,
            "confirm_result": None, "confirmed": None}
     if not res.violations:
         return out
     minimized, _runs = minimize(schedule, workdir / "ddmin",
-                                max_runs=max_runs)
-    min_res, _ = run_soak(minimized, workdir / "minimized")
+                                max_runs=max_runs, soak=soak)
+    min_res, _ = soak(minimized, workdir / "minimized")
     if not min_res.violations:
         # the budget ran out mid-reduction on a flaky boundary; the
         # full schedule is the (non-minimal but honest) repro
         minimized, min_res = schedule.fresh(), res
     repro = write_repro(workdir / "repro.json", minimized, min_res)
     confirm_res, _ = replay(repro, workdir / "confirm",
-                            dump_dir=workdir / "confirm" / "flightrec")
+                            dump_dir=workdir / "confirm" / "flightrec",
+                            soak=soak)
     out.update(minimized=minimized, repro=repro,
                confirm_result=confirm_res,
                confirmed=bool(confirm_res.violations))
@@ -1114,6 +1159,7 @@ def investigate(schedule: Schedule, workdir, *,
 
 __all__ = ["CASCADE_KINDS", "CORRUPTION_KINDS", "Clock",
            "CrucibleResult", "CrucibleRig",
-           "EVENT_KINDS", "FaultEvent", "REPRO_FORMAT", "Schedule",
-           "default_schedule", "investigate", "minimize", "replay",
+           "EVENT_KINDS", "FAULT_KIND_REGISTRY", "FaultEvent",
+           "REPRO_FORMAT", "Schedule", "default_schedule",
+           "investigate", "minimize", "register_fault_kind", "replay",
            "run_soak", "write_repro"]

@@ -39,6 +39,7 @@ or distance awareness — what k interleaved tenants would do with the
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 
 from .supply import ChipLedger, owner_tenant
@@ -121,6 +122,23 @@ class TopologyBinPacker:
             return 0
         return min(abs(pos - p) for p in positions)
 
+    @staticmethod
+    def _min_dist_sorted(pos, positions) -> int:
+        """:meth:`_min_dist` over an already-SORTED position list —
+        bisect instead of a linear scan, so a thousand-chip fleet
+        scores a candidate in O(log n).  Identical values by
+        construction (pinned in tests/test_sim.py equivalence)."""
+        if not positions:
+            return 0
+        i = bisect.bisect_left(positions, pos)
+        best = None
+        if i < len(positions):
+            best = positions[i] - pos
+        if i > 0:
+            d = pos - positions[i - 1]
+            best = d if best is None else min(best, d)
+        return best
+
     # -- single-chip placement (serving replicas) ------------------------
 
     def place_chip(self, tenant: str) -> int | None:
@@ -131,18 +149,27 @@ class TopologyBinPacker:
         partially holds; then land as FAR from other tenants' chips
         as possible (their regrow blocks stay wide); then as NEAR the
         tenant's own chips as possible (dense); then highest index
-        (the serving-from-the-tail convention as the final tie)."""
-        own = [self._pos[c] for c in self._tenant_chips(tenant)]
+        (the serving-from-the-tail convention as the final tie).
+
+        The conflict table is computed ONCE per call and distances go
+        through sorted-position bisect — at fleet scale the per-
+        candidate table rebuild made this O(chips^2) per placement
+        (the sim's thousand-replica soak is the evidence; same
+        decisions, pinned by the equivalence tests)."""
+        own = sorted(self._pos[c] for c in self._tenant_chips(tenant))
         own_domains = {p // self.domain_size for p in own}
-        others = [self._pos[c] for c in self._other_chips(tenant)]
+        others = sorted(self._pos[c]
+                        for c in self._other_chips(tenant))
+        table = self.conflict_table()
         best, best_key = None, None
         for c in self._free_healthy():
-            if self._conflicts((c,), tenant):
-                continue
             p = self._pos[c]
+            holders = table.get(p // self.domain_size, set())
+            if holders - {tenant}:
+                continue
             key = (p // self.domain_size in own_domains,
-                   self._min_dist(p, others),
-                   -self._min_dist(p, own) if own else 0,
+                   self._min_dist_sorted(p, others),
+                   -self._min_dist_sorted(p, own) if own else 0,
                    p)
             if best_key is None or key > best_key:
                 best, best_key = c, key
@@ -174,21 +201,98 @@ class TopologyBinPacker:
                            and owner == usable_owner)))
             usable.append(ok)
         own = set(self._tenant_chips(tenant))
+        # Hoisted per-call state so each window scores in O(1): the
+        # naive form recomputed the conflict table and rescanned the
+        # whole ledger for the largest free run PER WINDOW — O(chips^2)
+        # per placement, which the thousand-chip sim fleet cannot
+        # afford.  Same keys, same winner (equivalence-pinned).
+        table = self.conflict_table()
+        n_dom = (len(chips) + self.domain_size - 1) // self.domain_size
+        bad_dom = [1 if table.get(d, set()) - {tenant} else 0
+                   for d in range(n_dom)]
+        bad_pref = [0]
+        for b in bad_dom:
+            bad_pref.append(bad_pref[-1] + b)
+        usable_pref = [0]
+        for u in usable:
+            usable_pref.append(usable_pref[-1] + (1 if u else 0))
+        own_pref = [0]
+        for c in chips:
+            own_pref.append(own_pref[-1] + (1 if c in own else 0))
+        free = [self.ledger.owners.get(c) is None
+                and c not in self.ledger.unhealthy for c in chips]
+        segs = self._free_segments(free)
+        seg_starts = [s for s, _ in segs]
+        seg_ends = [e for _, e in segs]
+        # prefix/suffix maxima of segment lengths, so "largest free
+        # run outside a contiguous window" is a range-max query
+        pre_max = [0] * (len(segs) + 1)
+        for i, (s, e) in enumerate(segs):
+            pre_max[i + 1] = max(pre_max[i], e - s + 1)
+        suf_max = [0] * (len(segs) + 1)
+        for i in range(len(segs) - 1, -1, -1):
+            s, e = segs[i]
+            suf_max[i] = max(suf_max[i + 1], e - s + 1)
         best, best_key = None, None
         for start in range(len(chips) - n + 1):
-            window = chips[start:start + n]
-            if not all(usable[start + i] for i in range(n)):
+            if usable_pref[start + n] - usable_pref[start] != n:
                 continue
-            if self._conflicts(window, tenant):
+            dlo = start // self.domain_size
+            dhi = (start + n - 1) // self.domain_size
+            if bad_pref[dhi + 1] - bad_pref[dlo]:
                 continue
-            taken = set(window)
-            remaining = self._largest_free_run(exclude=taken)
-            key = (len(own & taken), remaining, -start)
+            remaining = self._largest_free_run_excluding(
+                segs, seg_starts, seg_ends, pre_max, suf_max, start,
+                start + n - 1)
+            key = (own_pref[start + n] - own_pref[start], remaining,
+                   -start)
             if best_key is None or key > best_key:
+                window = chips[start:start + n]
                 domains = tuple(sorted({self.domain_of(c)
                                         for c in window}))
                 best = Placement(chips=tuple(window), domains=domains)
                 best_key = key
+        return best
+
+    @staticmethod
+    def _free_segments(free) -> list[tuple[int, int]]:
+        """Maximal runs of free positions as inclusive (start, end)
+        index pairs."""
+        segs: list[tuple[int, int]] = []
+        run_start = None
+        for i, ok in enumerate(free):
+            if ok and run_start is None:
+                run_start = i
+            elif not ok and run_start is not None:
+                segs.append((run_start, i - 1))
+                run_start = None
+        if run_start is not None:
+            segs.append((run_start, len(free) - 1))
+        return segs
+
+    @staticmethod
+    def _largest_free_run_excluding(segs, seg_starts, seg_ends,
+                                    pre_max, suf_max, lo, hi) -> int:
+        """Largest free run with positions [lo, hi] carved out —
+        equal by construction to rescanning the ledger with those
+        positions excluded (``_largest_free_run(exclude=window)``),
+        because a window only trims or splits the segments it
+        overlaps and a contiguous window overlaps a contiguous
+        segment range."""
+        if not segs:
+            return 0
+        # first segment whose END reaches lo, last whose START <= hi
+        i = bisect.bisect_left(seg_ends, lo)
+        j = bisect.bisect_right(seg_starts, hi) - 1
+        if i > j:                   # window misses every segment
+            return max(pre_max[-1], 0)
+        best = max(pre_max[i], suf_max[j + 1])
+        s, _ = segs[i]
+        if lo > s:                  # left remnant of first overlap
+            best = max(best, lo - s)
+        _, e = segs[j]
+        if hi < e:                  # right remnant of last overlap
+            best = max(best, e - hi)
         return best
 
     def _largest_free_run(self, exclude=frozenset()) -> int:

@@ -56,6 +56,7 @@ repeated single actions), quota/entitlement caps bound it.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import logging
 import time
 
@@ -182,17 +183,25 @@ class TenantRegistry:
         self.capacity = capacity
         self._specs: dict[str, TenantSpec] = {}
         self._workloads: dict[str, object] = {}
+        # running floor total + memoized priority order: at fleet-
+        # simulator scale (10k tenants, sim/) re-summing floors per
+        # add is O(T^2) registration and re-sorting per tick is pure
+        # waste — the order only changes when the table does
+        self._floor_total = 0
+        self._order: list[TenantSpec] | None = None
 
     def add(self, spec: TenantSpec, workload) -> None:
         if spec.name in self._specs:
             raise ValueError(f"tenant {spec.name!r} already registered")
-        floors = sum(s.floor for s in self._specs.values()) + spec.floor
+        floors = self._floor_total + spec.floor
         if self.capacity is not None and floors > self.capacity:
             raise ValueError(
                 f"guaranteed floors ({floors}) exceed fleet capacity "
                 f"({self.capacity}) adding tenant {spec.name!r}")
         self._specs[spec.name] = spec
         self._workloads[spec.name] = workload
+        self._floor_total = floors
+        self._order = None
 
     def __iter__(self):
         return iter(self.by_priority())
@@ -208,10 +217,14 @@ class TenantRegistry:
 
     def by_priority(self, reverse: bool = True) -> list[TenantSpec]:
         """Specs ordered by (priority, name) — descending by default
-        (claim order); ascending is reclaim order."""
-        return sorted(self._specs.values(),
-                      key=lambda s: (s.priority, s.name),
-                      reverse=reverse)
+        (claim order); ascending is reclaim order.  Returns a fresh
+        list each call (callers may mutate); the sort itself is
+        cached until the next ``add``."""
+        if self._order is None:
+            self._order = sorted(self._specs.values(),
+                                 key=lambda s: (s.priority, s.name))
+        return (list(reversed(self._order)) if reverse
+                else list(self._order))
 
 
 @dataclasses.dataclass
@@ -242,7 +255,16 @@ def entitlements(states: list[TenantState], capacity: int
     priority classes — a class is topped up to its wants (capped at
     quota) before the next class down sees a chip, and inside one
     class chips go one at a time to the tenant with the lowest
-    entitlement-per-share (weighted max-min fairness)."""
+    entitlement-per-share (weighted max-min fairness).
+
+    Implementation: a per-class min-heap keyed exactly like the
+    naive argmin — ``(entitlement/share, name)``.  A tenant's key
+    changes only when IT receives a chip (pop, bump, re-push), so
+    every heap entry is always current and the grant sequence is
+    identical to recomputing the argmin per chip — O(capacity log T)
+    instead of the O(capacity x T) rescan, which a 10k-tenant fleet
+    (sim/) cannot afford.  Equivalence vs the rescan is pinned on
+    randomized states in tests/test_sim.py."""
     ent = {s.spec.name: min(s.spec.floor, s.spec.quota)
            for s in states}
     remaining = capacity - sum(ent.values())
@@ -250,17 +272,21 @@ def entitlements(states: list[TenantState], capacity: int
     for s in states:
         by_prio.setdefault(s.spec.priority, []).append(s)
     for prio in sorted(by_prio, reverse=True):
-        group = by_prio[prio]
-        while remaining > 0:
-            open_ = [s for s in group
-                     if ent[s.spec.name]
-                     < min(s.wanted, s.spec.quota)]
-            if not open_:
-                break
-            pick = min(open_, key=lambda s: (
-                ent[s.spec.name] / s.spec.share, s.spec.name))
-            ent[pick.spec.name] += 1
+        if remaining <= 0:
+            break
+        want = {s.spec.name: min(s.wanted, s.spec.quota)
+                for s in by_prio[prio]}
+        share = {s.spec.name: s.spec.share for s in by_prio[prio]}
+        heap = [(ent[n] / share[n], n) for n in want
+                if ent[n] < want[n]]
+        heapq.heapify(heap)
+        while remaining > 0 and heap:
+            _, name = heapq.heappop(heap)
+            ent[name] += 1
             remaining -= 1
+            if ent[name] < want[name]:
+                heapq.heappush(heap,
+                               (ent[name] / share[name], name))
     return ent
 
 
@@ -414,6 +440,17 @@ class MtConfig:
     up_after: int = 2
     down_after: int = 4
     regrow_after: int = 3
+    # reclaim_drain victim ordering: prefer the victim whose drain
+    # EMPTIES its link domain (frees a whole overlap token), newest
+    # first as the tie-break.  The fleet simulator's thousand-replica
+    # soak found the False behavior (pure newest-first) starving a
+    # higher-class grant FOREVER: when the entitlement floor halts
+    # the cascade before any domain empties, every free chip stays
+    # domain-conflicted and place_chip returns None on every tick
+    # (ddmin-minimized to a 6-chip repro — tests/test_sim.py
+    # test_drain_starvation_*; docs/SIMULATION.md writeup).  False
+    # reproduces the pre-fix ordering for that A/B.
+    domain_aware_drain: bool = True
 
 
 class MultiTenantReconciler:
@@ -461,6 +498,12 @@ class MultiTenantReconciler:
         self.tracer = tracer
         self._trace_ctx = (tracer.begin("arbiter")
                            if tracer is not None else None)
+        # labeled gauge children, resolved once per tenant: the
+        # prometheus ``labels()`` lookup (lock + tuple build + child
+        # dict) dominated the tick at fleet-simulator scale — 30k
+        # lookups per tick at 10k tenants (sim/) — and the child for
+        # a given tenant never changes
+        self._gauge_cache: dict[str, tuple] = {}
 
     # -- signals ---------------------------------------------------------
 
@@ -614,9 +657,23 @@ class MultiTenantReconciler:
                     if r.ready and r.in_flight]
             # newest idle first (old caches stay), busy only if the
             # reclaim has nothing idle to take — graceful either way
-            for victim in (list(reversed(idle))
-                           + (list(reversed(busy))
-                              if a.kind == RECLAIM_DRAIN else [])):
+            victims = (list(reversed(idle))
+                       + (list(reversed(busy))
+                          if a.kind == RECLAIM_DRAIN else []))
+            if a.kind == RECLAIM_DRAIN and self.cfg.domain_aware_drain:
+                # a reclaim exists to UNBLOCK a higher-class grant,
+                # and a grant is only ever blocked on overlap-token
+                # conflicts — so prefer the victim whose drain leaves
+                # the fewest chips that still conflict the
+                # BENEFICIARY in its link domain (0 = the domain
+                # empties for the claimant and the token frees);
+                # newest-first stays as the tie-break
+                victims = sorted(
+                    enumerate(victims),
+                    key=lambda iv: (self._domain_residue(
+                        a.beneficiary, iv[1]), iv[0]))
+                victims = [v for _, v in victims]
+            for victim in victims:
                 if not w.manager.begin_drain(victim):
                     continue
                 self._mt_event(now, a, replica=victim.name,
@@ -652,6 +709,27 @@ class MultiTenantReconciler:
             return [REGROW]
         return []
 
+    def _domain_residue(self, beneficiary: str | None,
+                        replica) -> int:
+        """How many chips would still CONFLICT a grant to
+        ``beneficiary`` in the victim's link domain after its drain —
+        the domain-aware reclaim key (0 means the drain leaves the
+        domain holding nothing but the claimant's own chips and free
+        ones, so its overlap token frees).  The beneficiary's own
+        chips never conflict its grant (binpack.place_chip skips
+        ``holders - {tenant}``).  Chips the packer does not track
+        sort last."""
+        chip = replica.chip
+        if chip is None or chip not in self.packer._pos:
+            return len(self.ledger.chips)
+        dom = self.packer.domain_of(chip)
+        left = 0
+        for c in self.packer.domain_chips(dom):
+            owner = owner_tenant(self.ledger.owners.get(c))
+            if c != chip and owner is not None and owner != beneficiary:
+                left += 1
+        return left
+
     def _mt_event(self, now: float, a: MtAction, **info) -> None:
         self.metrics.mt_actions.labels(tenant=a.tenant,
                                        action=a.kind).inc()
@@ -667,15 +745,24 @@ class MultiTenantReconciler:
 
     # -- observability ---------------------------------------------------
 
+    def _tenant_gauges(self, name: str) -> tuple:
+        g = self._gauge_cache.get(name)
+        if g is None:
+            g = (self.metrics.tenant_chips.labels(tenant=name),
+                 self.metrics.tenant_entitled.labels(tenant=name),
+                 self.metrics.tenant_adapter_bytes.labels(
+                     tenant=name))
+            self._gauge_cache[name] = g
+        return g
+
     def _export(self, states: list[TenantState]) -> None:
         for s in states:
-            name = s.spec.name
-            self.metrics.tenant_chips.labels(tenant=name).set(s.held)
-            self.metrics.tenant_entitled.labels(tenant=name).set(
-                self.arbiter.entitled.get(name, 0))
+            chips_g, ent_g, adapter_g = self._tenant_gauges(
+                s.spec.name)
+            chips_g.set(s.held)
+            ent_g.set(self.arbiter.entitled.get(s.spec.name, 0))
             if s.kind == SERVING:
-                self.metrics.tenant_adapter_bytes.labels(
-                    tenant=name).set(s.adapter_bytes)
+                adapter_g.set(s.adapter_bytes)
         free = len(self.ledger.healthy_free())
         self.metrics.chips.labels(owner="free").set(free)
         self.metrics.chips.labels(owner="unhealthy").set(

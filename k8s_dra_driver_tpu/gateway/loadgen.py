@@ -129,27 +129,13 @@ def load_trace(name: str) -> dict:
     return trace
 
 
-class VirtualClock:
-    """Injected time for hermetic, fully deterministic replays: the
-    gateway and the replay loop share one instance; ``sleep`` advances
-    it instead of blocking, so a replay with a virtual clock runs at
-    CPU speed with bit-identical scheduling across runs (the seeded-
-    bus determinism test rides this)."""
-
-    def __init__(self, t: float = 0.0, step_cost_s: float = 0.0):
-        self.t = t
-        # optional fixed cost charged per clock read — models a pump
-        # step taking nonzero time so overload math stays meaningful
-        # under virtual time
-        self.step_cost_s = step_cost_s
-
-    def __call__(self) -> float:
-        self.t += self.step_cost_s
-        return self.t
-
-    def sleep(self, dt: float) -> None:
-        if dt > 0:
-            self.t += dt
+# VirtualClock grew from a loadgen-internal helper into the fleet
+# simulator's time base and now lives in sim/clock.py; re-exported
+# here (and in __all__) so every existing import path keeps working.
+# The extraction is pinned bit-for-bit: same seeds -> same arrival
+# times -> same fixture files (tests/test_sim.py, plus the fixture
+# identity pins in tests/test_control_plane.py).
+from ..sim.clock import VirtualClock  # noqa: E402
 
 
 def replay(gateway, trace: dict, *, offered_x: float,

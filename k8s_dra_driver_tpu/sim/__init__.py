@@ -1,0 +1,43 @@
+"""Discrete-event fleet simulator (docs/SIMULATION.md).
+
+The testbed proves 8 chips; the north-star fleet is two to three
+orders of magnitude larger (ROADMAP #4).  This package scales the REAL policy layer — the
+topology bin-packer, fair-share arbiter, multi-tenant reconciler,
+and the crucible's fault schedules + invariant checkers — onto a
+simulated supply/demand plane: 1000 replicas, 64 link domains, 10k
+tenants, replayed diurnal/heavy-tail traces, all seeded-deterministic
+over an O(events) event heap (sim/clock.py).
+
+The policy objects run UNMODIFIED: sim/workload.py duck-types the
+gateway/manager/supervisor surfaces tenancy.py actuates, a plain
+ChipLedger carries supply, and cluster/invariants.check_cycle sweeps
+the simulated fleet every cycle exactly as it sweeps the live one.
+
+Only the clock is imported eagerly: gateway/loadgen.py re-exports
+:class:`VirtualClock` from here, and sim/fleet.py replays loadgen
+traces — a lazy ``__getattr__`` (PEP 562) breaks that cycle without
+making either side import inside functions.
+"""
+
+from .clock import EventHeap, VirtualClock
+
+_LAZY = {
+    "FleetSim": "fleet", "SimConfig": "fleet", "build_fleet": "fleet",
+    "run_sim_soak": "rig", "sim_soak_for": "rig",
+    "SimGateway": "workload", "SimReplica": "workload",
+    "SimReplicaManager": "workload", "SimSupervisor": "workload",
+}
+
+__all__ = ["EventHeap", "FleetSim", "SimConfig", "SimGateway",
+           "SimReplica", "SimReplicaManager", "SimSupervisor",
+           "VirtualClock", "build_fleet", "run_sim_soak",
+           "sim_soak_for"]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+        mod = importlib.import_module(f".{_LAZY[name]}", __name__)
+        return getattr(mod, name)
+    raise AttributeError(f"module {__name__!r} has no attribute "
+                         f"{name!r}")

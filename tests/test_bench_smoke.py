@@ -10,6 +10,8 @@ reduced round count — the TPU probes stay out (no hardware in CI).
 import sys
 from pathlib import Path
 
+import pytest
+
 sys.path.insert(0, str(Path(__file__).parent.parent))  # repo root
 sys.path.insert(0, str(Path(__file__).parent))
 
@@ -238,23 +240,94 @@ def test_probe_roster_pins_multitenant_scalars():
 def test_crucible_probe_streams_zero_violations(tmp_path):
     """The compound-fault crucible probe at the hermetic shape
     bench.py streams (same kwargs object, so this pins what actually
-    streams): the seeded soak survives every cycle, fires all eleven
-    fault kinds (the shard-corruption trio, the kv_exhaust seizure
-    wave, the pump_kill no-op arc — the rig's in-process gateway has
-    no pump subprocesses, so firing it pins exactly the logged no-op
-    contract — and the adapter_evict_storm starvation wave), lands
-    window-triggered overlaps, and — the scalar the whole subsystem
-    exists for — reports ZERO invariant violations."""
+    streams): the seeded soak survives every cycle, fires EVERY
+    registered fault kind (the roster is the registry —
+    crucible.FAULT_KIND_REGISTRY — not a hand-counted constant, so
+    registering a new kind without scheduling it in
+    default_schedule fails here), lands window-triggered overlaps,
+    and — the scalar the whole subsystem exists for — reports ZERO
+    invariant violations."""
+    from k8s_dra_driver_tpu.cluster import crucible
     from k8s_dra_driver_tpu.cluster.chaosprobe import crucible_probe
     out = crucible_probe(**bench.CRUCIBLE_KWARGS,
                          workdir=str(tmp_path))
     assert out["cru_survived_cycles"] == bench.CRUCIBLE_KWARGS["cycles"]
     assert out["cru_invariant_violations"] == 0
-    assert out["cru_fault_kinds"] == 11
+    assert out["cru_fault_kinds"] == len(crucible.EVENT_KINDS)
+    assert set(crucible.EVENT_KINDS) == set(
+        crucible.FAULT_KIND_REGISTRY)
     assert out["cru_overlap_hits"] >= 3
     assert out["cru_compound_mttr_ms"] > 0
     assert out["cru_finished"] == out["cru_submitted"] > 0
     assert out["cru_operator_repairs"] == 0
+
+
+def test_fleet_sim_probe_streams_scale_evidence(tmp_path):
+    """The fleet-simulator probe at the hermetic shape bench.py
+    streams (same kwargs object, so this pins what actually
+    streams): the thousand-replica soak survives every cycle with
+    ZERO invariant violations, the contended A/B shows the
+    pathology split (spread pre-fix starves, spread fixed grants,
+    packed never needs a drain), and the ddmin-minimized
+    drain-starvation repro still replays to a starved verdict."""
+    from k8s_dra_driver_tpu.sim.probe import fleet_sim_probe
+    out = fleet_sim_probe(**bench.FLEET_SIM_KWARGS,
+                          workdir=str(tmp_path))
+    assert out["sim_replicas"] == 1000
+    assert out["sim_survived_cycles"] == bench.FLEET_SIM_KWARGS[
+        "cycles"]
+    assert out["sim_invariant_violations"] == 0
+    assert out["sim_events_per_s"] > 0
+    assert out["sim_pathology_repro_ms"] > 0
+    assert out["sim_minimized_events"] == 1
+    assert out["sim_repro_starved"] is True
+    ab = out["ab"]
+    assert ab["spread_prefix"]["starved"] is True
+    assert ab["spread_prefix"]["spike_grant_t"] is None
+    assert ab["spread_fixed"]["starved"] is False
+    assert ab["spread_fixed"]["spike_grant_t"] is not None
+    assert ab["packed_prefix"]["drains"] == 0
+    assert ab["packed_prefix"]["straddled_domains"] == 0
+    assert (ab["spread_prefix"]["free_conflicted"]
+            > ab["packed_prefix"]["free_conflicted"])
+
+
+def test_probe_roster_pins_fleet_sim_scalars():
+    """Bench-line schema: the fleet-simulator scalars (events/s at
+    1000 replicas, fleet size, minimized-pathology replay cost) are
+    IN the compact line roster."""
+    probes = [p for p, _, _ in bench._PROBE_SCALARS]
+    assert "fleet_sim" in probes
+    keys = {k: f for _, k, f in bench._PROBE_SCALARS}
+    assert keys["sim_events_per_s"] == "sim_events_per_s"
+    assert keys["sim_replicas"] == "sim_replicas"
+    assert keys["sim_pathology_repro_ms"] == "sim_pathology_repro_ms"
+
+
+def test_fleet_sim_artifact_pins_claims():
+    """THE fleet-simulator acceptance gates (repo rule: perf claims
+    trace to tools/*.json): the recorded round must show the
+    thousand-replica soak clean, the packed-vs-spread fragmentation
+    split, the pre-fix starvation vs post-fix grant verdict, and a
+    sub-second minimized-pathology replay."""
+    artifact = Path(__file__).parent.parent / "tools" / \
+        "fleet_sim_cpu.json"
+    doc = bench.json.loads(artifact.read_text())
+    res = doc["result"]
+    assert doc["probe"] == "fleet_sim"
+    assert doc["harness"] == "sim/probe.py fleet_sim_probe"
+    assert res["sim_replicas"] == 1000
+    assert res["sim_invariant_violations"] == 0
+    assert res["sim_events_per_s"] >= 100
+    assert res["sim_pathology_repro_ms"] <= 5000
+    assert res["sim_repro_starved"] is True
+    assert res["sim_minimized_events"] == 1
+    ab = res["ab"]
+    assert ab["spread_prefix"]["starved"] is True
+    assert ab["spread_fixed"]["starved"] is False
+    assert ab["packed_prefix"]["straddled_domains"] == 0
+    assert (ab["spread_prefix"]["free_conflicted"]
+            > 10 * ab["packed_prefix"]["free_conflicted"])
 
 
 def test_resharding_probe_streams_detection_and_scaling(tmp_path):
@@ -699,7 +772,7 @@ def test_final_line_fits_driver_capture():
     Pin the new contract: the worst-case compact line stays under
     LINE_BUDGET and survives the tail capture."""
     line_obj = bench.compact_summary(_worst_case_result())
-    line = bench.json.dumps(line_obj)
+    line = bench._dumps_line(line_obj)
     assert len(line) < bench.LINE_BUDGET, len(line)
     # simulate the driver: lots of stray output, then the line; only
     # the last ~2 KB survive, and the last line of that must parse
@@ -757,7 +830,7 @@ def test_fit_line_clips_tail_not_headline():
             "summary": {"attention_x": 4.08,
                         **{f"future_probe_{i}": 1.0 for i in range(200)}}}
     fitted = bench._fit_line(dict(line, summary=dict(line["summary"])))
-    assert len(bench.json.dumps(fitted)) <= bench.LINE_BUDGET
+    assert len(bench._dumps_line(fitted)) <= bench.LINE_BUDGET
     assert fitted["summary"]["attention_x"] == 4.08
     assert fitted["summary_clipped"] > 0
 
@@ -827,8 +900,18 @@ def test_cpu_run_diverts_sidecar_from_tpu_artifact(tmp_path,
 
 def test_rendezvous_gang_probe():
     """The contract→collective probe at reduced width: two real
-    processes consume a real prepare's env and psum across processes."""
+    processes consume a real prepare's env and psum across
+    processes.  Some images ship an XLA CPU backend without
+    cross-process collectives ("Multiprocess computations aren't
+    implemented on the CPU backend") — the probe itself is the
+    capability detector, and on such images this test SKIPS loudly
+    with the backend's own words rather than failing on a capability
+    the code under test doesn't control."""
     out = bench.bench_rendezvous_gang(n_workers=2)
+    err = out.get("error") or ""
+    if "Multiprocess computations aren't implemented" in err:
+        pytest.skip("image's XLA CPU backend lacks cross-process "
+                    "collectives: " + err[-160:])
     assert out.get("psum_ok") is True, out
     assert out["wall_ms"] > 0
 

@@ -129,3 +129,17 @@ def test_scope_reaches_the_adapter_serving_tier():
     scoped = [p for scope in lint_deadlines.SCOPES
               for p in (repo / scope).rglob("*.py")]
     assert any("serving_lora" in str(p) for p in scoped)
+
+
+def test_scope_reaches_the_fleet_simulator():
+    """ISSUE 19 satellite: the package-wide scope walks sim/ too —
+    the event heap's ``run`` carries a ``max_events`` backstop, and
+    any blocking wait that appears must carry a deadline like
+    everything else."""
+    repo = Path(lint_deadlines.REPO)
+    scoped = [p for scope in lint_deadlines.SCOPES
+              for p in (repo / scope).rglob("*.py")]
+    assert any((Path("sim") / "clock.py").as_posix() in p.as_posix()
+               for p in scoped)
+    assert any((Path("sim") / "rig.py").as_posix() in p.as_posix()
+               for p in scoped)

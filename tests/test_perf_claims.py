@@ -38,6 +38,13 @@ def test_scope_covers_the_adapter_serving_tier():
     assert "k8s_dra_driver_tpu/serving_lora" in lint_perf_claims.SCOPES
 
 
+def test_scope_covers_the_fleet_simulator():
+    """ISSUE 19 satellite: sim/ docstrings carry events-per-second
+    and replay-cost claims (tools/fleet_sim_cpu.json), so the lint
+    walks them too."""
+    assert "k8s_dra_driver_tpu/sim" in lint_perf_claims.SCOPES
+
+
 def _scratch_repo(tmp_path, body, artifact=True):
     mod_dir = tmp_path / "k8s_dra_driver_tpu" / "ops"
     mod_dir.mkdir(parents=True)

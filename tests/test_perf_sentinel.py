@@ -107,6 +107,33 @@ class TestVerdicts:
         assert perf_sentinel.direction_of(
             "spec_verify_ms") == "lower"
 
+    def test_fleet_sim_scalars_classify_direction(self):
+        """The ISSUE 19 scalars, same suffix discipline: heap
+        events/s is a RATE (higher, via ``_per_s`` before the
+        duration rule can see the trailing ``_s``), fleet size is
+        higher via the explicit ``_replicas`` rule (shrinking the
+        simulated fleet must read as a regression, not noise), and
+        the minimized-pathology replay cost is lower via ``_ms``."""
+        assert perf_sentinel.direction_of(
+            "sim_events_per_s") == "higher"
+        assert perf_sentinel.direction_of(
+            "sim_replicas") == "higher"
+        assert perf_sentinel.direction_of(
+            "sim_pathology_repro_ms") == "lower"
+
+    def test_fleet_sim_artifact_gated(self):
+        """The recorded fleet-sim round is load-bearing: the gates
+        cover invariant cleanliness, events/s, replay cost, and the
+        packed layout's zero straddled domains."""
+        gated = [g for g in perf_sentinel.ARTIFACT_GATES
+                 if g[0] == "tools/fleet_sim_cpu.json"]
+        keys = {g[1] for g in gated}
+        assert ("result", "sim_invariant_violations") in keys
+        assert ("result", "sim_events_per_s") in keys
+        assert ("result", "sim_pathology_repro_ms") in keys
+        assert ("result", "ab", "packed_prefix",
+                "straddled_domains") in keys
+
     def test_improvement_recognized(self, tmp_path):
         _fixture(tmp_path, {"decode_tok_s": 200.0,
                             "sup_mttr_ms": 52.0})

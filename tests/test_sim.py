@@ -1,0 +1,452 @@
+"""Fleet simulator pins (k8s_dra_driver_tpu/sim/): event-heap
+semantics, the VirtualClock extraction, the binpack/entitlement
+fast-path equivalences the simulator's scale depends on, O(events)
+cost, journal determinism, and the drain-starvation pathology pair
+(the regression tests for the fix the simulator found —
+docs/SIMULATION.md)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from k8s_dra_driver_tpu.cluster.crucible import FaultEvent, Schedule
+from k8s_dra_driver_tpu.fleet.binpack import TopologyBinPacker
+from k8s_dra_driver_tpu.fleet.supply import (ChipLedger, serving_tag,
+                                             training_tag)
+from k8s_dra_driver_tpu.fleet.tenancy import (MtConfig, TenantRegistry,
+                                              TenantSpec, TenantState,
+                                              entitlements)
+from k8s_dra_driver_tpu.gateway import loadgen
+from k8s_dra_driver_tpu.sim import clock as sim_clock
+from k8s_dra_driver_tpu.sim.clock import EventHeap, VirtualClock
+from k8s_dra_driver_tpu.sim.fleet import SimConfig, build_fleet
+from k8s_dra_driver_tpu.sim.rig import (default_sim_schedule,
+                                        run_sim_soak)
+
+
+# -- event heap ----------------------------------------------------------
+
+
+class TestEventHeap:
+    def test_fires_in_time_then_insertion_order(self):
+        heap, log = EventHeap(), []
+        heap.at(2.0, log.append, "b")
+        heap.at(1.0, log.append, "a")
+        heap.at(2.0, log.append, "c")     # tie: insertion order
+        heap.at(3.0, log.append, "d")
+        heap.advance_to(2.5)
+        assert log == ["a", "b", "c"]
+        assert heap.now == 2.5
+        assert heap.processed == 3
+
+    def test_past_schedules_clamp_to_now(self):
+        heap, log = EventHeap(), []
+        heap.advance_to(5.0)
+        heap.at(1.0, log.append, "late")
+        assert heap.next_time() == 5.0
+        heap.advance_to(5.0)
+        assert log == ["late"]
+
+    def test_callbacks_see_their_own_timestamp(self):
+        heap, seen = EventHeap(), []
+        heap.at(1.5, lambda: seen.append(heap.now))
+        heap.at(4.0, lambda: seen.append(heap.now))
+        heap.advance_to(10.0)
+        assert seen == [1.5, 4.0]
+        assert heap.now == 10.0
+
+    def test_callbacks_may_schedule_within_the_advance(self):
+        heap, log = EventHeap(), []
+
+        def fire():
+            log.append(heap.now)
+            if heap.now < 3.0:
+                heap.after(1.0, fire)
+
+        heap.at(1.0, fire)
+        heap.run(until=10.0)
+        assert log == [1.0, 2.0, 3.0]
+
+    def test_run_backstop_raises_on_runaway(self):
+        heap = EventHeap()
+
+        def forever():
+            heap.after(0.0, forever)
+
+        heap.at(0.0, forever)
+        with pytest.raises(RuntimeError, match="exceeded"):
+            heap.run(until=1.0, max_events=100)
+
+
+# -- VirtualClock extraction (ISSUE 19 satellite) ------------------------
+
+
+class TestVirtualClockExtraction:
+    def test_loadgen_reexports_the_sim_class(self):
+        """The loadgen VirtualClock IS the sim one — one class, two
+        import paths, so clock-injected code keeps working and the
+        simulator shares the exact primitive the replays used."""
+        assert loadgen.VirtualClock is sim_clock.VirtualClock
+        assert "VirtualClock" in loadgen.__all__
+
+    def test_checked_in_traces_regenerate_bit_for_bit(self):
+        """Every checked-in trace fixture equals its generator output
+        exactly — the extraction changed no byte of any trace."""
+        for name in loadgen._FIXTURE_SEEDS:
+            assert loadgen.load_trace(name) == \
+                loadgen.generate_trace(name)
+
+    def test_replay_bit_identical_under_virtual_clock(self):
+        """Two virtual-clock replays of the same trace produce the
+        identical submission timeline — the determinism the fleet
+        simulator's arrival scheduling inherits."""
+
+        class _Manager:
+            replicas = ()
+
+        class RecordingGateway:
+            manager = _Manager()
+
+            def __init__(self, clock):
+                self.clock = clock
+                self.log = []
+
+            def submit(self, req, slo_s=None, tenant=None):
+                self.log.append((round(self.clock(), 9), req,
+                                 tenant))
+
+            def step(self):
+                pass
+
+            def pending(self):
+                return 0
+
+        trace = loadgen.load_trace("heavy_tail")
+        runs = []
+        for _ in range(2):
+            vc = VirtualClock()
+            gw = RecordingGateway(vc)
+            out = loadgen.replay(gw, trace, offered_x=1.0,
+                                 base_rps=50.0,
+                                 make_request=lambda i: f"r{i}",
+                                 n_requests=40, clock=vc,
+                                 sleep=vc.sleep)
+            runs.append((gw.log, out["submitted"]))
+        assert runs[0] == runs[1]
+        assert runs[0][1] == 40
+
+
+# -- binpack fast-path equivalence ---------------------------------------
+
+
+def _random_ledger(rng, n_chips, domain_size, tenants):
+    ledger = ChipLedger(range(n_chips))
+    for c in range(n_chips):
+        roll = rng.random()
+        if roll < 0.35:
+            t = tenants[int(rng.integers(len(tenants)))]
+            ledger.owners[c] = (training_tag(t) if rng.random() < 0.3
+                                else serving_tag(t, f"{t}-r{c}"))
+        elif roll < 0.45:
+            ledger.unhealthy[c] = "sim"
+    return TopologyBinPacker(ledger, domain_size=domain_size)
+
+
+def _naive_place_chip(pk, tenant):
+    """place_chip as originally written: conflict table and distance
+    rescans PER CANDIDATE — the O(chips^2) form the hoisted version
+    must match decision-for-decision."""
+    own = sorted(pk._pos[c] for c in pk._tenant_chips(tenant))
+    own_domains = {p // pk.domain_size for p in own}
+    others = sorted(pk._pos[c] for c in pk._other_chips(tenant))
+    best, best_key = None, None
+    for c in pk._free_healthy():
+        p = pk._pos[c]
+        if pk._conflicts([c], tenant):
+            continue
+        key = (p // pk.domain_size in own_domains,
+               pk._min_dist(p, others),
+               -pk._min_dist(p, own) if own else 0,
+               p)
+        if best_key is None or key > best_key:
+            best, best_key = c, key
+    return best
+
+
+def _naive_place_run(pk, tenant, n, usable_owner=None):
+    """place_run's original per-window rescan form."""
+    chips = pk.ledger.chips
+    own = set(pk._tenant_chips(tenant))
+    best, best_key = None, None
+    for start in range(len(chips) - n + 1):
+        window = chips[start:start + n]
+        ok = True
+        for c in window:
+            owner = pk.ledger.owners.get(c)
+            if c in pk.ledger.unhealthy or not (
+                    owner is None or (usable_owner is not None
+                                      and owner == usable_owner)):
+                ok = False
+                break
+        if not ok or pk._conflicts(window, tenant):
+            continue
+        remaining = pk._largest_free_run(exclude=set(window))
+        key = (sum(1 for c in window if c in own), remaining, -start)
+        if best_key is None or key > best_key:
+            best, best_key = tuple(window), key
+    return best
+
+
+class TestBinpackEquivalence:
+    def test_min_dist_sorted_matches_linear(self):
+        rng = np.random.default_rng(11)
+        for _ in range(300):
+            positions = sorted(rng.integers(0, 200, size=int(
+                rng.integers(0, 12))).tolist())
+            pos = int(rng.integers(0, 200))
+            assert (TopologyBinPacker._min_dist_sorted(pos, positions)
+                    == TopologyBinPacker._min_dist(pos, positions))
+
+    def test_place_chip_matches_per_candidate_rescan(self):
+        rng = np.random.default_rng(13)
+        tenants = ["a", "b", "c"]
+        for _ in range(150):
+            pk = _random_ledger(rng, int(rng.integers(8, 40)),
+                                int(rng.choice([1, 2, 4])), tenants)
+            t = tenants[int(rng.integers(len(tenants)))]
+            assert pk.place_chip(t) == _naive_place_chip(pk, t)
+
+    def test_place_run_matches_per_window_rescan(self):
+        rng = np.random.default_rng(17)
+        tenants = ["a", "b", "c"]
+        for _ in range(150):
+            pk = _random_ledger(rng, int(rng.integers(8, 40)),
+                                int(rng.choice([1, 2, 4])), tenants)
+            t = tenants[int(rng.integers(len(tenants)))]
+            n = int(rng.integers(1, 6))
+            use = training_tag(t) if rng.random() < 0.5 else None
+            got = pk.place_run(t, n, usable_owner=use)
+            want = _naive_place_run(pk, t, n, usable_owner=use)
+            assert (got.chips if got else None) == want
+
+    def test_largest_free_run_excluding_matches_rescan(self):
+        rng = np.random.default_rng(19)
+        for _ in range(300):
+            n = int(rng.integers(4, 30))
+            free = [bool(rng.random() < 0.6) for _ in range(n)]
+            segs = TopologyBinPacker._free_segments(free)
+            seg_starts = [s for s, _ in segs]
+            seg_ends = [e for _, e in segs]
+            pre = [0] * (len(segs) + 1)
+            for i, (s, e) in enumerate(segs):
+                pre[i + 1] = max(pre[i], e - s + 1)
+            suf = [0] * (len(segs) + 1)
+            for i in range(len(segs) - 1, -1, -1):
+                s, e = segs[i]
+                suf[i] = max(suf[i + 1], e - s + 1)
+            lo = int(rng.integers(0, n))
+            hi = int(rng.integers(lo, n))
+            got = TopologyBinPacker._largest_free_run_excluding(
+                segs, seg_starts, seg_ends, pre, suf, lo, hi)
+            best = run = 0
+            for i, ok in enumerate(free):
+                if ok and not (lo <= i <= hi):
+                    run += 1
+                    best = max(best, run)
+                else:
+                    run = 0
+            assert got == best
+
+
+# -- entitlement heap equivalence ----------------------------------------
+
+
+def _naive_entitlements(states, capacity):
+    """The per-chip argmin rescan the heap replaced."""
+    ent = {s.spec.name: min(s.spec.floor, s.spec.quota)
+           for s in states}
+    remaining = capacity - sum(ent.values())
+    by_prio = {}
+    for s in states:
+        by_prio.setdefault(s.spec.priority, []).append(s)
+    for prio in sorted(by_prio, reverse=True):
+        if remaining <= 0:
+            break
+        want = {s.spec.name: min(s.wanted, s.spec.quota)
+                for s in by_prio[prio]}
+        share = {s.spec.name: s.spec.share for s in by_prio[prio]}
+        while remaining > 0:
+            under = [n for n in want if ent[n] < want[n]]
+            if not under:
+                break
+            name = min(under, key=lambda n: (ent[n] / share[n], n))
+            ent[name] += 1
+            remaining -= 1
+    return ent
+
+
+class TestEntitlementHeapEquivalence:
+    def test_heap_matches_argmin_rescan(self):
+        rng = np.random.default_rng(23)
+        for _ in range(100):
+            states = []
+            for i in range(int(rng.integers(1, 20))):
+                quota = int(rng.integers(1, 12))
+                spec = TenantSpec(
+                    name=f"t{i:02d}", priority=int(rng.integers(1, 4)),
+                    quota=quota,
+                    floor=int(rng.integers(0, quota + 1)),
+                    share=float(rng.choice([0.5, 1.0, 2.0])))
+                states.append(TenantState(
+                    spec=spec, kind="serving", chips=frozenset(),
+                    wanted=int(rng.integers(0, 16))))
+            capacity = int(rng.integers(0, 64))
+            assert (entitlements(states, capacity)
+                    == _naive_entitlements(states, capacity))
+
+
+class TestRegistryCaching:
+    def test_floor_guard_and_cached_order(self):
+        reg = TenantRegistry(capacity=10)
+        reg.add(TenantSpec(name="b", priority=2, quota=6, floor=4),
+                object())
+        reg.add(TenantSpec(name="a", priority=2, quota=6, floor=4),
+                object())
+        with pytest.raises(ValueError, match="exceed"):
+            reg.add(TenantSpec(name="c", priority=1, quota=6,
+                               floor=3), object())
+        order = [s.name for s in reg.by_priority(reverse=False)]
+        assert order == ["a", "b"]
+        # cached list must not be corruptible by caller mutation
+        reg.by_priority().clear()
+        assert [s.name for s in reg.by_priority(reverse=False)] == \
+            ["a", "b"]
+        reg.add(TenantSpec(name="0", priority=3, quota=2, floor=2),
+                object())
+        assert [s.name for s in reg.by_priority()] == ["0", "b", "a"]
+
+
+# -- fleet determinism + O(events) ---------------------------------------
+
+
+class TestFleetScale:
+    def test_same_seed_same_journal_digest(self, tmp_path):
+        """Byte-identical journals on a same-seed rerun — the replay
+        contract the ddmin minimizer depends on."""
+        sched = default_sim_schedule(7, cycles=30)
+        r1, f1 = run_sim_soak(sched, tmp_path / "a",
+                              config=SimConfig.tiny())
+        r2, f2 = run_sim_soak(sched, tmp_path / "b",
+                              config=SimConfig.tiny())
+        assert f1.journal_digest() == f2.journal_digest()
+        assert r1.ok() and r2.ok()
+
+    def test_different_seed_different_journal(self, tmp_path):
+        sched = default_sim_schedule(7, cycles=30)
+        _, f1 = run_sim_soak(sched, tmp_path / "a",
+                             config=SimConfig.tiny(seed=7))
+        _, f2 = run_sim_soak(sched, tmp_path / "b",
+                             config=SimConfig.tiny(seed=8))
+        assert f1.journal_digest() != f2.journal_digest()
+
+    def test_idle_hour_pops_zero_events_at_1000_replicas(self):
+        """THE O(events) pin: a thousand idle replicas cost NOTHING
+        to advance past.  Build the headline fleet with no arrivals,
+        park the gangs (their step loops are the only perpetual
+        event source), drain the residue, and an hour of virtual
+        time pops zero events."""
+        fleet = build_fleet(SimConfig(seed=7, n_requests=0))
+        assert sum(len(fleet.gateways[p].manager.replicas)
+                   for p in fleet.pool_names) == 1000
+        for sup in fleet.sups.values():
+            sup.park()
+        fleet.heap.run(until=fleet.heap.now + 5.0)
+        before = fleet.heap.processed
+        fleet.heap.run(until=fleet.heap.now + 3600.0)
+        assert fleet.heap.processed == before
+        assert fleet.heap.now >= 3605.0
+
+    def test_contended_ab_fragmentation_split(self):
+        """The A/B the pathology rode in on: spread placement leaves
+        EVERY free chip domain-conflicted; packed keeps whole
+        domains free (recorded round: tools/fleet_sim_cpu.json)."""
+        spread = build_fleet(SimConfig.contended("spread"))
+        packed = build_fleet(SimConfig.contended("packed"))
+        # owners land in the ledger at the reconciler's sync — one
+        # tick each (no streak-gated action can fire on tick one)
+        spread.recon.tick()
+        packed.recon.tick()
+        fs, fp = spread.fragmentation(), packed.fragmentation()
+        assert fs["free_conflicted"] == fs["free"] > 0
+        assert fp["straddled_domains"] == 0
+        assert fp["free_conflicted"] < fs["free_conflicted"] / 10
+        assert fp["largest_free_block"] > fs["largest_free_block"]
+
+
+# -- the found pathology: domain-blind reclaim drains --------------------
+
+
+def _burst_schedule():
+    return Schedule(seed=7, cycles=30, events=[
+        FaultEvent(id="spike-wave", kind="burst", at_cycle=2, n=24),
+    ])
+
+
+def _spike_events(fleet):
+    grants = [t for t, k, i in fleet.recon.events
+              if k == "grant" and i.get("tenant") == "spike"]
+    drains = [i for t, k, i in fleet.recon.events
+              if k == "reclaim_drain"]
+    return grants, drains
+
+
+class TestDrainStarvationRegression:
+    """The pathology the thousand-replica soak found, ddmin-minimized
+    to the 28-chip ``SimConfig.repro()`` testbed (docs/SIMULATION.md):
+    under spread placement the reclaim cascade picked victims
+    newest-first with no topology awareness, scattering drains across
+    link domains so no domain ever emptied — the high-priority
+    newcomer starved with hundreds of free (conflicted) chips on the
+    floor.  The fix (MtConfig.domain_aware_drain) sorts victims by
+    beneficiary-domain residue so drains CONCENTRATE.  These two
+    tests are the regression pair: the first fails if the fix is
+    reverted, the second pins the pre-fix behavior the A/B records."""
+
+    def test_default_config_concentrates_drains_and_grants(
+            self, tmp_path):
+        res, fleet = run_sim_soak(_burst_schedule(), tmp_path,
+                                  config=SimConfig.repro())
+        grants, drains = _spike_events(fleet)
+        assert res.ok(), res.violations
+        assert grants, "spike tenant never granted under the fix"
+        # concentration: every drained chip sits in ONE link domain
+        pk = fleet.packer
+        assert len({pk.domain_of(d["chip"]) for d in drains}) == 1
+
+    def test_domain_blind_drains_starve_the_spike(self, tmp_path):
+        cfg = SimConfig.repro(
+            mt_config=MtConfig(domain_aware_drain=False))
+        res, fleet = run_sim_soak(_burst_schedule(), tmp_path,
+                                  config=cfg)
+        grants, drains = _spike_events(fleet)
+        assert not grants
+        assert drains, "cascade never even started"
+        # scattered: the drains straddle multiple domains
+        pk = fleet.packer
+        assert len({pk.domain_of(d["chip"]) for d in drains}) > 1
+        starved = [m for _, msgs in res.violations for m in msgs
+                   if "starvation" in m]
+        assert starved, res.violations
+        assert "spike" in starved[0]
+
+
+class TestSoakArtifacts:
+    def test_sim_soak_json_lands_with_digest(self, tmp_path):
+        res, fleet = run_sim_soak(default_sim_schedule(7, cycles=20),
+                                  tmp_path, config=SimConfig.tiny())
+        doc = json.loads((tmp_path / "sim_soak.json").read_text())
+        assert doc["journal_digest"] == fleet.journal_digest()
+        assert doc["events_processed"] == fleet.heap.processed
+        assert doc["config"]["n_replicas"] == 12
+        assert doc["violations"] == []

@@ -1,0 +1,150 @@
+"""Crucible x fleet simulator: the fault schedule, invariant
+checkers, and ddmin minimizer run UNCHANGED against the simulated
+fleet through the ``soak=`` seam (cluster/crucible.py) — the
+tentpole contract of the sim/ subsystem.  The drain-starvation
+pathology pins ride in tests/test_sim.py; here the pins are the
+seam itself: roster coverage, fidelity no-ops, minimization,
+deterministic replay, and the one-call investigate workflow."""
+
+import json
+
+import pytest
+
+from k8s_dra_driver_tpu.cluster import crucible
+from k8s_dra_driver_tpu.cluster.crucible import FaultEvent, Schedule
+from k8s_dra_driver_tpu.fleet.tenancy import MtConfig
+from k8s_dra_driver_tpu.sim.fleet import SimConfig
+from k8s_dra_driver_tpu.sim.rig import (NOOP_KINDS,
+                                        default_sim_schedule,
+                                        run_sim_soak, sim_soak_for)
+
+
+def _starved(res) -> bool:
+    return any("starvation" in m
+               for _, msgs in res.violations for m in msgs)
+
+
+def _noisy_starvation_schedule() -> Schedule:
+    """The burst that wedges the pre-fix arbiter, buried in decoy
+    faults ddmin must throw away."""
+    return Schedule(seed=7, cycles=30, events=[
+        FaultEvent(id="gang-chip", kind="chip_kill", at_cycle=1,
+                   chip=1),
+        FaultEvent(id="spike-wave", kind="burst", at_cycle=2, n=24),
+        FaultEvent(id="bitflip", kind="shard_bitflip", at_cycle=4),
+        FaultEvent(id="tear", kind="gen_tear", at_cycle=6),
+        FaultEvent(id="kv", kind="kv_exhaust", at_cycle=8),
+    ])
+
+
+@pytest.fixture()
+def prefix_soak():
+    """The crucible-shaped soak over the testbed repro fleet with the
+    drain fix DISABLED — the configuration the pathology lives in."""
+    return sim_soak_for(SimConfig.repro(
+        mt_config=MtConfig(domain_aware_drain=False)))
+
+
+class TestSoakContract:
+    def test_default_schedule_survives_and_fires_every_kind(
+            self, tmp_path):
+        """The registry IS the roster: the sim schedule exercises
+        every registered fault kind against the simulated fleet and
+        survives all of it with zero invariant violations."""
+        res, fleet = run_sim_soak(default_sim_schedule(7, cycles=60),
+                                  tmp_path, config=SimConfig.tiny())
+        assert res.ok(), res.violations
+        assert res.survived_cycles == 60
+        assert res.fault_kinds_fired == sorted(
+            crucible.FAULT_KIND_REGISTRY)
+        assert res.overlap_hits >= 1
+        assert res.finished > 0
+
+    def test_sim_schedule_covers_the_registry(self):
+        """Registering a new fault kind without scheduling it in
+        default_sim_schedule fails here — same discipline as the
+        chaosprobe roster pin."""
+        sched = default_sim_schedule(7, cycles=60)
+        assert {e.kind for e in sched.events} == set(
+            crucible.FAULT_KIND_REGISTRY)
+
+    def test_noop_kinds_are_logged_not_modeled(self, tmp_path):
+        """The fidelity contract (docs/SIMULATION.md): byte-level
+        faults are journal-logged no-ops in the sim — present in the
+        journal (so schedules replay completely) but mutating
+        nothing (so no phantom recoveries)."""
+        res, fleet = run_sim_soak(default_sim_schedule(7, cycles=60),
+                                  tmp_path, config=SimConfig.tiny())
+        logged = {k for _, k, i in fleet.journal
+                  if isinstance(i, dict) and i.get("noop")}
+        assert logged == {f"fault.{k}" for k in NOOP_KINDS}
+
+    def test_crucible_result_shape_feeds_minimize(self, tmp_path):
+        """run_sim_soak returns a real CrucibleResult — the minimizer
+        and replay consume it with zero adaptation."""
+        res, _ = run_sim_soak(default_sim_schedule(7, cycles=20),
+                              tmp_path, config=SimConfig.tiny())
+        assert isinstance(res, crucible.CrucibleResult)
+        assert res.ok() == (not res.violations
+                            and not res.gang_failures)
+
+
+class TestMinimizeThroughSeam:
+    def test_ddmin_reduces_to_the_single_burst(self, tmp_path,
+                                               prefix_soak):
+        minimized, runs = crucible.minimize(
+            _noisy_starvation_schedule(), tmp_path, soak=prefix_soak,
+            check=_starved)
+        assert len(minimized.events) == 1
+        assert minimized.events[0].kind == "burst"
+        assert runs <= 16
+
+    def test_minimized_repro_replays_deterministically(
+            self, tmp_path, prefix_soak):
+        minimized, _ = crucible.minimize(
+            _noisy_starvation_schedule(), tmp_path / "ddmin",
+            soak=prefix_soak, check=_starved)
+        min_res, _ = prefix_soak(minimized, tmp_path / "m")
+        repro = crucible.write_repro(tmp_path / "repro.json",
+                                     minimized, min_res)
+        r1, f1 = crucible.replay(repro, tmp_path / "r1",
+                                 soak=prefix_soak)
+        r2, f2 = crucible.replay(repro, tmp_path / "r2",
+                                 soak=prefix_soak)
+        assert _starved(r1) and _starved(r2)
+        assert f1.journal_digest() == f2.journal_digest()
+        assert r1.violations == r2.violations
+
+    def test_repro_file_is_auditable_json(self, tmp_path,
+                                          prefix_soak):
+        minimized, _ = crucible.minimize(
+            _noisy_starvation_schedule(), tmp_path / "ddmin",
+            soak=prefix_soak, check=_starved)
+        min_res, _ = prefix_soak(minimized, tmp_path / "m")
+        repro = crucible.write_repro(tmp_path / "repro.json",
+                                     minimized, min_res)
+        doc = json.loads(repro.read_text())
+        assert doc["format"] == crucible.REPRO_FORMAT
+        assert any("starvation" in v for _, vs in doc["violations"]
+                   for v in vs)
+
+
+class TestInvestigateThroughSeam:
+    def test_one_call_workflow_confirms_the_pathology(
+            self, tmp_path, prefix_soak):
+        out = crucible.investigate(_noisy_starvation_schedule(),
+                                   tmp_path, soak=prefix_soak)
+        assert out["confirmed"] is True
+        assert len(out["minimized"].events) == 1
+        assert out["repro"].exists()
+        assert _starved(out["confirm_result"])
+
+    def test_clean_fleet_yields_no_repro(self, tmp_path):
+        """Same schedule, fix ENABLED: investigate finds nothing to
+        minimize — the fixed policy layer absorbs the burst."""
+        soak = sim_soak_for(SimConfig.repro())
+        out = crucible.investigate(_noisy_starvation_schedule(),
+                                   tmp_path, soak=soak)
+        assert out["result"].ok()
+        assert out["minimized"] is None
+        assert out["repro"] is None

@@ -38,7 +38,8 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 SCOPES = ("k8s_dra_driver_tpu/ops", "k8s_dra_driver_tpu/models",
           "k8s_dra_driver_tpu/fleet", "k8s_dra_driver_tpu/gateway",
           "k8s_dra_driver_tpu/serving_kv",
-          "k8s_dra_driver_tpu/serving_lora")
+          "k8s_dra_driver_tpu/serving_lora",
+          "k8s_dra_driver_tpu/sim")
 
 #: perf-shaped numbers: "1.61x" (not "2x2" tile spellings), and
 #: numbers wearing a throughput/latency/bandwidth unit

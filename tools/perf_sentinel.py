@@ -65,7 +65,7 @@ REL_BAND = 0.25
 DIRECTION_RULES = (
     (re.compile(r"overhead_x$"), "lower"),
     (re.compile(r"(_x|_tflops|_gbps|_tok_s|_tps|_rps|_per_s|_frac"
-                r"|_ok|_accept_rate)$"), "higher"),
+                r"|_ok|_accept_rate|_replicas)$"), "higher"),
     (re.compile(r"(_ms|_s|_seconds|_ns|_us)$"), "lower"),
 )
 
@@ -95,6 +95,20 @@ ARTIFACT_GATES = (
     # residency ledger stopped keeping hot adapters resident
     ("tools/lora_serving_cpu.json",
      ("result", "lora_resident_hit_frac"), ">=", 0.4),
+    # fleet simulator (sim/probe.py): the thousand-replica soak must
+    # stay invariant-clean, keep O(events) throughput above the bar,
+    # replay the minimized drain-starvation repro in bounded wall
+    # time, and the packed layout of the contended A/B must keep
+    # whole link domains free (zero straddled domains)
+    ("tools/fleet_sim_cpu.json",
+     ("result", "sim_invariant_violations"), "<=", 0),
+    ("tools/fleet_sim_cpu.json",
+     ("result", "sim_events_per_s"), ">=", 100),
+    ("tools/fleet_sim_cpu.json",
+     ("result", "sim_pathology_repro_ms"), "<=", 5000),
+    ("tools/fleet_sim_cpu.json",
+     ("result", "ab", "packed_prefix", "straddled_domains"),
+     "<=", 0),
 )
 
 
