@@ -123,6 +123,12 @@ FLEET_SIM_KWARGS = dict(seed=7, cycles=20, ab_cycles=70)
 #: checked in the same run
 PAGED_KV_KWARGS = dict(wave=6, repeats=5)
 
+#: KV-tiering probe (serving_kv/tierprobe.py): the promote-vs-
+#: recompute duel on a demoted shared prefix + a demote/promote
+#: churn wave under a tight device watermark, byte-equality (greedy
+#: and sampled) checked against the recompute twin in the same run
+SERVING_TIER_KWARGS = dict(repeats=5, prefix_len=112)
+
 #: speculative-decode probe (models/specprobe.py): the induction-ramp
 #: duel — ngram drafts fused into the chained loop vs the identical
 #: non-speculative engine, byte-equality checked in the same run
@@ -869,6 +875,43 @@ def _paged_kv_probe(timeout_s: float = 300.0) -> dict:
     return payload
 
 
+def _serving_tier_probe(timeout_s: float = 300.0) -> dict:
+    """KV-tiering probe (serving_kv/tierprobe.py) in a CPU-pinned
+    subprocess: promote-vs-recompute wall on a demoted shared
+    prefix (crc-verified host slab device_put + suffix prefill vs
+    full-prompt prefill), plus the prefix hit fraction across a
+    demote/promote churn wave, outputs verified byte-equal (greedy
+    and sampled) in the same run."""
+    import subprocess
+
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+
+    kwargs = json.dumps(SERVING_TIER_KWARGS)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.serving_kv.tierprobe import "
+        "serving_tier_probe\n"
+        f"print(json.dumps(serving_tier_probe("
+        f"**json.loads({kwargs!r}))))\n")
+    env = cpu_jax_env(1)
+    try:
+        res = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                             env=env, capture_output=True, text=True,
+                             timeout=timeout_s)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    if res.returncode != 0:
+        return {"error": res.stderr.strip()[-300:]}
+    try:
+        payload = json.loads(res.stdout.strip().splitlines()[-1])
+    except (ValueError, IndexError) as e:
+        return {"error": f"unparseable output: {e}"}
+    payload["note"] = "CPU-pinned subprocess; " + payload.get("note", "")
+    return payload
+
+
 def _spec_decode_probe(timeout_s: float = 300.0) -> dict:
     """Speculative-decode probe (models/specprobe.py) in a CPU-pinned
     subprocess: fused-ngram-draft tokens/s over the identical
@@ -1514,6 +1557,9 @@ _PROBE_SCALARS = (
     ("serving_paged", "pg_cow_shared_frac", "pg_cow_shared_frac"),
     ("serving_paged", "pg_decode_tok_s_ratio",
      "pg_decode_tok_s_ratio"),
+    ("serving_tier", "tier_promote_ms", "tier_promote_ms"),
+    ("serving_tier", "tier_recompute_win_x", "tier_recompute_win_x"),
+    ("serving_tier", "tier_hit_frac", "tier_hit_frac"),
     ("serving_spec", "spec_tok_s_x", "spec_tok_s_x"),
     ("serving_spec", "spec_accept_rate", "spec_accept_rate"),
     ("serving_lora", "lora_switch_ms", "lora_switch_ms"),
@@ -1800,6 +1846,15 @@ def main() -> None:
                 timeout_s=min(240.0, _remaining() - 45.0))
         else:
             paged = {"error": "skipped: wall budget"}
+        # 3c5b. KV-tiering probe (hermetic, CPU subprocess):
+        #       promote-vs-recompute wall on a demoted shared prefix
+        #       + churn-wave hit fraction, byte-equality (greedy and
+        #       sampled) checked in-run.
+        if _remaining() > 90:
+            tier = _serving_tier_probe(
+                timeout_s=min(240.0, _remaining() - 45.0))
+        else:
+            tier = {"error": "skipped: wall budget"}
         # 3c6. Speculative-decode probe (hermetic, CPU subprocess):
         #      fused ngram-draft tokens/s over the identical
         #      non-speculative chained engine + the run's accept
@@ -1857,6 +1912,7 @@ def main() -> None:
         compute["fleet_sim"] = fleet_sim
         compute["resharding"] = resharding
         compute["serving_paged"] = paged
+        compute["serving_tier"] = tier
         compute["serving_spec"] = spec
         compute["serving_lora"] = lora
         compute["control_plane"] = ctl

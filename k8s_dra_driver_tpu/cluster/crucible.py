@@ -83,6 +83,13 @@ log = logging.getLogger(__name__)
 #: wave: adapter'd fills serialize through the surviving slot or
 #: hold at their prefill replicas, and the release must cold-load
 #: the evicted adapters back with byte-exact outputs.
+#: ``tier_corrupt`` (serving_kv/tiers.py) bit-flips one demoted KV
+#: slab (host arena in place, disk slab rewritten) on the matching
+#: tiered replicas — silent media corruption below the device tier:
+#: the next prefix hit must detect it at promote time (crc32), drop
+#: the entry loudly and fall back to recompute, staying byte-exact
+#: and exactly-once; on an untiered replica (or one with nothing
+#: demoted yet) it is a logged no-op.
 #: kind -> one-line description.  Insertion-ordered, so EVENT_KINDS
 #: (derived below) keeps the historical tuple order and every count
 #: pin becomes "matches the registry" instead of a hardcoded integer
@@ -118,7 +125,8 @@ for _kind, _desc in (
         ("gen_tear", "newest generation: manifest deleted"),
         ("kv_exhaust", "paged replicas: free KV blocks seized"),
         ("pump_kill", "multi-process gateway pump SIGKILLed"),
-        ("adapter_evict_storm", "adapter pools seized to one slot")):
+        ("adapter_evict_storm", "adapter pools seized to one slot"),
+        ("tier_corrupt", "demoted KV slab: silent bitflip")):
     register_fault_kind(_kind, _desc)
 del _kind, _desc
 
@@ -377,6 +385,12 @@ def default_schedule(seed: int = 7, cycles: int = 220) -> Schedule:
         FaultEvent(id="kv-exhaust-in-pressure", kind="kv_exhaust",
                    at_cycle=3 * u + 3, replica_glob="d*",
                    heal_after=3),
+        # ...and a demoted KV slab is silently bit-flipped at the
+        # crest (the pressure bursts just demoted the warm bursts'
+        # prefixes host-ward): the next same-prefix hit must catch
+        # the damage at promote time and recompute byte-exact
+        FaultEvent(id="tier-corrupt-in-pressure", kind="tier_corrupt",
+                   at_cycle=3 * u + 2, replica_glob="d*"),
         # ...and a decode replica is killed while prefill->decode
         # handoffs are in flight (drain-mid-KV-handoff)
         FaultEvent(id="decode-kill-in-handoff", kind="replica_kill",
@@ -531,6 +545,10 @@ class CrucibleRig:
         # replica name -> cycle at which its adapter-pool storm lifts
         self._adapter_seized: dict = {}
         self.adapter_storms = 0
+        # demoted KV slabs bit-flipped (serving_kv/tiers.py) — the
+        # detection oracle: every one must surface as a
+        # corrupt_fallback counter bump, never as wrong tokens
+        self.tier_corruptions = 0
         self._build()
 
     # -- construction ----------------------------------------------------
@@ -591,13 +609,21 @@ class CrucibleRig:
         # AdapterPool over the shared seed-deterministic roster, so
         # adapter'd bursts survive grants, drains and handoffs with
         # byte-identical weights everywhere
+        # paged engines carry a host tier (serving_kv/tiers.py) so
+        # pressure waves DEMOTE instead of dropping and tier_corrupt
+        # has a real slab to damage; 1 MiB holds this tiny model's
+        # whole store many times over (no disk tier in the soak — a
+        # spill dir per replica would outlive the rig's tmpdir wipes)
+        tier_kw = ({"kv_host_bytes": 1 << 20}
+                   if self.kv_layout == "paged" else {})
         self.mgr = DisaggReplicaManager(
             lambda name: ServingEngine(_params(), _cfg(), slots=2,
                                        prefix_cache=2,
                                        kv_layout=self.kv_layout,
                                        draft_source=self.draft_source,
                                        draft_len=self.draft_len,
-                                       adapter_pool=_adapter_pool()),
+                                       adapter_pool=_adapter_pool(),
+                                       **tier_kw),
             prefill_replicas=1, decode_replicas=1,
             chip_of=chip_map.get,
             health_source=self.ledger.current_unhealthy,
@@ -787,6 +813,32 @@ class CrucibleRig:
             if not hit:
                 log.info("crucible: %s matched no adapter-pooled "
                          "replica (glob %s); no-op", ev.id, glob)
+        elif ev.kind == "tier_corrupt":
+            import random as _random
+            glob = ev.replica_glob or "*"
+            hit = 0
+            for r in self.mgr.replicas:
+                store = getattr(r.engine, "_prefix", None)
+                corrupt = getattr(store, "corrupt_slab", None)
+                if corrupt is None or r.state == "dead":
+                    continue
+                if not fnmatch.fnmatchcase(r.name, glob):
+                    continue
+                # seeded per (schedule, cycle, replica): the soak is
+                # replayable bit for bit (crc32, not hash() — str
+                # hashing is salted per process)
+                import zlib as _zlib
+                rng = _random.Random(
+                    self.schedule.seed * 1000003 + cycle * 1009
+                    + _zlib.crc32(r.name.encode()))
+                key = corrupt(rng)
+                if key is not None:
+                    hit += 1
+            self.tier_corruptions += hit
+            if not hit:
+                log.info("crucible: %s found no demoted KV slab to "
+                         "corrupt (glob %s, layout %s); no-op",
+                         ev.id, glob, self.kv_layout)
         elif ev.kind in CORRUPTION_KINDS:
             self._corrupt(ev)
         elif ev.kind == "burst":

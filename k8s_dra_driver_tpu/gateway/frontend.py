@@ -143,6 +143,9 @@ class FleetGateway:
         # advances by deltas (a replaced replica's name never recurs
         # — ReplicaManager names are generation-fresh)
         self._kv_evictions_seen: dict[str, int] = {}
+        # tiered-KV counter fold (serving_kv/tiers.py): last seen
+        # per-tier totals per replica, same delta-fold pattern
+        self._kv_tier_seen: dict[str, dict[str, int]] = {}
         # adapter churn counter fold (serving_lora/): last seen
         # (cold_loads_total, evictions_total) per replica, same
         # delta-fold pattern as _kv_evictions_seen
@@ -569,6 +572,27 @@ class FleetGateway:
                 if total > seen:
                     self.metrics.kv_block_evictions.inc(total - seen)
                     self._kv_evictions_seen[r.name] = total
+            # tiered stores (serving_kv/tiers.py) additionally fold
+            # their per-tier counters as deltas and set the host-arena
+            # level; untiered stores have no tier_counters — skipped
+            tiers = getattr(store, "tier_counters", None)
+            if tiers is not None:
+                counts = tiers()
+                seen = self._kv_tier_seen.setdefault(
+                    r.name, dict.fromkeys(counts, 0))
+                for kind, counter in (
+                        ("hits", self.metrics.kv_tier_hits),
+                        ("promotions",
+                         self.metrics.kv_tier_promotions),
+                        ("demotions",
+                         self.metrics.kv_tier_demotions),
+                        ("corrupt_fallbacks",
+                         self.metrics.kv_tier_corrupt_fallbacks)):
+                    if counts[kind] > seen[kind]:
+                        counter.inc(counts[kind] - seen[kind])
+                        seen[kind] = counts[kind]
+                self.metrics.kv_host_arena_bytes.labels(
+                    replica=r.name).set(store.host_arena_bytes())
 
     def _fold_adapter_occupancy(self) -> None:
         """Fold every multi-adapter replica's pool levels and churn

@@ -154,6 +154,16 @@ class EngineReplica:
     def prefix_peek(self, prompt) -> int:
         return self.engine.prefix_peek(prompt)
 
+    def prefix_residency(self, prompt) -> tuple:
+        """(p, tier) across every KV storage tier — the router's
+        tier-preference probe (serving_kv/tiers.py).  Degrades to the
+        device-only peek when the engine predates tiering."""
+        fn = getattr(self.engine, "prefix_residency", None)
+        if fn is not None:
+            return fn(prompt)
+        p = self.prefix_peek(prompt)
+        return p, ("device" if p else None)
+
     def enqueue(self, g) -> None:
         self.engine.enqueue(g.request)
         self.in_flight[g.uid] = g

@@ -187,6 +187,27 @@ def _candidates(prompt, replicas, adapter=None) -> list:
             and adapter_admits(r, adapter)]
 
 
+#: residency-tier preference order for equal-affinity ties —
+#: device-resident adopts by reference, host/disk pay a promotion,
+#: and a replica whose affinity is routed-history only (tier None)
+#: holds nothing and ranks last (serving_kv/tiers.py)
+_TIER_ORDER = {"device": 0, "host": 1, "disk": 2}
+
+
+def _tier_rank(replica, prompt) -> int:
+    """Rank a replica's KV residency for ``prompt``: 0 device, 1
+    host, 2 disk, 3 nothing held.  Degrade-never-invent on a legacy
+    replica (no ``prefix_residency``): its ``prefix_peek`` match can
+    ONLY be device-resident, so a nonzero peek ranks 0."""
+    fn = getattr(replica, "prefix_residency", None)
+    if fn is None:
+        return 0 if int(replica.prefix_peek(prompt)) else 3
+    p, tier = fn(prompt)
+    if not p or tier is None:
+        return 3
+    return _TIER_ORDER.get(tier, 3)
+
+
 class LeastLoadedRouter(Router):
     """Pure least-queue-depth spill (also the affinity fallback)."""
 
@@ -253,11 +274,15 @@ class PrefixAffinityRouter(Router):
         best, _ = max(scored, key=lambda s: s[0])
         if best >= self.min_affinity:
             # deterministic among equals: deepest affinity, then the
-            # memory-aware spill key (least depth, adapter residency,
-            # accept bucket for SLO-tight requests, most KV headroom)
+            # best residency tier (device beats host beats disk — a
+            # promotion costs a PCIe transfer a device hit does not),
+            # then the memory-aware spill key (least depth, adapter
+            # residency, accept bucket for SLO-tight requests, most
+            # KV headroom)
             pick = min((r for a, r in scored if a == best),
-                       key=lambda r: _spill_key(r, self.slo_tight,
-                                                self.adapter))
+                       key=lambda r: (_tier_rank(r, prompt),
+                                      _spill_key(r, self.slo_tight,
+                                                 self.adapter)))
             self.last_reason = "affinity"
         else:
             pick = min(ready,

@@ -117,7 +117,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..serving_kv import (NULL_BLOCK, BlocksExhausted, KVBlockManager,
-                          PagedPrefixStore)
+                          PagedPrefixStore, TieredKVStore,
+                          kv_bytes_per_token)
 from ..utils import dispatch
 from . import decode as _decode
 from .decode import (KVCache, decode_step_rows, decode_window_rows,
@@ -365,8 +366,8 @@ class PrefixCache:
         if not self.bytes_per_token:
             arrs = (filled.k + filled.v + (filled.k_scale or [])
                     + (filled.v_scale or []))
-            self.bytes_per_token = (sum(a.nbytes for a in arrs)
-                                    // filled.k[0].shape[1])
+            self.bytes_per_token = kv_bytes_per_token(
+                arrs, filled.k[0].shape[1])
         key = tuple(tokens.tolist())
         self._store.pop(key, None)            # re-insert = most recent
         self._store[key] = filled
@@ -457,12 +458,19 @@ class ServingEngine:
                  kv_block_size: int = 16,
                  kv_blocks: int | None = None,
                  kv_kernel: bool | None = None,
+                 kv_host_bytes: int | None = None,
+                 kv_spill_dir=None,
                  adapter_pool=None):
         if prefill_chunk is not None and prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
         if kv_layout not in ("contiguous", "paged"):
             raise ValueError(f"unknown kv_layout {kv_layout!r}")
         self._paged = kv_layout == "paged"
+        if (kv_host_bytes or kv_spill_dir) and not self._paged:
+            # tiering demotes BLOCK-shaped slabs; the contiguous
+            # cache has no block ledger to demote from
+            raise ValueError("KV tiering (kv_host_bytes/kv_spill_dir) "
+                             "requires kv_layout='paged'")
         if self._paged:
             # composition gates: each of these owns cache rows in a
             # way the block ledger does not model yet — fail loudly
@@ -611,11 +619,22 @@ class ServingEngine:
             self._kv_dense_memo: tuple | None = None
             self._slot_blocks: list[list[int]] = [[] for _ in
                                                   range(slots)]
-            self._prefix = PagedPrefixStore(
-                prefix_cache or max(2 * slots, 4), self.kv_manager)
-            self._prefix.bytes_per_token = (
-                sum(a.nbytes for a in self.pool.k + self.pool.v)
-                // (kv_blocks * kv_block_size))
+            if kv_host_bytes or kv_spill_dir:
+                # tiered store (serving_kv/tiers.py): watermark
+                # eviction demotes host-ward, hits on demoted entries
+                # promote through the engine halves bound here
+                self._prefix = TieredKVStore(
+                    prefix_cache or max(2 * slots, 4),
+                    self.kv_manager,
+                    host_bytes=kv_host_bytes or 0,
+                    spill_dir=kv_spill_dir)
+                self._prefix.bind_engine(self._tier_gather,
+                                         self._tier_adopt)
+            else:
+                self._prefix = PagedPrefixStore(
+                    prefix_cache or max(2 * slots, 4), self.kv_manager)
+            self._prefix.bytes_per_token = kv_bytes_per_token(
+                self.pool.k + self.pool.v, kv_blocks * kv_block_size)
             self._kv_use_kernel = (kv_kernel if kv_kernel is not None
                                    else jax.default_backend() == "tpu")
             self._kv_preemptions = 0
@@ -802,6 +821,22 @@ class ServingEngine:
         if self._prefix is None:
             return 0
         return self._prefix.peek(np.asarray(prompt, np.int32))
+
+    def prefix_residency(self, prompt) -> tuple[int, str | None]:
+        """``(p, tier)`` of the longest held prefix across EVERY
+        storage tier — ``tier`` in {"device", "host", "disk", None}.
+        ``prefix_peek`` stays device-only so the admission
+        arithmetic keeps its conservative block counts; this probe is
+        the router's tier-preference signal (a device-resident match
+        adopts by reference, a host/disk match pays a promotion)."""
+        if self._prefix is None:
+            return 0, None
+        prompt = np.asarray(prompt, np.int32)
+        residency = getattr(self._prefix, "residency", None)
+        if residency is not None:
+            return residency(prompt)
+        p = self._prefix.peek(prompt)
+        return p, ("device" if p else None)
 
     # -- disaggregated prefill/decode (serving_disagg/) ------------------
     #
@@ -1030,6 +1065,18 @@ class ServingEngine:
                 self.kv_manager.alloc_failures)
             out["kv_spec_trims_total"] = (
                 self.kv_manager.spec_trims_total)
+            tiers = getattr(self._prefix, "tier_counters", None)
+            if tiers is not None:
+                tc = tiers()
+                out["kv_tier_hits_total"] = tc["hits"]
+                out["kv_tier_promotions_total"] = tc["promotions"]
+                out["kv_tier_demotions_total"] = tc["demotions"]
+                out["kv_tier_corrupt_fallbacks_total"] = (
+                    tc["corrupt_fallbacks"])
+                out["kv_host_arena_bytes"] = (
+                    self._prefix.host_arena_bytes())
+                out["kv_disk_tier_bytes"] = (
+                    self._prefix.disk_tier_bytes())
         if self._spec_on:
             out["speculative_windows_total"] = self._spec_windows
             out["speculative_accepted_total"] = self._spec_accepted
@@ -1586,6 +1633,51 @@ class ServingEngine:
         except BlocksExhausted:
             self._prefix.evict_until(n)
             return self.kv_manager.alloc(n)
+
+    # -- tiered-store device halves (serving_kv/tiers.py) ---------------
+    #
+    # The store owns the WHAT of tiering (which entry demotes, when a
+    # hit promotes); these two callbacks own the HOW of moving bytes
+    # across the PCIe boundary, because the pool pytree is functionally
+    # updated and only the engine holds the current generation.
+
+    def _tier_gather(self, entry) -> tuple[list, list]:
+        """Demotion gather: the entry's valid blocks as per-layer
+        host numpy slabs ([n_blocks, block_size, H_kv, D]).  Rides
+        the ONE fixed-width ``paged_gather_entry`` program (via
+        ``_kv_entry_dense``) and slices block-shaped views on the
+        host, so demotion adds no per-block-count recompiles.  CoW
+        makes this safe on shared blocks: content is immutable while
+        the store holds references (a slot writing "into" a shared
+        block copies first), so the gathered bytes are exactly the
+        prefix rows."""
+        nb = len(entry.block_ids)
+        bs = self._kv_bs
+        one = self._kv_entry_dense(entry, entry.length)
+        k = [np.ascontiguousarray(np.asarray(a)[0, :nb * bs].reshape(
+                 nb, bs, *a.shape[2:])) for a in one.k]
+        v = [np.ascontiguousarray(np.asarray(a)[0, :nb * bs].reshape(
+                 nb, bs, *a.shape[2:])) for a in one.v]
+        return k, v
+
+    def _tier_adopt(self, slab_k: list, slab_v: list) -> list[int]:
+        """Promotion adopt: device_put a host slab into freshly
+        allocated blocks (fill-path allocation — eviction yes,
+        preemption never; ``BlocksExhausted`` tells the store the
+        promotion lost the race to memory pressure).  Returns the
+        block ids; the caller owns their allocation references."""
+        nb = slab_k[0].shape[0]
+        ids = self._kv_alloc_fill(nb)
+        try:
+            self.pool = _decode.paged_adopt_slab(
+                self.pool,
+                [jnp.asarray(a) for a in slab_k],
+                [jnp.asarray(a) for a in slab_v],
+                jnp.asarray(np.asarray(ids, np.int32)))
+        except Exception:
+            self.kv_manager.free_blocks(ids)
+            raise
+        return ids
 
     def _kv_alloc_decode(self, slot: int, n: int) -> list[int]:
         """Decode-path allocation with the full escalation: free

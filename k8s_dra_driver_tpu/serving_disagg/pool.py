@@ -271,8 +271,19 @@ class DisaggReplicaManager(ReplicaManager):
         ``replica`` does, migrate that entry into ``replica``'s local
         PrefixCache so the imminent fill pays only the suffix.  Every
         failure mode (holder gone, entry evicted) degrades to a local
-        compute — the index is optimization, never correctness."""
-        p_local = replica.engine.prefix_peek(prompt)
+        compute — the index is optimization, never correctness.
+
+        Local residency is measured across ALL KV tiers
+        (serving_kv/tiers.py): an equal-depth prefix demoted to this
+        replica's own host arena beats a wire migration (a local
+        promotion moves the same bytes without the network hop), so
+        the fleet fetch only fires for a STRICTLY longer remote
+        match."""
+        residency = getattr(replica.engine, "prefix_residency", None)
+        if residency is not None:
+            p_local, _ = residency(prompt)
+        else:
+            p_local = replica.engine.prefix_peek(prompt)
         p_fleet, holder, key = self.index.lookup(prompt)
         if (holder is None or holder == replica.name
                 or p_fleet <= p_local):

@@ -18,7 +18,11 @@ SURVEY.md §2.3); this is the beyond-parity serving-memory tier.
 """
 
 from .manager import NULL_BLOCK, BlocksExhausted, KVBlockManager
-from .prefix import PagedEntry, PagedPrefixStore
+from .prefix import PagedEntry, PagedPrefixStore, kv_bytes_per_token
+from .tiers import (TIER_DEVICE, TIER_DISK, TIER_HOST, TIER_RANK,
+                    TierCorruption, TieredKVStore)
 
 __all__ = ["NULL_BLOCK", "BlocksExhausted", "KVBlockManager",
-           "PagedEntry", "PagedPrefixStore"]
+           "PagedEntry", "PagedPrefixStore", "kv_bytes_per_token",
+           "TieredKVStore", "TierCorruption", "TIER_DEVICE",
+           "TIER_HOST", "TIER_DISK", "TIER_RANK"]

@@ -38,6 +38,18 @@ import numpy as np
 from .manager import KVBlockManager
 
 
+def kv_bytes_per_token(arrays, n_tokens: int) -> int:
+    """Per-token KV byte cost measured from REAL arrays — the one
+    shared measurement both ``bytes_reused`` accounting (dense and
+    paged stores) and tier-demotion accounting (tiers.py) use, so the
+    two can never diverge.  int8-aware by construction: the caller
+    passes every tensor an entry actually holds (scale tensors
+    included for the int8 cache), and ``nbytes`` reports what the
+    dtype really costs."""
+    n = max(int(n_tokens), 1)
+    return sum(int(a.nbytes) for a in arrays) // n
+
+
 @dataclasses.dataclass
 class PagedEntry:
     """One remembered prefix: ``length`` valid token rows spread over
@@ -65,6 +77,10 @@ class PagedPrefixStore:
         self.bytes_per_token = 0
         #: capacity-LRU + pressure evictions (the metrics counter)
         self.evictions = 0
+        #: bytes those evictions covered (``entry_nbytes`` per entry,
+        #: the same int8-aware measurement ``bytes_reused`` uses) —
+        #: what tier demotion accounting (tiers.py) reconciles against
+        self.bytes_evicted = 0
         #: ``listener(event, key)``, event in {"insert", "evict",
         #: "drop"} — the fleet prefix index mirror hook
         #: (serving_disagg/index.py); raising listeners are isolated.
@@ -169,12 +185,24 @@ class PagedPrefixStore:
         while len(self._store) > self.entries:
             self._evict_oldest()
 
-    def _evict_oldest(self) -> None:
+    def entry_nbytes(self, entry: PagedEntry) -> int:
+        """Bytes of K/V an entry's valid rows cover — ``length`` times
+        the measured per-token cost (:func:`kv_bytes_per_token`), so
+        hit-reuse, eviction and demotion accounting share one number."""
+        return int(entry.length) * int(self.bytes_per_token)
+
+    def _evict_oldest(self) -> tuple[tuple, PagedEntry, int]:
+        """Drop the LRU-oldest entry; returns ``(key, entry, nbytes)``
+        so pressure paths (and the tiered store's demotion override)
+        see per-eviction byte sizes, not just a count."""
         key = next(iter(self._store))
         entry = self._store.pop(key)
         self._mgr.free_blocks(entry.block_ids)
+        nbytes = self.entry_nbytes(entry)
         self.evictions += 1
+        self.bytes_evicted += nbytes
         self._notify("evict", key)
+        return key, entry, nbytes
 
     def drop(self, tokens: np.ndarray) -> None:
         """Forget an entry (no-op if absent), releasing its block
@@ -202,7 +230,9 @@ class PagedPrefixStore:
     def evict_until(self, free_target: int) -> int:
         """Pressure eviction: drop LRU-oldest entries until the
         manager's free supply reaches ``free_target`` or the store is
-        empty; returns entries evicted.  Only blocks whose refcount
+        empty; returns entries evicted (per-eviction byte sizes
+        accumulate in ``bytes_evicted``, measured by
+        ``entry_nbytes``).  Only blocks whose refcount
         hits zero (cold — held by no active request) actually return
         memory, so a hot shared prefix costs nothing to "evict" and
         frees nothing: the engine keeps escalating to preemption."""

@@ -1,0 +1,176 @@
+"""KV-tiering probe: promote-vs-recompute economics as bench rows.
+
+bench.py runs this in a CPU-pinned subprocess (probe.py pattern — the
+tier moves are host-side memory discipline; the math is identical on
+any backend) and records three scalars per round:
+
+- ``tier_promote_ms`` — wall per shared-prefix fill served by
+  PROMOTION: the hot prefix was demoted to the host arena, the hit
+  checksum-verifies the slab, device_puts it into fresh blocks and
+  prefills only the suffix (serving_kv/tiers.py).
+- ``tier_recompute_win_x`` — the same fill on a tier-less twin whose
+  store dropped the entry (full-prompt prefill), divided by the
+  promote wall.  > 1 is tiering's whole reason to exist: moving
+  bytes back beats recomputing them; the committed artifact gate is
+  >= 1.3 (tools/perf_sentinel.py).
+- ``tier_hit_frac`` — prefix-store hit fraction across a churn wave
+  sized to overflow the device watermark, so entries continuously
+  demote and re-promote.  Without tiering these hits are structural
+  misses (eviction destroyed the entry); the floor is > 0.
+
+Outputs are verified byte-equal between the tiered engine and the
+recompute twin — greedy AND sampled — in the same run; a probe that
+wins the duel with different tokens records ``byte_equal: false``
+and the perf gate fails.  The probe model is sized (d_model=256,
+n_layers=4, 112-token prefix) so prefill compute dominates XLA-CPU
+per-op dispatch: the duel then measures recompute-FLOPs vs
+slab-transfer, not op-count noise.  The committed full-shape record
+is tools/kv_tiering_cpu.json (regenerate with
+tools/bench_kv_tiering.py); tests/test_bench_smoke.py pins its
+gates.
+"""
+
+from __future__ import annotations
+
+
+def _mk(seed: int, n: int, cfg):
+    import jax
+    import numpy as np
+    return np.asarray(jax.random.randint(
+        jax.random.PRNGKey(seed), (n,), 0, cfg.vocab), np.int32)
+
+
+def serving_tier_probe(prefix_len: int = 112, suffix_len: int = 4,
+                       max_new: int = 4, repeats: int = 5,
+                       churn_wave: int = 12, d_model: int = 256,
+                       n_layers: int = 4) -> dict:
+    """One promote-vs-recompute duel + one demote/promote churn
+    wave, flattened to bench scalars.  ``prefix_len`` sets the
+    recompute cost the promotion avoids; the churn wave sizes its
+    prompts to overflow a deliberately tight device watermark."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..models import TransformerConfig, init_params
+    from ..models.serving import Request, ServingEngine
+
+    t0 = time.perf_counter()
+    cfg = TransformerConfig(vocab=64, d_model=d_model,
+                            n_layers=n_layers, n_heads=8,
+                            d_head=d_model // 8, d_ff=4 * d_model,
+                            max_seq=prefix_len + 16, n_kv_heads=8,
+                            dtype=jnp.float32)
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    bs = 16
+    if cfg.max_seq % bs:
+        cfg = TransformerConfig(**{**cfg.__dict__,
+                                   "max_seq": ((cfg.max_seq // bs)
+                                               + 1) * bs})
+    prefix = _mk(7, prefix_len, cfg)
+
+    def fill_req(tag, i, temp=0.0):
+        return Request(uid=f"{tag}{i}",
+                       prompt=np.concatenate(
+                           [prefix, _mk(300 + i, suffix_len, cfg)]),
+                       max_new=max_new, temperature=temp, seed=11)
+
+    def mk_engine(tiered: bool):
+        kw = {"kv_host_bytes": 256 << 20} if tiered else {}
+        return ServingEngine(params, cfg, slots=2, prefix_cache=4,
+                             kv_layout="paged", kv_block_size=bs,
+                             **kw)
+
+    # -- promote vs recompute duel ------------------------------------
+    # Both engines warm the SAME shared prefix, then lose it from the
+    # device tier (flush = demotion on the tiered engine, plain
+    # eviction on the twin); the timed fill is then a promotion on
+    # one side and a full-prompt prefill on the other.
+    prefix_key = tuple(prefix.tolist())
+
+    def isolate_prefix(store):
+        """Keep EXACTLY the shared-prefix entry so every rep demotes
+        one slab of one block count — the adopt program compiles
+        once and the duel times steady-state promotion, not per-rep
+        XLA compiles (finish captures/fill entries have different
+        lengths, hence different slab shapes)."""
+        for key in [k for k in list(store._store)
+                    if k != prefix_key]:
+            store.drop(np.asarray(key, np.int32))
+
+    def timed(tiered: bool, temp: float = 0.0):
+        eng = mk_engine(tiered)
+        outs = {}
+        best = float("inf")
+        for rep in range(repeats):
+            eng.submit(Request(uid=f"warm{rep}", prompt=prefix,
+                               max_new=1))
+            eng.run()                      # jit + store warm
+            isolate_prefix(eng._prefix)
+            eng._prefix.flush()            # demote (or drop) the prefix
+            r = fill_req("d", rep, temp)
+            eng.submit(r)
+            t = time.perf_counter()
+            done = eng.run()
+            best = min(best, time.perf_counter() - t)
+            for f in done:
+                if not f.uid.startswith("warm"):
+                    outs[f.uid] = np.asarray(f.tokens)
+        return best, outs, eng
+
+    promote_s, tiered_out, tiered_eng = timed(True)
+    recompute_s, twin_out, _ = timed(False)
+    byte_equal = (set(tiered_out) == set(twin_out) and all(
+        np.array_equal(tiered_out[u], twin_out[u])
+        for u in tiered_out))
+    promoted = tiered_eng._prefix.promotions
+    # sampled rows must match too (same per-request seed both sides)
+    _, t_samp, _ = timed(True, temp=0.8)
+    _, r_samp, _ = timed(False, temp=0.8)
+    byte_equal = byte_equal and (set(t_samp) == set(r_samp)) and all(
+        np.array_equal(t_samp[u], r_samp[u]) for u in t_samp)
+
+    # -- churn wave: demote/promote under a tight watermark -----------
+    churn = mk_engine(True)
+    churn._prefix.entries = 2              # tight: every 3rd insert demotes
+    for i in range(churn_wave):
+        churn.submit(fill_req("c", i % 3))  # 3 rotating prompts
+        churn.run()
+    cst = churn._prefix
+    hit_frac = cst.hits / max(1, cst.hits + cst.misses)
+
+    return {
+        "tier_promote_ms": round(promote_s * 1e3, 2),
+        "tier_recompute_win_x": round(recompute_s / promote_s, 3),
+        "tier_hit_frac": round(hit_frac, 4),
+        "recompute_ms": round(recompute_s * 1e3, 2),
+        "promotions": int(promoted),
+        "churn_tier_hits": int(cst.tier_hits),
+        "churn_promotions": int(cst.promotions),
+        "churn_demotions": int(cst.demotions),
+        "byte_equal": bool(byte_equal),
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "note": (f"{prefix_len}-token shared prefix, {suffix_len}-"
+                 f"token suffixes, d_model={d_model} x {n_layers} "
+                 f"layers; promote = crc-verified host slab "
+                 f"device_put + suffix prefill vs full-prompt "
+                 f"recompute"),
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+    import json
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--prefix-len", type=int, default=112)
+    ns = ap.parse_args(argv)
+    print(json.dumps(serving_tier_probe(repeats=ns.repeats,
+                                        prefix_len=ns.prefix_len)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,9 +15,9 @@ replica kills + gang eviction + scheduled heal), ``worker_crash`` /
 ``worker_hang`` (gang eviction + reform), ``replica_kill``, and
 ``burst`` are fully live.  The byte-level kinds — the corruption trio
 (``shard_bitflip``/``shard_truncate``/``gen_tear``), ``kv_exhaust``,
-``pump_kill``, ``adapter_evict_storm`` — are journal-logged no-ops
-here: there are no bytes to damage, and the live crucible owns those
-arcs.  Window-triggered events honor the live semantics: fire at the
+``pump_kill``, ``adapter_evict_storm``, ``tier_corrupt`` — are
+journal-logged no-ops here: there are no bytes to damage, and the
+live crucible owns those arcs.  Window-triggered events honor the live semantics: fire at the
 first cycle >= ``after_cycle`` where an open window matches the glob
 (cascade / reform:<gang> / parked:<gang>), recording ``hit_windows``.
 """
@@ -37,7 +37,7 @@ from .fleet import SPIKE, FleetSim, SimConfig, build_fleet
 #: fidelity contract above) — everything else actuates
 NOOP_KINDS = frozenset({"shard_bitflip", "shard_truncate", "gen_tear",
                         "kv_exhaust", "pump_kill",
-                        "adapter_evict_storm"})
+                        "adapter_evict_storm", "tier_corrupt"})
 
 
 def _open_windows(fleet: FleetSim) -> list[str]:
@@ -310,6 +310,8 @@ def default_sim_schedule(seed: int = 7, cycles: int = 60) -> Schedule:
         FaultEvent(id="noop-adapter-storm", kind="adapter_evict_storm",
                    at_cycle=6 * u + 2, replica_glob="pool-0-r*",
                    heal_after=2),
+        FaultEvent(id="noop-tier-corrupt", kind="tier_corrupt",
+                   at_cycle=6 * u + 3, replica_glob="pool-1-r*"),
         FaultEvent(id="tail-wave", kind="burst", at_cycle=8 * u,
                    n=12, replica_glob="pool-1"),
     ]

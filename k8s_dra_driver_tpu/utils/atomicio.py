@@ -63,3 +63,15 @@ def write_atomic(path, text: str) -> None:
     write_durable(tmp, text)
     os.replace(tmp, path)
     fsync_dir(path.parent)
+
+
+def write_atomic_bytes(path, data: bytes) -> None:
+    """``write_atomic`` for binary payloads — the disk KV tier
+    (serving_kv/tiers.py) spills whole slab files whose commit point
+    IS the file itself (no separate manifest), so each write needs
+    the complete tmp + fsync + replace + dir-fsync discipline."""
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    write_durable_bytes(tmp, data)
+    os.replace(tmp, path)
+    fsync_dir(path.parent)

@@ -104,6 +104,11 @@ class MemWatch:
         if apool is not None:
             total += self.account("adapter_pool",
                                   apool.accounted_bytes(), unit)
+        arena = getattr(prefix, "host_arena_bytes", None)
+        if arena is not None:
+            # tiered store (serving_kv/tiers.py): demoted slabs are
+            # HOST DRAM the pool reservation does not cover
+            total += self.account("kv_host_arena", arena(), unit)
         return total
 
     def account_compile_cache(self, cache_dir=None) -> int:

@@ -298,6 +298,36 @@ class GatewayMetrics(_DigestSourceMixin):
             "block headroom for the queue head (fleet-wide block "
             "exhaustion: the request waits, then sheds at its "
             "deadline)", registry=self.registry)
+        # tiered KV store (serving_kv/tiers.py): demotion keeps
+        # evicted prefixes alive in host DRAM / on disk, promotion
+        # moves them back on a hit — counters delta-folded per pump
+        # step from each store's monotonic totals, plus the host-arena
+        # occupancy level
+        self.kv_tier_hits = Counter(
+            "tpu_serving_kv_tier_hits_total",
+            "Prefix hits served from a demoted (host/disk) entry via "
+            "promotion, across all tiered replicas",
+            registry=self.registry)
+        self.kv_tier_promotions = Counter(
+            "tpu_serving_kv_tier_promotions_total",
+            "Demoted KV entries promoted back into device blocks "
+            "(checksum-verified device_put + adopt)",
+            registry=self.registry)
+        self.kv_tier_demotions = Counter(
+            "tpu_serving_kv_tier_demotions_total",
+            "Watermark evictions that demoted the entry host-ward "
+            "instead of dropping it", registry=self.registry)
+        self.kv_tier_corrupt_fallbacks = Counter(
+            "tpu_serving_kv_tier_corrupt_fallbacks_total",
+            "Demoted slabs that failed checksum verification at "
+            "promote time — entry dropped loudly, request fell back "
+            "to recompute (never a wrong answer)",
+            registry=self.registry)
+        self.kv_host_arena_bytes = Gauge(
+            "tpu_serving_kv_host_arena_bytes",
+            "Host-DRAM arena bytes holding demoted KV slabs per "
+            "tiered replica (memwatch-accounted)", ["replica"],
+            registry=self.registry)
         self.spec_accept_rate = Gauge(
             "tpu_gateway_spec_accept_rate",
             "EWMA of the speculative-decode draft acceptance rate "

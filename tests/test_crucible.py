@@ -249,6 +249,59 @@ def test_spec_fleet_survives_kill_and_kv_exhaust(tmp_path):
     assert windows > 0, "speculation never engaged under faults"
 
 
+@pytest.mark.faults
+def test_tier_corrupt_arc_falls_back_and_promotes_byte_equal(tmp_path):
+    """ISSUE 20 satellite: the tier_corrupt arc, targeted.  Two
+    bursts overflow the 2-entry device stores so the host arena
+    fills with demoted slabs, the injection flips bytes inside ONE
+    demoted slab per replica, then the SAME prompt families re-burst
+    (burst prompts are ``_prompt(prompt_seed + i, ...)``, so reusing
+    a seed re-submits identical prompts under fresh uids).  The
+    re-burst's promote attempts must split cleanly: the damaged slab
+    is refused on checksum (corrupt_fallbacks ticks, the prefix is
+    recomputed from scratch) while healthy siblings promote — and
+    the end-of-run checkers hold finished==submitted byte-equal to
+    the greedy oracle, proving a lying tier can slow the fleet but
+    never poison an answer.  The injection repeats every cycle (one
+    random demoted slab per replica per firing — recurring silent
+    media damage, not a single flip) because residency-aware routing
+    actively STEERS traffic away from a stale holder: once a family
+    promotes anywhere, the index sends its re-bursts to that device
+    copy, so only sustained damage across the arena reliably crosses
+    a promote path."""
+    sched = Schedule(seed=23, cycles=16, events=[
+        FaultEvent(id="tc-warm", kind="burst", at_cycle=1, n=6,
+                   prompt_seed=71),
+        FaultEvent(id="tc-press", kind="burst", at_cycle=2, n=6,
+                   prompt_seed=83),
+        FaultEvent(id="tc-re1", kind="burst", at_cycle=5, n=6,
+                   prompt_seed=71),
+        FaultEvent(id="tc-re2", kind="burst", at_cycle=7, n=6,
+                   prompt_seed=83),
+        FaultEvent(id="tc-re3", kind="burst", at_cycle=9, n=6,
+                   prompt_seed=71),
+        FaultEvent(id="tc-re4", kind="burst", at_cycle=11, n=6,
+                   prompt_seed=83),
+    ] + [FaultEvent(id=f"tc-flip{c}", kind="tier_corrupt",
+                    at_cycle=c, replica_glob="*")
+         for c in range(3, 13)])
+    res, rig = cru.run_soak(sched, tmp_path / "tier")
+    assert_no_violations(
+        [f"cycle {c}: {m}" for c, v in res.violations for m in v],
+        label="tier-corrupt")
+    assert res.submitted == 36 and res.finished == res.submitted
+    # the injections found real demoted slabs to damage (not no-ops)
+    assert rig.tier_corruptions >= 1
+    fallbacks = sum(
+        r.engine.stats().get("kv_tier_corrupt_fallbacks_total", 0)
+        for r in rig.mgr.replicas)
+    promotions = sum(
+        r.engine.stats().get("kv_tier_promotions_total", 0)
+        for r in rig.mgr.replicas)
+    assert fallbacks >= 1, "no promote ever hit the damaged slab"
+    assert promotions >= 1, "no healthy slab ever promoted"
+
+
 # -- the hardened double-fault arcs, one targeted test each ---------------
 
 def _sup(tmp_path, *, dp, batch, plan=None, health_source=None,

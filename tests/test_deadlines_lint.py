@@ -131,6 +131,20 @@ def test_scope_reaches_the_adapter_serving_tier():
     assert any("serving_lora" in str(p) for p in scoped)
 
 
+def test_scope_reaches_the_kv_tiering_layer():
+    """ISSUE 20 satellite: the package-wide scope walks the tiered
+    store too — the disk tier's fsync discipline rides atomicio
+    (bounded), and any blocking wait that appears in tiers.py or
+    tierprobe.py must carry a deadline like everything else."""
+    repo = Path(lint_deadlines.REPO)
+    scoped = [p for scope in lint_deadlines.SCOPES
+              for p in (repo / scope).rglob("*.py")]
+    for name in ("tiers.py", "tierprobe.py"):
+        assert any(
+            (Path("serving_kv") / name).as_posix() in p.as_posix()
+            for p in scoped), name
+
+
 def test_scope_reaches_the_fleet_simulator():
     """ISSUE 19 satellite: the package-wide scope walks sim/ too —
     the event heap's ``run`` carries a ``max_events`` backstop, and

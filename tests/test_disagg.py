@@ -267,7 +267,7 @@ def test_router_prefers_index_holder_then_falls_back():
     pa, pb = Stub("p0", ROLE_PREFILL, depth=3), Stub("p1", ROLE_PREFILL)
     d0 = Stub("d0", ROLE_DECODE)
     pr = prompt(13, 8)
-    idx._held["p0"] = {tuple(pr[:6].tolist())}
+    idx._held["p0"] = {tuple(pr[:6].tolist()): "device"}
     # busier holder still wins on affinity
     assert router.route(pr, [pa, pb, d0]) is pa
     # no prefill capacity -> decode fallback (local prefill)

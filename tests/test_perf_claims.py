@@ -45,6 +45,20 @@ def test_scope_covers_the_fleet_simulator():
     assert "k8s_dra_driver_tpu/sim" in lint_perf_claims.SCOPES
 
 
+def test_scope_reaches_the_kv_tiering_layer():
+    """ISSUE 20 satellite: the tiered store's docstrings carry
+    promote-vs-recompute win claims (tools/kv_tiering_cpu.json), and
+    the serving_kv scope the paged-KV PR added must actually walk
+    the new files — tiers.py and tierprobe.py are lint subjects, not
+    bystanders."""
+    assert "k8s_dra_driver_tpu/serving_kv" in lint_perf_claims.SCOPES
+    repo = Path(lint_perf_claims.__file__).parent.parent
+    scoped = [p for scope in lint_perf_claims.SCOPES
+              for p in (repo / scope).rglob("*.py")]
+    names = {p.name for p in scoped if "serving_kv" in str(p)}
+    assert {"tiers.py", "tierprobe.py"} <= names
+
+
 def _scratch_repo(tmp_path, body, artifact=True):
     mod_dir = tmp_path / "k8s_dra_driver_tpu" / "ops"
     mod_dir.mkdir(parents=True)

@@ -687,3 +687,645 @@ class TestGatewayKVSignal:
         m = re.search(r"tpu_gateway_kv_block_evictions_total "
                       r"(\d+)\.0", text)
         assert m and int(m.group(1)) == eng._prefix.evictions
+
+
+# -- the tiered store (serving_kv/tiers.py, ISSUE 20) ---------------------
+
+
+def tiered_pair(n_blocks=12, entries=2, bs=4, host_bytes=1 << 20,
+                spill_dir=None, dtype=np.float32):
+    """Store-level harness: a TieredKVStore over a synthetic one-layer
+    'pool' (block id -> (k_row, v_row) numpy rows) with gather/adopt
+    functions that move rows through the real demote/promote
+    machinery — the engine halves minus the engine."""
+    from k8s_dra_driver_tpu.serving_kv import TieredKVStore
+
+    mgr = KVBlockManager(n_blocks, bs)
+    store = TieredKVStore(entries, mgr, host_bytes=host_bytes,
+                          spill_dir=spill_dir)
+    rows: dict[int, tuple] = {}
+
+    def gather(entry):
+        k = [np.stack([rows[b][0] for b in entry.block_ids])]
+        v = [np.stack([rows[b][1] for b in entry.block_ids])]
+        return k, v
+
+    def adopt(slab_k, slab_v):
+        ids = mgr.alloc(slab_k[0].shape[0])
+        for i, b in enumerate(ids):
+            rows[b] = (np.array(slab_k[0][i]), np.array(slab_v[0][i]))
+        return ids
+
+    store.bind_engine(gather, adopt)
+
+    def fill(seed, n_tokens, block_ids=None, cold=True):
+        toks = prompt(seed, n_tokens)
+        rng = np.random.default_rng(seed)
+        ids = block_ids if block_ids is not None \
+            else mgr.alloc((n_tokens + bs - 1) // bs)
+        for b in ids:
+            # a block already in the pool is SHARED — sharing means
+            # identical bytes (same prefix, same KV), never a rewrite
+            if b not in rows:
+                rows[b] = (
+                    rng.integers(-100, 100, (bs, 2)).astype(dtype),
+                    rng.integers(-100, 100, (bs, 2)).astype(dtype))
+        store.insert(toks, ids, n_tokens)
+        if cold and block_ids is None:
+            mgr.free_blocks(ids)
+        return toks, ids
+
+    return mgr, store, rows, fill
+
+
+class TestTieredStore:
+    def test_ctor_requires_a_sub_device_tier(self):
+        from k8s_dra_driver_tpu.serving_kv import TieredKVStore
+        with pytest.raises(ValueError, match="host_bytes"):
+            TieredKVStore(2, KVBlockManager(4, 4))
+
+    def test_demote_then_promote_round_trip_byte_exact(self):
+        from k8s_dra_driver_tpu.serving_kv import TIER_DEVICE, TIER_HOST
+        mgr, store, rows, fill = tiered_pair(entries=2)
+        toks_a, ids_a = fill(1, 8)
+        orig = [(np.array(rows[b][0]), np.array(rows[b][1]))
+                for b in ids_a]
+        toks_b, _ = fill(2, 8)
+        fill(3, 8)                       # overflow: A demotes, not dies
+        assert store.demotions == 1 and store.evictions == 1
+        assert store.residency_of(tuple(toks_a.tolist())) == TIER_HOST
+        assert store.host_arena_bytes() > 0
+        assert mgr.refcount(ids_a[0]) == 0        # device side released
+        # residency probe sees the demoted depth; peek stays device-only
+        probe = np.concatenate([toks_a, prompt(9, 2)])
+        assert store.peek(probe) == 0
+        assert store.residency(probe) == (8, TIER_HOST)
+        # the hit promotes: checksum-verified rows land in fresh blocks
+        p, entry = store.longest_prefix(probe)
+        assert p == 8 and entry is not None
+        assert store.tier_hits == 1 and store.promotions == 1
+        assert store.residency_of(tuple(toks_a.tolist())) == TIER_DEVICE
+        # promotion is a MOVE: A's slab left the arena; re-inserting A
+        # into the full store displaced the now-coldest B host-ward
+        assert tuple(toks_a.tolist()) not in store._demoted
+        assert store.residency_of(tuple(toks_b.tolist())) == TIER_HOST
+        for i, b in enumerate(entry.block_ids):
+            np.testing.assert_array_equal(rows[b][0], orig[i][0])
+            np.testing.assert_array_equal(rows[b][1], orig[i][1])
+
+    def test_cow_shared_blocks_demote_safely(self):
+        """Demoting an entry whose block is still referenced by a
+        SECOND entry: the slab gathers before the free, the sharer
+        keeps its (still-refcounted) block, and promotion rebuilds
+        the demoted entry byte-exact in fresh blocks."""
+        mgr, store, rows, fill = tiered_pair(entries=2)
+        toks_a, ids_a = fill(1, 8)                # blocks [a, b]
+        orig = [(np.array(rows[b][0]), np.array(rows[b][1]))
+                for b in ids_a]
+        shared_tail = mgr.alloc(1)
+        # entry B shares A's first block (the CoW-prefix shape)
+        fill(2, 8, block_ids=[ids_a[0], shared_tail[0]])
+        mgr.free_blocks(shared_tail)
+        fill(3, 8)                                # A demotes
+        assert store.demotions == 1
+        assert mgr.refcount(ids_a[0]) == 1        # B still holds it
+        assert mgr.refcount(ids_a[1]) == 0        # unshared half freed
+        p, entry = store.longest_prefix(
+            np.concatenate([toks_a, prompt(9, 2)]))
+        assert p == 8
+        assert entry.block_ids[0] != ids_a[0]     # fresh block, no alias
+        for i, b in enumerate(entry.block_ids):
+            np.testing.assert_array_equal(rows[b][0], orig[i][0])
+            np.testing.assert_array_equal(rows[b][1], orig[i][1])
+
+    def test_promotion_losing_block_race_stays_demoted(self):
+        """Promotion must never preempt: when adoption cannot cover
+        its blocks the entry STAYS demoted (no drop, no corruption
+        counter) and the same hit succeeds once pressure clears."""
+        from k8s_dra_driver_tpu.serving_kv import TIER_HOST
+        mgr, store, rows, fill = tiered_pair(n_blocks=5, entries=1)
+        toks_a, _ = fill(1, 4)
+        fill(2, 4)                                # A demotes (entries=1)
+        key_a = tuple(toks_a.tolist())
+        hot = mgr.alloc(mgr.free)                 # exhaust the pool
+        probe = np.concatenate([toks_a, prompt(9, 2)])
+        p, entry = store.longest_prefix(probe)
+        assert entry is None or p < 4             # fell back, no promote
+        assert store.promotions == 0
+        assert store.corrupt_fallbacks == 0
+        assert store.residency_of(key_a) == TIER_HOST   # still demoted
+        mgr.free_blocks(hot)
+        p, entry = store.longest_prefix(probe)
+        assert p == 4 and entry is not None
+        assert store.promotions == 1
+
+    def test_corrupt_host_slab_falls_back_loudly(self):
+        import random
+        mgr, store, rows, fill = tiered_pair(entries=1)
+        toks_a, _ = fill(1, 8)
+        fill(2, 8)                                # A demotes to host
+        assert store.corrupt_slab(random.Random(7)) \
+            == tuple(toks_a.tolist())
+        probe = np.concatenate([toks_a, prompt(9, 2)])
+        p, entry = store.longest_prefix(probe)
+        assert p == 0 and entry is None           # recompute, not garbage
+        assert store.corrupt_fallbacks == 1
+        assert store.promotions == 0 and store.tier_hits == 0
+        assert store.residency_of(tuple(toks_a.tolist())) is None
+        assert store.host_arena_bytes() == 0      # dropped everywhere
+
+    def test_disk_cascade_restart_adoption_and_corruption(self, tmp_path):
+        """Host-arena displacement cascades to the crc-checked disk
+        tier; a FRESH store over the same spill dir re-adopts the
+        entry from headers alone and promotes byte-exact; a bit-flip
+        on the spill file is detected at promote time."""
+        import random
+        from k8s_dra_driver_tpu.serving_kv import TIER_DISK, TieredKVStore
+        spill = tmp_path / "spill"
+        # arena sized to hold ONE 8-token slab (2 blocks x (4,2)
+        # float32 rows x 2 arrays = 128 bytes): the second demotion
+        # displaces the first to disk
+        mgr, store, rows, fill = tiered_pair(
+            entries=1, host_bytes=150, spill_dir=spill)
+        toks_a, ids_a = fill(1, 8)
+        orig = [(np.array(rows[b][0]), np.array(rows[b][1]))
+                for b in ids_a]
+        fill(2, 8)                                # A -> host
+        fill(3, 8)                                # B -> host, A -> disk
+        key_a = tuple(toks_a.tolist())
+        assert store.residency_of(key_a) == TIER_DISK
+        assert store.demoted_counts() == {"host": 1, "disk": 1}
+        assert store.disk_tier_bytes() > 0
+        # restart: a fresh disk-only store (fresh manager — the
+        # engine died and the host arena died with it)
+        mgr2 = KVBlockManager(12, 4)
+        store2 = TieredKVStore(2, mgr2, spill_dir=spill)
+        assert store2.residency_of(key_a) == TIER_DISK
+        rows2: dict[int, tuple] = {}
+
+        def gather2(entry):
+            k = [np.stack([rows2[b][0] for b in entry.block_ids])]
+            v = [np.stack([rows2[b][1] for b in entry.block_ids])]
+            return k, v
+
+        def adopt2(slab_k, slab_v):
+            ids = mgr2.alloc(slab_k[0].shape[0])
+            for i, b in enumerate(ids):
+                rows2[b] = (np.array(slab_k[0][i]),
+                            np.array(slab_v[0][i]))
+            return ids
+
+        store2.bind_engine(gather2, adopt2)
+        p, entry = store2.longest_prefix(
+            np.concatenate([toks_a, prompt(9, 2)]))
+        assert p == 8 and store2.promotions == 1
+        for i, b in enumerate(entry.block_ids):
+            np.testing.assert_array_equal(rows2[b][0], orig[i][0])
+            np.testing.assert_array_equal(rows2[b][1], orig[i][1])
+        # disk corruption: re-spill (disk-only store demotes straight
+        # to disk), flip one payload byte, watch the promote refuse
+        store2.flush()
+        damaged = store2.corrupt_slab(random.Random(3))
+        assert damaged == key_a
+        pr = np.concatenate(
+            [np.asarray(damaged, np.int32), prompt(9, 2)])
+        p, entry = store2.longest_prefix(pr)
+        assert entry is None or p < len(damaged)
+        assert store2.corrupt_fallbacks == 1
+        assert store2.residency_of(damaged) is None
+
+    def test_int8_slab_round_trips_byte_exact(self, tmp_path):
+        """int8 K/V (the quantized-cache dtype the paged ENGINE
+        rejects, but the store must not mangle): demote through host
+        AND disk, promote, byte-identical rows both ways."""
+        from k8s_dra_driver_tpu.serving_kv import TIER_DISK
+        mgr, store, rows, fill = tiered_pair(
+            entries=1, host_bytes=20, spill_dir=tmp_path / "s8",
+            dtype=np.int8)
+        toks_a, ids_a = fill(1, 4)
+        orig = [(np.array(rows[b][0]), np.array(rows[b][1]))
+                for b in ids_a]
+        fill(2, 4)                                # A -> host (64 bytes)
+        fill(3, 4)                                # B -> host, A -> disk
+        assert store.residency_of(tuple(toks_a.tolist())) == TIER_DISK
+        p, entry = store.longest_prefix(
+            np.concatenate([toks_a, prompt(9, 2)]))
+        assert p == 4 and store.promotions == 1
+        for i, b in enumerate(entry.block_ids):
+            assert rows[b][0].dtype == np.int8
+            np.testing.assert_array_equal(rows[b][0], orig[i][0])
+            np.testing.assert_array_equal(rows[b][1], orig[i][1])
+
+    def test_host_arena_lru_displacement_order(self):
+        from k8s_dra_driver_tpu.serving_kv.tiers import (HostArena,
+                                                         HostSlab,
+                                                         slab_checksum)
+
+        def slab(seed, nbytes):
+            a = np.full((nbytes // 2,), seed, np.uint8)
+            return HostSlab(length=1, k=[a], v=[a],
+                            crc=slab_checksum([a], [a]))
+
+        arena = HostArena(100)
+        assert arena.put(("a",), slab(1, 40)) == []
+        assert arena.put(("b",), slab(2, 40)) == []
+        out = arena.put(("c",), slab(3, 70))      # displaces a then b
+        assert [k for k, _ in out] == [("a",), ("b",)]
+        assert arena.used_bytes == 70
+        # a slab over the whole budget never lands; caller cascades
+        out = arena.put(("d",), slab(4, 200))
+        assert [k for k, _ in out] == [("d",)]
+        assert ("d",) not in arena
+
+    def test_fresh_insert_supersedes_stale_demoted_copy(self):
+        """A re-fill of a demoted key (the recompute fallback path)
+        must release the stale slab — the demoted map can never
+        shadow a fresher device entry."""
+        from k8s_dra_driver_tpu.serving_kv import TIER_DEVICE, TIER_HOST
+        mgr, store, rows, fill = tiered_pair(entries=2)
+        toks_a, _ = fill(1, 8)
+        toks_b, _ = fill(2, 8)
+        fill(3, 8)                                # A demotes
+        key_a = tuple(toks_a.tolist())
+        key_b = tuple(toks_b.tolist())
+        slab_bytes = store.host_arena_bytes()
+        assert key_a in store._demoted
+        fill(1, 8)              # recompute re-inserts A; B demotes
+        assert key_a not in store._demoted
+        assert store.residency_of(key_a) == TIER_DEVICE
+        assert store.residency_of(key_b) == TIER_HOST
+        # A's stale slab released: the arena holds only B's slab
+        assert store.host_arena_bytes() == slab_bytes
+        # drop() clears the demoted tier too
+        store.drop(toks_b)
+        assert store.residency_of(key_b) is None
+        assert store.host_arena_bytes() == 0
+
+
+class TestTieredEngine:
+    def test_tiering_requires_paged_layout(self):
+        with pytest.raises(ValueError, match="paged"):
+            ServingEngine(params(), CFG, slots=1,
+                          kv_host_bytes=1 << 20)
+
+    def test_promote_wave_byte_equal_and_prefill_free(self):
+        """THE acceptance arc: a warmed shared prefix is flushed
+        (demoted on the tiered engine, destroyed on the recompute
+        twin), then a greedy+sampled wave rides it back.  The tiered
+        engine's token streams must byte-equal BOTH oracles — the
+        all-HBM engine that never lost the prefix and the recompute
+        twin that re-prefills it — while paying ZERO full prefills
+        (dispatch attribution: suffix fills + one slab adopt only)."""
+        p = params()
+        sys_p = prompt(99, 21)
+        reqs = [("g0", np.concatenate([sys_p, prompt(1, 4)]), 0.0, 0),
+                ("s1", np.concatenate([sys_p, prompt(2, 4)]), 0.8, 11),
+                ("g2", np.concatenate([sys_p, prompt(3, 4)]), 0.0, 0)]
+
+        def engines():
+            tiered = ServingEngine(p, CFG, slots=2, kv_layout="paged",
+                                   kv_blocks=16,
+                                   kv_host_bytes=1 << 20)
+            allhbm = ServingEngine(p, CFG, slots=2, kv_layout="paged",
+                                   kv_blocks=16)
+            recompute = ServingEngine(p, CFG, slots=2,
+                                      kv_layout="paged", kv_blocks=16)
+            return tiered, allhbm, recompute
+
+        tiered, allhbm, recompute = engines()
+        for eng in (tiered, allhbm, recompute):
+            eng.submit(Request(uid="warm", prompt=sys_p, max_new=1))
+            eng.run()
+        tiered._prefix.flush()        # demote: prefix -> host arena
+        recompute._prefix.flush()     # destroy: prefix -> tokens
+        assert tiered._prefix.demotions >= 1
+        assert tiered._prefix.host_arena_bytes() > 0
+
+        def wave(eng):
+            for uid, pr, temp, seed in reqs:
+                eng.submit(Request(uid=uid, prompt=pr, max_new=5,
+                                   temperature=temp, seed=seed))
+            return {f.uid: f.tokens for f in eng.run()}
+
+        from k8s_dra_driver_tpu.utils import dispatch
+        with dispatch.track() as t_tier:
+            got = wave(tiered)
+        with dispatch.track() as t_rec:
+            want_rec = wave(recompute)
+        want_hbm = wave(allhbm)
+        assert set(got) == {"g0", "s1", "g2"}
+        for uid, pr, temp, _ in reqs:
+            np.testing.assert_array_equal(
+                got[uid], want_hbm[uid],
+                err_msg=f"{uid}: tiered diverged from all-HBM oracle")
+            np.testing.assert_array_equal(
+                got[uid], want_rec[uid],
+                err_msg=f"{uid}: tiered diverged from recompute twin")
+            if temp == 0.0:
+                np.testing.assert_array_equal(
+                    got[uid], reference(p, pr, 5),
+                    err_msg=f"{uid}: diverged from greedy reference")
+        # attribution: the tiered wave paid NO full prefill — every
+        # fill was suffix-only over the promoted prefix, and the
+        # prefix itself arrived via ONE slab adopt (device_put),
+        # while the recompute twin re-prefilled from scratch
+        assert t_tier.by_label.get("prefill", 0) == 0
+        assert t_tier.by_label.get("prefill_suffix", 0) >= 3
+        assert t_tier.by_label.get("paged_slab_adopt", 0) == 1
+        assert t_rec.by_label.get("prefill", 0) >= 1
+        st = tiered._prefix
+        assert st.tier_hits == 1 and st.promotions == 1
+        assert st.corrupt_fallbacks == 0
+        stats = tiered.stats()
+        assert stats["kv_tier_hits_total"] == 1
+        assert stats["kv_tier_promotions_total"] == 1
+        assert stats["kv_tier_demotions_total"] >= 1
+        assert stats["kv_tier_corrupt_fallbacks_total"] == 0
+        assert "kv_host_arena_bytes" in stats
+        assert "kv_disk_tier_bytes" in stats
+
+    def test_residency_probe_and_engine_flush_demotes(self):
+        """prefix_residency reports the cross-tier (p, tier) pair the
+        router consumes, while prefix_peek stays device-only."""
+        eng = ServingEngine(params(), CFG, slots=2, kv_layout="paged",
+                            kv_blocks=16, kv_host_bytes=1 << 20)
+        sys_p = prompt(99, 21)
+        eng.submit(Request(uid="warm", prompt=sys_p, max_new=1))
+        eng.run()
+        probe = np.concatenate([sys_p, prompt(7, 3)])
+        assert eng.prefix_peek(probe) == 21
+        assert eng.prefix_residency(probe) == (21, "device")
+        eng._prefix.flush()
+        assert eng.prefix_peek(probe) == 0
+        assert eng.prefix_residency(probe) == (21, "host")
+        miss = prompt(55, 8)
+        assert eng.prefix_residency(miss) == (0, None)
+
+    def test_promotion_racing_eviction_demotes_cold_not_dies(self):
+        """Engine-level promotion under block pressure: the adopt's
+        fill-path allocation evicts COLD store entries (which demote
+        host-ward on a tiered store) rather than failing — promoting
+        one prefix may demote another, and both stay recoverable."""
+        eng = ServingEngine(params(), CFG, slots=1, kv_layout="paged",
+                            kv_blocks=5, kv_block_size=16,
+                            kv_host_bytes=1 << 20, prefix_cache=4)
+        pr_a, pr_b = prompt(61, 20), prompt(62, 20)
+        for uid, pr in (("a", pr_a), ("b", pr_b)):
+            eng.submit(Request(uid=uid, prompt=pr, max_new=1))
+            eng.run()
+        st = eng._prefix
+        # demote A only (oldest); B stays device-resident and cold
+        st.evict_until(2)
+        assert st.residency_of(tuple(pr_a.tolist())) == "host"
+        assert st.residency_of(tuple(pr_b.tolist())) == "device"
+        # the pool is now too tight to hold A + B + an active slot:
+        # promoting A must demote cold B, not fail the request
+        eng.submit(Request(uid="a2", prompt=np.concatenate(
+            [pr_a, prompt(63, 3)]), max_new=2))
+        done = {f.uid: f.tokens for f in eng.run()}
+        assert set(done) == {"a2"}
+        np.testing.assert_array_equal(
+            done["a2"],
+            reference(params(), np.concatenate(
+                [pr_a, prompt(63, 3)]), 2))
+        assert st.promotions == 1
+        assert st.residency_of(tuple(pr_b.tolist())) == "host"
+
+    def test_disk_spill_survives_engine_restart(self, tmp_path):
+        """The warm prefix spilled to disk outlives the engine: a
+        FRESH engine over the same spill dir promotes it and the
+        wave byte-equals the reference — state recovery, not cache
+        luck."""
+        p = params()
+        sys_p = prompt(99, 21)
+        spill = tmp_path / "kvspill"
+        eng = ServingEngine(p, CFG, slots=2, kv_layout="paged",
+                            kv_blocks=16, kv_spill_dir=spill)
+        eng.submit(Request(uid="warm", prompt=sys_p, max_new=1))
+        eng.run()
+        eng._prefix.flush()           # disk-only store: spill to disk
+        assert eng._prefix.disk_tier_bytes() > 0
+        del eng
+        eng2 = ServingEngine(p, CFG, slots=2, kv_layout="paged",
+                             kv_blocks=16, kv_spill_dir=spill)
+        pr = np.concatenate([sys_p, prompt(5, 4)])
+        assert eng2.prefix_residency(pr) == (21, "disk")
+        eng2.submit(Request(uid="x", prompt=pr, max_new=5))
+        done = {f.uid: f.tokens for f in eng2.run()}
+        np.testing.assert_array_equal(done["x"], reference(p, pr, 5))
+        assert eng2._prefix.promotions == 1
+
+    def test_memwatch_accounts_the_host_arena(self):
+        from k8s_dra_driver_tpu.utils.memwatch import MemWatch
+        eng = ServingEngine(params(), CFG, slots=2, kv_layout="paged",
+                            kv_blocks=16, kv_host_bytes=1 << 20)
+        eng.submit(Request(uid="warm", prompt=prompt(99, 21),
+                           max_new=1))
+        eng.run()
+        eng._prefix.flush()
+        arena = eng._prefix.host_arena_bytes()
+        assert arena > 0
+        mw = MemWatch()
+        mw.account_engine(eng, "r0")
+        snap = mw.snapshot()
+        assert snap["components"]["kv_host_arena/r0"] == arena
+
+
+class _TierStub(_KVStub):
+    """Router-facing stub with a cross-tier residency signal.
+    ``prefix_peek`` stays device-only (the real engines' contract),
+    so host/disk residents report 0 there and (p, tier) here."""
+
+    def __init__(self, name, p=0, tier=None, **kw):
+        super().__init__(name, **kw)
+        self._p = p
+        self._tier = tier
+
+    def prefix_peek(self, prompt):
+        return self._p if self._tier == "device" else 0
+
+    def prefix_residency(self, prompt):
+        return (self._p, self._tier) if self._p else (0, None)
+
+
+class TestTierRoutingAndIndex:
+    def test_tier_rank_orders_device_host_disk_nothing(self):
+        from k8s_dra_driver_tpu.gateway.router import _tier_rank
+        pr = prompt(1, 8)
+        ranks = [_tier_rank(_TierStub("r", p=6, tier=t), pr)
+                 for t in ("device", "host", "disk", None)]
+        assert ranks == [0, 1, 2, 3]
+        # legacy replica (no prefix_residency): a nonzero peek can
+        # only be device-resident; zero holds nothing
+
+        class _Legacy(_KVStub):
+            def prefix_peek(self, prompt):
+                return 5
+
+        assert _tier_rank(_Legacy("r"), pr) == 0
+        assert _tier_rank(_KVStub("r"), pr) == 3
+
+    def test_affinity_tie_prefers_the_better_tier(self):
+        """Two replicas at equal affinity depth: the device-resident
+        match wins over the host-resident one (adopt-by-reference
+        beats a promotion), host over disk.  The host replica's
+        affinity arrives via routed history (its peek is 0), so the
+        tie is real."""
+        pr = prompt(17, 7)                       # cap = 6
+        r_host = _TierStub("rh", p=6, tier="host")
+        r_dev = _TierStub("rd", p=6, tier="device")
+        router = PrefixAffinityRouter(min_affinity=4)
+        # seed rh's routed history: a solo route records the prompt
+        assert router.route(pr, [r_host]) is r_host
+        assert router.last_reason == "spill"
+        pick = router.route(pr, [r_host, r_dev])
+        assert pick is r_dev
+        assert router.last_reason == "affinity"
+        # same tie against a DISK resident: host wins
+        r_disk = _TierStub("rk", p=6, tier="disk")
+        router2 = PrefixAffinityRouter(min_affinity=4)
+        assert router2.route(pr, [r_host]) is r_host
+        assert router2.route(pr, [r_disk]) is r_disk
+        pick = router2.route(pr, [r_disk, r_host])
+        assert pick is r_host
+
+    def test_fleet_index_tracks_residency_tiers(self):
+        from k8s_dra_driver_tpu.serving_disagg.index import (
+            FleetPrefixIndex)
+        idx = FleetPrefixIndex()
+        mgr, store, rows, fill = tiered_pair(entries=1)
+        idx.attach("r0", store)
+        toks_a, _ = fill(1, 8)
+        key_a = tuple(toks_a.tolist())
+        assert idx.tier_of("r0", key_a) == "device"
+        fill(2, 8)                               # A demotes
+        assert idx.tier_of("r0", key_a) == "host"
+        probe = np.concatenate([toks_a, prompt(9, 2)])
+        p, entry = store.longest_prefix(probe)   # promote
+        assert p == 8
+        assert idx.tier_of("r0", key_a) == "device"
+        store.drop(toks_a)
+        assert idx.tier_of("r0", key_a) is None
+
+    def test_fleet_index_lookup_prefers_device_holder(self):
+        from k8s_dra_driver_tpu.serving_disagg.index import (
+            FleetPrefixIndex)
+        idx = FleetPrefixIndex()
+        # r0 holds the key demoted; r1 holds it device-resident
+        mgr0, st0, _, fill0 = tiered_pair(entries=1)
+        mgr1, st1, _, fill1 = tiered_pair(entries=1)
+        toks, _ = fill0(1, 8)
+        fill0(2, 8)                              # r0's copy -> host
+        fill1(1, 8)                              # r1's copy: device
+        idx.attach("r0", st0)
+        idx.attach("r1", st1)
+        probe = np.concatenate([toks, prompt(9, 2)])
+        p, name, key = idx.lookup(probe)
+        assert (p, name) == (8, "r1")
+        assert idx.tier_of("r0", key) == "host"
+        assert idx.tier_of("r1", key) == "device"
+
+    def test_fleet_index_seeds_disk_survivors_on_attach(self, tmp_path):
+        from k8s_dra_driver_tpu.serving_disagg.index import (
+            FleetPrefixIndex)
+        from k8s_dra_driver_tpu.serving_kv import TieredKVStore
+        mgr, store, rows, fill = tiered_pair(
+            entries=1, host_bytes=0, spill_dir=tmp_path / "sp")
+        toks, _ = fill(1, 8)
+        fill(2, 8)                               # A -> disk directly
+        key = tuple(toks.tolist())
+        # restart: fresh store over the surviving spill dir
+        store2 = TieredKVStore(2, KVBlockManager(12, 4),
+                               spill_dir=tmp_path / "sp")
+        idx = FleetPrefixIndex()
+        idx.attach("r0", store2)
+        assert idx.tier_of("r0", key) == "disk"
+        p, name, k = idx.lookup(np.concatenate([toks, prompt(9, 2)]))
+        assert (p, name, k) == (8, "r0", key)
+
+    def test_gateway_folds_tier_counters_once(self):
+        """The pump's delta-fold: demote/promote counters land in the
+        registry exactly once — idle steps must not re-count them —
+        and the host-arena gauge tracks the store's level."""
+        mgr = paged_pool(replicas=1, kv_blocks=16,
+                         kv_host_bytes=1 << 20)
+        gw = FleetGateway(mgr, queue_capacity=8)
+        sys_p = prompt(99, 21)
+        gw.submit(Request(uid="warm", prompt=sys_p, max_new=1))
+        gw.run_until_idle()
+        eng = mgr.replicas[0].engine
+        eng._prefix.flush()                      # demote host-ward
+        gw.submit(Request(uid="x", prompt=np.concatenate(
+            [sys_p, prompt(5, 3)]), max_new=3))
+        gw.run_until_idle()
+        assert eng._prefix.promotions == 1
+        text = gw.metrics.render().decode()
+        assert re.search(
+            r"tpu_serving_kv_tier_demotions_total [1-9]", text)
+        assert re.search(
+            r"tpu_serving_kv_tier_promotions_total 1\.0", text)
+        assert re.search(
+            r"tpu_serving_kv_tier_hits_total 1\.0", text)
+        arena = eng._prefix.host_arena_bytes()
+        assert re.search(
+            r'tpu_serving_kv_host_arena_bytes\{replica="r0"\} '
+            + str(float(arena)).replace(".", r"\."), text)
+        # idle pump steps: totals unchanged (deltas, not re-folds)
+        gw.step()
+        gw.step()
+        text2 = gw.metrics.render().decode()
+        for fam in ("tpu_serving_kv_tier_demotions_total",
+                    "tpu_serving_kv_tier_promotions_total",
+                    "tpu_serving_kv_tier_hits_total"):
+            line = [ln for ln in text.splitlines()
+                    if ln.startswith(fam + " ")]
+            line2 = [ln for ln in text2.splitlines()
+                     if ln.startswith(fam + " ")]
+            assert line == line2, fam
+
+    def test_replica_killed_mid_promotion_exactly_once(self):
+        """Chaos twin of the acceptance arc: r0 promotes the demoted
+        prefix and takes the victim request in flight, then dies.
+        The drain requeues the victim, r1 recomputes it from tokens,
+        and the outcome is exactly-once and byte-equal — a promotion
+        in flight is never a lost or doubled request."""
+        from k8s_dra_driver_tpu.cluster.faults import FaultPlan
+        from invariants import (assert_byte_equal,
+                                assert_exactly_once,
+                                assert_requeue_observed)
+        plan = FaultPlan.from_json({"rules": [
+            {"verb": "health", "kind": "Replica", "name": "r0",
+             "skip": 1, "times": 1, "error": "drop"}]})
+        mgr = ReplicaManager(
+            lambda name: ServingEngine(params(), CFG, slots=2,
+                                       kv_layout="paged",
+                                       kv_blocks=16,
+                                       kv_host_bytes=1 << 20),
+            replicas=2, fault_plan=plan)
+        gw = FleetGateway(mgr, queue_capacity=8)
+        sys_p = prompt(99, 21)
+        r0 = mgr.replicas[0]
+        # warm ONLY r0 and flush: the prefix is host-resident there
+        r0.engine.submit(Request(uid="warm", prompt=sys_p, max_new=1))
+        r0.engine.run()
+        r0.engine._prefix.flush()
+        assert r0.engine._prefix.demotions >= 1
+        pr = np.concatenate([sys_p, prompt(5, 4)])
+        victim = Request(uid="v", prompt=pr, max_new=6)
+        g = gw.submit(victim, slo_s=120.0)
+        assert g.status == "queued"
+        done = gw.step()
+        # spill routing lands on r0 (first of equals); the dispatch's
+        # fill already promoted the demoted prefix
+        assert "v" in r0.in_flight
+        assert r0.engine._prefix.promotions == 1
+        done += gw.step()                 # 2nd health poll: r0 dies
+        done += gw.run_until_idle()
+        assert_exactly_once(gw, [victim])
+        assert_byte_equal(gw, [victim],
+                          lambda p, n: reference(params(), p, n))
+        assert_requeue_observed(gw)
+        text = gw.metrics.render().decode()
+        assert re.search(r"tpu_gateway_drains_total 1\.0", text)
+        st = gw.stats()
+        assert st["replicas"]["dead"] == 1

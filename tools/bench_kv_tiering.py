@@ -1,0 +1,63 @@
+"""Regenerate tools/kv_tiering_cpu.json.
+
+The artifact behind the KV-tiering claims (docs/SERVING.md "KV
+tiering"): wall per shared-prefix fill served by PROMOTION (crc-
+verified host slab device_put + suffix-only prefill) vs the full-
+prompt recompute a tier-less twin pays for the same fill, the win
+ratio the sentinel gates at >= 1.3, and the churn-wave hit fraction
+under a deliberately tight device watermark — with outputs verified
+byte-equal (greedy AND sampled) against the recompute twin in the
+same run.  Always CPU-pinned (the tier moves are host-side memory
+discipline; serving_kv/tierprobe.py documents the model sizing),
+but still run it on an IDLE machine — see
+tools/int8_decode_v5e_loaded_host.json for what a loaded host does
+to recorded baselines.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import platform
+import subprocess
+import sys
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main() -> None:
+    from k8s_dra_driver_tpu.utils.cpuproc import (CPU_FORCE_PRELUDE,
+                                                  cpu_jax_env)
+    code = (
+        CPU_FORCE_PRELUDE
+        + "import json\n"
+        "from k8s_dra_driver_tpu.serving_kv.tierprobe import "
+        "serving_tier_probe\n"
+        "print(json.dumps(serving_tier_probe(repeats=5, "
+        "prefix_len=112)))\n")
+    repo = pathlib.Path(__file__).resolve().parent.parent
+    res = subprocess.run([sys.executable, "-c", code], cwd=repo,
+                         env=cpu_jax_env(1), capture_output=True,
+                         text=True, timeout=600)
+    if res.returncode != 0:
+        sys.stderr.write(res.stderr)
+        raise SystemExit(1)
+    result = json.loads(res.stdout.strip().splitlines()[-1])
+    commit = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], cwd=repo,
+        capture_output=True, text=True).stdout.strip()
+    rec = {
+        "probe": "serving_tier",
+        "host": platform.machine(),
+        "platform": "cpu-hermetic",
+        "commit": commit,
+        "harness": "serving_kv/tierprobe.py serving_tier_probe",
+        "result": result,
+    }
+    path = pathlib.Path(__file__).parent / "kv_tiering_cpu.json"
+    path.write_text(json.dumps(rec, indent=1) + "\n")
+    print(json.dumps(rec, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -95,6 +95,15 @@ ARTIFACT_GATES = (
     # residency ledger stopped keeping hot adapters resident
     ("tools/lora_serving_cpu.json",
      ("result", "lora_resident_hit_frac"), ">=", 0.4),
+    # KV tiering (serving_kv/tierprobe.py): promotion — crc-verified
+    # host slab device_put + suffix-only prefill — must beat the
+    # full-prompt recompute it replaces, and the duel outputs must
+    # byte-equal the recompute twin (greedy AND sampled; bool lands
+    # as 1/0 under >=)
+    ("tools/kv_tiering_cpu.json",
+     ("result", "tier_recompute_win_x"), ">=", 1.3),
+    ("tools/kv_tiering_cpu.json",
+     ("result", "byte_equal"), ">=", 1),
     # fleet simulator (sim/probe.py): the thousand-replica soak must
     # stay invariant-clean, keep O(events) throughput above the bar,
     # replay the minimized drain-starvation repro in bounded wall
